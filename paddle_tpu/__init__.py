@@ -1,0 +1,24 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid (reference: SunAhong1993/Paddle), built from scratch on
+jax/XLA/pallas/pjit with a C++ host runtime.
+
+Usage mirrors the reference::
+
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+"""
+from . import reader_utils as reader  # paddle.reader.*
+from .reader_utils import batch  # noqa: F401  paddle.batch
+from . import fluid  # noqa: F401
+from . import dataset  # noqa: F401
+from . import distributed  # noqa: F401
+
+__version__ = "0.1.0"
+
+# paddle.* conveniences of the 1.5/1.6 era
+enable_dygraph = fluid.dygraph.enable_dygraph
+disable_dygraph = fluid.dygraph.disable_dygraph
+
+
+def version():
+    return __version__

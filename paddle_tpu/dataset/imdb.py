@@ -1,0 +1,31 @@
+"""IMDB sentiment reader (ref: python/paddle/dataset/imdb.py) — synthetic
+token-sequence stand-in: word-id sequences + binary label."""
+import numpy as np
+
+VOCAB_SIZE = 5147
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(VOCAB_SIZE)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            label = int(rng.integers(0, 2))
+            length = int(rng.integers(8, 64))
+            base = rng.integers(0, VOCAB_SIZE // 2, size=length)
+            if label:  # positive reviews skew to upper vocab half
+                base = base + VOCAB_SIZE // 2 - 1
+            yield base.astype("int64").tolist(), label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(2048, 13)
+
+
+def test(word_idx=None):
+    return _reader(512, 17)

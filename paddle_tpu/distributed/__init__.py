@@ -1,0 +1,2 @@
+"""paddle_tpu.distributed (ref: python/paddle/distributed/)."""
+from . import launch  # noqa: F401

@@ -1,0 +1,52 @@
+"""Multi-host launcher (ref: python/paddle/distributed/launch.py).
+
+The reference forks one process per GPU and wires NCCL env vars. On TPU
+pods, each *host* runs one process that owns its local chips and joins the
+ICI mesh via jax.distributed — so the launcher initializes jax.distributed
+from the standard env (COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID) and
+execs the training script in-process.
+"""
+import argparse
+import os
+import runpy
+import sys
+
+__all__ = ["launch", "main"]
+
+
+def launch(training_script, coordinator=None, num_processes=None,
+           process_id=None, script_args=()):
+    import jax
+
+    coordinator = coordinator or os.environ.get("COORDINATOR_ADDRESS")
+    num_processes = num_processes or os.environ.get("NUM_PROCESSES")
+    process_id = process_id or os.environ.get("PROCESS_ID")
+    if coordinator and num_processes:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(num_processes),
+            process_id=int(process_id or 0),
+        )
+    sys.argv = [training_script] + list(script_args)
+    runpy.run_path(training_script, run_name="__main__")
+
+
+def main():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--coordinator", default=None)
+    parser.add_argument("--num_processes", default=None)
+    parser.add_argument("--process_id", default=None)
+    parser.add_argument("training_script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    launch(
+        args.training_script,
+        args.coordinator,
+        args.num_processes,
+        args.process_id,
+        args.script_args,
+    )
+
+
+if __name__ == "__main__":
+    main()

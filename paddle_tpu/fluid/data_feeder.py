@@ -1,0 +1,83 @@
+"""DataFeeder (ref: python/paddle/fluid/data_feeder.py): converts python /
+numpy minibatch rows into the feed dict of dense arrays."""
+import numpy as np
+
+from . import core
+from .framework import Variable
+
+__all__ = ["DataFeeder"]
+
+
+class DataToLoDTensorConverter:
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = [s if s not in (None, -1) else None for s in shape]
+        self.dtype = core.np_dtype(core.convert_dtype(dtype))
+        self.data = []
+
+    def feed(self, data):
+        self.data.append(np.asarray(data, dtype=self.dtype))
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.stack(
+                [d.reshape([s for s in self.shape[1:] if s is not None] or d.shape)
+                 if None not in self.shape[1:] else d
+                 for d in self.data]
+            )
+            return arr
+        # LoD case: pad to max length, companion lengths array
+        from .lod import LoDTensor
+
+        return LoDTensor.from_sequences(self.data)
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                from .framework import default_main_program
+
+                each_var = (program or default_main_program()).global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list should contain Variables")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(self.place, lod, shape, dtype)
+            for lod, shape, dtype in zip(
+                self.feed_lod_level, self.feed_shapes, self.feed_dtypes
+            )
+        ]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "sample has %d fields, expected %d"
+                % (len(each_sample), len(converters))
+            )
+            for value, converter in zip(each_sample, converters):
+                converter.feed(value)
+        return {
+            name: conv.done()
+            for name, conv in zip(self.feed_names, converters)
+        }
+
+    def feed_parallel(self, iterable, num_places=None):
+        yield self.feed(iterable)
+
+    def decorate_reader(self, reader, multi_devices=False, num_places=None,
+                        drop_last=True):
+        def __reader_creator__():
+            for item in reader():
+                yield self.feed(item)
+
+        return __reader_creator__

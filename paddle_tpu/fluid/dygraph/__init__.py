@@ -1,0 +1,32 @@
+"""dygraph imperative mode (ref: python/paddle/fluid/dygraph/__init__.py)."""
+from . import base
+from .base import (  # noqa: F401
+    enabled,
+    guard,
+    no_grad,
+    to_variable,
+    enable_dygraph,
+    disable_dygraph,
+)
+from . import layers
+from .layers import Layer  # noqa: F401
+from . import nn
+from .nn import *  # noqa: F401,F403
+from . import tracer
+from .tracer import VarBase  # noqa: F401
+from . import checkpoint
+from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from . import jit
+from .jit import TracedLayer  # noqa: F401
+from . import parallel
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import *  # noqa: F401,F403
+
+__all__ = (
+    ["enabled", "guard", "no_grad", "to_variable", "Layer", "VarBase",
+     "save_dygraph", "load_dygraph", "TracedLayer", "DataParallel",
+     "ParallelEnv", "prepare_context"]
+    + nn.__all__
+    + learning_rate_scheduler.__all__
+)

@@ -1,0 +1,287 @@
+"""dygraph.base: guard / to_variable / eager helpers
+(ref: python/paddle/fluid/dygraph/base.py)."""
+import contextlib
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import core, framework, unique_name
+from ..initializer import (
+    BilinearInitializer,
+    ConstantInitializer,
+    MSRAInitializer,
+    NormalInitializer,
+    NumpyArrayInitializer,
+    TruncatedNormalInitializer,
+    UniformInitializer,
+    XavierInitializer,
+)
+from . import tracer as tr
+from .tracer import VarBase
+
+__all__ = [
+    "guard", "enabled", "to_variable", "no_grad", "enable_dygraph",
+    "disable_dygraph",
+]
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+_guard_exit = []
+
+
+def enable_dygraph(place=None):
+    ctx = framework._dygraph_guard(tr._tracer)
+    ctx.__enter__()
+    pctx = framework._dygraph_place_guard(place or core.default_place())
+    pctx.__enter__()
+    _guard_exit.append((ctx, pctx))
+
+
+def disable_dygraph():
+    if _guard_exit:
+        ctx, pctx = _guard_exit.pop()
+        pctx.__exit__(None, None, None)
+        ctx.__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    with framework._dygraph_guard(tr._tracer):
+        with framework._dygraph_place_guard(place or core.default_place()):
+            yield
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    prev = tr._tracer.enabled
+    tr._tracer.enabled = False
+    try:
+        yield
+    finally:
+        tr._tracer.enabled = prev
+
+
+def no_grad(func=None):
+    if func is None:
+        return no_grad_ctx()
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with no_grad_ctx():
+            return func(*args, **kwargs)
+
+    return wrapper
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    arr = np.asarray(value)
+    return VarBase(jnp.asarray(arr), name=name, stop_gradient=False)
+
+
+# ---------------------------------------------------------------------------
+# eager initialization (maps graph initializers to direct jax calls)
+# ---------------------------------------------------------------------------
+def eager_init(initializer, shape, dtype):
+    dt = core.np_dtype(core.convert_dtype(dtype))
+    rng = tr._next_eager_rng()
+    shape = tuple(int(s) for s in shape)
+    if initializer is None:
+        initializer = XavierInitializer()
+    if isinstance(initializer, ConstantInitializer):
+        return jnp.full(shape, initializer._value, dtype=dt)
+    if isinstance(initializer, UniformInitializer):
+        return jax.random.uniform(
+            rng, shape, minval=initializer._low, maxval=initializer._high
+        ).astype(dt)
+    if isinstance(initializer, NormalInitializer):
+        return (
+            initializer._mean
+            + initializer._std_dev * jax.random.normal(rng, shape)
+        ).astype(dt)
+    if isinstance(initializer, TruncatedNormalInitializer):
+        return (
+            initializer._mean
+            + initializer._std_dev
+            * jax.random.truncated_normal(rng, -2.0, 2.0, shape)
+        ).astype(dt)
+    if isinstance(initializer, (XavierInitializer, MSRAInitializer)):
+        class _V:
+            pass
+
+        v = _V()
+        v.shape = shape
+        fan_in, fan_out = initializer._compute_fans(v)
+        import math
+
+        if isinstance(initializer, XavierInitializer):
+            fi = initializer._fan_in or fan_in
+            fo = initializer._fan_out or fan_out
+            if initializer._uniform:
+                lim = math.sqrt(6.0 / (fi + fo))
+                return jax.random.uniform(
+                    rng, shape, minval=-lim, maxval=lim
+                ).astype(dt)
+            std = math.sqrt(2.0 / (fi + fo))
+            return (std * jax.random.normal(rng, shape)).astype(dt)
+        fi = initializer._fan_in or fan_in
+        if initializer._uniform:
+            lim = math.sqrt(6.0 / fi)
+            return jax.random.uniform(
+                rng, shape, minval=-lim, maxval=lim
+            ).astype(dt)
+        std = math.sqrt(2.0 / fi)
+        return (std * jax.random.normal(rng, shape)).astype(dt)
+    if isinstance(initializer, NumpyArrayInitializer):
+        return jnp.asarray(initializer._value).astype(dt).reshape(shape)
+    raise TypeError("unsupported initializer %r for eager init" % initializer)
+
+
+def create_eager_parameter(attr, shape, dtype, startup_program=None):
+    value = eager_init(attr.initializer, shape, dtype)
+    p = VarBase(
+        value,
+        name=attr.name or unique_name.generate("eager_param"),
+        persistable=True,
+        trainable=attr.trainable,
+        stop_gradient=not attr.trainable,
+    )
+    p.optimize_attr = {"learning_rate": attr.learning_rate}
+    p.regularizer = attr.regularizer
+    return p
+
+
+# ---------------------------------------------------------------------------
+# dygraph optimizer updates
+# ---------------------------------------------------------------------------
+_EAGER_ACCS = {
+    "sgd": [],
+    "momentum": [("Velocity", "VelocityOut", 0.0)],
+    "lars_momentum": [("Velocity", "VelocityOut", 0.0)],
+    "adagrad": [("Moment", "MomentOut", 0.0)],
+    "decayed_adagrad": [("Moment", "MomentOut", 0.0)],
+    "adadelta": [
+        ("AvgSquaredGrad", "AvgSquaredGradOut", 0.0),
+        ("AvgSquaredUpdate", "AvgSquaredUpdateOut", 0.0),
+    ],
+    "adam": [
+        ("Moment1", "Moment1Out", 0.0),
+        ("Moment2", "Moment2Out", 0.0),
+        ("Beta1Pow", "Beta1PowOut", "beta1"),
+        ("Beta2Pow", "Beta2PowOut", "beta2"),
+    ],
+    "lamb": [
+        ("Moment1", "Moment1Out", 0.0),
+        ("Moment2", "Moment2Out", 0.0),
+        ("Beta1Pow", "Beta1PowOut", "beta1"),
+        ("Beta2Pow", "Beta2PowOut", "beta2"),
+    ],
+    "adamax": [
+        ("Moment", "MomentOut", 0.0),
+        ("InfNorm", "InfNormOut", 0.0),
+        ("Beta1Pow", None, "beta1"),
+    ],
+    "rmsprop": [
+        ("Moment", "MomentOut", 0.0),
+        ("MeanSquare", "MeanSquareOut", 0.0),
+        ("MeanGrad", "MeanGradOut", 0.0),
+    ],
+    "ftrl": [
+        ("SquaredAccumulator", "SquaredAccumOut", 0.0),
+        ("LinearAccumulator", "LinearAccumOut", 0.0),
+    ],
+}
+
+
+def _opt_attrs(opt):
+    m = {}
+    for k, v in opt.__dict__.items():
+        if k.startswith("_") and isinstance(v, (int, float, bool)):
+            m[k.lstrip("_")] = v
+    # common renames
+    ren = {
+        "momentum": "mu",
+        "rho": "decay" if opt.type == "rmsprop" else "rho",
+        "weight_decay": "weight_decay",
+    }
+    attrs = {}
+    for k, v in m.items():
+        attrs[ren.get(k, k)] = v
+    if opt.type in ("momentum", "lars_momentum") and "momentum" in m:
+        attrs["mu"] = m["momentum"]
+    if opt.type == "rmsprop" and "rho" in m:
+        attrs["decay"] = m["rho"]
+    if opt.type == "lamb":
+        attrs["weight_decay"] = getattr(opt, "_weight_decay", 0.01)
+    return attrs
+
+
+def dygraph_minimize(opt, loss, parameter_list=None, no_grad_set=None,
+                     grad_clip=None):
+    """Apply optimizer updates eagerly using param.grad (populated by
+    loss.backward())."""
+    from ...ops.registry import LowerContext, get_lowering
+
+    params = parameter_list
+    if params is None:
+        params = _default_param_registry()
+    if not params:
+        raise ValueError(
+            "dygraph minimize: pass parameter_list=model.parameters()"
+        )
+    if not hasattr(opt, "_eager_state"):
+        opt._eager_state = {}
+    lr = opt._learning_rate
+    if hasattr(lr, "step"):  # LearningRateDecay object
+        lr_val = lr.step()
+    else:
+        lr_val = float(lr)
+    lowering = get_lowering(opt.type)
+    spec = _EAGER_ACCS.get(opt.type)
+    if spec is None:
+        raise NotImplementedError(
+            "optimizer %s not supported in dygraph mode" % opt.type
+        )
+    attrs = _opt_attrs(opt)
+    for p in params:
+        if p.grad is None or not p.trainable:
+            continue
+        state = opt._eager_state.setdefault(p.name, {})
+        ins = {
+            "Param": [p.value],
+            "Grad": [jnp.asarray(p.grad, p.value.dtype)],
+            "LearningRate": [jnp.asarray(lr_val, jnp.float32)],
+        }
+        for slot, out_slot, fill in spec:
+            if slot not in state:
+                if isinstance(fill, str):
+                    state[slot] = jnp.asarray(attrs.get(fill, 0.9), jnp.float32)
+                else:
+                    state[slot] = jnp.zeros_like(p.value) + fill
+            ins[slot] = [state[slot]]
+        ctx = LowerContext(rng=tr._next_eager_rng())
+        outs = lowering(ctx, ins, attrs)
+        p.value = outs["ParamOut"][0]
+        for slot, out_slot, _ in spec:
+            if out_slot and out_slot in outs:
+                state[slot] = outs[out_slot][0]
+            elif out_slot is None and opt.type == "adamax":
+                state[slot] = state[slot] * attrs.get("beta1", 0.9)
+    return None, [(p, p.grad) for p in params]
+
+
+_param_registry = []
+
+
+def _register_param(p):
+    _param_registry.append(p)
+
+
+def _default_param_registry():
+    return [p for p in _param_registry if p.trainable]

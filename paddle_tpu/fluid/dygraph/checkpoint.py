@@ -1,0 +1,40 @@
+"""save/load_dygraph (ref: python/paddle/fluid/dygraph/checkpoint.py)."""
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict, model_path):
+    """Saves a Layer.state_dict() or optimizer state to model_path.pdparams."""
+    suffix = ".pdparams"
+    payload = {}
+    is_opt = False
+    for k, v in state_dict.items():
+        if hasattr(v, "numpy"):
+            payload[k] = np.asarray(v.numpy())
+        else:
+            payload[k] = v
+            is_opt = True
+    if is_opt:
+        suffix = ".pdopt"
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(model_path + suffix, "wb") as f:
+        pickle.dump(payload, f, protocol=2)
+
+
+def load_dygraph(model_path):
+    params, opt = None, None
+    if os.path.exists(model_path + ".pdparams"):
+        with open(model_path + ".pdparams", "rb") as f:
+            params = pickle.load(f)
+    if os.path.exists(model_path + ".pdopt"):
+        with open(model_path + ".pdopt", "rb") as f:
+            opt = pickle.load(f)
+    if params is None and opt is None:
+        raise ValueError("no checkpoint found at %s" % model_path)
+    return params, opt

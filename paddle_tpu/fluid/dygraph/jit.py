@@ -1,0 +1,66 @@
+"""TracedLayer (ref: python/paddle/fluid/dygraph/jit.py).
+
+TPU-native: tracing a dygraph Layer produces a jax.jit-compiled callable —
+the eager tape is bypassed entirely and XLA compiles the whole forward.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import base as dybase
+from . import tracer as tr
+from .tracer import VarBase
+
+__all__ = ["TracedLayer", "trace"]
+
+
+class TracedLayer:
+    def __init__(self, layer, feed_vars):
+        self._layer = layer
+        self._params = {p.name: p for p in layer.parameters()}
+
+        def pure_fn(param_vals, in_vals):
+            # temporarily bind param values, run eager forward w/o tape
+            old = {n: p.value for n, p in self._params.items()}
+            for n, p in self._params.items():
+                p.value = param_vals[n]
+            prev_enabled = tr._tracer.enabled
+            tr._tracer.enabled = False
+            try:
+                outs = layer(*[VarBase(v, stop_gradient=True) for v in in_vals])
+            finally:
+                tr._tracer.enabled = prev_enabled
+                for n, p in self._params.items():
+                    p.value = old[n]
+            if isinstance(outs, (list, tuple)):
+                return tuple(o.value for o in outs)
+            return (outs.value,)
+
+        self._jitted = jax.jit(pure_fn)
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        in_vals = [
+            v.value if isinstance(v, VarBase) else jnp.asarray(v)
+            for v in inputs
+        ]
+        pv = {n: p.value for n, p in self._params.items()}
+        outs = self._jitted(pv, in_vals)
+        return [VarBase(o, stop_gradient=True) for o in outs]
+
+    @staticmethod
+    def trace(layer, inputs):
+        traced = TracedLayer(layer, inputs)
+        outs = traced(inputs)
+        return outs, traced
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        from ..dygraph.checkpoint import save_dygraph
+
+        save_dygraph(self._layer.state_dict(), dirname + "/model")
+
+
+def trace(layer, inputs):
+    return TracedLayer.trace(layer, inputs)

@@ -1,0 +1,166 @@
+"""dygraph Layer base class (ref: python/paddle/fluid/dygraph/layers.py)."""
+import collections
+
+import numpy as np
+
+from .. import core, unique_name
+from ..param_attr import ParamAttr
+from . import base as dybase
+from . import tracer as tr
+from .tracer import VarBase
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        if name_scope is None:
+            name_scope = self.__class__.__name__.lower()
+        self._full_name = unique_name.generate(name_scope)
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._helper_once = None
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- modes -----------------------------------------------------------
+    def train(self):
+        self.training = True
+        tr.set_train_mode(True)
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        tr.set_train_mode(False)
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- parameters ------------------------------------------------------
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        if default_initializer is not None:
+            attr._set_default_initializer(default_initializer)
+        elif is_bias:
+            attr._set_default_bias_initializer()
+        else:
+            attr._set_default_param_initializer()
+        if attr.name is None:
+            attr.name = unique_name.generate(
+                ".".join([self._full_name, "b" if is_bias else "w"])
+            )
+        p = dybase.create_eager_parameter(attr, shape, dtype)
+        dybase._register_param(p)
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        return VarBase(
+            None,
+            name=name or unique_name.generate(self._full_name + ".var"),
+            persistable=bool(persistable),
+        )
+
+    def parameters(self, include_sublayers=True):
+        ret = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                ret.extend(l.parameters())
+        return ret
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        for name, p in self._parameters.items():
+            yield (prefix + ("." if prefix else "") + name, p)
+        if include_sublayers:
+            for lname, l in self._sub_layers.items():
+                yield from l.named_parameters(
+                    prefix + ("." if prefix else "") + lname
+                )
+
+    def sublayers(self, include_sublayers=True):
+        ret = []
+        for l in self._sub_layers.values():
+            ret.append(l)
+            if include_sublayers:
+                ret.extend(l.sublayers())
+        return ret
+
+    def named_sublayers(self, prefix="", include_sublayers=True,
+                        include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            p = prefix + ("." if prefix else "") + name
+            yield p, l
+            if include_sublayers:
+                yield from l.named_sublayers(p)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- state dict ------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix=""):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            if p is not None:
+                dest[structured_name_prefix + name] = p
+        return dest
+
+    def set_dict(self, stat_dict, include_sublayers=True):
+        named = dict(self.named_parameters())
+        by_varname = {p.name: p for _, p in named.items()}
+        for k, v in stat_dict.items():
+            target = named.get(k) or by_varname.get(k)
+            if target is None:
+                continue
+            arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+            target.set_value(arr)
+
+    load_dict = set_dict
+
+    # -- call ------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+    # -- attribute auto-registration -------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, VarBase) and value.persistable:
+            if params is not None:
+                params[name] = value
+        elif isinstance(value, Layer):
+            if layers is not None:
+                layers[name] = value
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        params = self.__dict__.get("_parameters")
+        if params is not None and name in params:
+            return params[name]
+        layers = self.__dict__.get("_sub_layers")
+        if layers is not None and name in layers:
+            return layers[name]
+        raise AttributeError(
+            "%s has no attribute %s" % (type(self).__name__, name)
+        )

@@ -1,0 +1,206 @@
+"""dygraph LR schedulers (ref: python/paddle/fluid/dygraph/
+learning_rate_scheduler.py) — python objects with .step()."""
+import math
+
+__all__ = [
+    "NoamDecay", "PiecewiseDecay", "NaturalExpDecay", "ExponentialDecay",
+    "InverseTimeDecay", "PolynomialDecay", "CosineDecay", "LinearLrWarmup",
+    "ReduceLROnPlateau",
+]
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1, dtype="float32"):
+        self.step_num = begin
+        self.step_size = step
+        self.dtype = dtype
+
+    def step(self):
+        lr = self.get_lr()
+        self.step_num += self.step_size
+        return lr
+
+    __call__ = step
+
+    def get_lr(self):
+        raise NotImplementedError
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.boundaries = boundaries
+        self.values = values
+
+    def get_lr(self):
+        for i, b in enumerate(self.boundaries):
+            if self.step_num < b:
+                return self.values[i]
+        return self.values[-1]
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+
+    def get_lr(self):
+        s = max(self.step_num, 1)
+        return (self.d_model ** -0.5) * min(
+            s ** -0.5, s * self.warmup_steps ** -1.5
+        )
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def get_lr(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.learning_rate * math.exp(-self.decay_rate * d)
+
+
+class ExponentialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def get_lr(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.learning_rate * (self.decay_rate ** d)
+
+
+class InverseTimeDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def get_lr(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.learning_rate / (1 + self.decay_rate * d)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=0.0001,
+                 power=1.0, cycle=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.end_learning_rate = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def get_lr(self):
+        s = self.step_num
+        if self.cycle:
+            div = max(1.0, math.ceil(s / self.decay_steps))
+            decay_steps = self.decay_steps * div
+        else:
+            decay_steps = self.decay_steps
+            s = min(s, decay_steps)
+        return (self.learning_rate - self.end_learning_rate) * (
+            (1 - s / decay_steps) ** self.power
+        ) + self.end_learning_rate
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def get_lr(self):
+        cur_epoch = math.floor(self.step_num / self.step_each_epoch)
+        return (
+            self.learning_rate
+            * 0.5
+            * (math.cos(cur_epoch * math.pi / self.epochs) + 1)
+        )
+
+
+class LinearLrWarmup(LearningRateDecay):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 begin=1, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+
+    def get_lr(self):
+        if self.step_num < self.warmup_steps:
+            return self.start_lr + (self.end_lr - self.start_lr) * (
+                self.step_num / self.warmup_steps
+            )
+        base = self.learning_rate
+        return base.get_lr() if hasattr(base, "get_lr") else base
+
+
+class ReduceLROnPlateau(LearningRateDecay):
+    def __init__(self, learning_rate, mode="min", decay_rate=0.1,
+                 patience=10, verbose=False, threshold=1e-4,
+                 threshold_mode="rel", cooldown=0, min_lr=0, eps=1e-8,
+                 dtype="float32"):
+        super().__init__(0, 1, dtype)
+        self.lr = learning_rate
+        self.mode = mode
+        self.decay_rate = decay_rate
+        self.patience = patience
+        self.verbose = verbose
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.eps = eps
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+
+    def get_lr(self):
+        return self.lr
+
+    def step(self, metric=None):
+        if metric is None:
+            return self.lr
+        m = float(metric)
+        better = (
+            self.best is None
+            or (self.mode == "min" and m < self.best - self.threshold)
+            or (self.mode == "max" and m > self.best + self.threshold)
+        )
+        if better:
+            self.best = m
+            self.num_bad = 0
+        elif self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                new_lr = max(self.lr * self.decay_rate, self.min_lr)
+                if self.lr - new_lr > self.eps:
+                    self.lr = new_lr
+                self.cooldown_counter = self.cooldown
+                self.num_bad = 0
+        return self.lr

@@ -1,0 +1,633 @@
+"""dygraph nn modules (ref: python/paddle/fluid/dygraph/nn.py)."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import core
+from ..initializer import Constant, Normal
+from ..param_attr import ParamAttr
+from . import tracer as tr
+from .layers import Layer
+from .tracer import VarBase, call_op
+
+__all__ = [
+    "Conv2D", "Conv3D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
+    "GRUUnit", "LayerNorm", "NCE", "PRelu", "BilinearTensorProduct",
+    "Conv2DTranspose", "GroupNorm", "SpectralNorm", "Dropout",
+]
+
+
+def _pair(v, n=2):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32",
+                 num_channels=None):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = _pair(filter_size)
+        self._stride = _pair(stride)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._groups = groups or 1
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._num_channels = num_channels
+        self.weight = None
+        self.bias = None
+
+    def _build_once(self, input):
+        channels = self._num_channels or input.shape[1]
+        filter_shape = [
+            self._num_filters,
+            channels // self._groups,
+        ] + self._filter_size
+        fan_in = channels * self._filter_size[0] * self._filter_size[1]
+        self.weight = self.create_parameter(
+            attr=self._param_attr,
+            shape=filter_shape,
+            dtype=self._dtype,
+            default_initializer=Normal(0.0, (2.0 / fan_in) ** 0.5),
+        )
+        if self._bias_attr is not False:
+            self.bias = self.create_parameter(
+                attr=self._bias_attr,
+                shape=[self._num_filters],
+                dtype=self._dtype,
+                is_bias=True,
+            )
+
+    def forward(self, input):
+        if self.weight is None:
+            self._build_once(input)
+        out = call_op(
+            "conv2d",
+            {"Input": [input], "Filter": [self.weight]},
+            {
+                "strides": self._stride,
+                "paddings": self._padding,
+                "dilations": self._dilation,
+                "groups": self._groups,
+            },
+            out_slots=("Output",),
+        )
+        if self.bias is not None:
+            out = call_op(
+                "elementwise_add",
+                {"X": [out], "Y": [self.bias]},
+                {"axis": 1},
+            )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class Conv3D(Conv2D):
+    def __init__(self, name_scope, num_filters, filter_size, **kw):
+        kw.setdefault("stride", 1)
+        super().__init__(name_scope, num_filters, filter_size, **kw)
+        self._filter_size = _pair(filter_size, 3)
+        self._stride = _pair(kw.get("stride", 1), 3)
+        self._padding = _pair(kw.get("padding", 0), 3)
+        self._dilation = _pair(kw.get("dilation", 1), 3)
+
+    def forward(self, input):
+        if self.weight is None:
+            self._build_once(input)
+        out = call_op(
+            "conv3d",
+            {"Input": [input], "Filter": [self.weight]},
+            {
+                "strides": self._stride,
+                "paddings": self._padding,
+                "dilations": self._dilation,
+                "groups": self._groups,
+            },
+            out_slots=("Output",),
+        )
+        if self.bias is not None:
+            out = call_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]}, {"axis": 1}
+            )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, name_scope, num_filters, filter_size, output_size=None,
+                 padding=0, stride=1, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = _pair(filter_size)
+        self._padding = _pair(padding)
+        self._stride = _pair(stride)
+        self._dilation = _pair(dilation)
+        self._groups = groups or 1
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input):
+        if self.weight is None:
+            channels = input.shape[1]
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[channels, self._num_filters // self._groups]
+                + self._filter_size,
+                dtype=self._dtype,
+            )
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr,
+                    shape=[self._num_filters],
+                    dtype=self._dtype,
+                    is_bias=True,
+                )
+        out = call_op(
+            "conv2d_transpose",
+            {"Input": [input], "Filter": [self.weight]},
+            {
+                "strides": self._stride,
+                "paddings": self._padding,
+                "dilations": self._dilation,
+                "groups": self._groups,
+            },
+            out_slots=("Output",),
+        )
+        if self.bias is not None:
+            out = call_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]}, {"axis": 1}
+            )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope, pool_size=-1, pool_type="max",
+                 pool_stride=1, pool_padding=0, global_pooling=False,
+                 use_cudnn=True, ceil_mode=False, exclusive=True,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": _pair(pool_size),
+            "strides": _pair(pool_stride),
+            "paddings": _pair(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, input):
+        return call_op("pool2d", {"X": [input]}, dict(self._attrs))
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__("linear", dtype)
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=[input_dim, output_dim], dtype=dtype
+        )
+        self.bias = (
+            None
+            if bias_attr is False
+            else self.create_parameter(
+                attr=bias_attr, shape=[output_dim], dtype=dtype, is_bias=True
+            )
+        )
+        self._act = act
+
+    def forward(self, input):
+        out = call_op(
+            "mul",
+            {"X": [input], "Y": [self.weight]},
+            {"x_num_col_dims": len(input.shape) - 1, "y_num_col_dims": 1},
+        )
+        if self.bias is not None:
+            out = call_op(
+                "elementwise_add",
+                {"X": [out], "Y": [self.bias]},
+                {"axis": len(out.shape) - 1},
+            )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class FC(Layer):
+    def __init__(self, name_scope, size, num_flatten_dims=1, param_attr=None,
+                 bias_attr=None, act=None, is_test=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input):
+        if self.weight is None:
+            in_features = int(
+                np.prod(input.shape[self._num_flatten_dims :])
+            )
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[in_features, self._size],
+                dtype=self._dtype,
+            )
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr,
+                    shape=[self._size],
+                    dtype=self._dtype,
+                    is_bias=True,
+                )
+        out = call_op(
+            "mul",
+            {"X": [input], "Y": [self.weight]},
+            {
+                "x_num_col_dims": self._num_flatten_dims,
+                "y_num_col_dims": 1,
+            },
+        )
+        if self.bias is not None:
+            out = call_op(
+                "elementwise_add",
+                {"X": [out], "Y": [self.bias]},
+                {"axis": self._num_flatten_dims},
+            )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope, num_channels, act=None, is_test=False,
+                 momentum=0.9, epsilon=1e-05, param_attr=None,
+                 bias_attr=None, dtype="float32", data_layout="NCHW",
+                 in_place=False, moving_mean_name=None,
+                 moving_variance_name=None, do_model_average_for_mean_and_var=False,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(name_scope, dtype)
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=[num_channels], dtype=dtype,
+            default_initializer=Constant(1.0),
+        )
+        self.bias = self.create_parameter(
+            attr=bias_attr, shape=[num_channels], dtype=dtype, is_bias=True
+        )
+        self._mean = VarBase(
+            jnp.zeros((num_channels,), core.np_dtype(dtype)),
+            name=moving_mean_name, persistable=True, stop_gradient=True,
+            trainable=False,
+        )
+        self._variance = VarBase(
+            jnp.ones((num_channels,), core.np_dtype(dtype)),
+            name=moving_variance_name, persistable=True, stop_gradient=True,
+            trainable=False,
+        )
+
+    def forward(self, input):
+        outs = {
+            "Y": [VarBase()],
+            "MeanOut": [self._mean],
+            "VarianceOut": [self._variance],
+            "SavedMean": [VarBase()],
+            "SavedVariance": [VarBase()],
+        }
+        tr.eager_run_op(
+            type="batch_norm",
+            inputs={
+                "X": [input],
+                "Scale": [self.weight],
+                "Bias": [self.bias],
+                "Mean": [self._mean],
+                "Variance": [self._variance],
+            },
+            outputs=outs,
+            attrs={
+                "momentum": self._momentum,
+                "epsilon": self._epsilon,
+                "is_test": not self.training,
+                "data_layout": self._data_layout,
+                "use_global_stats": self._use_global_stats,
+            },
+        )
+        return outs["Y"][0]
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 is_distributed=False, padding_idx=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope or "embedding", dtype)
+        self._size = size
+        self._padding_idx = (
+            -1 if padding_idx is None else
+            padding_idx if padding_idx >= 0 else size[0] + padding_idx
+        )
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=size, dtype=dtype
+        )
+
+    def forward(self, input):
+        return call_op(
+            "lookup_table_v2",
+            {"Ids": [input], "W": [self.weight]},
+            {"padding_idx": self._padding_idx},
+        )
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope, scale=True, shift=True,
+                 begin_norm_axis=1, epsilon=1e-05, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._scale = scale
+        self._shift = shift
+        self._begin_norm_axis = begin_norm_axis
+        self._epsilon = epsilon
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input):
+        if self.weight is None and self._scale:
+            n = int(np.prod(input.shape[self._begin_norm_axis :]))
+            self.weight = self.create_parameter(
+                attr=self._param_attr, shape=[n], dtype=self._dtype,
+                default_initializer=Constant(1.0),
+            )
+            if self._shift:
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr, shape=[n], dtype=self._dtype,
+                    is_bias=True,
+                )
+        ins = {"X": [input]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = call_op(
+            "layer_norm",
+            ins,
+            {
+                "epsilon": self._epsilon,
+                "begin_norm_axis": self._begin_norm_axis,
+            },
+            out_slots=("Y", "Mean", "Variance"),
+        )
+        y = out["Y"][0]
+        if self._act:
+            y = call_op(self._act, {"X": [y]})
+        return y
+
+
+class GRUUnit(Layer):
+    def __init__(self, name_scope, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size  # 3*D
+        d = size // 3
+        self._d = d
+        self._origin_mode = origin_mode
+        self._activation = activation
+        self._gate_activation = gate_activation
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=[d, 3 * d], dtype=dtype
+        )
+        self.bias = self.create_parameter(
+            attr=bias_attr, shape=[1, 3 * d], dtype=dtype, is_bias=True
+        )
+
+    def forward(self, input, hidden):
+        outs = {
+            "Hidden": [VarBase()],
+            "ResetHiddenPrev": [VarBase()],
+            "Gate": [VarBase()],
+        }
+        tr.eager_run_op(
+            type="gru_unit",
+            inputs={
+                "Input": [input],
+                "HiddenPrev": [hidden],
+                "Weight": [self.weight],
+                "Bias": [self.bias],
+            },
+            outputs=outs,
+            attrs={
+                "activation": self._activation,
+                "gate_activation": self._gate_activation,
+                "origin_mode": self._origin_mode,
+            },
+        )
+        return outs["Hidden"][0], outs["ResetHiddenPrev"][0], outs["Gate"][0]
+
+
+class NCE(Layer):
+    def __init__(self, name_scope, num_total_classes, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=None,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_total_classes = num_total_classes
+        self._num_neg_samples = num_neg_samples or 10
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input, label, sample_weight=None):
+        if self.weight is None:
+            dim = input.shape[1]
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[self._num_total_classes, dim],
+                dtype=self._dtype,
+            )
+            self.bias = self.create_parameter(
+                attr=self._bias_attr,
+                shape=[self._num_total_classes, 1],
+                dtype=self._dtype,
+                is_bias=True,
+            )
+        return call_op(
+            "nce",
+            {
+                "Input": [input],
+                "Label": [label],
+                "Weight": [self.weight],
+                "Bias": [self.bias],
+            },
+            {
+                "num_total_classes": self._num_total_classes,
+                "num_neg_samples": self._num_neg_samples,
+            },
+            out_slots=("Cost",),
+        )
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope, mode, param_attr=None, dtype="float32",
+                 channel=None, input_shape=None):
+        super().__init__(name_scope, dtype)
+        self._mode = mode
+        self._param_attr = param_attr
+        self._channel = channel
+        self._input_shape = input_shape
+        self.weight = None
+
+    def forward(self, input):
+        if self.weight is None:
+            if self._mode == "all":
+                shape = [1]
+            elif self._mode == "channel":
+                shape = [self._channel or input.shape[1]]
+            else:
+                shape = list(self._input_shape or input.shape[1:])
+            self.weight = self.create_parameter(
+                attr=self._param_attr, shape=shape, dtype=self._dtype,
+                default_initializer=Constant(0.25),
+            )
+        return call_op(
+            "prelu",
+            {"X": [input], "Alpha": [self.weight]},
+            {"mode": self._mode},
+        )
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, name_scope, size, name=None, act=None,
+                 param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, x, y):
+        if self.weight is None:
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[self._size, x.shape[1], y.shape[1]],
+                dtype=self._dtype,
+            )
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr, shape=[1, self._size],
+                    dtype=self._dtype, is_bias=True,
+                )
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = call_op("bilinear_tensor_product", ins)
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class GroupNorm(Layer):
+    def __init__(self, name_scope, groups, epsilon=1e-05, param_attr=None,
+                 bias_attr=None, act=None, data_layout="NCHW",
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._epsilon = epsilon
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input):
+        if self.weight is None:
+            c = input.shape[1]
+            self.weight = self.create_parameter(
+                attr=self._param_attr, shape=[c], dtype=self._dtype,
+                default_initializer=Constant(1.0),
+            )
+            self.bias = self.create_parameter(
+                attr=self._bias_attr, shape=[c], dtype=self._dtype,
+                is_bias=True,
+            )
+        out = call_op(
+            "group_norm",
+            {"X": [input], "Scale": [self.weight], "Bias": [self.bias]},
+            {"groups": self._groups, "epsilon": self._epsilon},
+            out_slots=("Y", "Mean", "Variance"),
+        )
+        y = out["Y"][0]
+        if self._act:
+            y = call_op(self._act, {"X": [y]})
+        return y
+
+
+class SpectralNorm(Layer):
+    def __init__(self, name_scope, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        self._u = None
+        self._v = None
+
+    def forward(self, weight):
+        if self._u is None:
+            h = weight.shape[self._dim]
+            w = int(np.prod(weight.shape)) // h
+            self._u = VarBase(
+                jnp.asarray(np.random.normal(size=h).astype("float32")),
+                persistable=True, stop_gradient=True, trainable=False,
+            )
+            self._v = VarBase(
+                jnp.asarray(np.random.normal(size=w).astype("float32")),
+                persistable=True, stop_gradient=True, trainable=False,
+            )
+        return call_op(
+            "spectral_norm",
+            {"Weight": [weight], "U": [self._u], "V": [self._v]},
+            {"dim": self._dim, "power_iters": self._power_iters,
+             "eps": self._eps},
+        )
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__("dropout")
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        out = call_op(
+            "dropout",
+            {"X": [input]},
+            {
+                "dropout_prob": self._p,
+                "is_test": not self.training,
+                "dropout_implementation": self._impl,
+            },
+            out_slots=("Out", "Mask"),
+        )
+        return out["Out"][0]

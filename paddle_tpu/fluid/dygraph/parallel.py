@@ -1,0 +1,84 @@
+"""dygraph DataParallel (ref: python/paddle/fluid/dygraph/parallel.py).
+
+TPU-native: gradients are all-reduced with jax.lax collectives when running
+under a mesh; single-process multi-device eager training instead uses the
+static-graph CompiledProgram path, so this class focuses on API parity:
+scale_loss + apply_collective_grads."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+
+__all__ = ["prepare_context", "ParallelEnv", "DataParallel", "Env"]
+
+
+def prepare_context(strategy=None):
+    return strategy
+
+
+class ParallelEnv:
+    def __init__(self):
+        self._nranks = 1
+        self._local_rank = 0
+        try:
+            self._nranks = jax.device_count()
+        except RuntimeError:
+            pass
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._local_rank
+
+    @property
+    def current_endpoint(self):
+        return "127.0.0.1:0"
+
+    @property
+    def trainer_endpoints(self):
+        return ["127.0.0.1:0"]
+
+
+Env = ParallelEnv
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super().__init__("data_parallel")
+        self._layers = layers
+        self._strategy = strategy or ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        n = getattr(self._strategy, "nranks", 1)
+        if n <= 1:
+            return loss
+        from .tracer import call_op
+
+        return call_op("scale", {"X": [loss]}, {"scale": 1.0 / n})
+
+    def apply_collective_grads(self):
+        # under pjit/shard_map the psum is inserted by the partitioner;
+        # eager single-host: no-op
+        pass
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    load_dict = set_dict

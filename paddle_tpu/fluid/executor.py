@@ -1,0 +1,215 @@
+"""Executor + Scope.
+
+TPU-native analogue of ref python/paddle/fluid/executor.py (Executor) and
+paddle/fluid/framework/scope.cc. The Scope holds device-resident jax arrays;
+Executor.run lowers the Program once per (program version, feed signature)
+into a jitted step function with donated state, then replays it — so steady-
+state training is a single XLA executable launch per iteration.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import core
+from . import framework
+from .framework import Program, Variable, default_main_program
+from .lowering import build_step_fn
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+
+
+class _TensorView:
+    """Compat shim for `scope.find_var(name).get_tensor()` usage."""
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return self._scope[self._name]
+
+    def set(self, value, place=None):
+        self._scope.set(self._name, value)
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._scope[self._name])
+        return arr.astype(dtype) if dtype else arr
+
+
+class Scope:
+    """name -> device array mapping (device-resident between runs)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def __getitem__(self, name):
+        return self._vars[name]
+
+    def __contains__(self, name):
+        return name in self._vars
+
+    def get(self, name, default=None):
+        return self._vars.get(name, default)
+
+    def keys(self):
+        return self._vars.keys()
+
+    def items(self):
+        return self._vars.items()
+
+    def pop(self, name, default=None):
+        return self._vars.pop(name, default)
+
+    def find_var(self, name):
+        if name not in self._vars:
+            return None
+        return _TensorView(self, name)
+
+    def var(self, name):
+        return _TensorView(self, name)
+
+    def new_scope(self):
+        return Scope()
+
+    def drop_kids(self):
+        pass
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+
+
+def _as_name(v):
+    if isinstance(v, Variable):
+        return v.name
+    if isinstance(v, str):
+        return v
+    raise TypeError("fetch/feed entry must be Variable or str, got %r" % (v,))
+
+
+class Executor:
+    """Runs Programs. `place` selects the XLA backend (TPUPlace/CPUPlace)."""
+
+    def __init__(self, place=None):
+        self.place = place if place is not None else core.default_place()
+        self._cache = {}
+        self._run_counter = 0
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+        use_prune=False,
+    ):
+        if self._closed:
+            raise RuntimeError("Executor is closed")
+        program = program if program is not None else default_main_program()
+        # CompiledProgram (data-parallel) delegates to its own runner
+        if hasattr(program, "_executor_run"):
+            return program._executor_run(
+                self, feed, fetch_list, scope, return_numpy
+            )
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [_as_name(f) for f in fetch_list]
+
+        feed_arrays = self._prepare_feeds(program, feed)
+        state = self._gather_state(program, scope)
+
+        sig = (
+            id(program),
+            program._version,
+            tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items())),
+            tuple(fetch_names),
+            tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
+        )
+        entry = self._cache.get(sig) if use_program_cache else None
+        if entry is None:
+            step = build_step_fn(program, list(feed_arrays.keys()), fetch_names)
+            jitted = jax.jit(step, donate_argnums=(0,))
+            entry = jitted
+            if use_program_cache:
+                self._cache[sig] = entry
+
+        rng = self._next_rng(program)
+        fetches, new_state = entry(state, feed_arrays, rng)
+        for k, v in new_state.items():
+            scope.set(k, v)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _prepare_feeds(self, program, feed):
+        block = program.global_block()
+        out = {}
+        for name, value in feed.items():
+            value = getattr(value, "_ndarray", value)  # LoDTensor shim
+            arr = np.asarray(value)
+            if block.has_var(name):
+                var = block.var(name)
+                if var.dtype is not None:
+                    want = core.np_dtype(var.dtype)
+                    if arr.dtype != want:
+                        arr = arr.astype(want)
+            dev = self.place.jax_device()
+            out[name] = jax.device_put(arr, dev)
+        return out
+
+    def _gather_state(self, program, scope):
+        state = {}
+        for v in program.global_block().vars.values():
+            if v.persistable and v.name in scope:
+                state[v.name] = scope[v.name]
+        return state
+
+    def _next_rng(self, program):
+        self._run_counter += 1
+        seed = program.random_seed
+        if seed == 0:
+            seed = abs(hash(("paddle_tpu", id(program)))) % (2**31)
+        return jax.random.PRNGKey(seed + 1000003 * self._run_counter)
+
+    def close(self):
+        self._cache.clear()
+        self._closed = True
+
+    # compat no-ops ----------------------------------------------------
+    def infer_from_dataset(self, *a, **k):
+        raise NotImplementedError(
+            "dataset trainer path not supported; use DataLoader + run()"
+        )
+
+    def train_from_dataset(self, *a, **k):
+        raise NotImplementedError(
+            "dataset trainer path not supported; use DataLoader + run()"
+        )

@@ -1,0 +1,196 @@
+"""Model save/load (ref: python/paddle/fluid/io.py).
+
+Parameters/persistables are saved as .npz archives; the inference program is
+serialized as the Program JSON (TPU-native stand-in for the ProgramDesc
+protobuf — same information, introspectable).
+"""
+import os
+import json
+
+import numpy as np
+
+from . import core
+from .executor import global_scope
+from .framework import Parameter, Program, Variable, default_main_program
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "batch", "save", "load",
+]
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def _collect(program, predicate, vars=None):
+    if vars is not None:
+        return [
+            program.global_block().var(v) if isinstance(v, str) else v
+            for v in vars
+        ]
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    var_list = _collect(main_program, predicate or is_persistable, vars)
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    payload = {}
+    for v in var_list:
+        val = scope.get(v.name)
+        if val is None:
+            continue
+        payload[v.name] = np.asarray(val)
+    fname = filename or "__vars__.npz"
+    np.savez(os.path.join(dirname, fname), **payload)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(
+        executor, dirname, main_program, predicate=is_parameter,
+        filename=filename or "__params__.npz",
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(
+        executor, dirname, main_program, predicate=is_persistable,
+        filename=filename or "__persistables__.npz",
+    )
+
+
+def _load_npz(dirname, filename):
+    path = os.path.join(dirname, filename)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    return np.load(path, allow_pickle=False)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    var_list = _collect(main_program, predicate or is_persistable, vars)
+    data = _load_npz(dirname, filename or "__vars__.npz")
+    scope = global_scope()
+    for v in var_list:
+        if v.name in data:
+            scope.set(v.name, np.asarray(data[v.name]))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(
+        executor, dirname, main_program, predicate=is_parameter,
+        filename=filename or "__params__.npz",
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(
+        executor, dirname, main_program, predicate=is_persistable,
+        filename=filename or "__persistables__.npz",
+    )
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+    export_for_deployment=True,
+    program_only=False,
+):
+    """ref io.py:save_inference_model."""
+    main_program = main_program or default_main_program()
+    inference_program = main_program._prune(target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "program": json.loads(inference_program.to_json()),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [
+            t.name if isinstance(t, Variable) else t for t in target_vars
+        ],
+    }
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump(meta, f)
+    if not program_only:
+        save_params(
+            executor, dirname, main_program,
+            filename=params_filename or "__params__.npz",
+        )
+    return [meta["fetch_names"]]
+
+
+def load_inference_model(
+    dirname,
+    executor,
+    model_filename=None,
+    params_filename=None,
+    pserver_endpoints=None,
+):
+    """ref io.py:load_inference_model → (program, feed_names, fetch_vars)."""
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename)) as f:
+        meta = json.load(f)
+    program = Program.from_json(json.dumps(meta["program"]))
+    # load params into scope
+    data = _load_npz(dirname, params_filename or "__params__.npz")
+    scope = global_scope()
+    for name in data.files:
+        scope.set(name, np.asarray(data[name]))
+    fetch_vars = [
+        program.global_block().var(n) for n in meta["fetch_names"]
+    ]
+    return [program, meta["feed_names"], fetch_vars]
+
+
+def save(program, model_path):
+    """paddle 1.6-style fluid.save."""
+    dirname = os.path.dirname(model_path) or "."
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    payload = {}
+    for v in program.list_vars():
+        if v.persistable and v.name in scope:
+            payload[v.name] = np.asarray(scope[v.name])
+    np.savez(model_path + ".pdparams.npz", **payload)
+    with open(model_path + ".pdmodel.json", "w") as f:
+        f.write(program.to_json())
+
+
+def load(program, model_path, executor=None, var_list=None):
+    data = np.load(model_path + ".pdparams.npz")
+    scope = global_scope()
+    names = (
+        [v.name if isinstance(v, Variable) else v for v in var_list]
+        if var_list
+        else list(data.files)
+    )
+    for name in names:
+        if name in data:
+            scope.set(name, np.asarray(data[name]))
+
+
+def batch(reader, batch_size, drop_last=False):
+    from ..reader_utils import batch as _batch
+
+    return _batch(reader, batch_size, drop_last)
+
+
+def get_program_persistable_vars(program):
+    return [v for v in program.list_vars() if v.persistable]
+
+
+def get_program_parameter(program):
+    return program.all_parameters()

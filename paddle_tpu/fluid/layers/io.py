@@ -1,0 +1,32 @@
+"""Data input layers (ref: python/paddle/fluid/layers/io.py data())."""
+from .. import core
+from ..framework import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(
+    name,
+    shape,
+    append_batch_size=True,
+    dtype="float32",
+    lod_level=0,
+    type=core.VarType.LOD_TENSOR,
+    stop_gradient=True,
+):
+    """Declare a feed variable (ref layers/io.py:data). With
+    append_batch_size=True a leading -1 batch dim is added."""
+    helper_shape = list(shape)
+    if append_batch_size:
+        helper_shape = [-1] + helper_shape
+    main = default_main_program().current_block().create_var(
+        name=name,
+        shape=helper_shape,
+        dtype=dtype,
+        type=type,
+        stop_gradient=stop_gradient,
+        lod_level=lod_level,
+        is_data=True,
+        need_check_feed=True,
+    )
+    return main

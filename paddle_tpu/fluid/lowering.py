@@ -1,0 +1,202 @@
+"""Program → jax function lowering.
+
+This is the TPU-native replacement for the reference's C++ executor loop
+(ref: paddle/fluid/framework/executor.cc Executor::RunPreparedContext), which
+walks the ProgramDesc and dispatches a kernel per op. Here the whole block is
+traced into ONE pure function
+
+    step(state_dict, feed_dict, rng) -> (fetches, new_state_dict)
+
+and handed to jax.jit: XLA sees the full op graph (forward, vjp-derived
+backward, optimizer updates) and fuses/schedules it as a single HloModule —
+no per-op launches, no HBM round-trips between ops, params donated.
+
+Autodiff: the symbolic `backward` op appended by backward.append_backward is
+lowered by closing over the preceding ops and calling jax.vjp — replacing the
+reference's per-op grad-kernel transpile (ref: python/paddle/fluid/backward.py
+_append_backward_ops_).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import ops as ops_lib
+from ..ops.registry import LowerContext, get_lowering
+from . import core
+
+
+class OpLoweringError(RuntimeError):
+    pass
+
+
+def _format_callstack(op):
+    try:
+        frames = [
+            "    %s:%d in %s" % (f.filename, f.lineno, f.name)
+            for f in op.callstack[-3:]
+        ]
+        return "\n".join(frames)
+    except Exception:
+        return "    <no callstack>"
+
+
+def resolve_inputs(op, env):
+    ins = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n not in env:
+                raise OpLoweringError(
+                    "op '%s' input %s='%s' has no value. Was the var fed, "
+                    "initialized by the startup program, or produced by an "
+                    "earlier op?\n  op: %s\n  defined at:\n%s"
+                    % (op.type, slot, n, op, _format_callstack(op))
+                )
+            vals.append(env[n])
+        ins[slot] = vals
+    return ins
+
+
+def bind_outputs(op, outs, env, var_lookup):
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for i, n in enumerate(names):
+            if i >= len(vals):
+                break
+            v = vals[i]
+            var = var_lookup(n)
+            if var is not None and var.stop_gradient and _is_float(v):
+                v = lax.stop_gradient(v)
+            env[n] = v
+
+
+def _is_float(v):
+    try:
+        return jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+    except Exception:
+        return False
+
+
+def apply_op(op, env, ctx, var_lookup, op_tag=0):
+    fn = get_lowering(op.type)
+    ins = resolve_inputs(op, env)
+    ctx.set_op_tag(op_tag)
+    try:
+        outs = fn(ctx, ins, op.attrs)
+    except (OpLoweringError, NotImplementedError):
+        raise
+    except Exception as e:
+        raise OpLoweringError(
+            "lowering op '%s' failed: %s: %s\n  op: %s\n  defined at:\n%s"
+            % (op.type, type(e).__name__, e, op, _format_callstack(op))
+        ) from e
+    bind_outputs(op, outs, env, var_lookup)
+    return env
+
+
+def run_ops(block, op_list, env, ctx):
+    """Sequentially lower a list of ops; each symbolic `backward` op is
+    lowered by jax.vjp over a replay of the ENTIRE preceding program (so a
+    second minimize/gradients call on the same program differentiates its
+    own forward ops too). PRNG draws are keyed per op position, so the
+    replay reproduces identical random draws (dropout masks etc.) and XLA
+    CSE collapses the duplicated subgraph."""
+    var_lookup = _make_var_lookup(block)
+    env0 = dict(env)  # initial state+feeds — replay starts here
+    cached_grads = {}  # grads from earlier backward ops, replayed as consts
+    for idx, op in enumerate(op_list):
+        if op.type != "backward":
+            env = apply_op(op, env, ctx, var_lookup, op_tag=idx)
+            continue
+        bw_op = op
+        target_names = bw_op.attrs["targets"]
+        loss_name = bw_op.input("Loss")[0]
+        region = op_list[:idx]
+
+        # targets must be bindable at program start (params/feeds/state);
+        # differentiating w.r.t. mid-program intermediates isn't supported
+        primals = []
+        for n in target_names:
+            if n not in env0:
+                raise OpLoweringError(
+                    "backward target '%s' is not a parameter/feed/state var; "
+                    "differentiating w.r.t. intermediate vars is not "
+                    "supported — pass the producing inputs instead" % n
+                )
+            primals.append(env0[n])
+
+        def fwd(primal_vals, _region=region, _tn=target_names,
+                _ln=loss_name):
+            e = dict(env0)
+            e.update(zip(_tn, primal_vals))
+            for j, rop in enumerate(_region):
+                if rop.type == "backward":
+                    for gn in rop.output("Grads"):
+                        e[gn] = lax.stop_gradient(cached_grads[gn])
+                    continue
+                e = apply_op(rop, e, ctx, var_lookup, op_tag=j)
+            return e[_ln], e
+
+        (loss_val, vjp_fn, env) = jax.vjp(fwd, primals, has_aux=True)
+        (grads,) = vjp_fn(jnp.ones_like(loss_val))
+        grad_names = bw_op.output("Grads")
+        for n, g in zip(grad_names, grads):
+            env[n] = g
+            cached_grads[n] = g
+    return env
+
+
+def _make_var_lookup(block):
+    def lookup(name):
+        blk = block
+        while blk is not None:
+            v = blk.vars.get(name)
+            if v is not None:
+                return v
+            blk = blk.parent_block
+        return None
+
+    return lookup
+
+
+def persistable_names(program):
+    names = []
+    for v in program.global_block().vars.values():
+        if v.persistable:
+            names.append(v.name)
+    return names
+
+
+def build_step_fn(program, feed_names, fetch_names, is_test=False,
+                  extra_env=None):
+    """Return a pure function step(state, feeds, rng) -> (fetches, new_state).
+
+    ``state`` / ``feeds`` are dicts name->array. ``new_state`` contains every
+    persistable var that has a value after the run (parameters, optimizer
+    accumulators, batch-norm stats, step counters, ...).
+    """
+    block = program.global_block()
+    op_list = list(block.ops)
+    persist = set(persistable_names(program))
+
+    def step(state, feeds, rng):
+        ctx = LowerContext(rng=rng, is_test=is_test, program=program)
+        ctx.run_ops = run_ops  # control-flow ops recurse through this
+        env = {}
+        if extra_env:
+            env.update(extra_env)
+        env.update(state)
+        env.update(feeds)
+        env = run_ops(block, op_list, env, ctx)
+        missing = [n for n in fetch_names if n not in env]
+        if missing:
+            raise OpLoweringError(
+                "fetch vars %s were never computed by the program" % missing
+            )
+        fetches = [env[n] for n in fetch_names]
+        new_state = {n: env[n] for n in persist if n in env}
+        return fetches, new_state
+
+    return step

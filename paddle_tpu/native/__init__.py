@@ -1,0 +1,5 @@
+"""Native (C++) host runtime: prefetching data pipeline + pinned staging
+arena (TPU-native analogue of paddle/fluid/operators/reader/ +
+paddle/fluid/memory/). Built lazily with g++; pure-python fallback keeps the
+framework importable before the first build."""
+from . import pipeline  # noqa: F401

@@ -1,0 +1,53 @@
+"""Lazy g++ build + ctypes loader for the native host runtime."""
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libpaddle_tpu_native.so")
+_SRC = os.path.join(_HERE, "dataloader.cpp")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _compile():
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", _LIB_PATH,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_native():
+    """Return the ctypes lib, building it on first call; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _tried:
+            return None
+        _tried = True
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+            ):
+                _compile()
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.ptq_create.restype = ctypes.c_void_p
+            lib.ptq_create.argtypes = [ctypes.c_int]
+            lib.ptq_put.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.ptq_get.restype = ctypes.c_long
+            lib.ptq_get.argtypes = [ctypes.c_void_p]
+            lib.ptq_destroy.argtypes = [ctypes.c_void_p]
+            lib.arena_create.restype = ctypes.c_void_p
+            lib.arena_create.argtypes = [ctypes.c_size_t]
+            lib.arena_alloc.restype = ctypes.c_void_p
+            lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+            lib.arena_reset.argtypes = [ctypes.c_void_p]
+            lib.arena_destroy.argtypes = [ctypes.c_void_p]
+            _lib = lib
+            return _lib
+        except Exception:
+            return None

@@ -1,0 +1,89 @@
+// Native host runtime for paddle_tpu.
+//
+// TPU-native analogue of the reference's C++ reader stack
+// (ref: paddle/fluid/operators/reader/blocking_queue.h,
+//  paddle/fluid/framework/blocking_queue.h) and host memory arena
+// (ref: paddle/fluid/memory/allocation/*).
+//
+// - ptq_*: bounded MPMC token queue with condition-variable blocking.
+//   Python keeps the actual batch objects; tokens flow through C++ so the
+//   producer thread blocks/wakes without holding the GIL.
+// - arena_*: bump-pointer pinned staging arena for feed buffers (64-byte
+//   aligned so dma_map-style transfers stay aligned).
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+
+extern "C" {
+
+struct TokenQueue {
+  std::deque<long> items;
+  std::mutex mu;
+  std::condition_variable not_empty;
+  std::condition_variable not_full;
+  size_t capacity;
+};
+
+void* ptq_create(int capacity) {
+  auto* q = new TokenQueue();
+  q->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  return q;
+}
+
+void ptq_put(void* handle, long token) {
+  auto* q = static_cast<TokenQueue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_full.wait(lk, [q] { return q->items.size() < q->capacity; });
+  q->items.push_back(token);
+  q->not_empty.notify_one();
+}
+
+long ptq_get(void* handle) {
+  auto* q = static_cast<TokenQueue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [q] { return !q->items.empty(); });
+  long t = q->items.front();
+  q->items.pop_front();
+  q->not_full.notify_one();
+  return t;
+}
+
+void ptq_destroy(void* handle) { delete static_cast<TokenQueue*>(handle); }
+
+// ---------------------------------------------------------------------------
+struct Arena {
+  char* base;
+  size_t size;
+  size_t offset;
+};
+
+void* arena_create(size_t bytes) {
+  auto* a = new Arena();
+  a->base = static_cast<char*>(::operator new(bytes, std::align_val_t(64)));
+  a->size = bytes;
+  a->offset = 0;
+  return a;
+}
+
+void* arena_alloc(void* handle, size_t bytes) {
+  auto* a = static_cast<Arena*>(handle);
+  size_t aligned = (bytes + 63) & ~size_t(63);
+  if (a->offset + aligned > a->size) return nullptr;
+  void* p = a->base + a->offset;
+  a->offset += aligned;
+  return p;
+}
+
+void arena_reset(void* handle) { static_cast<Arena*>(handle)->offset = 0; }
+
+void arena_destroy(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  ::operator delete(a->base, std::align_val_t(64));
+  delete a;
+}
+
+}  // extern "C"

@@ -1,0 +1,60 @@
+"""Host data pipeline facade.
+
+Uses the C++ ring-buffer queue (dataloader.cpp, built on first use) when
+available; otherwise a python queue. The C++ path exists because the
+reference's reader stack is C++ (paddle/fluid/operators/reader/
+blocking_queue.h) — feeding a TPU at full HBM bandwidth needs the GIL out of
+the producer path for real workloads.
+"""
+import queue as _pyqueue
+
+from . import build
+
+
+class _PyQueue:
+    def __init__(self, capacity):
+        self._q = _pyqueue.Queue(maxsize=capacity)
+
+    def put(self, item):
+        self._q.put(item)
+
+    def get(self):
+        return self._q.get()
+
+
+class _NativeQueue:
+    """ctypes wrapper over the C++ SPSC ring buffer. Python objects are
+    passed via an index table (the C++ side manages slot tokens + blocking),
+    so arbitrary numpy batches ride through without serialization."""
+
+    def __init__(self, capacity, lib):
+        self._lib = lib
+        self._handle = lib.ptq_create(capacity)
+        self._slots = {}
+        self._next = 0
+
+    def put(self, item):
+        self._next += 1
+        token = self._next
+        self._slots[token] = item
+        self._lib.ptq_put(self._handle, token)
+
+    def get(self):
+        token = self._lib.ptq_get(self._handle)
+        return self._slots.pop(token)
+
+    def __del__(self):
+        try:
+            self._lib.ptq_destroy(self._handle)
+        except Exception:
+            pass
+
+
+def make_queue(capacity=64):
+    lib = build.load_native()
+    if lib is not None:
+        try:
+            return _NativeQueue(capacity, lib)
+        except Exception:
+            pass
+    return _PyQueue(capacity)

@@ -1,0 +1,25 @@
+"""Op lowering library — importing this package registers all lowerings."""
+from .registry import (  # noqa: F401
+    LOWERINGS,
+    LowerContext,
+    get_lowering,
+    has_lowering,
+    register_op,
+)
+
+from . import math_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import metric_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
+
+
+def _register_late_modules():
+    """Modules that depend on fluid internals import lazily to avoid cycles."""
+    from . import sequence_ops  # noqa: F401
+    from . import control_ops  # noqa: F401
+    from . import collective_ops  # noqa: F401
+    from . import detection_ops  # noqa: F401

@@ -1,0 +1,255 @@
+"""Detection op lowerings (ref: paddle/fluid/operators/detection/ and
+roi_pool_op.cc / roi_align_op.cc). ROIs use the dense (N_roi, 4) box format
+with a companion batch-index vector (LoD → static shapes)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+def _roi_batch_idx(ins, n_rois):
+    if ins.get("RoisBatchIdx"):
+        return ins["RoisBatchIdx"][0].astype(jnp.int32)
+    return jnp.zeros((n_rois,), jnp.int32)
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    x = ins["X"][0]            # (N, C, H, W)
+    rois = ins["ROIs"][0]      # (R, 4) [x1, y1, x2, y2]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    bidx = _roi_batch_idx(ins, r)
+
+    def pool_one(roi, bi):
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.maximum(jnp.round(roi[2] * scale).astype(jnp.int32), x1 + 1)
+        y2 = jnp.maximum(jnp.round(roi[3] * scale).astype(jnp.int32), y1 + 1)
+        # sample a dense grid and max-reduce per bin (static shapes)
+        gh, gw = ph * 4, pw * 4
+        ys = y1 + (jnp.arange(gh) + 0.5) * (y2 - y1) / gh
+        xs = x1 + (jnp.arange(gw) + 0.5) * (x2 - x1) / gw
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        patch = x[bi][:, yi][:, :, xi]  # (C, gh, gw)
+        patch = patch.reshape(c, ph, 4, pw, 4)
+        return jnp.max(patch, axis=(2, 4))
+
+    out = jax.vmap(pool_one)(rois, bidx)
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register_op("roi_align")
+def _roi_align(ctx, ins, attrs):
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    ratio = attrs.get("sampling_ratio", -1)
+    if ratio <= 0:
+        ratio = 2
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    bidx = _roi_batch_idx(ins, r)
+
+    def bilinear(img, y, x_):
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x_).astype(jnp.int32)
+        y1, x1 = y0 + 1, x0 + 1
+        wy = y - y0
+        wx = x_ - x0
+        y0c = jnp.clip(y0, 0, h - 1)
+        y1c = jnp.clip(y1, 0, h - 1)
+        x0c = jnp.clip(x0, 0, w - 1)
+        x1c = jnp.clip(x1, 0, w - 1)
+        v = (
+            img[:, y0c, x0c] * (1 - wy) * (1 - wx)
+            + img[:, y0c, x1c] * (1 - wy) * wx
+            + img[:, y1c, x0c] * wy * (1 - wx)
+            + img[:, y1c, x1c] * wy * wx
+        )
+        return v
+
+    def align_one(roi, bi):
+        x1 = roi[0] * scale
+        y1 = roi[1] * scale
+        x2 = roi[2] * scale
+        y2 = roi[3] * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = x[bi]
+        acc = jnp.zeros((c, ph, pw))
+        for iy in range(ratio):
+            for ix in range(ratio):
+                yy = y1 + (jnp.arange(ph)[:, None] + (iy + 0.5) / ratio) * bin_h
+                xx = x1 + (jnp.arange(pw)[None, :] + (ix + 0.5) / ratio) * bin_w
+                yyb = jnp.broadcast_to(yy, (ph, pw))
+                xxb = jnp.broadcast_to(xx, (ph, pw))
+                acc = acc + bilinear(img, yyb, xxb)
+        return acc / (ratio * ratio)
+
+    out = jax.vmap(align_one)(rois, bidx)
+    return single(out)
+
+
+@register_op("box_coder")
+def _box_coder(ctx, ins, attrs):
+    """Encode/decode boxes vs priors (ref: detection/box_coder_op.cc)."""
+    prior = ins["PriorBox"][0]         # (M, 4)
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    off = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph_ = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph_ * 0.5
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph_[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph_[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        return {"OutputBox": [out]}
+    # decode: target (N, M, 4)
+    t = target
+    if pvar is not None:
+        t = t * pvar[None, :, :]
+    dcx = t[..., 0] * pw + pcx
+    dcy = t[..., 1] * ph_ + pcy
+    dw = jnp.exp(t[..., 2]) * pw
+    dh = jnp.exp(t[..., 3]) * ph_
+    out = jnp.stack(
+        [dcx - dw * 0.5, dcy - dh * 0.5,
+         dcx + dw * 0.5 - off, dcy + dh * 0.5 - off],
+        axis=-1,
+    )
+    return {"OutputBox": [out]}
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]  # (N,4), (M,4)
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    return single(inter / jnp.maximum(union, 1e-10))
+
+
+@register_op("prior_box")
+def _prior_box(ctx, ins, attrs):
+    """SSD prior boxes (ref: detection/prior_box_op.cc)."""
+    feat = ins["Input"][0]   # (N, C, H, W)
+    image = ins["Image"][0]  # (N, C, IH, IW)
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    ratios = attrs.get("aspect_ratios", [1.0])
+    flip = attrs.get("flip", True)
+    clip = attrs.get("clip", False)
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+    ars = []
+    for r in ratios:
+        ars.append(r)
+        if flip and abs(r - 1.0) > 1e-6:
+            ars.append(1.0 / r)
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        sizes = []
+        for ar in ars:
+            sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if ms_i < len(max_sizes):
+            sizes.append(
+                (np.sqrt(ms * max_sizes[ms_i]),) * 2
+            )
+        for bw, bh in sizes:
+            cx = (jnp.arange(w) + offset) * sw
+            cy = (jnp.arange(h) + offset) * sh
+            cxg, cyg = jnp.meshgrid(cx, cy)
+            box = jnp.stack(
+                [
+                    (cxg - bw / 2) / iw,
+                    (cyg - bh / 2) / ih,
+                    (cxg + bw / 2) / iw,
+                    (cyg + bh / 2) / ih,
+                ],
+                axis=-1,
+            )
+            boxes.append(box)
+    out = jnp.stack(boxes, axis=2)  # (H, W, num_priors, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances), out.shape
+    )
+    return {"Boxes": [out], "Variances": [var]}
+
+
+@register_op("yolo_box")
+def _yolo_box(ctx, ins, attrs):
+    """YOLOv3 box decoding (ref: detection/yolo_box_op.cc)."""
+    x = ins["X"][0]            # (N, A*(5+C), H, W)
+    img_size = ins["ImgSize"][0]  # (N, 2) [h, w]
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w)[None, None, None, :]
+    grid_y = jnp.arange(h)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    input_h = downsample * h
+    input_w = downsample * w
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / h
+    bw = jnp.exp(x[:, :, 2]) * aw / input_w
+    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    mask = (conf > conf_thresh).astype(x.dtype)
+    imgh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imgw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    boxes = jnp.stack(
+        [
+            (bx - bw / 2) * imgw,
+            (by - bh / 2) * imgh,
+            (bx + bw / 2) * imgw,
+            (by + bh / 2) * imgh,
+        ],
+        axis=-1,
+    )
+    boxes = boxes * mask[..., None]
+    boxes = boxes.reshape(n, na * h * w, 4)
+    scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2).reshape(
+        n, na * h * w, class_num
+    )
+    return {"Boxes": [boxes], "Scores": [scores]}

@@ -1,0 +1,252 @@
+"""Loss op lowerings (ref: paddle/fluid/operators/cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, squared_l2_distance, bce ops, hinge,
+huber, margin_rank, etc.)."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+def _squeeze_label(label):
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        return label[..., 0]
+    return label
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    soft = attrs.get("soft_label", False)
+    ignore = attrs.get("ignore_index", -100)
+    eps = 1e-12
+    if soft:
+        out = -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1, keepdims=True)
+    else:
+        lab = _squeeze_label(label).astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            x, lab[..., None].clip(0, x.shape[-1] - 1), axis=-1
+        )[..., 0]
+        out = -jnp.log(jnp.maximum(picked, eps))
+        out = jnp.where(lab == ignore, 0.0, out)
+        out = out[..., None]
+    return {"Y": [out]}
+
+
+@register_op("cross_entropy2")
+def _cross_entropy2(ctx, ins, attrs):
+    r = _cross_entropy(ctx, ins, attrs)
+    y = r["Y"][0]
+    return {"Y": [y], "XShape": [jnp.zeros((0,))], "MatchX": [y]}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_ce(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    soft = attrs.get("soft_label", False)
+    ignore = attrs.get("ignore_index", -100)
+    axis = attrs.get("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if soft:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = _squeeze_label(label).astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, lab[..., None].clip(0, logits.shape[axis] - 1), axis=axis
+        )[..., 0]
+        loss = -picked
+        loss = jnp.where(lab == ignore, 0.0, loss)
+        loss = loss[..., None]
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        cnt = jnp.sum((label != ignore).astype(loss.dtype))
+        loss = loss / jnp.maximum(cnt, 1.0)
+    return single(loss)
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = x - y
+    return single(d * d)
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = x - y
+    return {
+        "Out": [jnp.sum(d * d, axis=-1, keepdims=True)],
+        "sub_result": [d],
+    }
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    inw = ins["InsideWeight"][0] if ins.get("InsideWeight") else 1.0
+    outw = ins["OutsideWeight"][0] if ins.get("OutsideWeight") else 1.0
+    s2 = sigma * sigma
+    d = (x - y) * inw
+    ad = jnp.abs(d)
+    val = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    out = jnp.sum(val * outw, axis=tuple(range(1, x.ndim)))[:, None]
+    return {"Out": [out], "Diff": [d]}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": [out], "Residual": [r]}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2 * label - 1) * logits)]}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    label, left, right = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (left - right) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(out.dtype)]}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return single(jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register_op("bpr_loss")
+def _bpr_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    lab = _squeeze_label(label).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, lab[:, None], axis=-1)
+    diff = x - pos
+    loss = jnp.mean(
+        jnp.log1p(jnp.exp(diff)), axis=-1, keepdims=True
+    )
+    return {"Y": [loss]}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, ins, attrs):
+    pred, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    return {
+        "Loss": [
+            -label * jnp.log(pred + eps)
+            - (1 - label) * jnp.log(1 - pred + eps)
+        ]
+    }
+
+
+@register_op("kldiv_loss")
+def _kldiv_loss(ctx, ins, attrs):
+    x, target = ins["X"][0], ins["Target"][0]
+    red = attrs.get("reduction", "mean")
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    if red == "mean":
+        return {"Loss": [jnp.mean(loss)]}
+    if red == "sum":
+        return {"Loss": [jnp.sum(loss)]}
+    if red == "batchmean":
+        return {"Loss": [jnp.sum(loss) / x.shape[0]]}
+    return {"Loss": [loss]}
+
+
+@register_op("dice_loss")
+def _dice_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    label_oh = jax.nn.one_hot(_squeeze_label(label).astype(jnp.int32), x.shape[-1])
+    reduce_axes = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * label_oh, axis=reduce_axes)
+    union = jnp.sum(x, axis=reduce_axes) + jnp.sum(label_oh, axis=reduce_axes)
+    return single(jnp.mean(1 - (2 * inter + eps) / (union + eps)))
+
+
+@register_op("center_loss")
+def _center_loss(ctx, ins, attrs):
+    x, label, centers = ins["X"][0], ins["Label"][0], ins["Centers"][0]
+    alpha = ins["CenterUpdateRate"][0] if ins.get("CenterUpdateRate") else 0.5
+    lab = _squeeze_label(label).astype(jnp.int32)
+    picked = centers[lab]
+    diff = x - picked
+    loss = 0.5 * jnp.sum(diff * diff, axis=-1, keepdims=True)
+    if attrs.get("need_update", True):
+        counts = jnp.zeros((centers.shape[0],)).at[lab].add(1.0)
+        upd = jnp.zeros_like(centers).at[lab].add(diff)
+        new_centers = centers + alpha * upd / (counts[:, None] + 1.0)
+    else:
+        new_centers = centers
+    return {
+        "Loss": [loss],
+        "SampleCenterDiff": [diff],
+        "CentersOut": [new_centers],
+    }
+
+
+@register_op("npair_loss_helper")
+def _npair_dummy(ctx, ins, attrs):  # composed in python layer
+    raise NotImplementedError
+
+
+@register_op("mse_loss")
+def _mse_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return single(jnp.mean((x - y) ** 2))
+
+
+@register_op("sampled_softmax_with_cross_entropy")
+def _sampled_softmax_ce(ctx, ins, attrs):
+    """Sampled softmax (ref: sample_logits_op.cc). TPU-native: uniform
+    candidate sampling with log-q correction, static sample count."""
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    num_samples = attrs.get("num_samples", 64)
+    n_classes = logits.shape[-1]
+    lab = label.astype(jnp.int32)  # (batch, num_true)
+    samples = jax.random.randint(
+        ctx.next_rng(), (num_samples,), 0, n_classes
+    )
+    # gather true + sampled logits
+    true_logits = jnp.take_along_axis(logits, lab, axis=-1)
+    sampled_logits = logits[:, samples]
+    # remove accidental hits softly: subtract large where sample == label
+    hits = (samples[None, None, :] == lab[:, :, None]).any(axis=1)
+    sampled_logits = jnp.where(hits, -1e20, sampled_logits)
+    all_logits = jnp.concatenate([true_logits, sampled_logits], axis=-1)
+    logq = jnp.log(1.0 / n_classes)
+    all_logits = all_logits - logq
+    tgt = jnp.zeros(all_logits.shape[0], dtype=jnp.int32)
+    logp = jax.nn.log_softmax(all_logits, axis=-1)
+    loss = -jnp.take_along_axis(logp, tgt[:, None], axis=-1)
+    return {"Loss": [loss]}
+
+
+@register_op("teacher_student_sigmoid_loss")
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
+    z = jnp.clip(x, -soft_max_up, soft_max_up)
+    loss = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0.0) - z * label
+    return {"Y": [loss]}

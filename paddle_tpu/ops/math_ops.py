@@ -1,0 +1,287 @@
+"""Math op lowerings: elementwise, matmul, reductions, comparisons.
+
+Replaces the reference's elementwise_*_op.cc/cu, matmul_op, mul_op,
+reduce_*_op, scale_op, sum_op, clip_op, compare/logical ops
+(ref: paddle/fluid/operators/elementwise/*, matmul_op.cc, reduce_ops/*)
+with jax.numpy lowerings — XLA fuses the elementwise chains into the
+surrounding matmuls on TPU.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+def _broadcast_y(x, y, axis):
+    """Paddle elementwise broadcast: y aligns to x starting at `axis`
+    (axis=-1 → align trailing dims)."""
+    if x.shape == y.shape:
+        return y
+    if y.ndim == 0:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    # trim trailing size-1 dims of y that paddle allows (e.g. shape (N,1))
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1 and axis + len(yshape) > x.ndim:
+        yshape.pop()
+    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return y.reshape(new_shape)
+
+
+def _ew(fn):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        y = ins["Y"][0]
+        y = _broadcast_y(x, y, attrs.get("axis", -1))
+        return single(fn(x, y))
+
+    return lower
+
+
+register_op("elementwise_add")(_ew(jnp.add))
+register_op("elementwise_sub")(_ew(jnp.subtract))
+register_op("elementwise_mul")(_ew(jnp.multiply))
+register_op("elementwise_div")(_ew(jnp.divide))
+register_op("elementwise_max")(_ew(jnp.maximum))
+register_op("elementwise_min")(_ew(jnp.minimum))
+register_op("elementwise_pow")(_ew(jnp.power))
+register_op("elementwise_mod")(_ew(jnp.mod))
+register_op("elementwise_floordiv")(_ew(jnp.floor_divide))
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """Flattening matmul (ref: paddle/fluid/operators/mul_op.cc): x is
+    flattened to 2-D at x_num_col_dims, y at y_num_col_dims."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(_prod(xs[:xnc])), int(_prod(xs[xnc:]))))
+    y2 = y.reshape((int(_prod(ys[:ync])), int(_prod(ys[ync:]))))
+    out = x2 @ y2
+    out_shape = xs[:xnc] + ys[ync:]
+    return single(out.reshape(out_shape))
+
+
+def _prod(t):
+    r = 1
+    for v in t:
+        r *= int(v)
+    return r
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return single(out)
+
+
+def _reduce(fn, bool_out=False):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        dim = attrs.get("dim", None)
+        keep_dim = attrs.get("keep_dim", False)
+        reduce_all = attrs.get("reduce_all", False) or dim is None
+        if reduce_all:
+            axis = None
+        else:
+            axis = tuple(d if d >= 0 else d + x.ndim for d in dim)
+        out = fn(x, axis=axis, keepdims=keep_dim)
+        return single(out)
+
+    return lower
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+register_op("reduce_all")(_reduce(jnp.all))
+register_op("reduce_any")(_reduce(jnp.any))
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return single(jnp.mean(ins["X"][0]))
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0)
+    if ins.get("ScaleTensor"):
+        scale = ins["ScaleTensor"][0]
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    return single(out.astype(x.dtype) if hasattr(out, "astype") else out)
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return single(out)
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    x = ins["X"][0]
+    lo = ins["Min"][0] if ins.get("Min") else attrs["min"]
+    hi = ins["Max"][0] if ins.get("Max") else attrs["max"]
+    return single(jnp.clip(x, lo, hi))
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return single(x * scale.astype(x.dtype))
+
+
+def _cmp(fn):
+    def lower(ctx, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, attrs.get("axis", -1))
+        return single(fn(x, y))
+
+    return lower
+
+
+register_op("equal")(_cmp(jnp.equal))
+register_op("not_equal")(_cmp(jnp.not_equal))
+register_op("less_than")(_cmp(jnp.less))
+register_op("less_equal")(_cmp(jnp.less_equal))
+register_op("greater_than")(_cmp(jnp.greater))
+register_op("greater_equal")(_cmp(jnp.greater_equal))
+
+register_op("logical_and")(_cmp(jnp.logical_and))
+register_op("logical_or")(_cmp(jnp.logical_or))
+register_op("logical_xor")(_cmp(jnp.logical_xor))
+
+
+@register_op("logical_not")
+def _logical_not(ctx, ins, attrs):
+    return single(jnp.logical_not(ins["X"][0]))
+
+
+@register_op("isfinite")
+def _isfinite(ctx, ins, attrs):
+    return single(jnp.all(jnp.isfinite(ins["X"][0])))
+
+
+@register_op("abs")
+def _abs(ctx, ins, attrs):
+    return single(jnp.abs(ins["X"][0]))
+
+
+@register_op("sign")
+def _sign(ctx, ins, attrs):
+    return single(jnp.sign(ins["X"][0]))
+
+
+@register_op("pow")
+def _pow(ctx, ins, attrs):
+    x = ins["X"][0]
+    factor = ins["FactorTensor"][0] if ins.get("FactorTensor") else attrs.get("factor", 1.0)
+    return single(jnp.power(x, factor))
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-12)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    return {"Out": [x / jnp.maximum(norm, eps)], "Norm": [norm]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear(ctx, ins, attrs):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    # w: (size, dx, dy)
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return single(out)
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return single(jnp.sum(x * x).reshape(()))
+
+
+@register_op("frobenius_norm")
+def _frobenius_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    return single(jnp.sqrt(jnp.sum(x * x)))
+
+
+@register_op("kron")
+def _kron(ctx, ins, attrs):
+    return single(jnp.kron(ins["X"][0], ins["Y"][0]))
+
+
+@register_op("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return single(jnp.sum(x * y, axis=-1, keepdims=x.ndim > 1))
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    rev = attrs.get("reverse", False)
+    if rev:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad)[
+            tuple(
+                slice(0, -1) if i == (axis % x.ndim) else slice(None)
+                for i in range(x.ndim)
+            )
+        ]
+    if rev:
+        out = jnp.flip(out, axis)
+    return single(out)

@@ -1,0 +1,103 @@
+"""Metric op lowerings (ref: paddle/fluid/operators/metrics/accuracy_op.cc,
+auc_op.cc, precision_recall_op, mean_iou_op)."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+@register_op("accuracy")
+def _accuracy(ctx, ins, attrs):
+    pred_idx = ins["Indices"][0]  # (N, k) top-k indices
+    label = ins["Label"][0]
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label[:, 0]
+    hit = (pred_idx == label[:, None].astype(pred_idx.dtype)).any(axis=-1)
+    correct = jnp.sum(hit.astype(jnp.float32))
+    total = jnp.array(float(pred_idx.shape[0]), jnp.float32)
+    return {
+        "Accuracy": [correct / total],
+        "Correct": [correct.astype(jnp.int32)],
+        "Total": [total.astype(jnp.int32)],
+    }
+
+
+@register_op("auc")
+def _auc(ctx, ins, attrs):
+    """Streaming AUC with fixed histogram bins; stat tensors are persistable
+    state threaded through the step like batch-norm running stats."""
+    predict = ins["Predict"][0]
+    label = ins["Label"][0]
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    if label.ndim == 2:
+        label = label[:, 0]
+    pos_prob = predict[:, -1] if predict.ndim == 2 else predict
+    bins = jnp.clip(
+        (pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds
+    )
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bins].add(is_pos)
+    stat_neg = stat_neg.at[bins].add(1 - is_pos)
+    # AUC by trapezoid over thresholds (descending)
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1.0)
+    fpr = fp / jnp.maximum(tot_neg, 1.0)
+    auc = jnp.trapezoid(tpr, fpr)
+    return {
+        "AUC": [auc],
+        "StatPosOut": [stat_pos],
+        "StatNegOut": [stat_neg],
+    }
+
+
+@register_op("mean_iou")
+def _mean_iou(ctx, ins, attrs):
+    pred, label = ins["Predictions"][0], ins["Labels"][0]
+    n = attrs["num_classes"]
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    idx = label * n + pred
+    cm = jnp.zeros((n * n,), jnp.float32).at[idx].add(1.0).reshape(n, n)
+    inter = jnp.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    return {
+        "OutMeanIou": [miou],
+        "OutWrong": [(cm.sum(1) - inter).astype(jnp.int32)],
+        "OutCorrect": [inter.astype(jnp.int32)],
+    }
+
+
+@register_op("precision_recall")
+def _precision_recall(ctx, ins, attrs):
+    # simplified single-batch precision/recall per class
+    idx = ins["Indices"][0][:, 0]
+    label = ins["Labels"][0]
+    if label.ndim == 2:
+        label = label[:, 0]
+    n = attrs["class_number"]
+    idx = idx.astype(jnp.int32)
+    label = label.astype(jnp.int32)
+    tp = jnp.zeros((n,)).at[label].add((idx == label).astype(jnp.float32))
+    pred_cnt = jnp.zeros((n,)).at[idx].add(1.0)
+    lab_cnt = jnp.zeros((n,)).at[label].add(1.0)
+    precision = tp / jnp.maximum(pred_cnt, 1.0)
+    recall = tp / jnp.maximum(lab_cnt, 1.0)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-6)
+    metrics = jnp.stack(
+        [precision.mean(), recall.mean(), f1.mean(),
+         precision.mean(), recall.mean(), f1.mean()]
+    )
+    return {
+        "BatchMetrics": [metrics],
+        "AccumMetrics": [metrics],
+        "AccumStatesInfo": [jnp.stack([tp, pred_cnt - tp, lab_cnt - tp], axis=1)],
+    }

@@ -1,0 +1,302 @@
+"""Misc op lowerings: CTC, NCE, hierarchical sigmoid, row_conv, unfold,
+shard_index, hash, cvm, fsp (ref: paddle/fluid/operators/{warpctc_op,nce_op,
+hierarchical_sigmoid_op,row_conv_op,unfold_op,shard_index_op,hash_op,cvm_op,
+fsp_op}.*)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+@register_op("isinf_any")
+def _isinf_any(ctx, ins, attrs):
+    return single(jnp.any(jnp.isinf(ins["X"][0])))
+
+
+@register_op("isnan_any")
+def _isnan_any(ctx, ins, attrs):
+    return single(jnp.any(jnp.isnan(ins["X"][0])))
+
+
+@register_op("shard_index")
+def _shard_index(ctx, ins, attrs):
+    x = ins["X"][0]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return single(jnp.where(in_shard, x % shard_size, ignore_value))
+
+
+@register_op("hash")
+def _hash(ctx, ins, attrs):
+    x = ins["X"][0].astype(jnp.uint32)
+    mod_by = attrs["mod_by"]
+    num_hash = attrs.get("num_hash", 1)
+    outs = []
+    for i in range(num_hash):
+        h = (x * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9 * (i + 1)))
+        h = h ^ (h >> 16)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    out = jnp.stack(outs, axis=-2) if num_hash > 1 else outs[0]
+    return single(out)
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution over (B, T, D) with future context window."""
+    x, w = ins["X"][0], ins["Filter"][0]  # w: (ctx+1, D)
+    k = w.shape[0]
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        shifted = jnp.pad(x[:, i:, :], ((0, 0), (0, i), (0, 0)))
+        out = out + shifted * w[i][None, None, :]
+    return single(out)
+
+
+@register_op("unfold")
+def _unfold(ctx, ins, attrs):
+    x = ins["X"][0]
+    ks = attrs["kernel_sizes"]
+    st = attrs["strides"]
+    pd = attrs["paddings"]
+    dl = attrs["dilations"]
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=tuple(ks),
+        window_strides=tuple(st),
+        padding=[(pd[0], pd[0]), (pd[1], pd[1])] if len(pd) == 2 else [(pd[0], pd[1]), (pd[2], pd[3])],
+        rhs_dilation=tuple(dl),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    np_, cp, hp, wp = patches.shape
+    return {"Y": [patches.reshape(np_, cp, hp * wp)]}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    x = ins["X"][0]
+    ks = attrs["kernels"]
+    st = attrs["strides"]
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=tuple(ks),
+        window_strides=tuple(st),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    n, cp, hp, wp = patches.shape
+    return single(
+        jnp.moveaxis(patches.reshape(n, cp, hp * wp), 1, 2).reshape(-1, cp)
+    )
+
+
+@register_op("cvm")
+def _cvm(ctx, ins, attrs):
+    x = ins["X"][0]
+    if attrs.get("use_cvm", True):
+        return {"Y": [x]}
+    return {"Y": [x[:, 2:]]}
+
+
+@register_op("fsp")
+def _fsp(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    n, cx = x.shape[0], x.shape[1]
+    cy = y.shape[1]
+    hw = x.shape[2] * x.shape[3]
+    xf = x.reshape(n, cx, hw)
+    yf = y.reshape(n, cy, hw)
+    return single(jnp.einsum("nch,ndh->ncd", xf, yf) / hw)
+
+
+@register_op("nce")
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation with uniform negative sampling."""
+    x = ins["Input"][0]          # (B, D)
+    label = ins["Label"][0]      # (B, num_true)
+    w = ins["Weight"][0]         # (C, D)
+    b = ins["Bias"][0] if ins.get("Bias") else None  # (C, 1)
+    num_neg = attrs.get("num_neg_samples", 10)
+    n_classes = attrs["num_total_classes"]
+    lab = label.astype(jnp.int32)
+    if lab.ndim == 1:
+        lab = lab[:, None]
+    neg = jax.random.randint(ctx.next_rng(), (num_neg,), 0, n_classes)
+
+    def score(ids):  # ids (..,) -> logits
+        s = jnp.einsum("bd,...d->b...", x, w[ids])
+        if b is not None:
+            s = s + b[ids, 0]
+        return s
+
+    true_logit = jnp.sum(x * w[lab[:, 0]], axis=-1)
+    if b is not None:
+        true_logit = true_logit + b[lab[:, 0], 0]
+    neg_logit = x @ w[neg].T
+    if b is not None:
+        neg_logit = neg_logit + b[neg, 0][None, :]
+    logq = jnp.log(num_neg / n_classes)
+    pos_loss = jax.nn.softplus(-(true_logit - logq))
+    neg_loss = jnp.sum(jax.nn.softplus(neg_logit - logq), axis=-1)
+    return {"Cost": [(pos_loss + neg_loss)[:, None]]}
+
+
+@register_op("hierarchical_sigmoid")
+def _hsigmoid(ctx, ins, attrs):
+    """Default complete-binary-tree hierarchical sigmoid."""
+    x = ins["X"][0]          # (B, D)
+    label = ins["Label"][0]  # (B, 1)
+    w = ins["W"][0]          # (C-1, D)
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    num_classes = attrs["num_classes"]
+    depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+    lab = label.astype(jnp.int32)
+    if lab.ndim == 2:
+        lab = lab[:, 0]
+    # complete binary tree: internal node ids along the path to leaf `lab`
+    loss = jnp.zeros(x.shape[0], x.dtype)
+    node = jnp.ones_like(lab)  # root = 1 (1-indexed heap order)
+    code = lab + num_classes   # leaf position in heap
+    # walk from leaf up: bits of (lab + C) below the msb give directions
+    for d in range(depth, 0, -1):
+        parent = code >> d
+        bit = (code >> (d - 1)) & 1
+        nid = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+        valid = parent >= 1
+        logit = jnp.sum(x * w[nid], axis=-1)
+        if b is not None:
+            logit = logit + b[nid, 0]
+        # bit==1 → go right (target 1), else 0
+        step_loss = jax.nn.softplus(jnp.where(bit == 1, -logit, logit))
+        loss = loss + jnp.where(valid, step_loss, 0.0)
+    return {"Out": [loss[:, None]]}
+
+
+@register_op("warpctc")
+def _warpctc(ctx, ins, attrs):
+    """CTC loss, dense log-domain forward algorithm via lax.scan
+    (TPU-native replacement for the warp-ctc CUDA kernel).
+
+    Logits: (B, T, C) padded; Label: (B, L) padded with `blank`;
+    LogitsLength/LabelLength: (B,) int. Output: (B, 1) loss.
+    """
+    logits = ins["Logits"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    blank = attrs.get("blank", 0)
+    B = logits.shape[0] if logits.ndim == 3 else 1
+    if logits.ndim == 2:
+        logits = logits[None]
+        label = label[None] if label.ndim == 1 else label
+    T = logits.shape[1]
+    L = label.shape[1]
+    logits_len = (
+        ins["LogitsLength"][0].astype(jnp.int32)
+        if ins.get("LogitsLength")
+        else jnp.full((B,), T, jnp.int32)
+    )
+    label_len = (
+        ins["LabelLength"][0].astype(jnp.int32)
+        if ins.get("LabelLength")
+        else jnp.sum((label != blank).astype(jnp.int32), axis=1)
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    NEG = -1e30
+
+    # extended label: blank, l1, blank, l2, ..., blank  (length S = 2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    pos = jnp.arange(S)[None, :]
+    valid_ext = pos < (2 * label_len[:, None] + 1)
+    # allowed skip: ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != ext_m2) & (pos >= 2)
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    first_lab = jnp.take_along_axis(
+        logp[:, 0, :], ext[:, 1:2].clip(0), axis=-1
+    )[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_len > 0, first_lab, NEG))
+
+    def step(alpha, t):
+        a_prev = alpha
+        a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=NEG)[:, :S]
+        a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=NEG)[:, :S]
+        a_m2 = jnp.where(can_skip, a_m2, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_m1), a_m2)
+        emit = jnp.take_along_axis(logp[:, t, :], ext.clip(0), axis=-1)
+        new_alpha = merged + emit
+        new_alpha = jnp.where(valid_ext, new_alpha, NEG)
+        # freeze past logits_len
+        new_alpha = jnp.where((t < logits_len)[:, None], new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = 2 * label_len - 1
+    end2 = 2 * label_len
+    a1 = jnp.take_along_axis(alpha, end1.clip(0)[:, None], axis=1)[:, 0]
+    a1 = jnp.where(label_len > 0, a1, NEG)
+    a2 = jnp.take_along_axis(alpha, end2[:, None], axis=1)[:, 0]
+    loss = -jnp.logaddexp(a1, a2)
+    return {"Loss": [loss[:, None]]}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    x = ins["X"][0]
+    b = attrs["blocksize"]
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return single(x.reshape(n, c * b * b, h // b, w // b))
+
+
+@register_op("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    layout = attrs.get("data_layout", "NCHW")
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+    out = x
+    if ins.get("Scale"):
+        out = out * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(bshape)
+    return single(out)
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """Single GRU step (ref: paddle/fluid/operators/gru_unit_op.cc).
+    Input: (B, 3D) projected input; Weight: (D, 3D) with gate weights in
+    the first 2D columns and candidate weights in the last D."""
+    x = ins["Input"][0]            # (B, 3D)
+    h_prev = ins["HiddenPrev"][0]  # (B, D)
+    w = ins["Weight"][0]           # (D, 3D)
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    d = h_prev.shape[-1]
+    origin_mode = attrs.get("origin_mode", False)
+    gate_act = attrs.get("gate_activation", "sigmoid")
+    act = attrs.get("activation", "tanh")
+    if b is not None:
+        x = x + b.reshape((1, 3 * d))
+    gates = x[:, : 2 * d] + h_prev @ w[:, : 2 * d]
+    gact = jax.nn.sigmoid if gate_act == "sigmoid" else jnp.tanh
+    cact = jnp.tanh if act == "tanh" else jax.nn.relu
+    u = gact(gates[:, :d])
+    r = gact(gates[:, d : 2 * d])
+    reset_h = r * h_prev
+    c = cact(x[:, 2 * d :] + reset_h @ w[:, 2 * d :])
+    if origin_mode:
+        h = u * h_prev + (1 - u) * c
+    else:
+        h = (1 - u) * h_prev + u * c
+    return {"Hidden": [h], "ResetHiddenPrev": [reset_h], "Gate": [gates]}

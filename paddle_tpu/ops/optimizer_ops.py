@@ -1,0 +1,242 @@
+"""Optimizer update op lowerings.
+
+Replaces sgd_op, momentum_op, adagrad_op, adam_op, adamax_op, rmsprop_op,
+adadelta_op, ftrl_op, lamb_op, lars_momentum_op, decayed_adagrad_op,
+dpsgd_op (ref: paddle/fluid/operators/optimizers/*). These are ordinary ops
+in the Program, so the whole update fuses into the one jitted train step and
+parameters update in-place in HBM via buffer donation.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, single
+
+
+@register_op("sgd")
+def _sgd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [p - lr.astype(p.dtype) * g.astype(p.dtype)]}
+
+
+@register_op("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v, lr = (
+        ins["Param"][0],
+        ins["Grad"][0],
+        ins["Velocity"][0],
+        ins["LearningRate"][0],
+    )
+    mu = attrs.get("mu", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    g = g.astype(p.dtype)
+    lr = lr.astype(p.dtype)
+    v_new = mu * v + g
+    if use_nesterov:
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op("lars_momentum")
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v, lr = (
+        ins["Param"][0],
+        ins["Grad"][0],
+        ins["Velocity"][0],
+        ins["LearningRate"][0],
+    )
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    pn = jnp.sqrt(jnp.sum(p * p))
+    gn = jnp.sqrt(jnp.sum(g * g))
+    local_lr = jnp.where(
+        (pn > 0) & (gn > 0),
+        lr * coeff * pn / (gn + decay * pn + 1e-12),
+        lr,
+    )
+    v_new = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_new], "VelocityOut": [v_new]}
+
+
+@register_op("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, m, lr = (
+        ins["Param"][0],
+        ins["Grad"][0],
+        ins["Moment"][0],
+        ins["LearningRate"][0],
+    )
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = m + g * g
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": [p_new], "MomentOut": [m_new]}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m, lr = (
+        ins["Param"][0],
+        ins["Grad"][0],
+        ins["Moment"][0],
+        ins["LearningRate"][0],
+    )
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * g * g
+    return {
+        "ParamOut": [p - lr * g / (jnp.sqrt(m_new) + eps)],
+        "MomentOut": [m_new],
+    }
+
+
+@register_op("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_grad = ins["AvgSquaredGrad"][0]
+    avg_sq_upd = ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_grad + (1 - rho) * g * g
+    upd = -jnp.sqrt((avg_sq_upd + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_upd + (1 - rho) * upd * upd
+    return {
+        "ParamOut": [p + upd],
+        "AvgSquaredGradOut": [g2],
+        "AvgSquaredUpdateOut": [u2],
+    }
+
+
+def _adam_core(p, g, m, v, beta1_pow, beta2_pow, lr, beta1, beta2, eps):
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    lr_t = lr * jnp.sqrt(1 - beta2_pow) / (1 - beta1_pow)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return p_new, m_new, v_new
+
+
+@register_op("adam")
+def _adam(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    cdtype = jnp.float32
+    pf = p.astype(cdtype)
+    p_new, m_new, v_new = _adam_core(
+        pf, g.astype(cdtype), m, v, b1p, b2p, lr, beta1, beta2, eps
+    )
+    return {
+        "ParamOut": [p_new.astype(p.dtype)],
+        "Moment1Out": [m_new],
+        "Moment2Out": [v_new],
+        "Beta1PowOut": [b1p * beta1],
+        "Beta2PowOut": [b2p * beta2],
+    }
+
+
+@register_op("adamax")
+def _adamax(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m, inf_norm = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = beta1 * m + (1 - beta1) * g
+    inf_new = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    p_new = p - (lr / (1 - b1p)) * m_new / (inf_new + eps)
+    return {
+        "ParamOut": [p_new],
+        "MomentOut": [m_new],
+        "InfNormOut": [inf_new],
+    }
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    ms = ins["MeanSquare"][0]
+    mom = ins["Moment"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    ms_new = rho * ms + (1 - rho) * g * g
+    if centered:
+        mg = ins["MeanGrad"][0]
+        mg_new = rho * mg + (1 - rho) * g
+        denom = ms_new - mg_new * mg_new + eps
+    else:
+        mg_new = None
+        denom = ms_new + eps
+    mom_new = momentum * mom + lr * g / jnp.sqrt(denom)
+    out = {
+        "ParamOut": [p - mom_new],
+        "MeanSquareOut": [ms_new],
+        "MomentOut": [mom_new],
+    }
+    if centered:
+        out["MeanGradOut"] = [mg_new]
+    return out
+
+
+@register_op("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    new_sq = sq + g * g
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    quad = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre_shrink = (l1 * jnp.sign(new_lin) - new_lin) / quad
+    p_new = jnp.where(jnp.abs(new_lin) > l1, pre_shrink, 0.0)
+    return {
+        "ParamOut": [p_new],
+        "SquaredAccumOut": [new_sq],
+        "LinearAccumOut": [new_lin],
+    }
+
+
+@register_op("lamb")
+def _lamb(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    m_hat = m_new / (1 - b1p)
+    v_hat = v_new / (1 - b2p)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return {
+        "ParamOut": [p - lr * trust * r],
+        "Moment1Out": [m_new],
+        "Moment2Out": [v_new],
+        "Beta1PowOut": [b1p * beta1],
+        "Beta2PowOut": [b2p * beta2],
+    }
+
+
+@register_op("dpsgd")
+def _dpsgd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    gn = jnp.sqrt(jnp.sum(g * g))
+    g = g * jnp.minimum(1.0, clip / jnp.maximum(gn, 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.next_rng(), g.shape)
+    return {"ParamOut": [p - lr * (g + noise / batch_size)]}

@@ -1,0 +1,151 @@
+"""Random op lowerings (ref: uniform_random_op.cc, gaussian_random_op.cc,
+truncated_gaussian_random_op.cc, randint_op, sampling_id_op, randperm_op,
+random_crop_op). All draw from the LowerContext's threaded PRNG key — the
+fork-in counter makes every trace site deterministic given the step key."""
+import jax
+import jax.numpy as jnp
+
+from ..fluid import core
+from .registry import register_op, single
+
+
+def _dtype(attrs, default="float32"):
+    return core.np_dtype(core.convert_dtype(attrs.get("dtype", default)))
+
+
+def _shape(ins, attrs):
+    if ins.get("ShapeTensor"):
+        return tuple(int(v) for v in ins["ShapeTensor"])
+    return tuple(int(s) for s in attrs["shape"])
+
+
+@register_op("uniform_random")
+def _uniform_random(ctx, ins, attrs):
+    shape = _shape(ins, attrs)
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    return single(
+        jax.random.uniform(
+            ctx.next_rng(), shape, minval=lo, maxval=hi
+        ).astype(_dtype(attrs))
+    )
+
+
+@register_op("uniform_random_batch_size_like")
+def _uniform_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[
+        attrs.get("input_dim_idx", 0)
+    ]
+    return single(
+        jax.random.uniform(
+            ctx.next_rng(),
+            tuple(shape),
+            minval=attrs.get("min", -1.0),
+            maxval=attrs.get("max", 1.0),
+        ).astype(_dtype(attrs))
+    )
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx, ins, attrs):
+    shape = _shape(ins, attrs)
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    return single(
+        (mean + std * jax.random.normal(ctx.next_rng(), shape)).astype(
+            _dtype(attrs)
+        )
+    )
+
+
+@register_op("gaussian_random_batch_size_like")
+def _gaussian_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[
+        attrs.get("input_dim_idx", 0)
+    ]
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    return single(
+        (mean + std * jax.random.normal(ctx.next_rng(), tuple(shape))).astype(
+            _dtype(attrs)
+        )
+    )
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = _shape(ins, attrs)
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = jax.random.truncated_normal(ctx.next_rng(), -2.0, 2.0, shape)
+    return single((mean + std * out).astype(_dtype(attrs)))
+
+
+@register_op("randint")
+def _randint(ctx, ins, attrs):
+    shape = _shape(ins, attrs)
+    return single(
+        jax.random.randint(
+            ctx.next_rng(), shape, attrs.get("low", 0), attrs.get("high", 100)
+        ).astype(_dtype(attrs, "int64"))
+    )
+
+
+@register_op("randperm")
+def _randperm(ctx, ins, attrs):
+    n = attrs["n"]
+    return single(
+        jax.random.permutation(ctx.next_rng(), n).astype(_dtype(attrs, "int64"))
+    )
+
+
+@register_op("sampling_id")
+def _sampling_id(ctx, ins, attrs):
+    x = ins["X"][0]
+    idx = jax.random.categorical(ctx.next_rng(), jnp.log(jnp.maximum(x, 1e-20)))
+    return single(idx.astype(jnp.int64))
+
+
+@register_op("multinomial")
+def _multinomial(ctx, ins, attrs):
+    x = ins["X"][0]
+    num = attrs.get("num_samples", 1)
+    logits = jnp.log(jnp.maximum(x, 1e-20))
+    out = jax.random.categorical(ctx.next_rng(), logits, shape=(num,) + x.shape[:-1])
+    return single(jnp.moveaxis(out, 0, -1).astype(jnp.int64))
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    return single(
+        x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+    )
+
+
+@register_op("random_crop")
+def _random_crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = attrs["shape"]
+    # crop trailing len(shape) dims to `shape` at a random offset
+    k = len(shape)
+    key = ctx.next_rng()
+    starts = []
+    for i, s in enumerate(shape):
+        dim = x.shape[x.ndim - k + i]
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, dim - s + 1))
+    idx = [slice(None)] * (x.ndim - k)
+    start_full = [0] * (x.ndim - k) + [int(0)] * k
+    # dynamic_slice for traced starts
+    from jax import lax
+
+    starts_full = [jnp.array(0)] * (x.ndim - k) + starts
+    sizes = list(x.shape[: x.ndim - k]) + list(shape)
+    return single(lax.dynamic_slice(x, starts_full, sizes))

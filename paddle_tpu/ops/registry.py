@@ -1,0 +1,78 @@
+"""Op lowering registry.
+
+TPU-native replacement for the reference's per-device kernel registry
+(ref: paddle/fluid/framework/op_registry.h + ~581 kernels under
+paddle/fluid/operators/). Each op type maps to ONE lowering function written
+in jax/lax — XLA generates the TPU kernels, fuses across ops, and autodiff
+comes from jax.vjp over the lowered region instead of hand-written grad
+kernels.
+
+Lowering signature::
+
+    def lower(ctx, ins, attrs) -> {output_slot: [jax values]}
+
+``ins`` maps input slot -> list of jax values (missing optional slots are
+empty lists). ``ctx`` is a LowerContext carrying PRNG state, train/test mode
+and the mesh axis environment for collective ops.
+"""
+import jax
+
+LOWERINGS = {}
+
+
+def register_op(name):
+    def deco(fn):
+        if name in LOWERINGS:
+            raise ValueError("op %s registered twice" % name)
+        LOWERINGS[name] = fn
+        return fn
+
+    return deco
+
+
+def get_lowering(op_type):
+    fn = LOWERINGS.get(op_type)
+    if fn is None:
+        raise NotImplementedError(
+            "no TPU lowering registered for op '%s' (registered: %d ops)"
+            % (op_type, len(LOWERINGS))
+        )
+    return fn
+
+
+def has_lowering(op_type):
+    return op_type in LOWERINGS
+
+
+class LowerContext:
+    """Carries trace-time state through a block lowering."""
+
+    def __init__(self, rng=None, is_test=False, mesh_axes=None, program=None):
+        self._rng = rng
+        self._rng_count = 0
+        self._op_tag = 0
+        self.is_test = is_test
+        self.mesh_axes = mesh_axes or {}  # logical axis name -> mesh axis
+        self.program = program
+
+    def set_op_tag(self, tag):
+        """Key PRNG draws by op position so a vjp replay of the same op
+        reproduces identical randomness (dropout masks etc.)."""
+        self._op_tag = int(tag)
+        self._rng_count = 0
+
+    def next_rng(self):
+        """Deterministic per-(op, draw) PRNG key derived from the step key."""
+        if self._rng is None:
+            raise RuntimeError(
+                "op requires randomness but no PRNG key was provided"
+            )
+        self._rng_count += 1
+        return jax.random.fold_in(
+            self._rng, (self._op_tag << 10) + self._rng_count
+        )
+
+
+def single(val):
+    """Helper: wrap a single output value for the conventional 'Out' slot."""
+    return {"Out": [val]}

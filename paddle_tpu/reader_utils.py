@@ -1,0 +1,144 @@
+"""Reader decorators (ref: python/paddle/reader/decorator.py +
+python/paddle/batch.py)."""
+import itertools
+import random
+
+__all__ = ["batch", "shuffle", "buffered", "map_readers", "chain", "compose",
+           "firstn", "xmap_readers", "cache"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    if batch_size <= 0:
+        raise ValueError("batch_size should be positive")
+    return batch_reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def buffered(reader, size):
+    import queue
+    import threading
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+
+        def _fill():
+            for d in r:
+                q.put(d)
+            q.put(_End)
+
+        t = threading.Thread(target=_fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for item in r():
+                yield item
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                yield sum(
+                    list(map(make_tuple, [o for o in outputs if o is not None])),
+                    (),
+                )
+
+    return reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    # thread pool map (host-side preprocessing off the main thread)
+    import concurrent.futures
+
+    def data_reader():
+        with concurrent.futures.ThreadPoolExecutor(process_num) as pool:
+            for out in pool.map(mapper, reader()):
+                yield out
+
+    return data_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def cache_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        for d in all_data:
+            yield d
+
+    return cache_reader

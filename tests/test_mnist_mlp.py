@@ -1,0 +1,74 @@
+"""End-to-end slice: fluid program -> executor -> SGD training on MNIST MLP
+(mirrors the reference book chapter / test_recognize_digits)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def _build_mlp():
+    img = fluid.data(name="img", shape=[784], dtype="float32")
+    label = fluid.data(name="label", shape=[1], dtype="int64")
+    h1 = fluid.layers.fc(input=img, size=64, act="relu")
+    h2 = fluid.layers.fc(input=h1, size=64, act="relu")
+    logits = fluid.layers.fc(input=h2, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(
+        input=fluid.layers.softmax(logits), label=label
+    )
+    return img, label, avg_loss, acc
+
+
+def test_mnist_mlp_trains():
+    startup = fluid.default_startup_program()
+    startup.random_seed = 42
+    img, label, avg_loss, acc = _build_mlp()
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(avg_loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+
+    train_reader = paddle.batch(
+        paddle.dataset.mnist.train(), batch_size=64, drop_last=True
+    )
+    feeder = fluid.DataFeeder(feed_list=[img, label], place=place)
+
+    losses = []
+    accs = []
+    for step, batch in enumerate(train_reader()):
+        feed = feeder.feed([(x, [y]) for x, y in batch])
+        loss_v, acc_v = exe.run(
+            fluid.default_main_program(),
+            feed=feed,
+            fetch_list=[avg_loss, acc],
+        )
+        losses.append(float(loss_v))
+        accs.append(float(acc_v))
+        if step >= 60:
+            break
+
+    assert losses[0] > 1.5, "initial loss should be ~ln(10)"
+    assert np.mean(losses[-10:]) < np.mean(losses[:5]) * 0.7, (
+        "loss did not drop: first=%s last=%s" % (losses[:5], losses[-10:])
+    )
+    assert np.mean(accs[-10:]) > 0.6, "accuracy should learn the synthetic signal"
+
+
+def test_executor_cache_and_state_persistence():
+    startup = fluid.default_startup_program()
+    startup.random_seed = 1
+    x = fluid.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=3)
+    loss = fluid.layers.mean(y)
+    opt = fluid.optimizer.SGD(learning_rate=0.5)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.ones((2, 4), dtype="float32")}
+    l1 = exe.run(feed=feed, fetch_list=[loss])[0]
+    l2 = exe.run(feed=feed, fetch_list=[loss])[0]
+    # params were updated by SGD between runs, loss must change
+    assert not np.allclose(l1, l2)
