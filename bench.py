@@ -1,0 +1,100 @@
+"""Headline benchmark: BERT-base MLM pretraining throughput, tokens/sec/chip
+(matches BASELINE.json: "BERT-base tokens/sec/chip").
+
+Runs the full framework path — fluid Program -> single-XLA-module train step
+(vjp backward + Adam) in bf16 compute — on whatever accelerator jax exposes
+(the real TPU chip under the driver; CPU locally).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+vs_baseline denominator: the reference stack's published-era BERT-base
+single-GPU training throughput on V100 (fp32/amp mixed era) ≈ 5300
+tokens/sec (batch 32 × seq 128 at ~1.3 steps/s). BASELINE.json carries no
+published number, so this documented constant is the comparison point.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+V100_BASELINE_TOKENS_PER_SEC = 5300.0
+
+
+def main():
+    t_setup = time.time()
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.models import bert
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 7
+    fluid.default_main_program().random_seed = 7
+
+    backend = jax.devices()[0].platform
+    on_accel = backend != "cpu"
+    cfg = bert.bert_base() if on_accel else bert.bert_tiny()
+    seq = 128 if on_accel else 64
+    batch = 32 if on_accel else 8
+
+    vs = bert.build_bert_pretrain(cfg, seq)
+    opt = fluid.optimizer.Adam(learning_rate=1e-4)
+    if on_accel:
+        from paddle_tpu.fluid.contrib.mixed_precision import decorate
+
+        opt = decorate(opt, use_bf16=True)
+    opt.minimize(vs["loss"])
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    ids, labels = bert.synthetic_batch(cfg, batch, seq)
+    feed = {"input_ids": ids, "mlm_labels": labels}
+    fetch = [vs["loss"]]
+
+    # warmup (compile)
+    t0 = time.time()
+    loss0 = float(exe.run(feed=feed, fetch_list=fetch)[0])
+    compile_s = time.time() - t0
+
+    # timed steps
+    n_steps = 30 if on_accel else 5
+    t0 = time.time()
+    for _ in range(n_steps):
+        out = exe.run(feed=feed, fetch_list=fetch)
+    # out fetch forces sync
+    last = float(out[0])
+    dt = time.time() - t0
+    tokens_per_sec = n_steps * batch * seq / dt
+
+    result = {
+        "metric": "bert_base_pretrain_throughput" if on_accel
+        else "bert_tiny_pretrain_throughput_cpu",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(
+            tokens_per_sec / V100_BASELINE_TOKENS_PER_SEC, 3
+        ),
+        "detail": {
+            "backend": backend,
+            "batch": batch,
+            "seq_len": seq,
+            "steps": n_steps,
+            "step_ms": round(1000 * dt / n_steps, 2),
+            "compile_s": round(compile_s, 1),
+            "loss_first": round(loss0, 4),
+            "loss_last": round(last, 4),
+            "setup_s": round(t0 - t_setup, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -14,12 +14,16 @@ from .. import core
 from ... import ops as ops_lib
 from ...ops.registry import LowerContext, get_lowering
 
-_eager_rng = [jax.random.PRNGKey(0)]
+# lazy: creating a PRNGKey initializes the jax backend, which must not
+# happen at import time (the TPU tunnel may be busy or absent)
+_eager_rng = [None]
 _rng_counter = [0]
 _train_mode = [True]
 
 
 def _next_eager_rng():
+    if _eager_rng[0] is None:
+        _eager_rng[0] = jax.random.PRNGKey(0)
     _rng_counter[0] += 1
     return jax.random.fold_in(_eager_rng[0], _rng_counter[0])
 

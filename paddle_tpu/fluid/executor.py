@@ -172,6 +172,21 @@ class Executor:
     def _prepare_feeds(self, program, feed):
         block = program.global_block()
         out = {}
+        feed = dict(feed)
+        # LoDTensor feeds expand into (padded array, @SEQ_LEN lengths);
+        # plain-array feeds of lod_level>0 vars default to full lengths
+        for name in list(feed.keys()):
+            v = feed[name]
+            seq_name = name + "@SEQ_LEN"
+            if not block.has_var(seq_name) or seq_name in feed:
+                continue
+            if getattr(v, "seq_lens", None) is not None:
+                feed[seq_name] = np.asarray(v.seq_lens, dtype="int32")
+            else:
+                arr = np.asarray(getattr(v, "_ndarray", v))
+                feed[seq_name] = np.full(
+                    (arr.shape[0],), arr.shape[1], dtype="int32"
+                )
         for name, value in feed.items():
             value = getattr(value, "_ndarray", value)  # LoDTensor shim
             arr = np.asarray(value)

@@ -13,6 +13,13 @@ from . import metric_op
 from .metric_op import *  # noqa: F401,F403
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import *  # noqa: F401,F403
+from . import control_flow
+from .control_flow import *  # noqa: F401,F403
+from . import sequence_lod
+from .sequence_lod import *  # noqa: F401,F403
+from . import rnn
+from .rnn import *  # noqa: F401,F403
+from . import collective  # noqa: F401
 from . import math_op_patch
 
 math_op_patch.monkey_patch_variable()
@@ -25,3 +32,6 @@ __all__ += ops.__all__
 __all__ += loss.__all__
 __all__ += metric_op.__all__
 __all__ += learning_rate_scheduler.__all__
+__all__ += control_flow.__all__
+__all__ += sequence_lod.__all__
+__all__ += rnn.__all__

@@ -19,7 +19,8 @@ def data(
     helper_shape = list(shape)
     if append_batch_size:
         helper_shape = [-1] + helper_shape
-    main = default_main_program().current_block().create_var(
+    block = default_main_program().current_block()
+    main = block.create_var(
         name=name,
         shape=helper_shape,
         dtype=dtype,
@@ -29,4 +30,15 @@ def data(
         is_data=True,
         need_check_feed=True,
     )
+    if lod_level and lod_level > 0:
+        # TPU-native LoD: sequences are fed dense-padded with a companion
+        # per-row length vector (see fluid/lod.py); sequence_* layers wire
+        # this var into their SeqLen slot.
+        block.create_var(
+            name=name + "@SEQ_LEN",
+            shape=[-1],
+            dtype="int32",
+            stop_gradient=True,
+            is_data=True,
+        )
     return main

@@ -83,6 +83,8 @@ def apply_op(op, env, ctx, var_lookup, op_tag=0):
     fn = get_lowering(op.type)
     ins = resolve_inputs(op, env)
     ctx.set_op_tag(op_tag)
+    ctx.current_env = env  # control-flow ops close over the outer env
+    ctx.run_ops = run_ops
     try:
         outs = fn(ctx, ins, op.attrs)
     except (OpLoweringError, NotImplementedError):
@@ -104,11 +106,14 @@ def run_ops(block, op_list, env, ctx):
     replay reproduces identical random draws (dropout masks etc.) and XLA
     CSE collapses the duplicated subgraph."""
     var_lookup = _make_var_lookup(block)
+    # tag ops uniquely across blocks so sub-block PRNG keys don't collide
+    # with outer-block keys (keys also fold in ctx._iter_token inside loops)
+    tag_base = block.idx * 100003
     env0 = dict(env)  # initial state+feeds — replay starts here
     cached_grads = {}  # grads from earlier backward ops, replayed as consts
     for idx, op in enumerate(op_list):
         if op.type != "backward":
-            env = apply_op(op, env, ctx, var_lookup, op_tag=idx)
+            env = apply_op(op, env, ctx, var_lookup, op_tag=tag_base + idx)
             continue
         bw_op = op
         target_names = bw_op.attrs["targets"]
@@ -136,7 +141,7 @@ def run_ops(block, op_list, env, ctx):
                     for gn in rop.output("Grads"):
                         e[gn] = lax.stop_gradient(cached_grads[gn])
                     continue
-                e = apply_op(rop, e, ctx, var_lookup, op_tag=j)
+                e = apply_op(rop, e, ctx, var_lookup, op_tag=tag_base + j)
             return e[_ln], e
 
         (loss_val, vjp_fn, env) = jax.vjp(fwd, primals, has_aux=True)

@@ -1,0 +1,3 @@
+"""Model zoo matching the reference's benchmark configs (BASELINE.json):
+MNIST MLP, ResNet-50, BERT-base, Transformer NMT, Wide&Deep CTR — all built
+through the paddle_tpu.fluid layer API so they exercise the framework."""

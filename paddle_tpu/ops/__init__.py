@@ -15,11 +15,12 @@ from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from . import control_ops  # noqa: F401
+from . import collective_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
 
 
 def _register_late_modules():
-    """Modules that depend on fluid internals import lazily to avoid cycles."""
-    from . import sequence_ops  # noqa: F401
-    from . import control_ops  # noqa: F401
-    from . import collective_ops  # noqa: F401
-    from . import detection_ops  # noqa: F401
+    """All op modules are imported eagerly above; kept for compatibility."""

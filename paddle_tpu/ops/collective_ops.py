@@ -1,1 +1,173 @@
-"""Placeholder — filled in as the subsystem lands."""
+"""Collective op lowerings.
+
+Replaces the reference's NCCL collective kernels
+(ref: paddle/fluid/operators/collective/c_allreduce_op.h, c_allgather_op.cc,
+c_broadcast_op.cc, c_reducescatter_op.cc, c_comm_init_op.cc) with jax.lax
+collectives. Inside shard_map over a Mesh these lower to XLA all-reduce /
+all-gather / reduce-scatter riding the ICI; outside any mesh axis they are
+identities (single participant), which matches NCCL world-size-1 semantics.
+
+The main data/tensor-parallel path does NOT use these ops — pjit + GSPMD
+sharding inserts collectives automatically (see parallel/sharding.py). These
+exist for API parity and for explicit shard_map programs.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+def _axis(ctx, attrs):
+    """Resolve the mesh axis for a collective ring id; None = no axis bound
+    (single-device execution)."""
+    ring = attrs.get("ring_id", 0)
+    return ctx.mesh_axes.get(ring) or ctx.mesh_axes.get("collective")
+
+
+def _allreduce(reducer):
+    def lower(ctx, ins, attrs):
+        x = ins["X"][0]
+        ax = _axis(ctx, attrs)
+        if ax is None:
+            return single(x)
+        return single(reducer(x, axis_name=ax))
+
+    return lower
+
+
+register_op("c_allreduce_sum")(_allreduce(lax.psum))
+register_op("c_allreduce_max")(_allreduce(lax.pmax))
+register_op("c_allreduce_min")(_allreduce(lax.pmin))
+
+
+@register_op("c_allreduce_prod")
+def _c_allreduce_prod(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return single(x)
+    # XLA has no native product all-reduce: gather the axis then reduce
+    # (exact, including zeros/signs, unlike a log-space psum)
+    gathered = lax.all_gather(x, axis_name=ax)
+    return single(jnp.prod(gathered, axis=0))
+
+
+@register_op("c_allgather")
+def _c_allgather(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return single(x)
+    out = lax.all_gather(x, axis_name=ax)
+    # paddle concatenates along dim 0
+    return single(out.reshape((-1,) + x.shape[1:]))
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return single(x)
+    root = attrs.get("root", 0)
+    # select root's value on every participant
+    src = lax.all_gather(x, axis_name=ax)
+    return single(src[root])
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return single(x)
+    return single(lax.psum_scatter(x, axis_name=ax, tiled=True))
+
+
+@register_op("c_concat")
+def _c_concat(ctx, ins, attrs):
+    return _c_allgather(ctx, ins, attrs)
+
+
+@register_op("c_identity")
+def _c_identity(ctx, ins, attrs):
+    return single(ins["X"][0])
+
+
+@register_op("c_split")
+def _c_split(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return single(x)
+    idx = lax.axis_index(ax)
+    n = lax.axis_size(ax)
+    per = x.shape[0] // n
+    return single(lax.dynamic_slice_in_dim(x, idx * per, per, axis=0))
+
+
+@register_op("c_sync_calc_stream")
+def _c_sync_calc_stream(ctx, ins, attrs):
+    # XLA's dataflow order replaces stream synchronisation
+    return single(ins["X"][0])
+
+
+@register_op("c_sync_comm_stream")
+def _c_sync_comm_stream(ctx, ins, attrs):
+    return single(ins["X"][0])
+
+
+@register_op("c_comm_init")
+def _c_comm_init(ctx, ins, attrs):
+    # communicator setup is implicit in the mesh; no-op for parity
+    return {}
+
+
+@register_op("c_comm_init_all")
+def _c_comm_init_all(ctx, ins, attrs):
+    return {}
+
+
+@register_op("c_gen_nccl_id")
+def _c_gen_nccl_id(ctx, ins, attrs):
+    return {}
+
+
+@register_op("barrier")
+def _barrier(ctx, ins, attrs):
+    ax = _axis(ctx, attrs)
+    if ins.get("X"):
+        x = ins["X"][0]
+        if ax is not None:
+            # data-dependent no-op forces a rendezvous
+            x = x + 0 * lax.psum(jnp.zeros((), x.dtype), axis_name=ax)
+        return single(x)
+    return {}
+
+
+@register_op("ppermute")
+def _ppermute(ctx, ins, attrs):
+    """Ring permute — building block for ring attention / pipeline."""
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return single(x)
+    n = lax.axis_size(ax)
+    shift = attrs.get("shift", 1)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return single(lax.ppermute(x, axis_name=ax, perm=perm))
+
+
+@register_op("all_to_all")
+def _all_to_all(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return single(x)
+    split_axis = attrs.get("split_axis", 0)
+    concat_axis = attrs.get("concat_axis", 0)
+    return single(
+        lax.all_to_all(x, ax, split_axis=split_axis, concat_axis=concat_axis,
+                       tiled=True)
+    )

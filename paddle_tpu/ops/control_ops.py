@@ -1,1 +1,161 @@
-"""Placeholder — filled in as the subsystem lands."""
+"""Control-flow op lowerings.
+
+Replaces the reference's C++ control-flow operators
+(ref: paddle/fluid/operators/controlflow/while_op.cc,
+conditional_block_op.cc) with lax.while_loop / lax.cond over sub-block
+lowering — compiler-friendly control flow with static carried shapes, as
+XLA requires.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+def _sub_block(ctx, idx):
+    return ctx.program.block(idx)
+
+
+def _run_block_ops(ctx, block, env):
+    # recurse through the same machinery the top-level lowerer uses
+    return ctx.run_ops(block, block.ops, env, ctx)
+
+
+@register_op("while")
+def _while(ctx, ins, attrs):
+    """Loop a sub-block until its condition var goes False.
+    inputs: Condition=[cond_name value], X=[carried values]
+    attrs: sub_block (idx), carried_names (order matches X),
+           cond_name, outer_env (bound by the lowerer via ctx)."""
+    block = _sub_block(ctx, attrs["sub_block"])
+    carried_names = attrs["carried_names"]
+    cond_name = attrs["cond_name"]
+    outer_env = dict(ctx.current_env)
+    init = {n: v for n, v in zip(carried_names, ins["X"])}
+    init[cond_name] = ins["Condition"][0]
+    init["__iter__"] = jnp.zeros((), jnp.int32)
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_name], ()).astype(bool)
+
+    def body_fn(carry):
+        env = dict(outer_env)
+        env.update(carry)
+        env.pop("__iter__")
+        # per-iteration PRNG token: random ops inside the loop draw fresh
+        # keys each iteration instead of a baked trace-time constant
+        prev_token = ctx._iter_token
+        ctx._iter_token = carry["__iter__"]
+        try:
+            env = _run_block_ops(ctx, block, env)
+        finally:
+            ctx._iter_token = prev_token
+        out = {n: env[n] for n in carried_names}
+        out[cond_name] = env[cond_name]
+        out["__iter__"] = carry["__iter__"] + 1
+        return out
+
+    final = lax.while_loop(cond_fn, body_fn, init)
+    return {"Out": [final[n] for n in carried_names]}
+
+
+@register_op("conditional_block")
+def _conditional_block(ctx, ins, attrs):
+    """Run a sub-block iff cond; assigned vars escape (must pre-exist so the
+    false branch has values)."""
+    block = _sub_block(ctx, attrs["sub_block"])
+    written = attrs["written_names"]
+    outer_env = dict(ctx.current_env)
+    cond = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+    prev_vals = ins["X"]  # current values of written vars
+
+    def true_fn(vals):
+        env = dict(outer_env)
+        env.update(zip(written, vals))
+        env = _run_block_ops(ctx, block, env)
+        return tuple(env[n] for n in written)
+
+    def false_fn(vals):
+        return tuple(vals)
+
+    outs = lax.cond(cond, true_fn, false_fn, tuple(prev_vals))
+    return {"Out": list(outs)}
+
+
+@register_op("cond")
+def _cond(ctx, ins, attrs):
+    """layers.cond(pred, true_fn, false_fn): both branches are sub-blocks;
+    outputs are the paired return vars."""
+    tb = _sub_block(ctx, attrs["true_block"])
+    fb = _sub_block(ctx, attrs["false_block"])
+    t_names = attrs["true_out_names"]
+    f_names = attrs["false_out_names"]
+    outer_env = dict(ctx.current_env)
+    pred = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+
+    def t_fn(_):
+        env = _run_block_ops(ctx, tb, dict(outer_env))
+        return tuple(env[n] for n in t_names)
+
+    def f_fn(_):
+        env = _run_block_ops(ctx, fb, dict(outer_env))
+        return tuple(env[n] for n in f_names)
+
+    outs = lax.cond(pred, t_fn, f_fn, 0)
+    return {"Out": list(outs)}
+
+
+@register_op("static_rnn")
+def _static_rnn(ctx, ins, attrs):
+    """StaticRNN: lax.scan of the step sub-block over the time axis.
+    step inputs (T, ...) sliced per step; memories carried."""
+    block = _sub_block(ctx, attrs["sub_block"])
+    mem_names = attrs["mem_names"]          # in-block memory var names
+    mem_updated = attrs["mem_updated"]      # names holding new memory value
+    x_names = attrs["x_names"]              # in-block step-input names
+    out_names = attrs["out_names"]          # step outputs collected
+    outer_env = dict(ctx.current_env)
+    mems = ins["Mem"]
+    xs = ins["X"]  # each (T, ...)
+
+    tsteps = xs[0].shape[0] if xs else 1
+
+    def step(carry, inp):
+        t, xt = inp
+        env = dict(outer_env)
+        env.update(zip(mem_names, carry))
+        env.update(zip(x_names, xt))
+        prev_token = ctx._iter_token
+        ctx._iter_token = t
+        try:
+            env = _run_block_ops(ctx, block, env)
+        finally:
+            ctx._iter_token = prev_token
+        new_carry = tuple(env[n] for n in mem_updated)
+        outs = tuple(env[n] for n in out_names)
+        return new_carry, outs
+
+    _, stacked = lax.scan(
+        step, tuple(mems), (jnp.arange(tsteps), tuple(xs))
+    )
+    return {"Out": list(stacked)}
+
+
+@register_op("is_empty")
+def _is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    return single(jnp.array(x.size == 0))
+
+
+@register_op("select_input")
+def _select_input(ctx, ins, attrs):
+    xs = ins["X"]
+    mask = jnp.reshape(ins["Mask"][0], ()).astype(jnp.int32)
+    stacked = jnp.stack(xs)
+    return single(stacked[mask])
+
+
+@register_op("select_output")
+def _select_output(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}

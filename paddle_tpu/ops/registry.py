@@ -51,6 +51,10 @@ class LowerContext:
         self._rng = rng
         self._rng_count = 0
         self._op_tag = 0
+        # traced per-iteration token set by while/scan lowerings so random
+        # draws differ across loop iterations (a bare fold_in inside a traced
+        # body would be a compile-time constant reused every iteration)
+        self._iter_token = None
         self.is_test = is_test
         self.mesh_axes = mesh_axes or {}  # logical axis name -> mesh axis
         self.program = program
@@ -68,9 +72,12 @@ class LowerContext:
                 "op requires randomness but no PRNG key was provided"
             )
         self._rng_count += 1
-        return jax.random.fold_in(
+        key = jax.random.fold_in(
             self._rng, (self._op_tag << 10) + self._rng_count
         )
+        if self._iter_token is not None:
+            key = jax.random.fold_in(key, self._iter_token)
+        return key
 
 
 def single(val):

@@ -1,0 +1,248 @@
+"""Recurrent op lowerings.
+
+Replaces lstm_op, gru_op, cudnn_lstm_op (ref: paddle/fluid/operators/
+{lstm_op.cc,gru_op.cc,cudnn_lstm_op.cu.cc}) with lax.scan recurrences.
+The per-step matmuls are batched (B, 4D/3D) MXU matmuls; the input
+projection x@W is hoisted out of the scan so the loop body is the small
+recurrent matmul only. Dense-padded sequences + SeqLen masking (state
+freezes past each row's length, matching LoD semantics).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+def _lens(ins, x, t_axis=1):
+    if ins.get("SeqLen"):
+        return ins["SeqLen"][0].astype(jnp.int32)
+    return jnp.full((x.shape[0],), x.shape[t_axis], jnp.int32)
+
+
+def _act(name):
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda v: v,
+    }[name]
+
+
+@register_op("lstm")
+def _lstm(ctx, ins, attrs):
+    """Single-layer LSTM over (B, T, 4D) pre-projected input
+    (ref lstm_op.cc: Input is x@Wx (+bias), Weight is recurrent (D, 4D)).
+    Gate order i, c(g), f, o — reference's candidate-before-forget layout."""
+    xproj = ins["Input"][0]              # (B, T, 4D)
+    w = ins["Weight"][0]                 # (D, 4D)
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    lens = _lens(ins, xproj)
+    d = w.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((xproj.shape[0], d), xproj.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((xproj.shape[0], d), xproj.dtype)
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    is_reverse = attrs.get("is_reverse", False)
+    use_peepholes = attrs.get("use_peepholes", False)
+    if b is not None:
+        xproj = xproj + b.reshape((1, 1, -1))[:, :, : 4 * d]
+
+    xs = jnp.moveaxis(xproj, 1, 0)       # (T, B, 4D)
+    tsteps = xs.shape[0]
+    if is_reverse:
+        xs = xs[::-1]
+
+    def step(carry, inp):
+        h, c = carry
+        xt, t = inp
+        gates = xt + h @ w
+        i = gate_act(gates[:, :d])
+        g = cand_act(gates[:, d : 2 * d])
+        f = gate_act(gates[:, 2 * d : 3 * d])
+        o = gate_act(gates[:, 3 * d :])
+        c_new = f * c + i * g
+        h_new = o * cell_act(c_new)
+        tt = (tsteps - 1 - t) if is_reverse else t
+        live = (tt < lens)[:, None]
+        h_new = jnp.where(live, h_new, h)
+        c_new = jnp.where(live, c_new, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_last, c_last), (hs, cs) = lax.scan(
+        step, (h0, c0), (xs, jnp.arange(tsteps))
+    )
+    if is_reverse:
+        hs = hs[::-1]
+        cs = cs[::-1]
+    return {
+        "Hidden": [jnp.moveaxis(hs, 0, 1)],
+        "Cell": [jnp.moveaxis(cs, 0, 1)],
+        "LastH": [h_last],
+        "LastC": [c_last],
+    }
+
+
+@register_op("gru")
+def _gru(ctx, ins, attrs):
+    """GRU over (B, T, 3D) pre-projected input (ref gru_op.cc)."""
+    xproj = ins["Input"][0]
+    w = ins["Weight"][0]                 # (D, 3D)
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    lens = _lens(ins, xproj)
+    d = w.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((xproj.shape[0], d), xproj.dtype)
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    origin_mode = attrs.get("origin_mode", False)
+    is_reverse = attrs.get("is_reverse", False)
+    if b is not None:
+        xproj = xproj + b.reshape((1, 1, -1))
+    xs = jnp.moveaxis(xproj, 1, 0)
+    tsteps = xs.shape[0]
+    if is_reverse:
+        xs = xs[::-1]
+
+    def step(h, inp):
+        xt, t = inp
+        ru = gate_act(xt[:, : 2 * d] + h @ w[:, : 2 * d])
+        u = ru[:, :d]
+        r = ru[:, d:]
+        c = cand_act(xt[:, 2 * d :] + (r * h) @ w[:, 2 * d :])
+        h_new = u * h + (1 - u) * c if origin_mode else (1 - u) * h + u * c
+        tt = (tsteps - 1 - t) if is_reverse else t
+        h_new = jnp.where((tt < lens)[:, None], h_new, h)
+        return h_new, h_new
+
+    h_last, hs = lax.scan(step, h0, (xs, jnp.arange(tsteps)))
+    if is_reverse:
+        hs = hs[::-1]
+    return {
+        "Hidden": [jnp.moveaxis(hs, 0, 1)],
+        "LastH": [h_last],
+    }
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """One LSTM cell step (ref lstm_unit_op.cc): X = [x, h] @ W + b already
+    projected to (B, 4D)."""
+    gates = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    d = c_prev.shape[-1]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i = jax.nn.sigmoid(gates[:, :d])
+    g = jnp.tanh(gates[:, d : 2 * d])
+    f = jax.nn.sigmoid(gates[:, 2 * d : 3 * d] + forget_bias)
+    o = jax.nn.sigmoid(gates[:, 3 * d :])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("cudnn_lstm")
+def _cudnn_lstm(ctx, ins, attrs):
+    """Multi-layer (optionally bidirectional) LSTM — the cuDNN-fused kernel's
+    role, rebuilt as stacked scans (XLA fuses the per-step matmuls)."""
+    x = ins["Input"][0]  # (B, T, D_in)
+    w_ih = ins["WeightIh"]  # list per layer(*dir): (D_in, 4D)
+    w_hh = ins["WeightHh"]
+    biases = ins.get("Bias", [])
+    num_layers = attrs.get("num_layers", 1)
+    bidirectional = attrs.get("is_bidirec", False)
+    lens = _lens(ins, x)
+    ndir = 2 if bidirectional else 1
+    out = x
+    for layer in range(num_layers):
+        dir_outs = []
+        for dr in range(ndir):
+            idx = layer * ndir + dr
+            proj = jnp.einsum("btd,df->btf", out, w_ih[idx])
+            if idx < len(biases):
+                proj = proj + biases[idx].reshape(1, 1, -1)
+            sub = _lstm(
+                ctx,
+                {
+                    "Input": [proj],
+                    "Weight": [w_hh[idx]],
+                    "SeqLen": [lens],
+                },
+                {"is_reverse": dr == 1},
+            )
+            dir_outs.append(sub["Hidden"][0])
+        out = (
+            jnp.concatenate(dir_outs, axis=-1) if ndir == 2 else dir_outs[0]
+        )
+    return {"Out": [out], "LastH": [out[:, -1]], "LastC": [out[:, -1]]}
+
+
+@register_op("beam_search")
+def _beam_search(ctx, ins, attrs):
+    """One beam expansion (ref beam_search_op.cc), static (B, beam) shapes.
+    pre_ids/pre_scores: (B*beam, 1); ids/scores: (B*beam, K) candidates
+    (scores already accumulated when is_accumulated)."""
+    pre_ids = ins["pre_ids"][0].reshape(-1)
+    pre_scores = ins["pre_scores"][0].reshape(-1)
+    ids = ins["ids"][0]
+    scores = ins["scores"][0]
+    beam = attrs["beam_size"]
+    end_id = attrs["end_id"]
+    bb, k = scores.shape
+    b = bb // beam
+
+    finished = pre_ids == end_id
+    # finished beams contribute exactly one candidate: (end_id, pre_score)
+    neg = jnp.full((k,), -1e30, scores.dtype)
+    scores = jnp.where(
+        finished[:, None],
+        jnp.concatenate(
+            [pre_scores[:, None], jnp.broadcast_to(neg[1:], (bb, k - 1))],
+            axis=1,
+        ),
+        scores,
+    )
+    ids = jnp.where(finished[:, None], end_id, ids)
+
+    flat_scores = scores.reshape(b, beam * k)
+    flat_ids = ids.reshape(b, beam * k)
+    top_scores, top_pos = lax.top_k(flat_scores, beam)
+    sel_ids = jnp.take_along_axis(flat_ids, top_pos, axis=1)
+    parent_local = top_pos // k                      # beam index in batch
+    parent = parent_local + jnp.arange(b)[:, None] * beam
+    return {
+        "selected_ids": [sel_ids.reshape(-1, 1).astype(jnp.int64)],
+        "selected_scores": [top_scores.reshape(-1, 1)],
+        "parent_idx": [parent.reshape(-1).astype(jnp.int64)],
+    }
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrace beams into sequences (ref beam_search_decode_op.cc).
+    Ids: (T, B*beam, 1) selected ids per step; Parents: (T, B*beam) global
+    parent indices (optional — identity if omitted)."""
+    ids = ins["Ids"][0]
+    scores = ins["Scores"][0]
+    tsteps = ids.shape[0]
+    ids2 = ids.reshape(tsteps, -1)       # (T, BB)
+    bb = ids2.shape[1]
+    if ins.get("Parents"):
+        parents = ins["Parents"][0].reshape(tsteps, bb).astype(jnp.int32)
+    else:
+        parents = jnp.broadcast_to(jnp.arange(bb, dtype=jnp.int32), (tsteps, bb))
+
+    def back(cursor, t):
+        # walking t = T-1 .. 0
+        tok = ids2[t][cursor]
+        cursor_new = parents[t][cursor]
+        return cursor_new, tok
+
+    cursor0 = jnp.arange(bb, dtype=jnp.int32)
+    _, toks_rev = lax.scan(back, cursor0, jnp.arange(tsteps - 1, -1, -1))
+    seqs = toks_rev[::-1].T              # (BB, T)
+    final_scores = scores.reshape(tsteps, -1)[-1]
+    return {
+        "SentenceIds": [seqs.astype(jnp.int64)],
+        "SentenceScores": [final_scores],
+    }

@@ -1,1 +1,341 @@
-"""Placeholder — filled in as the subsystem lands."""
+"""Sequence op lowerings.
+
+Replaces the reference's LoD-walking sequence kernels
+(ref: paddle/fluid/operators/sequence_ops/*) with masked/segment math on
+dense-padded (B, T, ...) tensors + a SeqLen vector — static shapes that XLA
+tiles on the MXU, no ragged host-side offset walking.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+
+def _mask(x, lens):
+    """(B, T) bool validity mask broadcastable over x (B, T, ...)."""
+    t = x.shape[1]
+    m = jnp.arange(t)[None, :] < lens[:, None]
+    return m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+def _lens(ins, x):
+    if ins.get("SeqLen"):
+        return ins["SeqLen"][0].astype(jnp.int32)
+    return jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    x = ins["X"][0]
+    lens = _lens(ins, x)
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    m = _mask(x, lens)
+    xm = jnp.where(m, x, 0.0)
+    cnt = jnp.maximum(lens, 1).astype(x.dtype)
+    cnt = cnt.reshape((-1,) + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(xm, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(xm, axis=1) / cnt
+    elif ptype == "SQRT":
+        out = jnp.sum(xm, axis=1) / jnp.sqrt(cnt)
+    elif ptype == "MAX":
+        out = jnp.max(jnp.where(m, x, -jnp.inf), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        )[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError("sequence_pool type %s" % ptype)
+    return {"Out": [out], "MaxIndex": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x = ins["X"][0]  # (B, T) or (B, T, 1)
+    lens = _lens(ins, x)
+    m = _mask(x, lens)
+    logits = jnp.where(m, x, -1e30)
+    out = jax.nn.softmax(logits, axis=1)
+    return single(jnp.where(m, out, 0.0))
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    lens = _lens(ins, x)
+    t = x.shape[1]
+    # index i -> len-1-i inside each sequence, identity in padding
+    idx = jnp.arange(t)[None, :]
+    src = jnp.where(idx < lens[:, None], lens[:, None] - 1 - idx, idx)
+    return {"Y": [jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1,
+    )]}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """Repeat each sequence i of X ylens[i] times along a new ragged axis —
+    dense form: X (B, T, ...) -> (B, Ty, T, ...) masked. The common use
+    (X is per-sequence vector, ref_level=0) maps to broadcast."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    # dense padded: tile x rows to y's time dim
+    if x.ndim == 2 and y.ndim >= 2:
+        out = jnp.broadcast_to(
+            x[:, None, :], (x.shape[0], y.shape[1], x.shape[1])
+        )
+        return single(out)
+    raise NotImplementedError(
+        "sequence_expand with %d-D X is a ragged repeat the dense-padded "
+        "representation cannot express; restructure with broadcasting or "
+        "gather over explicit indices" % x.ndim
+    )
+
+
+@register_op("sequence_expand_as")
+def _sequence_expand_as(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == 2 and y.ndim >= 2:
+        return single(
+            jnp.broadcast_to(x[:, None, :],
+                             (x.shape[0], y.shape[1], x.shape[1]))
+        )
+    return single(jnp.broadcast_to(x, y.shape))
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    """Concatenate along time; lens add. Dense: place second after first's
+    length per row."""
+    xs = ins["X"]
+    if len(xs) == 1:
+        return single(xs[0])
+    lens_list = ins.get("SeqLen", [])
+    if len(lens_list) != len(xs):
+        return single(jnp.concatenate(xs, axis=1))
+    total_t = sum(x.shape[1] for x in xs)
+    b = xs[0].shape[0]
+    out = jnp.zeros((b, total_t) + xs[0].shape[2:], xs[0].dtype)
+    offs = jnp.zeros((b,), jnp.int32)
+    for x, l in zip(xs, lens_list):
+        t = x.shape[1]
+        pos = offs[:, None] + jnp.arange(t)[None, :]
+        valid = jnp.arange(t)[None, :] < l[:, None]
+        bidx = jnp.arange(b)[:, None]
+        out = out.at[bidx, jnp.where(valid, pos, total_t - 1)].add(
+            jnp.where(valid.reshape(valid.shape + (1,) * (x.ndim - 2)), x, 0)
+        )
+        offs = offs + l.astype(jnp.int32)
+    return single(out)
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window conv over time (ref sequence_conv_op.cc): for window k
+    centered at contextStart, out_t = sum_j x[t+j] @ W_j."""
+    x = ins["X"][0]        # (B, T, D)
+    w = ins["Filter"][0]   # (k*D, F)
+    lens = _lens(ins, x)
+    k = attrs.get("contextLength", 3)
+    start = attrs.get("contextStart", -(k // 2))
+    d = x.shape[-1]
+    m = _mask(x, lens)
+    xm = jnp.where(m, x, 0.0)
+    pieces = []
+    for j in range(k):
+        shift = start + j
+        if shift < 0:
+            shifted = jnp.pad(xm, ((0, 0), (-shift, 0), (0, 0)))[:, : x.shape[1]]
+        elif shift > 0:
+            shifted = jnp.pad(xm, ((0, 0), (0, shift), (0, 0)))[:, shift:]
+        else:
+            shifted = xm
+        pieces.append(shifted)
+    ctx_feat = jnp.concatenate(pieces, axis=-1)  # (B, T, k*D)
+    out = jnp.einsum("btd,df->btf", ctx_feat, w)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return single(jnp.where(m, out, 0.0))
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ctx, ins, attrs):
+    x = ins["X"][0]  # lengths (B,) or (B,1)
+    maxlen = attrs.get("maxlen", -1)
+    if ins.get("MaxLenTensor"):
+        try:
+            maxlen = int(ins["MaxLenTensor"][0])
+        except (TypeError, jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError):
+            raise NotImplementedError(
+                "sequence_mask needs a static (python int) maxlen on TPU — "
+                "a traced MaxLenTensor is a data-dependent output shape XLA "
+                "cannot compile"
+            )
+    lens = x.reshape(-1).astype(jnp.int32)
+    if maxlen is None or maxlen < 0:
+        raise NotImplementedError(
+            "sequence_mask needs static maxlen on TPU (data-dependent "
+            "shapes can't be compiled); pass maxlen explicitly"
+        )
+    out = (jnp.arange(maxlen)[None, :] < lens[:, None])
+    from ..fluid import core as _core
+
+    dt = attrs.get("out_dtype", "int64")
+    return {"Y": [out.astype(_core.np_dtype(_core.convert_dtype(dt)))]}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx, ins, attrs):
+    # dense representation is already padded: re-pad to padded_length
+    x = ins["X"][0]
+    lens = _lens(ins, x)
+    plen = attrs.get("padded_length", -1)
+    pad_value = ins["PadValue"][0] if ins.get("PadValue") else 0.0
+    t = x.shape[1]
+    if plen is None or plen < 0:
+        plen = t
+    m = _mask(x, lens)
+    out = jnp.where(m, x, pad_value)
+    if plen > t:
+        pads = [(0, 0), (0, plen - t)] + [(0, 0)] * (x.ndim - 2)
+        out = jnp.pad(out, pads, constant_values=pad_value)
+    else:
+        out = out[:, :plen]
+    return {"Out": [out], "Length": [lens.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ctx, ins, attrs):
+    x = ins["X"][0]
+    lens = ins["Length"][0].astype(jnp.int32)
+    m = _mask(x, lens)
+    return single(jnp.where(m, x, 0.0))
+
+
+@register_op("sequence_enumerate")
+def _sequence_enumerate(ctx, ins, attrs):
+    x = ins["X"][0]  # (B, T)
+    win = attrs["win_size"]
+    pad = attrs.get("pad_value", 0)
+    t = x.shape[1]
+    cols = []
+    for j in range(win):
+        if j == 0:
+            cols.append(x)
+        else:
+            cols.append(
+                jnp.pad(x, ((0, 0), (0, j)), constant_values=pad)[:, j:]
+            )
+    return single(jnp.stack(cols, axis=-1))
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    x = ins["X"][0]
+    offset = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    src = offset[:, None] + idx
+    valid = idx < length[:, None]
+    src = jnp.where(valid, jnp.minimum(src, t - 1), 0)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1
+    )
+    return single(jnp.where(
+        valid.reshape(valid.shape + (1,) * (x.ndim - 2)), out, 0.0
+    ))
+
+
+@register_op("sequence_erase")
+def _sequence_erase(ctx, ins, attrs):
+    raise NotImplementedError(
+        "sequence_erase produces data-dependent lengths; filter host-side "
+        "before feeding (TPU requires static shapes)"
+    )
+
+
+@register_op("lod_reset")
+def _lod_reset(ctx, ins, attrs):
+    x = ins["X"][0]
+    return single(x)
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    dim = attrs["new_dim"]
+    b, t = x.shape[0], x.shape[1]
+    d = x.shape[2] if x.ndim > 2 else 1
+    return single(x.reshape(b, t * d // dim, dim))
+
+
+@register_op("sequence_scatter")
+def _sequence_scatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    b = x.shape[0]
+    bidx = jnp.arange(b)[:, None]
+    return single(x.at[bidx, ids].add(upd))
+
+
+@register_op("edit_distance")
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance between padded hyp/ref token sequences
+    (ref edit_distance_op.cc) via a lax.scan DP."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)     # (B, Th)
+    ref = ins["Refs"][0].astype(jnp.int32)     # (B, Tr)
+    hyp_lens = (
+        ins["HypsLength"][0].astype(jnp.int32).reshape(-1)
+        if ins.get("HypsLength")
+        else jnp.full((hyp.shape[0],), hyp.shape[1], jnp.int32)
+    )
+    ref_lens = (
+        ins["RefsLength"][0].astype(jnp.int32).reshape(-1)
+        if ins.get("RefsLength")
+        else jnp.full((ref.shape[0],), ref.shape[1], jnp.int32)
+    )
+    normalized = attrs.get("normalized", False)
+    b, th = hyp.shape
+    tr = ref.shape[1]
+
+    def per_batch(h, r, hl, rl):
+        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+
+        def step(row, i):
+            # computing DP row i+1 (hyp position i)
+            def inner(carry, j):
+                prev_diag, new_row = carry
+                cost = jnp.where(h[i] == r[j], 0.0, 1.0)
+                val = jnp.minimum(
+                    jnp.minimum(new_row[j] + 1.0, row[j + 1] + 1.0),
+                    prev_diag + cost,
+                )
+                new_row = new_row.at[j + 1].set(val)
+                return (row[j + 1], new_row), None
+
+            new_row = jnp.zeros_like(row).at[0].set(i + 1.0)
+            (_, new_row), _ = lax.scan(
+                inner, (row[0], new_row), jnp.arange(tr)
+            )
+            # only advance while i < hl
+            return jnp.where(i < hl, new_row, row), None
+
+        row, _ = lax.scan(step, row0, jnp.arange(th))
+        d = row[jnp.minimum(rl, tr)]
+        return jnp.where(normalized, d / jnp.maximum(rl, 1), d)
+
+    out = jax.vmap(per_batch)(hyp, ref, hyp_lens, ref_lens)
+    return {
+        "Out": [out[:, None]],
+        "SequenceNum": [jnp.array(b, jnp.int64)],
+    }

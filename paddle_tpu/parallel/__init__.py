@@ -1,0 +1,8 @@
+"""Distributed/parallel subsystem — the TPU-native replacement for the
+reference's fleet + transpiler + NCCL stack (ref: python/paddle/fluid/
+incubate/fleet, transpiler/, operators/collective/)."""
+from . import mesh  # noqa: F401
+from . import sharding  # noqa: F401
+from . import fleet  # noqa: F401
+from . import ring_attention  # noqa: F401
+from . import pipeline  # noqa: F401

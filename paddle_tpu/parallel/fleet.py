@@ -1,0 +1,237 @@
+"""fleet distributed-training API
+(ref: python/paddle/fluid/incubate/fleet/collective/__init__.py and
+incubate/fleet/base/fleet_base.py).
+
+Same surface: init(role_maker) / distributed_optimizer(opt, strategy) /
+minimize / main_program. TPU-native semantics: instead of transpiling NCCL
+ops into the program, minimize() attaches a device Mesh + sharding rules and
+hands back a DistributedProgram the ordinary Executor runs; XLA partitions
+the step and places collectives on ICI.
+"""
+import numpy as np
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..fluid import framework
+from .mesh import build_mesh
+from .sharding import DistributedProgram, ShardingRule
+
+__all__ = [
+    "init", "is_worker", "is_server", "worker_num", "worker_index",
+    "distributed_optimizer", "DistributedStrategy", "PaddleCloudRoleMaker",
+    "UserDefinedRoleMaker", "fleet",
+]
+
+
+class DistributedStrategy:
+    """Collective-mode strategy knobs (ref: fleet DistributedStrategy +
+    DistributedStrategy in collective fleet). TPU additions: explicit
+    tensor/sequence parallel degrees mapped to mesh axes."""
+
+    def __init__(self):
+        self.mode = "collective"
+        self.nccl_comm_num = 1  # parity only
+        self.use_local_sgd = False
+        self.use_dgc = False
+        self.fuse_all_reduce_ops = True
+        # mesh layout
+        self.tensor_parallel_degree = 1
+        self.sequence_parallel_degree = 1
+        self.pipeline_parallel_degree = 1
+        self.sharding_degree = 1  # ZeRO-style optimizer-state sharding
+        # name-pattern tensor-parallel rules: [(regex, spec tuple)]
+        self.tensor_parallel_rules = []
+        self.amp = False
+        self.recompute = False
+        self.recompute_checkpoints = []
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_num = 1
+        self._index = 0
+
+    def worker_num(self):
+        return self._worker_num
+
+    def worker_index(self):
+        return self._index
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def generate_role(self):
+        pass
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True):
+        super().__init__()
+        import os
+
+        self._worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self._index = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=None, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._worker_num = worker_num
+        self._index = current_id
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._mesh = None
+        self._origin_program = None
+        self._distributed_program = None
+        self._optimizer = None
+
+    # -- lifecycle -------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True):
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        return self
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker is not None and self._role_maker.is_server()
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_num(self):
+        try:
+            return max(
+                len(jax.devices()),
+                self._role_maker.worker_num() if self._role_maker else 1,
+            )
+        except RuntimeError:
+            return 1
+
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_endpoints(self, to_string=False):
+        eps = ["tpu:%d" % i for i in range(self.worker_num())]
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        pass
+
+    # -- programs --------------------------------------------------------
+    @property
+    def main_program(self):
+        return self._distributed_program or framework.default_main_program()
+
+    @property
+    def startup_program(self):
+        return framework.default_startup_program()
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        self._optimizer = DistributedOptimizer(optimizer, self._strategy, self)
+        return self._optimizer
+
+    def _build(self, program):
+        s = self._strategy or DistributedStrategy()
+        ndev = len(jax.devices())
+        tp = max(1, s.tensor_parallel_degree)
+        sp = max(1, s.sequence_parallel_degree)
+        axes = {}
+        used = tp * sp
+        if ndev // used >= 1:
+            axes["dp"] = ndev // used
+        if tp > 1:
+            axes["tp"] = tp
+        if sp > 1:
+            axes["sp"] = sp
+        self._mesh = build_mesh(axes)
+        rules = [ShardingRule(p, spec) for p, spec in s.tensor_parallel_rules]
+        if s.sharding_degree > 1:
+            # ZeRO-ish: shard every large parameter's first dim over dp
+            rules.append(ShardingRule(r".*", P("dp")))
+        self._distributed_program = DistributedProgram(
+            program, self._mesh, param_rules=rules
+        )
+        return self._distributed_program
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ..fluid import io
+
+        return io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or framework.default_main_program(),
+        )
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ..fluid import io
+
+        return io.save_persistables(
+            executor, dirname, main_program or framework.default_main_program()
+        )
+
+
+class DistributedOptimizer:
+    def __init__(self, optimizer, strategy, fleet_obj):
+        self._optimizer = optimizer
+        self._strategy = strategy
+        self._fleet = fleet_obj
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt = self._optimizer
+        if self._strategy.recompute:
+            from ..fluid.optimizer import RecomputeOptimizer
+
+            opt = RecomputeOptimizer(opt)
+            opt._set_checkpoints(self._strategy.recompute_checkpoints)
+        if self._strategy.amp:
+            from ..fluid.contrib.mixed_precision import decorate
+
+            opt = decorate(opt)
+        result = opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        self._fleet._build(loss.block.program)
+        return result
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True):
+    return fleet.init(role_maker, is_collective)
+
+
+def is_worker():
+    return fleet.is_worker()
+
+
+def is_server():
+    return fleet.is_server()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)

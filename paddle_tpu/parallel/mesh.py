@@ -1,0 +1,51 @@
+"""Device mesh management.
+
+The analogue of the reference's communicator/ring setup
+(ref: paddle/fluid/platform/collective_helper.cc): instead of NCCL rings
+keyed by ring_id, parallelism is expressed as named axes of a
+jax.sharding.Mesh laid out over ICI.
+"""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["build_mesh", "get_default_mesh", "set_default_mesh", "P",
+           "NamedSharding", "Mesh"]
+
+_default_mesh = None
+
+
+def build_mesh(axes=None, devices=None):
+    """Build a Mesh from {axis_name: size}. Sizes must multiply to the
+    device count; a -1 size is inferred."""
+    if devices is None:
+        devices = jax.devices()
+    ndev = len(devices)
+    if not axes:
+        axes = {"dp": ndev}
+    names = list(axes.keys())
+    sizes = [axes[n] for n in names]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = ndev // known
+    total = int(np.prod(sizes))
+    if total != ndev:
+        raise ValueError(
+            "mesh axes %s multiply to %d but %d devices available"
+            % (dict(zip(names, sizes)), total, ndev)
+        )
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def set_default_mesh(mesh):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh():
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = build_mesh()
+    return _default_mesh

@@ -1,0 +1,88 @@
+"""Pipeline parallelism over a mesh axis.
+
+TPU-native rework of the reference's PipelineOptimizer
+(ref: python/paddle/fluid/optimizer.py:3193, which splits the program at
+cut points and runs section workers over queues). Here the pipeline is the
+classic collective-permute microbatch schedule: every device on the 'pp'
+axis holds one stage's weights; activations flow around the ring with
+lax.ppermute inside a lax.scan over (microbatches + stages - 1) ticks.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["gpipe", "gpipe_sharded"]
+
+
+def gpipe(stage_fn, stage_params, x_microbatches, axis_name):
+    """Run a homogeneous-stage pipeline inside shard_map.
+
+    stage_fn(params, x) -> y          one stage's forward
+    stage_params: this device's stage weights (leading stage dim removed)
+    x_microbatches: (M, ...) microbatches, identical on every device
+    Returns (M, ...) outputs valid on the LAST stage device.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    ticks = m + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    buf = jnp.zeros_like(x_microbatches[0])
+    outs = jnp.zeros((m,) + x_microbatches.shape[1:],
+                     x_microbatches.dtype)
+
+    def body(carry, t):
+        buf, outs = carry
+        # stage 0 injects microbatch t (if any remain); others take the
+        # activation handed to them last tick
+        inject = jnp.where(t < m, t, 0)
+        x_in = jnp.where(idx == 0, x_microbatches[inject], buf)
+        y = stage_fn(stage_params, x_in)
+        # last stage records finished microbatch (t - (n-1))
+        done_idx = t - (n - 1)
+        record = (idx == n - 1) & (done_idx >= 0)
+        outs = lax.cond(
+            record,
+            lambda o: o.at[jnp.maximum(done_idx, 0)].set(y),
+            lambda o: o,
+            outs,
+        )
+        buf_next = lax.ppermute(y, axis_name, perm)
+        return (buf_next, outs), None
+
+    (buf, outs), _ = lax.scan(body, (buf, outs), jnp.arange(ticks))
+    # only the last stage recorded outputs; broadcast them to every device
+    # (other stages hold zeros, so a psum over the axis is a broadcast)
+    return lax.psum(outs, axis_name)
+
+
+def gpipe_sharded(stage_fn, stacked_params, x, mesh, axis="pp",
+                  n_microbatches=None):
+    """Global entry: stacked_params has leading stage dim == mesh.shape[axis];
+    x: (B, ...) global batch split into microbatches."""
+    from jax.experimental.shard_map import shard_map
+
+    n = mesh.shape[axis]
+    mb = n_microbatches or n
+    xm = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
+
+    def local(params_stacked, xm_local):
+        params = jax.tree_util.tree_map(lambda p: p[0], params_stacked)
+        return gpipe(stage_fn, params, xm_local, axis)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+            P(),
+        ),
+        out_specs=P(),
+        check_rep=False,
+    )
+    outs = fn(stacked_params, xm)
+    return outs.reshape((x.shape[0],) + outs.shape[2:])

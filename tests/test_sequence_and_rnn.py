@@ -1,0 +1,198 @@
+"""Sequence (LoD), RNN, and control-flow subsystem tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.lod import LoDTensor
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def _lod_batch():
+    seqs = [
+        np.arange(3 * 2, dtype="float32").reshape(3, 2),
+        np.arange(5 * 2, dtype="float32").reshape(5, 2) + 10,
+        np.arange(1 * 2, dtype="float32").reshape(1, 2) + 100,
+    ]
+    return LoDTensor.from_sequences(seqs), seqs
+
+
+def test_sequence_pool_masked():
+    x = fluid.data(name="x", shape=[None, 2], dtype="float32",
+                   lod_level=1, append_batch_size=False)
+    avg = fluid.layers.sequence_pool(x, "average")
+    mx = fluid.layers.sequence_pool(x, "max")
+    last = fluid.layers.sequence_last_step(x)
+    exe = _exe()
+    lod, seqs = _lod_batch()
+    a, m, l = exe.run(feed={"x": lod}, fetch_list=[avg, mx, last])
+    for i, s in enumerate(seqs):
+        np.testing.assert_allclose(a[i], s.mean(0), rtol=1e-6)
+        np.testing.assert_allclose(m[i], s.max(0), rtol=1e-6)
+        np.testing.assert_allclose(l[i], s[-1], rtol=1e-6)
+
+
+def test_sequence_softmax_sums_to_one_over_valid():
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32",
+                   lod_level=1, append_batch_size=False)
+    sm = fluid.layers.sequence_softmax(x)
+    exe = _exe()
+    lod = LoDTensor.from_sequences(
+        [np.random.randn(2).astype("float32"),
+         np.random.randn(4).astype("float32")]
+    )
+    out = exe.run(feed={"x": lod}, fetch_list=[sm])[0]
+    assert abs(out[0, :2].sum() - 1.0) < 1e-5
+    assert out[0, 2:].sum() == 0.0
+    assert abs(out[1].sum() - 1.0) < 1e-5
+
+
+def test_dynamic_lstm_and_gru_shapes_and_masking():
+    d = 8
+    x = fluid.data(name="x", shape=[None, 6, 4 * d], dtype="float32",
+                   lod_level=1, append_batch_size=False)
+    h, c = fluid.layers.dynamic_lstm(x, size=4 * d, use_peepholes=False)
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    lod = LoDTensor.from_sequences(
+        [np.random.randn(3, 4 * d).astype("float32"),
+         np.random.randn(6, 4 * d).astype("float32")]
+    )
+    hv, cv = exe.run(feed={"x": lod}, fetch_list=[h, c])
+    assert hv.shape == (2, 6, d)
+    # hidden state frozen after sequence end for the short row
+    np.testing.assert_allclose(hv[0, 2], hv[0, 5], rtol=1e-6)
+
+
+def test_static_rnn_matches_manual_scan():
+    t, b, d = 4, 3, 5
+    x = fluid.data(name="x", shape=[t, b, d], dtype="float32",
+                   append_batch_size=False)
+    h0 = fluid.layers.fill_constant([b, d], "float32", 0.0)
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        h_prev = rnn.memory(init=h0)
+        h = fluid.layers.elementwise_add(xt, h_prev)
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    exe = _exe()
+    xv = np.random.randn(t, b, d).astype("float32")
+    o = exe.run(feed={"x": xv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(o, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_while_loop_counts():
+    i = fluid.layers.fill_constant([1], "float32", 0.0)
+    n = fluid.layers.fill_constant([1], "float32", 5.0)
+    acc = fluid.layers.fill_constant([1], "float32", 0.0)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond)
+    with w.block():
+        fluid.layers.increment(acc, value=2.0)
+        fluid.layers.increment(i, value=1.0)
+        fluid.layers.less_than(i, n, cond=cond)
+    exe = _exe()
+    out = exe.run(feed={}, fetch_list=[acc, i])
+    assert float(out[0]) == 10.0
+    assert float(out[1]) == 5.0
+
+
+def test_cond_branches():
+    x = fluid.data(name="x", shape=[1], dtype="float32",
+                   append_batch_size=False)
+    pred = fluid.layers.greater_than(
+        x, fluid.layers.fill_constant([1], "float32", 0.0)
+    )
+    out = fluid.layers.cond(
+        pred,
+        lambda: fluid.layers.fill_constant([1], "float32", 1.0),
+        lambda: fluid.layers.fill_constant([1], "float32", -1.0),
+    )
+    exe = _exe()
+    assert float(exe.run(feed={"x": np.array([3.0], "float32")},
+                         fetch_list=[out])[0]) == 1.0
+    assert float(exe.run(feed={"x": np.array([-3.0], "float32")},
+                         fetch_list=[out])[0]) == -1.0
+
+
+def test_switch_piecewise():
+    lr = fluid.layers.fill_constant([1], "float32", 0.0)
+    step = fluid.data(name="step", shape=[1], dtype="float32",
+                      append_batch_size=False)
+    sw = fluid.layers.Switch()
+    with sw.case(fluid.layers.less_than(
+        step, fluid.layers.fill_constant([1], "float32", 10.0)
+    )):
+        fluid.layers.assign(
+            fluid.layers.fill_constant([1], "float32", 0.1), lr
+        )
+    with sw.default():
+        fluid.layers.assign(
+            fluid.layers.fill_constant([1], "float32", 0.01), lr
+        )
+    exe = _exe()
+    assert abs(float(exe.run(feed={"step": np.array([5.0], "float32")},
+                             fetch_list=[lr])[0]) - 0.1) < 1e-7
+    assert abs(float(exe.run(feed={"step": np.array([50.0], "float32")},
+                             fetch_list=[lr])[0]) - 0.01) < 1e-7
+
+
+def test_warpctc_matches_trivial_case():
+    # single timestep, single label: loss = -log softmax(logit)[label]
+    logits = fluid.data(name="lg", shape=[1, 2, 3], dtype="float32",
+                        append_batch_size=False)
+    label = fluid.data(name="lb", shape=[1, 1], dtype="int64",
+                       append_batch_size=False)
+    ll = fluid.data(name="ll", shape=[1], dtype="int64",
+                    append_batch_size=False)
+    tl = fluid.data(name="tl", shape=[1], dtype="int64",
+                    append_batch_size=False)
+    loss = fluid.layers.warpctc(
+        logits, label, blank=0, input_length=tl, label_length=ll
+    )
+    exe = _exe()
+    lg = np.array([[[0.1, 2.0, 0.3], [0.0, 0.0, 0.0]]], "float32")
+    out = exe.run(
+        feed={
+            "lg": lg,
+            "lb": np.array([[1]], "int64"),
+            "ll": np.array([1], "int64"),
+            "tl": np.array([1], "int64"),
+        },
+        fetch_list=[loss],
+    )[0]
+    expected = -np.log(
+        np.exp(2.0) / np.exp(lg[0, 0]).sum()
+    )
+    np.testing.assert_allclose(out[0, 0], expected, rtol=1e-4)
+
+
+def test_beam_search_step():
+    beam, k, b = 2, 3, 1
+    pre_ids = fluid.data(name="pi", shape=[b * beam, 1], dtype="int64",
+                         append_batch_size=False)
+    pre_scores = fluid.data(name="ps", shape=[b * beam, 1], dtype="float32",
+                            append_batch_size=False)
+    ids = fluid.data(name="ids", shape=[b * beam, k], dtype="int64",
+                     append_batch_size=False)
+    scores = fluid.data(name="sc", shape=[b * beam, k], dtype="float32",
+                        append_batch_size=False)
+    sel_ids, sel_scores = fluid.layers.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=beam, end_id=0
+    )
+    exe = _exe()
+    out_ids, out_sc = exe.run(
+        feed={
+            "pi": np.array([[5], [6]], "int64"),
+            "ps": np.array([[0.0], [0.0]], "float32"),
+            "ids": np.array([[1, 2, 3], [4, 5, 6]], "int64"),
+            "sc": np.array([[0.5, 0.1, 0.2], [0.9, 0.3, 0.1]], "float32"),
+        },
+        fetch_list=[sel_ids, sel_scores],
+    )
+    np.testing.assert_allclose(out_sc.reshape(-1), [0.9, 0.5], rtol=1e-6)
+    assert out_ids.reshape(-1).tolist() == [4, 1]
