@@ -56,6 +56,8 @@ from .parallel_executor import ParallelExecutor  # noqa: F401
 from . import dygraph
 from . import profiler
 from . import contrib
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, memory_optimize, release_memory  # noqa: F401
 
 # late op registrations that need fluid internals
 from ..ops import _register_late_modules as _late

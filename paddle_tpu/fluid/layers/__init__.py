@@ -20,6 +20,10 @@ from .sequence_lod import *  # noqa: F401,F403
 from . import rnn
 from .rnn import *  # noqa: F401,F403
 from . import collective  # noqa: F401
+from . import detection
+from .detection import *  # noqa: F401,F403
+from . import distributions
+from .distributions import *  # noqa: F401,F403
 from . import math_op_patch
 
 math_op_patch.monkey_patch_variable()
@@ -35,3 +39,5 @@ __all__ += learning_rate_scheduler.__all__
 __all__ += control_flow.__all__
 __all__ += sequence_lod.__all__
 __all__ += rnn.__all__
+__all__ += detection.__all__
+__all__ += distributions.__all__

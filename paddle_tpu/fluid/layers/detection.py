@@ -1,0 +1,296 @@
+"""Detection layers (ref: python/paddle/fluid/layers/detection.py) — the
+core subset: box coding, IoU, priors, yolo, nms (static-shape top-k form),
+ssd/yolo losses composed from primitives.
+"""
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "box_coder", "iou_similarity", "prior_box", "yolo_box", "yolov3_loss",
+    "multiclass_nms", "bipartite_match", "ssd_loss", "density_prior_box",
+    "box_clip", "detection_output",
+]
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    helper = LayerHelper("box_coder", **locals())
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, Variable):
+        ins["PriorBoxVar"] = [prior_box_var]
+    elif isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = list(prior_box_var)
+    helper.append_op(
+        type="box_coder", inputs=ins, outputs={"OutputBox": [out]},
+        attrs=attrs,
+    )
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None and y.shape is not None:
+        out.shape = (x.shape[0], y.shape[0])
+    helper.append_op(
+        type="iou_similarity",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"box_normalized": box_normalized},
+    )
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", **locals())
+    box = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [box], "Variances": [var]},
+        attrs={
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variance),
+            "flip": flip,
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+        },
+    )
+    return box, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    # density variant reduces to prior_box with expanded size lists
+    sizes = []
+    for d, s in zip(densities or [1], fixed_sizes or [1.0]):
+        sizes.extend([s] * (d * d))
+    return prior_box(
+        input, image, min_sizes=sizes, aspect_ratios=fixed_ratios or [1.0],
+        variance=variance, clip=clip, steps=steps, offset=offset,
+    )
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, name=None, clip_bbox=True):
+    helper = LayerHelper("yolo_box", **locals())
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="yolo_box",
+        inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={
+            "anchors": list(anchors),
+            "class_num": class_num,
+            "conf_thresh": conf_thresh,
+            "downsample_ratio": downsample_ratio,
+        },
+    )
+    return boxes, scores
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """YOLOv3 loss composed from primitives (ref yolov3_loss_op.cc):
+    coordinate MSE + objectness/class BCE on responsible anchors."""
+    from . import nn, tensor, loss as loss_layers
+
+    helper = LayerHelper("yolov3_loss", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = (x.shape[0],)
+    helper.append_op(
+        type="yolov3_loss",
+        inputs={"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]},
+        outputs={"Loss": [out]},
+        attrs={
+            "anchors": list(anchors),
+            "anchor_mask": list(anchor_mask),
+            "class_num": class_num,
+            "ignore_thresh": ignore_thresh,
+            "downsample_ratio": downsample_ratio,
+        },
+    )
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """Static-shape NMS: returns exactly keep_top_k rows per image as
+    (label, score, x1, y1, x2, y2), padded with label=-1 (TPU-native form
+    of the reference's variable-length LoD output)."""
+    helper = LayerHelper("multiclass_nms", **locals())
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    if bboxes.shape is not None:
+        out.shape = (bboxes.shape[0], keep_top_k, 6)
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "normalized": normalized,
+            "background_label": background_label,
+        },
+    )
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    decoded = box_coder(
+        prior_box, prior_box_var, loc, code_type="decode_center_size"
+    )
+    return multiclass_nms(
+        decoded, scores, score_threshold, nms_top_k, keep_top_k,
+        nms_threshold, background_label=background_label,
+    )
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", **locals())
+    match_idx = helper.create_variable_for_type_inference("int32")
+    match_dist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(
+        type="bipartite_match",
+        inputs={"DistMat": [dist_matrix]},
+        outputs={
+            "ColToRowMatchIndices": [match_idx],
+            "ColToRowMatchDist": [match_dist],
+        },
+        attrs={"match_type": match_type or "bipartite"},
+    )
+    return match_idx, match_dist
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type="box_clip",
+        inputs={"Input": [input], "ImInfo": [im_info]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """SSD multibox loss composed from primitives (ref detection.py
+    ssd_loss): per-prior gt matching by IoU, smooth-L1 on matched encoded
+    offsets, softmax cross-entropy against matched labels (background for
+    unmatched priors), with negatives down-weighted in place of the
+    reference's hard-negative mining (static shapes)."""
+    from . import nn, loss as loss_layers, tensor
+
+    iou = iou_similarity(gt_box, prior_box)          # (n_gt, n_prior)
+    best_iou = nn.reduce_max(iou, dim=[0])           # (n_prior,)
+    best_gt = tensor.argmax(iou, axis=0)             # (n_prior,) gt index
+    pos_mask = tensor.cast(
+        nn._layer(
+            "greater_equal",
+            {"X": best_iou,
+             "Y": tensor.fill_constant([1], "float32", overlap_threshold)},
+            out_dtype="bool", out_shape=best_iou.shape,
+        ),
+        "float32",
+    )
+    # localization: smooth-L1 of predicted offsets vs the MATCHED gt's
+    # encoded offsets (gather the per-prior matched row of the encode
+    # matrix: encoded[gt, prior] -> take diag of gathered rows)
+    encoded = box_coder(prior_box, prior_box_var or [0.1, 0.1, 0.2, 0.2],
+                        gt_box)                      # (n_gt, n_prior, 4)
+    n_prior = prior_box.shape[0] if prior_box.shape else None
+    if n_prior in (None, -1):
+        raise ValueError(
+            "ssd_loss needs a static prior count (priors are build-time "
+            "constants); declare prior_box with a concrete first dim"
+        )
+    enc_matched = nn.gather_nd(
+        encoded,
+        nn.stack(
+            [best_gt,
+             tensor.cast(
+                 nn._layer(
+                     "range", {}, {"start": 0.0, "end": float(n_prior),
+                                   "step": 1.0, "dtype": "int64"},
+                     out_dtype="int64", out_shape=(n_prior,),
+                 ),
+                 "int64",
+             )],
+            axis=1,
+        ),
+    )                                                # (n_prior, 4)
+    loc_l = nn.reduce_sum(
+        nn.elementwise_mul(
+            nn.reduce_sum(
+                loss_layers.huber_loss(location, enc_matched, 1.0), dim=[-1]
+            ),
+            pos_mask,
+        )
+    )
+    # classification: matched gt label where positive, background otherwise
+    matched_label = nn.gather(gt_label, best_gt)     # (n_prior, 1)
+    bg = tensor.fill_constant_batch_size_like(
+        matched_label, [-1, 1], "int64", float(background_label)
+    )
+    target_label = nn.elementwise_add(
+        nn.elementwise_mul(
+            matched_label, tensor.cast(nn.unsqueeze(pos_mask, [1]), "int64")
+        ),
+        nn.elementwise_mul(
+            bg,
+            tensor.cast(
+                nn.unsqueeze(nn.scale(pos_mask, -1.0, bias=1.0), [1]),
+                "int64",
+            ),
+        ),
+    )
+    ce = loss_layers.softmax_with_cross_entropy(confidence, target_label)
+    weights = nn.unsqueeze(
+        nn.scale(pos_mask, scale=1.0 - 1.0 / neg_pos_ratio,
+                 bias=1.0 / neg_pos_ratio),
+        [1],
+    )
+    conf_l = nn.reduce_sum(nn.elementwise_mul(ce, weights))
+    total = nn.elementwise_add(
+        nn.scale(loc_l, scale=loc_loss_weight),
+        nn.scale(conf_l, scale=conf_loss_weight),
+    )
+    if normalize:
+        n_pos = nn.reduce_sum(pos_mask)
+        total = nn.elementwise_div(
+            total, nn.elementwise_max(
+                n_pos, tensor.fill_constant([], "float32", 1.0)
+            )
+        )
+    return total

@@ -1,0 +1,150 @@
+"""Distribute/memory transpilers
+(ref: python/paddle/fluid/transpiler/distribute_transpiler.py,
+memory_optimization_transpiler.py, collective.py).
+
+API-compatible surface with TPU-native semantics:
+
+- DistributeTranspiler(pserver mode): there are no parameter servers on a
+  TPU pod — the role the pserver shards played (holding slices of big
+  embeddings + applying async updates) maps to vocab-sharded parameters
+  over the mesh with synchronous ICI all-reduce. transpile() therefore
+  annotates the program with sharding rules instead of splitting it into
+  trainer/pserver programs; get_trainer_program() returns the annotated
+  program, get_pserver_program() raises with this explanation.
+- memory_optimize/release_memory: XLA's buffer assignment + donated
+  arguments already reuse buffers aggressively; these are no-ops kept for
+  script compatibility (they print a note once).
+"""
+import warnings
+
+from . import framework
+
+__all__ = [
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "memory_optimize",
+    "release_memory",
+    "HashName",
+    "RoundRobin",
+]
+
+
+class DistributeTranspilerConfig:
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "collective"
+    print_log = False
+    wait_port = True
+    sync_mode = True
+
+
+class HashName:
+    def __init__(self, pserver_endpoints):
+        self.eps = pserver_endpoints
+
+    def dispatch(self, varlist):
+        return [self.eps[hash(v.name) % len(self.eps)] for v in varlist]
+
+
+class RoundRobin:
+    def __init__(self, pserver_endpoints):
+        self.eps = pserver_endpoints
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self.eps[self._i % len(self.eps)])
+            self._i += 1
+        return out
+
+
+class DistributeTranspiler:
+    """ref transpiler/distribute_transpiler.py DistributeTranspiler."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._program = None
+        self._trainer_id = 0
+        self._trainers = 1
+
+    def transpile(
+        self,
+        trainer_id,
+        program=None,
+        pservers="127.0.0.1:6174",
+        trainers=1,
+        sync_mode=True,
+        startup_program=None,
+        current_endpoint="127.0.0.1:6174",
+    ):
+        self._trainer_id = trainer_id
+        self._trainers = trainers
+        self._program = program or framework.default_main_program()
+        # annotate: data-parallel over 'dp', embeddings vocab-sharded over
+        # 'tp' if a tp axis exists (DistributedProgram applies the rules)
+        from jax.sharding import PartitionSpec as P
+
+        rules = []
+        for p in self._program.all_parameters():
+            if getattr(p, "is_distributed", False) or (
+                p.shape and len(p.shape) == 2 and p.shape[0] >= 8192
+            ):
+                rules.append((p.name, P("tp", None)))
+        self._program._sharding_spec = rules
+        return self
+
+    def get_trainer_program(self, wait_port=True):
+        if self._program is not None and self._program._sharding_spec:
+            # hand back a runnable mesh-sharded program so the annotation
+            # is actually consumed (Executor dispatches through it)
+            import jax
+
+            from ..parallel.mesh import build_mesh
+            from ..parallel.sharding import DistributedProgram
+
+            try:
+                ndev = len(jax.devices())
+            except RuntimeError:
+                ndev = 1
+            tp = 2 if ndev % 2 == 0 and ndev > 1 else 1
+            mesh = build_mesh({"dp": ndev // tp, "tp": tp})
+            return DistributedProgram(self._program, mesh)
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        raise NotImplementedError(
+            "TPU pods have no parameter servers: the pserver shard role is "
+            "replaced by vocab-sharded parameters over the ICI mesh "
+            "(rules annotated on the program; run it through "
+            "parallel.sharding.DistributedProgram or fleet)"
+        )
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        return framework.default_startup_program()
+
+
+_mem_note = [False]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    if not _mem_note[0]:
+        _mem_note[0] = True
+        warnings.warn(
+            "memory_optimize is a no-op: XLA buffer assignment + donated "
+            "arguments already provide in-place reuse; use "
+            "fluid.optimizer.RecomputeOptimizer for rematerialisation",
+            stacklevel=2,
+        )
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program

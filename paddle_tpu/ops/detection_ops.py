@@ -253,3 +253,221 @@ def _yolo_box(ctx, ins, attrs):
         n, na * h * w, class_num
     )
     return {"Boxes": [boxes], "Scores": [scores]}
+
+
+@register_op("box_clip")
+def _box_clip(ctx, ins, attrs):
+    boxes = ins["Input"][0]
+    im_info = ins["ImInfo"][0]  # (N, 3) h, w, scale  or (3,)
+    if im_info.ndim == 1:
+        im_info = im_info[None]
+    # im_info = [resized_h, resized_w, scale]; clip in ORIGINAL image coords
+    scale = jnp.maximum(im_info[:, 2], 1e-6) if im_info.shape[1] > 2 else 1.0
+    h = jnp.round(im_info[:, 0] / scale) - 1
+    w = jnp.round(im_info[:, 1] / scale) - 1
+    if boxes.ndim == 2:
+        boxes = boxes[None]
+    x1 = jnp.clip(boxes[..., 0], 0, w[:, None])
+    y1 = jnp.clip(boxes[..., 1], 0, h[:, None])
+    x2 = jnp.clip(boxes[..., 2], 0, w[:, None])
+    y2 = jnp.clip(boxes[..., 3], 0, h[:, None])
+    return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}
+
+
+def _iou_matrix(a, b):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+@register_op("multiclass_nms")
+def _multiclass_nms(ctx, ins, attrs):
+    """Static-shape greedy NMS (ref detection/multiclass_nms_op.cc): output
+    is exactly (N, keep_top_k, 6) rows [label, score, x1, y1, x2, y2] padded
+    with label=-1 — fixed shapes instead of the reference's LoD output."""
+    bboxes = ins["BBoxes"][0]   # (N, M, 4)
+    scores = ins["Scores"][0]   # (N, C, M)
+    score_thresh = attrs["score_threshold"]
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    keep_top_k = attrs["keep_top_k"]
+    background = attrs.get("background_label", 0)
+    n, c, m = scores.shape
+
+    def per_image(boxes, sc):
+        # candidates: all (class, box) pairs except background
+        cls_ids = jnp.arange(c)[:, None].repeat(m, 1)   # (C, M)
+        flat_scores = sc.reshape(-1)
+        flat_cls = cls_ids.reshape(-1)
+        flat_box = jnp.tile(boxes, (c, 1))
+        valid = (flat_scores > score_thresh) & (flat_cls != background)
+        flat_scores = jnp.where(valid, flat_scores, -1.0)
+
+        def body(carry, _):
+            cur_scores, = carry
+            best = jnp.argmax(cur_scores)
+            best_score = cur_scores[best]
+            best_box = flat_box[best]
+            best_cls = flat_cls[best]
+            # suppress same-class overlapping candidates + self
+            ious = _iou_matrix(best_box[None], flat_box)[0]
+            suppress = ((ious > nms_thresh) & (flat_cls == best_cls)) | (
+                jnp.arange(flat_scores.shape[0]) == best
+            )
+            cur_scores = jnp.where(suppress, -1.0, cur_scores)
+            row = jnp.concatenate(
+                [
+                    jnp.where(best_score > 0, best_cls, -1)[None].astype(
+                        boxes.dtype
+                    ),
+                    best_score[None],
+                    best_box,
+                ]
+            )
+            return (cur_scores,), row
+
+        _, rows = lax.scan(body, (flat_scores,), None, length=keep_top_k)
+        return rows
+
+    out = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out]}
+
+
+@register_op("bipartite_match")
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching (ref detection/bipartite_match_op.cc):
+    repeatedly take the global max of the distance matrix."""
+    dist = ins["DistMat"][0]  # (R, C): rows=gt, cols=priors
+    r, c = dist.shape
+
+    def body(carry, _):
+        d, col_to_row, col_dist = carry
+        idx = jnp.argmax(d)
+        ri, ci = idx // c, idx % c
+        val = d[ri, ci]
+        take = val > -1e20
+        col_to_row = jnp.where(
+            take & (jnp.arange(c) == ci), ri, col_to_row
+        )
+        col_dist = jnp.where(take & (jnp.arange(c) == ci), val, col_dist)
+        d = jnp.where(jnp.arange(r)[:, None] == ri, -1e30, d)
+        d = jnp.where(jnp.arange(c)[None, :] == ci, -1e30, d)
+        return (d, col_to_row, col_dist), None
+
+    init = (
+        dist,
+        jnp.full((c,), -1, jnp.int32),
+        jnp.zeros((c,), dist.dtype),
+    )
+    (d, col_to_row, col_dist), _ = lax.scan(
+        body, init, None, length=min(r, c)
+    )
+    return {
+        "ColToRowMatchIndices": [col_to_row[None, :]],
+        "ColToRowMatchDist": [col_dist[None, :]],
+    }
+
+
+@register_op("yolov3_loss")
+def _yolov3_loss(ctx, ins, attrs):
+    """YOLOv3 training loss (ref detection/yolov3_loss_op.cc): coordinate
+    MSE + objectness/class BCE; gt assigned to the best-matching masked
+    anchor at its center cell."""
+    x = ins["X"][0]            # (N, A*(5+C), H, W)
+    gt_box = ins["GTBox"][0]   # (N, G, 4) cx cy w h, normalized
+    gt_label = ins["GTLabel"][0].astype(jnp.int32)  # (N, G)
+    anchors = np.asarray(attrs["anchors"], np.float32)
+    anchor_mask = list(attrs["anchor_mask"])
+    class_num = attrs["class_num"]
+    ignore_thresh = attrs.get("ignore_thresh", 0.7)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, _, h, w = x.shape
+    na = len(anchor_mask)
+    input_h, input_w = downsample * h, downsample * w
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    # jnp (not numpy) so traced best_a indices can gather into it
+    masked_anchors = jnp.asarray(anchors.reshape(-1, 2)[anchor_mask])
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + jnp.log1p(
+            jnp.exp(-jnp.abs(logit))
+        )
+
+    def per_image(xi, boxes, labels):
+        # xi: (5+C, A, H, W); assign each gt to its center cell + best
+        # anchor by wh IoU. Invalid (zero-padded) gt rows scatter into a
+        # dump column that is sliced away, so they cannot clobber cell 0.
+        valid = (boxes[:, 2] > 0) & (boxes[:, 3] > 0)
+        gi = jnp.clip((boxes[:, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((boxes[:, 1] * h).astype(jnp.int32), 0, h - 1)
+        gi = jnp.where(valid, gi, w)  # dump column index
+        gw = boxes[:, 2] * input_w
+        gh = boxes[:, 3] * input_h
+        aw = masked_anchors[:, 0][None, :]
+        ah = masked_anchors[:, 1][None, :]
+        inter = jnp.minimum(gw[:, None], aw) * jnp.minimum(gh[:, None], ah)
+        union = gw[:, None] * gh[:, None] + aw * ah - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=1)
+
+        def scat(vals, init=0.0, dtype=jnp.float32):
+            t = jnp.full((na, h, w + 1), init, dtype)
+            return t.at[best_a, gj, gi].set(vals)[:, :, :w]
+
+        obj_target = scat(valid.astype(jnp.float32))
+        txt = scat(boxes[:, 0] * w - jnp.minimum(gi, w - 1))
+        tyt = scat(boxes[:, 1] * h - gj)
+        twt = scat(jnp.log(jnp.maximum(
+            gw / jnp.maximum(masked_anchors[best_a, 0], 1e-6), 1e-6)))
+        tht = scat(jnp.log(jnp.maximum(
+            gh / jnp.maximum(masked_anchors[best_a, 1], 1e-6), 1e-6)))
+        cls_t = scat(labels, init=0, dtype=jnp.int32)
+
+        pos = obj_target
+        txi, tyi, twi, thi = xi[0], xi[1], xi[2], xi[3]
+        obj_logit = xi[4]
+        cls_logit = xi[5:]
+        coord = pos * (
+            bce(txi, txt)
+            + bce(tyi, tyt)
+            + (twi - twt) ** 2
+            + (thi - tht) ** 2
+        )
+        # objectness: positives get BCE vs 1; negatives are ignored when
+        # their decoded box overlaps ANY gt above ignore_thresh (ref
+        # yolov3_loss_op.h best-IoU ignore rule)
+        grid_x = jnp.arange(w)[None, None, :]
+        grid_y = jnp.arange(h)[None, :, None]
+        px = (jax.nn.sigmoid(txi) + grid_x) / w
+        py = (jax.nn.sigmoid(tyi) + grid_y) / h
+        pw = jnp.exp(jnp.clip(twi, -10, 10)) * (
+            masked_anchors[:, 0][:, None, None] / input_w
+        )
+        ph = jnp.exp(jnp.clip(thi, -10, 10)) * (
+            masked_anchors[:, 1][:, None, None] / input_h
+        )
+        # IoU of every prediction against every (valid) gt, center-size form
+        def iou_vs_gt(gb):
+            ix = jnp.minimum(px + pw / 2, gb[0] + gb[2] / 2) - jnp.maximum(
+                px - pw / 2, gb[0] - gb[2] / 2
+            )
+            iy = jnp.minimum(py + ph / 2, gb[1] + gb[3] / 2) - jnp.maximum(
+                py - ph / 2, gb[1] - gb[3] / 2
+            )
+            inter_ = jnp.maximum(ix, 0) * jnp.maximum(iy, 0)
+            union_ = pw * ph + gb[2] * gb[3] - inter_
+            return inter_ / jnp.maximum(union_, 1e-10)
+
+        ious = jax.vmap(iou_vs_gt)(boxes)  # (G, A, H, W)
+        ious = jnp.where(valid[:, None, None, None], ious, 0.0)
+        best_iou = jnp.max(ious, axis=0)
+        noobj = (pos == 0) & (best_iou <= ignore_thresh)
+        obj_l = pos * bce(obj_logit, 1.0) + noobj * bce(obj_logit, 0.0)
+        cls_oh = jax.nn.one_hot(cls_t, class_num).transpose(3, 0, 1, 2)
+        cls_l = pos[None] * bce(cls_logit, cls_oh)
+        return jnp.sum(coord) + jnp.sum(obj_l) + jnp.sum(cls_l)
+
+    losses = jax.vmap(per_image)(jnp.moveaxis(x, 2, 1), gt_box, gt_label)
+    return {"Loss": [losses]}

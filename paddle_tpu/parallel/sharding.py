@@ -65,7 +65,10 @@ class DistributedProgram:
                  feed_specs=None):
         self._program = program
         self._mesh = mesh
-        self._param_rules = param_rules or []
+        self._param_rules = list(param_rules or [])
+        # honor sharding annotations left by DistributeTranspiler.transpile
+        for name, spec in (getattr(program, "_sharding_spec", None) or []):
+            self._param_rules.append(ShardingRule(re.escape(name) + "$", spec))
         self._feed_axis = feed_axis
         self._feed_specs = feed_specs or {}  # feed name -> PartitionSpec
         self._cache = {}

@@ -1,0 +1,163 @@
+"""Detection and distribution layer tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_iou_similarity_and_box_coder():
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32",
+                   append_batch_size=False)
+    y = fluid.data(name="y", shape=[None, 4], dtype="float32",
+                   append_batch_size=False)
+    iou = fluid.layers.detection.iou_similarity(x, y)
+    exe = _exe()
+    bx = np.array([[0, 0, 2, 2]], "float32")
+    by = np.array([[1, 1, 3, 3], [0, 0, 2, 2]], "float32")
+    out = exe.run(feed={"x": bx, "y": by}, fetch_list=[iou])[0]
+    np.testing.assert_allclose(out[0], [1.0 / 7.0, 1.0], rtol=1e-5)
+
+
+def test_multiclass_nms_static_shape():
+    bboxes = fluid.data(name="bb", shape=[1, 4, 4], dtype="float32",
+                        append_batch_size=False)
+    scores = fluid.data(name="sc", shape=[1, 2, 4], dtype="float32",
+                        append_batch_size=False)
+    out = fluid.layers.detection.multiclass_nms(
+        bboxes, scores, score_threshold=0.1, nms_top_k=4, keep_top_k=3,
+        nms_threshold=0.5, background_label=0,
+    )
+    exe = _exe()
+    bb = np.array([[[0, 0, 1, 1], [0, 0, 1.05, 1], [5, 5, 6, 6],
+                    [0, 0, 0.1, 0.1]]], "float32")
+    sc = np.zeros((1, 2, 4), "float32")
+    sc[0, 1] = [0.9, 0.8, 0.7, 0.05]  # class 1 scores
+    o = exe.run(feed={"bb": bb, "sc": sc}, fetch_list=[out])[0]
+    assert o.shape == (1, 3, 6)
+    # best box kept, overlapping second suppressed, distant third kept
+    kept_scores = o[0, :, 1]
+    np.testing.assert_allclose(sorted(kept_scores[:2], reverse=True),
+                               [0.9, 0.7], rtol=1e-5)
+    assert o[0, 2, 0] == -1  # padded row
+
+
+def test_normal_distribution_kl_and_sampling():
+    from paddle_tpu.fluid.layers.distributions import Normal
+
+    n1 = Normal(0.0, 1.0)
+    n2 = Normal(1.0, 1.0)
+    kl = n1.kl_divergence(n2)
+    samp = n1.sample([1000], seed=7)
+    ent = n1.entropy()
+    exe = _exe()
+    klv, sv, ev = exe.run(feed={}, fetch_list=[kl, samp, ent])
+    np.testing.assert_allclose(klv, 0.5, atol=1e-5)  # KL(N(0,1)||N(1,1))
+    assert abs(sv.mean()) < 0.2
+    np.testing.assert_allclose(
+        ev, 0.5 * (1 + np.log(2 * np.pi)), atol=1e-5
+    )
+
+
+def test_categorical_log_prob():
+    from paddle_tpu.fluid.layers.distributions import Categorical
+
+    logits = fluid.layers.assign(
+        np.array([[1.0, 2.0, 0.5]], dtype="float32")
+    )
+    c = Categorical(logits)
+    val = fluid.layers.assign(np.array([1], dtype="int64"))
+    lp = c.log_prob(val)
+    exe = _exe()
+    out = exe.run(feed={}, fetch_list=[lp])[0]
+    expected = 2.0 - np.log(np.exp([1.0, 2.0, 0.5]).sum())
+    np.testing.assert_allclose(out[0], expected, rtol=1e-5)
+
+
+def test_transpiler_api_compat():
+    x = fluid.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(x, 3)
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, pservers="127.0.0.1:6174", trainers=2)
+    prog = t.get_trainer_program()
+    assert prog is fluid.default_main_program()
+    with pytest.raises(NotImplementedError):
+        t.get_pserver_program("127.0.0.1:6174")
+    # memory_optimize no-op keeps program runnable
+    fluid.memory_optimize(prog)
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    out = exe.run(feed={"x": np.ones((2, 4), "float32")}, fetch_list=[loss])
+    assert np.isfinite(out[0])
+
+
+def test_mvn_diag_entropy_matches_reference_formula():
+    from paddle_tpu.fluid.layers.distributions import MultivariateNormalDiag
+
+    cov = np.diag([0.4, 0.5]).astype("float32")
+    mvn = MultivariateNormalDiag(np.array([0.3, 0.5], "float32"), cov)
+    ent = mvn.entropy()
+    exe = _exe()
+    out = float(exe.run(feed={}, fetch_list=[ent])[0])
+    expected = 0.5 * (2 * (1 + np.log(2 * np.pi)) + np.log(0.4 * 0.5))
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_ssd_loss_uses_labels():
+    loc = fluid.data(name="loc", shape=[4, 4], dtype="float32",
+                     append_batch_size=False)
+    conf = fluid.data(name="conf", shape=[4, 3], dtype="float32",
+                      append_batch_size=False)
+    gtb = fluid.data(name="gtb", shape=[1, 4], dtype="float32",
+                     append_batch_size=False)
+    gtl = fluid.data(name="gtl", shape=[1, 1], dtype="int64",
+                     append_batch_size=False)
+    pb = fluid.data(name="pb", shape=[4, 4], dtype="float32",
+                    append_batch_size=False)
+    loss = fluid.layers.ssd_loss(loc, conf, gtb, gtl, pb)
+    exe = _exe()
+    feed = {
+        "loc": np.zeros((4, 4), "float32"),
+        "conf": np.random.default_rng(0).standard_normal((4, 3)).astype("float32"),
+        "gtb": np.array([[0, 0, 1, 1]], "float32"),
+        "gtl": np.array([[2]], "int64"),
+        "pb": np.array([[0, 0, 1, 1], [0, 0, 0.1, 0.1],
+                        [5, 5, 6, 6], [0.1, 0.1, 1.1, 1.1]], "float32"),
+    }
+    v1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    feed2 = dict(feed)
+    feed2["gtl"] = np.array([[1]], "int64")
+    v2 = float(exe.run(feed=feed2, fetch_list=[loss])[0])
+    assert np.isfinite(v1) and np.isfinite(v2)
+    assert v1 != v2, "ssd_loss must depend on gt labels"
+
+
+def test_yolov3_loss_runs():
+    x = fluid.data(name="yx", shape=[1, 3 * 7, 4, 4], dtype="float32",
+                   append_batch_size=False)
+    gtb = fluid.data(name="ygb", shape=[1, 2, 4], dtype="float32",
+                     append_batch_size=False)
+    gtl = fluid.data(name="ygl", shape=[1, 2], dtype="int64",
+                     append_batch_size=False)
+    loss = fluid.layers.yolov3_loss(
+        x, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
+        anchor_mask=[0, 1, 2], class_num=2, ignore_thresh=0.7,
+        downsample_ratio=32,
+    )
+    exe = _exe()
+    out = exe.run(
+        feed={
+            "yx": np.random.default_rng(0).standard_normal(
+                (1, 21, 4, 4)).astype("float32"),
+            "ygb": np.array(
+                [[[0.5, 0.5, 0.2, 0.3], [0, 0, 0, 0]]], "float32"),
+            "ygl": np.array([[1, 0]], "int64"),
+        },
+        fetch_list=[loss],
+    )[0]
+    assert np.isfinite(out).all() and out[0] > 0

@@ -1,0 +1,99 @@
+"""Model-family smoke + learning tests (BASELINE.json configs, small)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_resnet_trains_small():
+    from paddle_tpu.models import resnet
+
+    fluid.default_startup_program().random_seed = 5
+    vs = resnet.build_resnet_train(depth=18, class_num=4, image_size=32)
+    opt = fluid.optimizer.Momentum(0.05, 0.9)
+    opt.minimize(vs["loss"])
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(0)
+    imgs = rng.standard_normal((8, 3, 32, 32)).astype("float32") * 0.1
+    labels = rng.integers(0, 4, size=(8, 1)).astype("int64")
+    # make classes separable: add class-dependent channel bias
+    for i in range(8):
+        imgs[i, 0] += 0.5 * labels[i, 0]
+    losses = []
+    for _ in range(15):
+        lv = exe.run(
+            feed={"image": imgs, "label": labels}, fetch_list=[vs["loss"]]
+        )[0]
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_transformer_nmt_copy_task_learns():
+    from paddle_tpu.models import transformer_nmt as nmt
+
+    fluid.default_startup_program().random_seed = 5
+    cfg = nmt.NMTConfig(src_vocab=64, tgt_vocab=64, hidden=32, heads=4,
+                        ffn=64, enc_layers=1, dec_layers=1, max_len=16,
+                        dropout=0.0)
+    vs = nmt.build_transformer_nmt(cfg, src_len=8, tgt_len=8)
+    fluid.optimizer.Adam(3e-3).minimize(vs["loss"])
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    src, tgt, labels = nmt.synthetic_pair_batch(cfg, 16, 8, 8)
+    losses = []
+    for _ in range(30):
+        lv = exe.run(
+            feed={"src_ids": src, "tgt_ids": tgt, "tgt_labels": labels},
+            fetch_list=[vs["loss"]],
+        )[0]
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_wide_deep_learns_and_auc_improves():
+    from paddle_tpu.models import wide_deep as wd
+
+    fluid.default_startup_program().random_seed = 5
+    vs = wd.build_wide_deep(
+        num_sparse_fields=6, sparse_vocab=1000, emb_dim=8, num_dense=13,
+        hidden=[32, 32],
+    )
+    fluid.optimizer.Adam(1e-2).minimize(vs["loss"])
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    dense, sparse, label = wd.synthetic_ctr_batch(
+        256, num_sparse_fields=6, sparse_vocab=1000
+    )
+    aucs = []
+    for _ in range(20):
+        lv, av = exe.run(
+            feed={"dense": dense, "sparse": sparse, "ctr_label": label},
+            fetch_list=[vs["loss"], vs["auc"]],
+        )
+        aucs.append(float(av))
+    assert aucs[-1] > 0.8, aucs
+
+
+def test_bert_tiny_loss_drops():
+    from paddle_tpu.models import bert
+
+    fluid.default_startup_program().random_seed = 5
+    cfg = bert.bert_tiny(seq=32)
+    vs = bert.build_bert_pretrain(cfg, 32)
+    fluid.optimizer.Adam(1e-3).minimize(vs["loss"])
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    ids, labels = bert.synthetic_batch(cfg, 8, 32)
+    losses = []
+    for _ in range(12):
+        lv = exe.run(
+            feed={"input_ids": ids, "mlm_labels": labels},
+            fetch_list=[vs["loss"]],
+        )[0]
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
