@@ -58,18 +58,20 @@ def main():
     feed = {"input_ids": ids, "mlm_labels": labels}
     fetch = [vs["loss"]]
 
-    # warmup (compile)
+    # warmup: step 1 compiles; step 2 settles donated-buffer layouts so the
+    # timed loop measures steady state only
     t0 = time.time()
     loss0 = float(exe.run(feed=feed, fetch_list=fetch)[0])
     compile_s = time.time() - t0
+    exe.run(feed=feed, fetch_list=fetch)
 
-    # timed steps
+    # timed steps; keep fetches on device so the loop isn't serialized on
+    # per-step host readbacks (sync once at the end)
     n_steps = 30 if on_accel else 5
     t0 = time.time()
     for _ in range(n_steps):
-        out = exe.run(feed=feed, fetch_list=fetch)
-    # out fetch forces sync
-    last = float(out[0])
+        out = exe.run(feed=feed, fetch_list=fetch, return_numpy=False)
+    last = float(np.asarray(out[0]))
     dt = time.time() - t0
     tokens_per_sec = n_steps * batch * seq / dt
 

@@ -6,6 +6,8 @@ Executor.run lowers the Program once per (program version, feed signature)
 into a jitted step function with donated state, then replays it — so steady-
 state training is a single XLA executable launch per iteration.
 """
+import warnings
+
 import numpy as np
 
 import jax
@@ -40,8 +42,10 @@ class _TensorView:
 class Scope:
     """name -> device array mapping (device-resident between runs)."""
 
-    def __init__(self):
+    def __init__(self, parent=None):
         self._vars = {}
+        self._parent = parent
+        self._kids = []
 
     def set(self, name, value):
         self._vars[name] = value
@@ -65,18 +69,25 @@ class Scope:
         return self._vars.pop(name, default)
 
     def find_var(self, name):
-        if name not in self._vars:
-            return None
-        return _TensorView(self, name)
+        """Look up a var here or in any ancestor scope (ref
+        framework/scope.cc Scope::FindVar parent-chain semantics)."""
+        scope = self
+        while scope is not None:
+            if name in scope._vars:
+                return _TensorView(scope, name)
+            scope = scope._parent
+        return None
 
     def var(self, name):
         return _TensorView(self, name)
 
     def new_scope(self):
-        return Scope()
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
 
     def drop_kids(self):
-        pass
+        self._kids = []
 
 
 _global_scope = Scope()
@@ -105,6 +116,9 @@ def _as_name(v):
     if isinstance(v, str):
         return v
     raise TypeError("fetch/feed entry must be Variable or str, got %r" % (v,))
+
+
+_aot_warned = False
 
 
 class Executor:
@@ -152,15 +166,32 @@ class Executor:
             tuple(fetch_names),
             tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
         )
+        rng = self._next_rng(program)
         entry = self._cache.get(sig) if use_program_cache else None
         if entry is None:
             step = build_step_fn(program, list(feed_arrays.keys()), fetch_names)
             jitted = jax.jit(step, donate_argnums=(0,))
-            entry = jitted
+            # AOT-compile: freezes one executable for this signature. Without
+            # this, the donated state outputs come back in compiler-chosen
+            # layouts, and the SECOND run would retrace+recompile the whole
+            # module against those layouts (a full minutes-long compile for a
+            # big model). The AOT executable instead relayouts inputs on
+            # device, so run 2+ reuse the same binary.
+            try:
+                entry = jitted.lower(state, feed_arrays, rng).compile()
+            except Exception as e:
+                global _aot_warned
+                if not _aot_warned:
+                    _aot_warned = True
+                    warnings.warn(
+                        "AOT compile failed (%s: %s); falling back to traced "
+                        "jit — expect one redundant recompile on the second "
+                        "run of each program" % (type(e).__name__, e)
+                    )
+                entry = jitted  # fall back to the tracing path
             if use_program_cache:
                 self._cache[sig] = entry
 
-        rng = self._next_rng(program)
         fetches, new_state = entry(state, feed_arrays, rng)
         for k, v in new_state.items():
             scope.set(k, v)

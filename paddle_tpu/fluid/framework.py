@@ -610,13 +610,47 @@ class Program:
         for t in targets:
             target_names.add(t.name if isinstance(t, Variable) else t)
         gb = p.global_block()
+
+        def _op_reads(op):
+            """All names an op reads, including reads made by ops inside its
+            sub-blocks (while/cond bodies reference global-block vars that
+            never appear on the outer op's input list)."""
+            reads = set(op.input_arg_names)
+            if op.has_attr("sub_block"):
+                sub = p.block(op.attr("sub_block"))
+                produced = set()
+                for sop in sub.ops:
+                    reads.update(_op_reads(sop) - produced)
+                    produced.update(sop.output_arg_names)
+                reads -= set(sub.vars)  # locals of the sub-block
+            return reads
+
         needed = set(target_names)
         kept = []
         for op in reversed(gb.ops):
             if any(n in needed for n in op.output_arg_names):
                 kept.append(op)
-                needed.update(op.input_arg_names)
+                needed.update(_op_reads(op))
         gb.ops = list(reversed(kept))
+        # drop vars no op references (keep targets + data feeds, like the
+        # reference's prune which rebuilds the block from the kept op set).
+        # Ops carrying a sub_block (while/cond/...) reference global-block
+        # vars — e.g. parameters of layers built inside the body — only from
+        # within the sub-block's ops, so walk those recursively too.
+        referenced = set(target_names)
+
+        def _mark(ops):
+            for op in ops:
+                referenced.update(op.input_arg_names)
+                referenced.update(op.output_arg_names)
+                if op.has_attr("sub_block"):
+                    _mark(p.block(op.attr("sub_block")).ops)
+
+        _mark(gb.ops)
+        for name in list(gb.vars):
+            v = gb.vars[name]
+            if name not in referenced and not getattr(v, "is_data", False):
+                del gb.vars[name]
         p._bump_version()
         return p
 

@@ -78,14 +78,26 @@ def density_prior_box(input, image, densities=None, fixed_sizes=None,
                       fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
                       clip=False, steps=[0.0, 0.0], offset=0.5,
                       flatten_to_2d=False, name=None):
-    # density variant reduces to prior_box with expanded size lists
-    sizes = []
-    for d, s in zip(densities or [1], fixed_sizes or [1.0]):
-        sizes.extend([s] * (d * d))
-    return prior_box(
-        input, image, min_sizes=sizes, aspect_ratios=fixed_ratios or [1.0],
-        variance=variance, clip=clip, steps=steps, offset=offset,
+    helper = LayerHelper("density_prior_box", **locals())
+    box = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [box], "Variances": [var]},
+        attrs={
+            "densities": list(densities or [1]),
+            "fixed_sizes": list(fixed_sizes or [1.0]),
+            "fixed_ratios": list(fixed_ratios or [1.0]),
+            "variances": list(variance),
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+            "flatten_to_2d": flatten_to_2d,
+        },
     )
+    return box, var
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
@@ -151,6 +163,7 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
             "nms_top_k": nms_top_k,
             "keep_top_k": keep_top_k,
             "nms_threshold": nms_threshold,
+            "nms_eta": nms_eta,
             "normalized": normalized,
             "background_label": background_label,
         },

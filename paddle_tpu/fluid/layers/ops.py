@@ -8,8 +8,8 @@ __all__ = [
     "sigmoid", "logsigmoid", "exp", "tanh", "atan", "sqrt", "rsqrt", "abs",
     "ceil", "floor", "cos", "acos", "asin", "sin", "sinh", "cosh", "round",
     "reciprocal", "square", "softplus", "softsign", "softshrink",
-    "hard_shrink", "cumsum", "thresholded_relu", "uniform_random", "erf",
-    "tan",
+    "hard_shrink", "tanh_shrink", "cumsum", "thresholded_relu",
+    "uniform_random", "erf", "tan",
 ]
 
 
@@ -44,6 +44,7 @@ softplus = _make_unary("softplus")
 softsign = _make_unary("softsign")
 erf = _make_unary("erf")
 tan = _make_unary("tan")
+tanh_shrink = _make_unary("tanh_shrink")
 
 
 def softshrink(x, alpha=0.5):

@@ -16,6 +16,7 @@ API-compatible surface with TPU-native semantics:
   script compatibility (they print a note once).
 """
 import warnings
+import zlib
 
 from . import framework
 
@@ -45,7 +46,12 @@ class HashName:
         self.eps = pserver_endpoints
 
     def dispatch(self, varlist):
-        return [self.eps[hash(v.name) % len(self.eps)] for v in varlist]
+        # stable digest, NOT builtin hash(): every process (trainer/restart)
+        # must agree on the same var -> endpoint placement
+        return [
+            self.eps[zlib.crc32(v.name.encode()) % len(self.eps)]
+            for v in varlist
+        ]
 
 
 class RoundRobin:

@@ -17,7 +17,7 @@ __all__ = ["NMTConfig", "build_transformer_nmt", "synthetic_pair_batch"]
 class NMTConfig:
     def __init__(self, src_vocab=10000, tgt_vocab=10000, hidden=256,
                  heads=8, ffn=1024, enc_layers=4, dec_layers=4,
-                 max_len=64, dropout=0.1, bos_id=0, eos_id=1):
+                 max_len=64, dropout=0.1, bos_id=0, eos_id=1, pad_id=2):
         self.src_vocab = src_vocab
         self.tgt_vocab = tgt_vocab
         self.hidden = hidden
@@ -29,6 +29,8 @@ class NMTConfig:
         self.dropout = dropout
         self.bos_id = bos_id
         self.eos_id = eos_id
+        self.pad_id = pad_id  # loss masking target; distinct from eos so
+        # the model IS trained to emit end-of-sequence
 
 
 def _mha(q_in, kv_in, cfg, name, mask=None):
@@ -132,7 +134,7 @@ def build_transformer_nmt(cfg, src_len, tgt_len):
                        bias_attr=ParamAttr(name="out_proj.b"))
     loss = layers.mean(
         layers.softmax_with_cross_entropy(
-            logits, layers.unsqueeze(labels, [2]), ignore_index=cfg.eos_id
+            logits, layers.unsqueeze(labels, [2]), ignore_index=cfg.pad_id
         )
     )
     return {
@@ -144,14 +146,15 @@ def build_transformer_nmt(cfg, src_len, tgt_len):
 def synthetic_pair_batch(cfg, batch, src_len, tgt_len, seed=0):
     """Copy-task pairs: target = source tokens shifted (teaches quickly)."""
     rng = np.random.default_rng(seed)
-    src = rng.integers(2, cfg.src_vocab, size=(batch, src_len)).astype("int64")
+    # real tokens start above pad_id so padding never collides with content
+    lo = cfg.pad_id + 1
+    src = rng.integers(lo, cfg.src_vocab, size=(batch, src_len)).astype("int64")
+    content = np.clip(src[:, : tgt_len - 1] % cfg.tgt_vocab, lo,
+                      cfg.tgt_vocab - 1)
     tgt_full = np.concatenate(
-        [np.full((batch, 1), cfg.bos_id, "int64"), src[:, : tgt_len - 1] % cfg.tgt_vocab],
-        axis=1,
+        [np.full((batch, 1), cfg.bos_id, "int64"), content], axis=1
     )
     labels = np.concatenate(
-        [src[:, :tgt_len - 1] % cfg.tgt_vocab,
-         np.full((batch, 1), cfg.eos_id, "int64")],
-        axis=1,
+        [content, np.full((batch, 1), cfg.eos_id, "int64")], axis=1
     )
     return src, tgt_full, labels

@@ -211,6 +211,56 @@ def _prior_box(ctx, ins, attrs):
     return {"Boxes": [out], "Variances": [var]}
 
 
+@register_op("density_prior_box")
+def _density_prior_box(ctx, ins, attrs):
+    """Density prior boxes (ref: detection/density_prior_box_op.h): for each
+    (density d, fixed_size s) the s-sized boxes are replicated on a d x d
+    sub-grid inside every cell, shifted by step/d — NOT d*d copies at the
+    cell center."""
+    feat = ins["Input"][0]   # (N, C, H, W)
+    image = ins["Image"][0]  # (N, C, IH, IW)
+    densities = attrs.get("densities", [1])
+    fixed_sizes = attrs.get("fixed_sizes", [1.0])
+    fixed_ratios = attrs.get("fixed_ratios", [1.0])
+    clip = attrs.get("clip", False)
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+    # cell centers
+    cx0 = jnp.arange(w) * sw + offset * sw
+    cy0 = jnp.arange(h) * sh + offset * sh
+    cxg, cyg = jnp.meshgrid(cx0, cy0)  # (H, W)
+    boxes = []
+    for d, s in zip(densities, fixed_sizes):
+        shift_w = sw / d
+        shift_h = sh / d
+        for r in fixed_ratios:
+            bw = s * np.sqrt(r)
+            bh = s / np.sqrt(r)
+            for dy in range(d):
+                for dx in range(d):
+                    cx = cxg - sw / 2 + shift_w / 2 + dx * shift_w
+                    cy = cyg - sh / 2 + shift_h / 2 + dy * shift_h
+                    boxes.append(jnp.stack(
+                        [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                         (cx + bw / 2) / iw, (cy + bh / 2) / ih],
+                        axis=-1,
+                    ))
+    out = jnp.stack(boxes, axis=2)  # (H, W, num_priors, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    if attrs.get("flatten_to_2d", False):
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return {"Boxes": [out], "Variances": [var]}
+
+
 @register_op("yolo_box")
 def _yolo_box(ctx, ins, attrs):
     """YOLOv3 box decoding (ref: detection/yolo_box_op.cc)."""
@@ -265,13 +315,17 @@ def _box_clip(ctx, ins, attrs):
     scale = jnp.maximum(im_info[:, 2], 1e-6) if im_info.shape[1] > 2 else 1.0
     h = jnp.round(im_info[:, 0] / scale) - 1
     w = jnp.round(im_info[:, 1] / scale) - 1
-    if boxes.ndim == 2:
+    squeeze = boxes.ndim == 2
+    if squeeze:
         boxes = boxes[None]
     x1 = jnp.clip(boxes[..., 0], 0, w[:, None])
     y1 = jnp.clip(boxes[..., 1], 0, h[:, None])
     x2 = jnp.clip(boxes[..., 2], 0, w[:, None])
     y2 = jnp.clip(boxes[..., 3], 0, h[:, None])
-    return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}
+    out = jnp.stack([x1, y1, x2, y2], axis=-1)
+    if squeeze:  # keep the caller-declared rank
+        out = out[0]
+    return {"Output": [out]}
 
 
 def _iou_matrix(a, b):
@@ -293,11 +347,18 @@ def _multiclass_nms(ctx, ins, attrs):
     scores = ins["Scores"][0]   # (N, C, M)
     score_thresh = attrs["score_threshold"]
     nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", -1)
+    nms_eta = attrs.get("nms_eta", 1.0)
     keep_top_k = attrs["keep_top_k"]
     background = attrs.get("background_label", 0)
     n, c, m = scores.shape
 
     def per_image(boxes, sc):
+        # pre-NMS per-class top-k (ref keeps only the nms_top_k highest
+        # scoring candidates of each class before suppression)
+        if nms_top_k is not None and 0 < nms_top_k < m:
+            kth = lax.top_k(sc, nms_top_k)[0][:, -1:]
+            sc = jnp.where(sc >= kth, sc, -1.0)
         # candidates: all (class, box) pairs except background
         cls_ids = jnp.arange(c)[:, None].repeat(m, 1)   # (C, M)
         flat_scores = sc.reshape(-1)
@@ -307,17 +368,21 @@ def _multiclass_nms(ctx, ins, attrs):
         flat_scores = jnp.where(valid, flat_scores, -1.0)
 
         def body(carry, _):
-            cur_scores, = carry
+            cur_scores, thresh = carry
             best = jnp.argmax(cur_scores)
             best_score = cur_scores[best]
             best_box = flat_box[best]
             best_cls = flat_cls[best]
             # suppress same-class overlapping candidates + self
             ious = _iou_matrix(best_box[None], flat_box)[0]
-            suppress = ((ious > nms_thresh) & (flat_cls == best_cls)) | (
+            suppress = ((ious > thresh) & (flat_cls == best_cls)) | (
                 jnp.arange(flat_scores.shape[0]) == best
             )
             cur_scores = jnp.where(suppress, -1.0, cur_scores)
+            # adaptive NMS (ref: threshold decays by nms_eta while > 0.5)
+            thresh = jnp.where(
+                (nms_eta < 1.0) & (thresh > 0.5), thresh * nms_eta, thresh
+            )
             row = jnp.concatenate(
                 [
                     jnp.where(best_score > 0, best_cls, -1)[None].astype(
@@ -327,9 +392,10 @@ def _multiclass_nms(ctx, ins, attrs):
                     best_box,
                 ]
             )
-            return (cur_scores,), row
+            return (cur_scores, thresh), row
 
-        _, rows = lax.scan(body, (flat_scores,), None, length=keep_top_k)
+        init = (flat_scores, jnp.asarray(nms_thresh, boxes.dtype))
+        _, rows = lax.scan(body, init, None, length=keep_top_k)
         return rows
 
     out = jax.vmap(per_image)(bboxes, scores)

@@ -68,6 +68,7 @@ register_op("hard_shrink")(
         )
     )
 )
+register_op("tanh_shrink")(_act(lambda x, a: x - jnp.tanh(x)))
 register_op("hard_sigmoid")(
     _act(
         lambda x, a: jnp.clip(

@@ -68,7 +68,10 @@ class DistributedProgram:
         self._param_rules = list(param_rules or [])
         # honor sharding annotations left by DistributeTranspiler.transpile
         for name, spec in (getattr(program, "_sharding_spec", None) or []):
-            self._param_rules.append(ShardingRule(re.escape(name) + "$", spec))
+            # exact-name anchor: a bare suffix pattern would also capture
+            # params like "src_emb" when the annotation targets "emb"
+            self._param_rules.append(
+                ShardingRule("^" + re.escape(name) + "$", spec))
         self._feed_axis = feed_axis
         self._feed_specs = feed_specs or {}  # feed name -> PartitionSpec
         self._cache = {}

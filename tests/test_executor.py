@@ -1,0 +1,133 @@
+"""Executor feed/fetch, scope persistence, compile-cache tests (mirrors
+reference fluid/tests/unittests/test_executor_and_mul.py etc.)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import Scope, global_scope, scope_guard
+
+
+def _simple_net():
+    x = fluid.data("x", [4], dtype="float32")
+    y = fluid.layers.fc(
+        x, size=2,
+        param_attr=fluid.ParamAttr(
+            name="w", initializer=fluid.initializer.Constant(0.5)),
+        bias_attr=fluid.ParamAttr(
+            name="b", initializer=fluid.initializer.Constant(0.1)))
+    return x, y
+
+
+def test_feed_fetch_numpy():
+    _, y = _simple_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x_np = np.ones((3, 4), "float32")
+    (out,) = exe.run(feed={"x": x_np}, fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((3, 2), 4 * 0.5 + 0.1, "float32"),
+                               rtol=1e-6)
+
+
+def test_fetch_by_name_string():
+    _, y = _simple_net()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (out,) = exe.run(feed={"x": np.zeros((1, 4), "float32")},
+                     fetch_list=[y.name])
+    np.testing.assert_allclose(np.asarray(out), [[0.1, 0.1]], rtol=1e-6)
+
+
+def test_startup_initializes_scope_params():
+    _simple_net()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = global_scope()
+    assert "w" in scope and "b" in scope
+    np.testing.assert_allclose(np.asarray(scope["w"]),
+                               np.full((4, 2), 0.5, "float32"))
+
+
+def test_param_updates_persist_across_runs():
+    x = fluid.data("x", [4], dtype="float32")
+    y = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w2"))
+    loss = fluid.layers.reduce_mean(y)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    w0 = np.asarray(global_scope()["w2"]).copy()
+    feed = {"x": np.ones((2, 4), "float32")}
+    exe.run(feed=feed, fetch_list=[loss])
+    w1 = np.asarray(global_scope()["w2"]).copy()
+    assert not np.allclose(w0, w1), "SGD step must mutate scope param"
+    exe.run(feed=feed, fetch_list=[loss])
+    w2 = np.asarray(global_scope()["w2"])
+    assert not np.allclose(w1, w2)
+
+
+def test_compile_cache_reused_for_same_shapes():
+    _, y = _simple_net()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((2, 4), "float32")}
+    exe.run(feed=feed, fetch_list=[y])
+    n_after_first = len(exe._cache)
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[y])
+    assert len(exe._cache) == n_after_first
+    # new batch size -> new specialization
+    exe.run(feed={"x": np.ones((5, 4), "float32")}, fetch_list=[y])
+    assert len(exe._cache) == n_after_first + 1
+
+
+def test_scope_guard_isolates_state():
+    _, y = _simple_net()
+    exe = fluid.Executor()
+    fresh = Scope()
+    with scope_guard(fresh):
+        exe.run(fluid.default_startup_program())
+        assert "w" in fresh
+    assert "w" not in global_scope()
+
+
+def test_scope_tree():
+    s = Scope()
+    s.set("a", np.zeros(2))
+    child = s.new_scope()
+    assert child.find_var("a") is not None
+    child.set("b", np.ones(2))
+    assert "b" not in s
+    s.drop_kids()
+
+
+def test_run_specific_program():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2], dtype="float32")
+        y = fluid.layers.scale(x, scale=10.0)
+    exe = fluid.Executor()
+    exe.run(startup)
+    (out,) = exe.run(main, feed={"x": np.array([[1.0, 2.0]], "float32")},
+                     fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(out), [[10.0, 20.0]])
+
+
+def test_feed_dtype_coercion_and_errors():
+    _, y = _simple_net()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    # float64 feed is coerced to the var's float32
+    (out,) = exe.run(feed={"x": np.ones((1, 4), "float64")}, fetch_list=[y])
+    assert np.asarray(out).dtype == np.float32
+
+
+def test_missing_feed_raises():
+    _, y = _simple_net()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    try:
+        exe.run(feed={}, fetch_list=[y])
+    except Exception as e:
+        assert "x" in str(e)
+    else:
+        raise AssertionError("expected error for missing feed")

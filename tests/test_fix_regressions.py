@@ -1,0 +1,153 @@
+"""Regression tests for review findings: prune w/ control-flow sub-blocks,
+sharding-rule anchoring, density priors, nms_top_k, box_clip rank, stable
+endpoint hashing, NMT pad/eos separation."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_prune_keeps_params_used_inside_while_body():
+    i = layers.fill_constant([1], "float32", 0.0)
+    n = layers.fill_constant([1], "float32", 3.0)
+    x = fluid.data("x", [4], dtype="float32")
+    acc = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 0.0)
+
+    def body(it, a):
+        h = layers.fc(a, size=4,
+                      param_attr=fluid.ParamAttr(name="loop_w"),
+                      bias_attr=False)
+        return layers.increment(it, in_place=False), h
+
+    _, out = layers.while_loop(
+        lambda it, a: layers.less_than(it, n), body, [i, acc])
+    pruned = fluid.default_main_program()._prune([out])
+    kept = {v.name for v in pruned.list_vars()}
+    assert "loop_w" in kept, "param used only in while body must survive prune"
+    # and the pruned program still runs
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (o,) = exe.run(pruned, feed={"x": np.ones((2, 4), "float32")},
+                   fetch_list=[out])
+    assert np.asarray(o).shape == (2, 4)
+
+
+def test_prune_keeps_producer_of_var_read_only_in_sub_block():
+    """A var produced OUTSIDE the loop but read only INSIDE the body must
+    keep its producing op through _prune."""
+    x = fluid.data("x", [4], dtype="float32")
+    bias = layers.scale(x, scale=3.0)  # producer outside the loop
+    i = layers.fill_constant([1], "float32", 0.0)
+    n = layers.fill_constant([1], "float32", 2.0)
+    acc = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 0.0)
+
+    def body(it, a):
+        return (layers.increment(it, in_place=False),
+                layers.elementwise_add(a, bias))
+
+    _, out = layers.while_loop(
+        lambda it, a: layers.less_than(it, n), body, [i, acc])
+    pruned = fluid.default_main_program()._prune([out])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (o,) = exe.run(pruned, feed={"x": np.ones((2, 4), "float32")},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), np.full((2, 4), 6.0))
+
+
+def test_sharding_rule_annotation_is_exact_match():
+    from paddle_tpu.parallel.sharding import DistributedProgram
+    from paddle_tpu.parallel.mesh import build_mesh
+    from jax.sharding import PartitionSpec as P
+    import jax
+
+    if len(jax.devices()) < 8:
+        return
+    mesh = build_mesh({"tp": 8})
+    prog = fluid.default_main_program()
+    prog._sharding_spec = [("emb", P("tp", None))]
+    dist = DistributedProgram(prog, mesh, feed_axis=None)
+    sharded = dist.param_sharding("emb", (16, 4))
+    other = dist.param_sharding("src_emb", (16, 4))
+    assert sharded.spec == P("tp", None)
+    assert other.spec == P()  # suffix name must NOT inherit the rule
+
+
+def test_density_prior_box_subgrid_offsets():
+    feat = fluid.data("feat", [1, 8, 2, 2], append_batch_size=False)
+    img = fluid.data("img", [1, 3, 64, 64], append_batch_size=False)
+    box, var = layers.density_prior_box(
+        feat, img, densities=[2], fixed_sizes=[16.0], fixed_ratios=[1.0])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (b,) = exe.run(
+        feed={"feat": np.zeros((1, 8, 2, 2), "float32"),
+              "img": np.zeros((1, 3, 64, 64), "float32")},
+        fetch_list=[box])
+    b = np.asarray(b)  # (H, W, 4 priors, 4)
+    assert b.shape == (2, 2, 4, 4)
+    cell = b[0, 0]  # 4 priors of one cell
+    # density 2 => the 4 priors sit on a 2x2 sub-grid, NOT stacked identical
+    assert len({tuple(np.round(p, 5)) for p in cell}) == 4
+    # sub-grid shift = step/d = 32/2 = 16px => 0.25 normalized
+    centers_x = (cell[:, 0] + cell[:, 2]) / 2
+    assert np.isclose(sorted(set(np.round(centers_x, 4)))[1]
+                      - sorted(set(np.round(centers_x, 4)))[0], 0.25)
+
+
+def test_multiclass_nms_respects_nms_top_k():
+    # two far-apart boxes, same class, both above threshold
+    boxes = np.array([[[0, 0, 10, 10], [50, 50, 60, 60]]], "float32")
+    scores = np.array([[[0.0, 0.0], [0.9, 0.8]]], "float32")  # class1 scores
+    b = fluid.data("b", [1, 2, 4], append_batch_size=False)
+    s = fluid.data("s", [1, 2, 2], append_batch_size=False)
+    out = layers.multiclass_nms(b, s, score_threshold=0.1, nms_top_k=1,
+                                keep_top_k=5, background_label=0)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (o,) = exe.run(feed={"b": boxes, "s": scores}, fetch_list=[out])
+    o = np.asarray(o)[0]
+    n_detected = int((o[:, 0] >= 0).sum())
+    assert n_detected == 1, "nms_top_k=1 must keep only the best candidate"
+
+
+def test_box_clip_preserves_2d_rank():
+    b = fluid.data("b", [5, 4], append_batch_size=False)
+    info = fluid.data("im", [1, 3], append_batch_size=False)
+    out = layers.box_clip(b, info)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (o,) = exe.run(
+        feed={"b": np.array([[-5, -5, 200, 200]] * 5, "float32"),
+              "im": np.array([[100, 100, 1.0]], "float32")},
+        fetch_list=[out])
+    assert np.asarray(o).shape == (5, 4)
+    assert np.asarray(o).max() <= 99.0
+
+
+def test_hashname_dispatch_is_stable_digest():
+    import zlib
+    from paddle_tpu.fluid.transpiler import HashName
+
+    eps = ["ep0", "ep1", "ep2"]
+
+    class V:
+        def __init__(self, name):
+            self.name = name
+
+    vs = [V("fc_0.w_0"), V("emb"), V("fc_1.b_0")]
+    got = HashName(eps).dispatch(vs)
+    expect = [eps[zlib.crc32(v.name.encode()) % 3] for v in vs]
+    assert got == expect
+
+
+def test_nmt_trains_eos_but_masks_pad():
+    from paddle_tpu.models.transformer_nmt import (
+        NMTConfig, synthetic_pair_batch)
+
+    cfg = NMTConfig(src_vocab=50, tgt_vocab=50, hidden=16, heads=2, ffn=32,
+                    enc_layers=1, dec_layers=1)
+    src, tgt, labels = synthetic_pair_batch(cfg, 4, 8, 8)
+    assert (labels == cfg.eos_id).any(), "labels must contain real EOS"
+    assert not (labels == cfg.pad_id).any()
+    assert src.min() > cfg.pad_id

@@ -1,0 +1,150 @@
+"""Program/Block/Variable/Operator IR tests (mirrors reference
+fluid/tests/unittests/test_program.py + test_operator_desc.py style)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def test_program_block_structure():
+    prog = Program()
+    g = prog.global_block()
+    assert g.idx == 0 and g.parent_idx == -1
+    with program_guard(prog):
+        x = g.create_var(name="x", shape=[2, 3], dtype="float32")
+        assert g.var("x") is x
+        assert g.has_var("x")
+        sub = prog._create_block()
+        assert sub.parent_idx == 0
+        assert sub._var_recursive("x") is x
+        prog._rollback()
+        assert prog.current_block() is g
+
+
+def test_operator_io_and_attrs():
+    prog = Program()
+    with program_guard(prog):
+        b = prog.global_block()
+        x = b.create_var(name="x", shape=[2, 2], dtype="float32")
+        y = b.create_var(name="y", shape=[2, 2], dtype="float32")
+        op = b.append_op(type="scale", inputs={"X": [x]},
+                         outputs={"Out": [y]},
+                         attrs={"scale": 2.0, "bias": 0.0})
+        assert op.type == "scale"
+        assert op.input("X") == ["x"]
+        assert op.output("Out") == ["y"]
+        assert op.attr("scale") == 2.0
+        op._set_attr("scale", 3.0)
+        assert op.attr("scale") == 3.0
+        assert "scale" in op.all_attrs()
+        assert op.input_arg_names == ["x"]
+        assert op.output_arg_names == ["y"]
+
+
+def test_layer_records_ops_in_default_program():
+    x = fluid.data("x", [4], dtype="float32")
+    y = fluid.layers.fc(x, size=3)
+    prog = fluid.default_main_program()
+    op_types = [op.type for op in prog.global_block().ops]
+    assert "mul" in op_types or "matmul" in op_types or "fc" in op_types
+    params = prog.all_parameters()
+    assert len(params) == 2  # weight + bias
+    assert all(isinstance(p, framework.Parameter) for p in params)
+    assert y.shape[-1] == 3
+
+
+def test_program_clone_for_test_disables_dropout_randomness():
+    x = fluid.data("x", [8], dtype="float32")
+    h = fluid.layers.fc(x, size=8)
+    h = fluid.layers.dropout(h, dropout_prob=0.5)
+    loss = fluid.layers.reduce_mean(h)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    # clone shares parameters but is a distinct Program
+    assert test_prog is not fluid.default_main_program()
+    names_main = {p.name for p in
+                  fluid.default_main_program().all_parameters()}
+    names_test = {p.name for p in test_prog.all_parameters()}
+    assert names_main == names_test
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((2, 8), "float32")}
+    a = np.asarray(exe.run(test_prog, feed=feed, fetch_list=[loss])[0])
+    b = np.asarray(exe.run(test_prog, feed=feed, fetch_list=[loss])[0])
+    # test-mode dropout is identity => deterministic
+    np.testing.assert_allclose(a, b)
+
+
+def test_prune_keeps_only_needed_ops():
+    x = fluid.data("x", [4], dtype="float32")
+    h = fluid.layers.fc(x, size=4, name="keepme")
+    unused = fluid.layers.fc(x, size=9, name="dropme")
+    pruned = fluid.default_main_program()._prune([h])
+    kept_vars = {v.name for v in pruned.list_vars()}
+    assert h.name in kept_vars
+    assert unused.name not in kept_vars
+
+
+def test_program_json_roundtrip():
+    x = fluid.data("x", [4], dtype="float32")
+    h = fluid.layers.fc(x, size=3)
+    fluid.layers.softmax(h)
+    prog = fluid.default_main_program()
+    text = prog.to_json()
+    prog2 = Program.from_json(text)
+    assert [op.type for op in prog2.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+    assert {v.name for v in prog2.list_vars()} == \
+        {v.name for v in prog.list_vars()}
+    # parameters keep their Parameter-ness and trainability
+    assert {p.name for p in prog2.all_parameters()} == \
+        {p.name for p in prog.all_parameters()}
+
+
+def test_unique_name_generator():
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b
+    with unique_name.guard():
+        # fresh generator inside guard: numbering restarts (ref behavior)
+        c = unique_name.generate("fc")
+        assert c == a
+    # after guard the outer generator resumes
+    d = unique_name.generate("fc")
+    assert d not in (a, b)
+
+
+def test_name_scope_prefixes():
+    with framework.name_scope("outer"):
+        with framework.name_scope("inner"):
+            full = framework._full_name_scope()
+    assert "outer" in full and "inner" in full
+
+
+def test_grad_var_name():
+    assert framework.grad_var_name("w") == "w@GRAD"
+
+
+def test_variable_stop_gradient_blocks_grad():
+    x = fluid.data("x", [3], append_batch_size=False, dtype="float32",
+                   stop_gradient=False)
+    frozen = fluid.layers.fc(x, size=3,
+                             param_attr=fluid.ParamAttr(trainable=False),
+                             bias_attr=fluid.ParamAttr(trainable=False))
+    w_trainable = fluid.layers.create_parameter([3], "float32",
+                                                name="w_t")
+    y = fluid.layers.elementwise_add(frozen, w_trainable)
+    loss = fluid.layers.reduce_sum(y)
+    pg = fluid.backward.append_backward(loss)
+    names = {p.name for p, g in pg}
+    assert "w_t" in names
+    assert all(not n.startswith("fc") or "w_t" == n for n in names)
+
+
+def test_program_guard_restores_defaults():
+    before = fluid.default_main_program()
+    p = Program()
+    with program_guard(p):
+        assert fluid.default_main_program() is p
+    assert fluid.default_main_program() is before

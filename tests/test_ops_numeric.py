@@ -1,0 +1,267 @@
+"""OpTest-style numeric checks: forward AND gradient vs torch-cpu oracle.
+
+Mirrors the reference's python/paddle/fluid/tests/unittests/op_test.py
+pattern (forward output check + gradient check per op), but instead of
+finite differences the oracle is torch autograd on CPU.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.backward import gradients
+
+
+def run_fwd_grad(build, x_np):
+    """Build y = build(x) on a fed var, return (y, dsum(y)/dx)."""
+    x = fluid.data(name="x", shape=list(x_np.shape), append_batch_size=False,
+                   dtype=str(x_np.dtype), stop_gradient=False)
+    y = build(x)
+    loss = fluid.layers.reduce_sum(y)
+    (gx,) = gradients(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    y_v, gx_v = exe.run(feed={"x": x_np}, fetch_list=[y, gx])
+    return np.asarray(y_v), np.asarray(gx_v)
+
+
+def torch_fwd_grad(fn, x_np):
+    t = torch.tensor(x_np, requires_grad=True)
+    y = fn(t)
+    y.sum().backward()
+    return y.detach().numpy(), t.grad.numpy()
+
+
+RNG = np.random.default_rng(42)
+X24 = RNG.standard_normal((2, 4)).astype("float32")
+XPOS = (RNG.random((2, 4)).astype("float32") + 0.1)
+
+UNARY_CASES = [
+    ("relu", lambda L, x: L.relu(x), torch.relu, X24),
+    ("sigmoid", lambda L, x: L.sigmoid(x), torch.sigmoid, X24),
+    ("tanh", lambda L, x: L.tanh(x), torch.tanh, X24),
+    ("exp", lambda L, x: L.exp(x), torch.exp, X24),
+    ("log", lambda L, x: L.log(x), torch.log, XPOS),
+    ("sqrt", lambda L, x: L.sqrt(x), torch.sqrt, XPOS),
+    ("square", lambda L, x: L.square(x), lambda t: t * t, X24),
+    ("abs", lambda L, x: L.abs(x), torch.abs, X24),
+    ("gelu", lambda L, x: L.gelu(x),
+     lambda t: torch.nn.functional.gelu(t), X24),
+    ("leaky_relu", lambda L, x: L.leaky_relu(x, alpha=0.02),
+     lambda t: torch.nn.functional.leaky_relu(t, 0.02), X24),
+    ("elu", lambda L, x: L.elu(x, alpha=1.0),
+     lambda t: torch.nn.functional.elu(t, 1.0), X24),
+    ("softplus", lambda L, x: L.softplus(x),
+     lambda t: torch.nn.functional.softplus(t), X24),
+    ("softsign", lambda L, x: L.softsign(x),
+     lambda t: torch.nn.functional.softsign(t), X24),
+    ("softmax", lambda L, x: L.softmax(x),
+     lambda t: torch.softmax(t, -1), X24),
+    ("reciprocal", lambda L, x: L.reciprocal(x),
+     torch.reciprocal, XPOS),
+    ("sin", lambda L, x: L.sin(x), torch.sin, X24),
+    ("cos", lambda L, x: L.cos(x), torch.cos, X24),
+    ("rsqrt", lambda L, x: L.rsqrt(x), torch.rsqrt, XPOS),
+    ("erf", lambda L, x: L.erf(x), torch.erf, X24),
+    ("swish", lambda L, x: L.swish(x),
+     lambda t: t * torch.sigmoid(t), X24),
+    ("relu6", lambda L, x: L.relu6(x),
+     lambda t: torch.nn.functional.relu6(t), X24),
+    ("hard_sigmoid", lambda L, x: L.hard_sigmoid(x),
+     lambda t: torch.clamp(0.2 * t + 0.5, 0.0, 1.0), X24),
+    ("cumsum", lambda L, x: L.cumsum(x, axis=1),
+     lambda t: torch.cumsum(t, 1), X24),
+    ("reduce_sum", lambda L, x: L.reduce_sum(x, dim=1),
+     lambda t: t.sum(1), X24),
+    ("reduce_mean", lambda L, x: L.reduce_mean(x, dim=1),
+     lambda t: t.mean(1), X24),
+    ("reduce_max", lambda L, x: L.reduce_max(x, dim=1),
+     lambda t: t.max(1).values, X24),
+    ("transpose", lambda L, x: L.transpose(x, perm=[1, 0]),
+     lambda t: t.t(), X24),
+    ("scale", lambda L, x: L.scale(x, scale=3.0, bias=1.5),
+     lambda t: 3.0 * t + 1.5, X24),
+    ("l2_normalize", lambda L, x: L.l2_normalize(x, axis=1),
+     lambda t: torch.nn.functional.normalize(t, dim=1), X24),
+]
+
+
+@pytest.mark.parametrize("name,build,oracle,x", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_fwd_grad(name, build, oracle, x):
+    y_v, gx_v = run_fwd_grad(lambda v: build(fluid.layers, v), x)
+    y_t, gx_t = torch_fwd_grad(oracle, x)
+    np.testing.assert_allclose(y_v, y_t, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(gx_v, gx_t, rtol=2e-5, atol=2e-5)
+
+
+def test_matmul_fwd_grad():
+    a_np = RNG.standard_normal((3, 4)).astype("float32")
+    b_np = RNG.standard_normal((4, 5)).astype("float32")
+    a = fluid.data("a", [3, 4], append_batch_size=False,
+                   stop_gradient=False)
+    b = fluid.data("b", [4, 5], append_batch_size=False,
+                   stop_gradient=False)
+    y = fluid.layers.matmul(a, b)
+    loss = fluid.layers.reduce_sum(y)
+    ga, gb = gradients(loss, [a, b])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    y_v, ga_v, gb_v = exe.run(feed={"a": a_np, "b": b_np},
+                              fetch_list=[y, ga, gb])
+    ta = torch.tensor(a_np, requires_grad=True)
+    tb = torch.tensor(b_np, requires_grad=True)
+    ty = ta @ tb
+    ty.sum().backward()
+    np.testing.assert_allclose(np.asarray(y_v), ty.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ga_v), ta.grad.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb_v), tb.grad.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_fwd_vs_torch():
+    x_np = RNG.standard_normal((2, 3, 8, 8)).astype("float32")
+    x = fluid.data("x", [2, 3, 8, 8], append_batch_size=False,
+                   stop_gradient=False)
+    y = fluid.layers.conv2d(
+        x, num_filters=5, filter_size=3, padding=1, stride=1,
+        param_attr=fluid.ParamAttr(
+            name="cw", initializer=fluid.initializer.Constant(0.1)),
+        bias_attr=fluid.ParamAttr(
+            name="cb", initializer=fluid.initializer.Constant(0.2)),
+    )
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (y_v,) = exe.run(feed={"x": x_np}, fetch_list=[y])
+    w = torch.full((5, 3, 3, 3), 0.1)
+    b = torch.full((5,), 0.2)
+    ty = torch.nn.functional.conv2d(torch.tensor(x_np), w, b, padding=1)
+    np.testing.assert_allclose(np.asarray(y_v), ty.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pool2d_vs_torch(pool_type):
+    x_np = RNG.standard_normal((2, 3, 8, 8)).astype("float32")
+
+    def build(x):
+        return fluid.layers.pool2d(x, pool_size=2, pool_type=pool_type,
+                                   pool_stride=2)
+
+    def oracle(t):
+        f = (torch.nn.functional.max_pool2d if pool_type == "max"
+             else torch.nn.functional.avg_pool2d)
+        return f(t, 2, 2)
+
+    y_v, gx_v = run_fwd_grad(build, x_np)
+    y_t, gx_t = torch_fwd_grad(oracle, x_np)
+    np.testing.assert_allclose(y_v, y_t, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(gx_v, gx_t, rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_vs_torch():
+    x_np = RNG.standard_normal((4, 6)).astype("float32")
+
+    def build(x):
+        return fluid.layers.layer_norm(
+            x, begin_norm_axis=1,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(1.0)),
+            bias_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(0.0)))
+
+    def oracle(t):
+        return torch.nn.functional.layer_norm(t, (6,))
+
+    y_v, gx_v = run_fwd_grad(build, x_np)
+    y_t, gx_t = torch_fwd_grad(oracle, x_np)
+    np.testing.assert_allclose(y_v, y_t, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gx_v, gx_t, rtol=1e-4, atol=1e-4)
+
+
+def test_batch_norm_train_vs_torch():
+    x_np = RNG.standard_normal((4, 3, 5, 5)).astype("float32")
+
+    def build(x):
+        return fluid.layers.batch_norm(
+            x,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(1.0)),
+            bias_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(0.0)))
+
+    def oracle(t):
+        return torch.nn.functional.batch_norm(
+            t, None, None, training=True, eps=1e-5)
+
+    y_v, gx_v = run_fwd_grad(build, x_np)
+    y_t, gx_t = torch_fwd_grad(oracle, x_np)
+    np.testing.assert_allclose(y_v, y_t, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gx_v, gx_t, rtol=1e-3, atol=1e-4)
+
+
+def test_softmax_with_cross_entropy_vs_torch():
+    logits_np = RNG.standard_normal((6, 10)).astype("float32")
+    labels_np = RNG.integers(0, 10, size=(6, 1)).astype("int64")
+    logits = fluid.data("logits", [6, 10], append_batch_size=False,
+                        stop_gradient=False)
+    labels = fluid.data("labels", [6, 1], append_batch_size=False,
+                        dtype="int64")
+    loss = fluid.layers.softmax_with_cross_entropy(logits, labels)
+    total = fluid.layers.reduce_sum(loss)
+    (g,) = gradients(total, [logits])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    loss_v, g_v = exe.run(feed={"logits": logits_np, "labels": labels_np},
+                          fetch_list=[loss, g])
+    t = torch.tensor(logits_np, requires_grad=True)
+    tl = torch.nn.functional.cross_entropy(
+        t, torch.tensor(labels_np[:, 0]), reduction="none")
+    tl.sum().backward()
+    np.testing.assert_allclose(np.asarray(loss_v)[:, 0], tl.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_v), t.grad.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_embedding_grad_is_scatter():
+    ids_np = np.array([[0], [2], [0]], dtype="int64")
+    ids = fluid.data("ids", [3, 1], append_batch_size=False, dtype="int64")
+    emb = fluid.layers.embedding(
+        ids, size=(4, 3),
+        param_attr=fluid.ParamAttr(
+            name="emb_w", initializer=fluid.initializer.Constant(0.5)))
+    loss = fluid.layers.reduce_sum(emb)
+    pg = fluid.backward.append_backward(loss)
+    grad_var = [g for p, g in pg if p.name == "emb_w"][0]
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    (g_v,) = exe.run(feed={"ids": ids_np}, fetch_list=[grad_var])
+    expect = np.zeros((4, 3), "float32")
+    expect[0] = 2.0  # row 0 appears twice
+    expect[2] = 1.0
+    np.testing.assert_allclose(np.asarray(g_v), expect)
+
+
+def test_elementwise_broadcast_fwd_grad():
+    a_np = RNG.standard_normal((2, 3, 4)).astype("float32")
+    b_np = RNG.standard_normal((3, 4)).astype("float32")
+    a = fluid.data("a", [2, 3, 4], append_batch_size=False,
+                   stop_gradient=False)
+    b = fluid.data("b", [3, 4], append_batch_size=False,
+                   stop_gradient=False)
+    y = fluid.layers.elementwise_mul(a, b)
+    loss = fluid.layers.reduce_sum(y)
+    ga, gb = gradients(loss, [a, b])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    y_v, ga_v, gb_v = exe.run(feed={"a": a_np, "b": b_np},
+                              fetch_list=[y, ga, gb])
+    ta = torch.tensor(a_np, requires_grad=True)
+    tb = torch.tensor(b_np, requires_grad=True)
+    (ta * tb).sum().backward()
+    np.testing.assert_allclose(np.asarray(y_v), a_np * b_np, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ga_v), ta.grad.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb_v), tb.grad.numpy(), rtol=1e-5)

@@ -1,0 +1,198 @@
+"""Optimizer numeric + convergence tests (mirrors reference
+test_optimizer.py + per-optimizer op tests): every optimizer must descend a
+quadratic bowl; Adam/Momentum checked against closed-form updates."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import global_scope
+
+
+def _bowl_loss(name="wq"):
+    """loss = mean((w - 3)^2) over a 4-vector parameter."""
+    w = fluid.layers.create_parameter(
+        [4], "float32", name=name,
+        default_initializer=fluid.initializer.Constant(0.0))
+    target = fluid.layers.fill_constant([4], "float32", 3.0)
+    diff = fluid.layers.elementwise_sub(w, target)
+    return fluid.layers.reduce_mean(fluid.layers.square(diff))
+
+
+OPTIMIZERS = [
+    ("sgd", lambda: fluid.optimizer.SGD(learning_rate=0.2), 60),
+    ("momentum", lambda: fluid.optimizer.Momentum(0.1, momentum=0.9), 60),
+    # LARS trust ratio ~ ||w||/||g|| is tiny near w=0, so it needs more steps
+    ("lars", lambda: fluid.optimizer.LarsMomentum(0.5, momentum=0.9), 300),
+    ("adagrad", lambda: fluid.optimizer.Adagrad(0.5), 120),
+    ("decayed_adagrad",
+     lambda: fluid.optimizer.DecayedAdagrad(0.5), 120),
+    ("adadelta",
+     lambda: fluid.optimizer.Adadelta(3.0, epsilon=1e-4), 150),
+    ("adam", lambda: fluid.optimizer.Adam(0.3), 80),
+    ("adamax", lambda: fluid.optimizer.Adamax(0.3), 80),
+    ("rmsprop", lambda: fluid.optimizer.RMSProp(0.3), 80),
+    ("ftrl", lambda: fluid.optimizer.Ftrl(0.9), 150),
+    ("lamb", lambda: fluid.optimizer.Lamb(0.1), 120),
+    ("dpsgd", lambda: fluid.optimizer.Dpsgd(0.3, clip=5.0, batch_size=1.0,
+                                            sigma=0.0), 200),
+]
+
+
+@pytest.mark.parametrize("name,make,steps", OPTIMIZERS,
+                         ids=[o[0] for o in OPTIMIZERS])
+def test_optimizer_descends_bowl(name, make, steps):
+    loss = _bowl_loss()
+    opt = make()
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    first = float(exe.run(fetch_list=[loss])[0])
+    for _ in range(steps - 1):
+        out = exe.run(fetch_list=[loss])
+    last = float(out[0])
+    assert last < first * 0.15, (
+        "%s failed to descend: %.4f -> %.4f" % (name, first, last))
+
+
+def test_sgd_matches_closed_form():
+    loss = _bowl_loss(name="w_sgd")
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(fetch_list=[loss])
+    # grad of mean((w-3)^2) at w=0 is 2*(0-3)/4 = -1.5 ; w1 = 0.1*1.5
+    np.testing.assert_allclose(
+        np.asarray(global_scope()["w_sgd"]),
+        np.full(4, 0.15, "float32"), rtol=1e-5)
+
+
+def test_adam_first_step_matches_formula():
+    loss = _bowl_loss(name="w_adam")
+    fluid.optimizer.Adam(learning_rate=0.01, beta1=0.9, beta2=0.999,
+                         epsilon=1e-8).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(fetch_list=[loss])
+    g = -1.5
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mh = m / (1 - 0.9)
+    vh = v / (1 - 0.999)
+    expect = 0.0 - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(
+        np.asarray(global_scope()["w_adam"]),
+        np.full(4, expect, "float32"), rtol=1e-4)
+
+
+def test_momentum_accumulator_state_persists():
+    loss = _bowl_loss(name="w_mom")
+    fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(fetch_list=[loss])
+    w1 = np.asarray(global_scope()["w_mom"]).copy()
+    exe.run(fetch_list=[loss])
+    w2 = np.asarray(global_scope()["w_mom"])
+    # velocity carries over: second step moves farther than the first
+    assert np.all(np.abs(w2 - w1) > np.abs(w1 - 0.0))
+
+
+def test_grad_clip_by_global_norm():
+    loss = _bowl_loss(name="w_clip")
+    fluid.clip.set_gradient_clip(
+        fluid.clip.GradientClipByGlobalNorm(clip_norm=0.01))
+    fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(fetch_list=[loss])
+    w = np.asarray(global_scope()["w_clip"])
+    # ||update|| = lr * clip_norm
+    assert np.linalg.norm(w) <= 0.0101
+
+
+def test_l2_regularizer_changes_update():
+    w = fluid.layers.create_parameter(
+        [4], "float32", name="w_reg",
+        default_initializer=fluid.initializer.Constant(1.0),
+        attr=fluid.ParamAttr(
+            name="w_reg",
+            regularizer=fluid.regularizer.L2Decay(0.5)))
+    loss = fluid.layers.reduce_mean(w)  # grad = 0.25 each
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(fetch_list=[loss])
+    # update = lr * (0.25 + 0.5 * 1.0) = 0.075
+    np.testing.assert_allclose(
+        np.asarray(global_scope()["w_reg"]),
+        np.full(4, 1.0 - 0.075, "float32"), rtol=1e-5)
+
+
+def test_lr_scheduler_exponential_decay():
+    loss = _bowl_loss(name="w_lr")
+    lr = fluid.layers.exponential_decay(
+        learning_rate=0.1, decay_steps=1, decay_rate=0.5, staircase=True)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(fetch_list=[loss])
+    w1 = np.asarray(global_scope()["w_lr"]).copy()
+    # step 0 used lr=0.1 -> w1 = 0.1 * 1.5 = 0.15
+    np.testing.assert_allclose(w1, np.full(4, 0.15, "float32"), rtol=1e-5)
+    exe.run(fetch_list=[loss])
+    w2 = np.asarray(global_scope()["w_lr"])
+    # step 1 used lr=0.05; grad at 0.15 = 2*(0.15-3)/4 = -1.425
+    np.testing.assert_allclose(
+        w2, w1 + 0.05 * 1.425, rtol=1e-4)
+
+
+def test_ema_tracks_params():
+    loss = _bowl_loss(name="w_ema")
+    opt = fluid.optimizer.SGD(learning_rate=0.2)
+    opt.minimize(loss)
+    ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+    ema.update()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for _ in range(5):
+        exe.run(fetch_list=[loss])
+    w = np.asarray(global_scope()["w_ema"]).copy()
+    with ema.apply(exe):
+        w_avg = np.asarray(global_scope()["w_ema"]).copy()
+    w_restored = np.asarray(global_scope()["w_ema"])
+    np.testing.assert_allclose(w_restored, w)
+    # EMA lags behind the raw trajectory toward 3.0
+    assert np.all(w_avg < w)
+
+
+def test_recompute_optimizer_same_result_as_plain():
+    import paddle_tpu.fluid as fl
+
+    def run(use_recompute):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [8], dtype="float32")
+            h1 = fl.layers.fc(x, size=8, act="relu",
+                              param_attr=fluid.ParamAttr(
+                                  name="rw1",
+                                  initializer=fluid.initializer.Constant(0.1)))
+            h2 = fl.layers.fc(h1, size=8, act="relu",
+                              param_attr=fluid.ParamAttr(
+                                  name="rw2",
+                                  initializer=fluid.initializer.Constant(0.1)))
+            loss = fl.layers.reduce_mean(h2)
+            opt = fluid.optimizer.SGD(learning_rate=0.5)
+            if use_recompute:
+                opt = fluid.optimizer.RecomputeOptimizer(opt)
+                opt._set_checkpoints([h1])
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        from paddle_tpu.fluid.executor import Scope, scope_guard
+        with scope_guard(Scope()):
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((2, 8), "float32")},
+                    fetch_list=[loss])
+            from paddle_tpu.fluid.executor import global_scope
+            return np.asarray(global_scope()["rw1"]).copy()
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
