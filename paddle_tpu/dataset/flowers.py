@@ -1,0 +1,31 @@
+"""Flowers-102 reader (ref: python/paddle/dataset/flowers.py). Yields
+(3x224x224 float32 image, int64 label); synthetic textured images with a
+class-dependent signal stand in for the real download."""
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+_N_CLASSES = 102
+
+
+def _samples(split, n):
+    rng = np.random.default_rng({"train": 41, "test": 42, "valid": 43}[split])
+    for _ in range(n):
+        label = int(rng.integers(0, _N_CLASSES))
+        img = rng.random((3, 224, 224)).astype("float32") * 0.3
+        # class-keyed stripe pattern
+        row = (label * 2) % 224
+        img[:, row:row + 4, :] += 0.6
+        yield np.clip(img, 0.0, 1.0), label
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return lambda: _samples("train", 300)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return lambda: _samples("test", 60)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return lambda: _samples("valid", 60)

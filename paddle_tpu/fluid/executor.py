@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from . import core
 from . import framework
 from .framework import Program, Variable, default_main_program
-from .lowering import build_step_fn
+from .lowering import OpLoweringError, build_step_fn
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 
@@ -77,6 +77,27 @@ class Scope:
                 return _TensorView(scope, name)
             scope = scope._parent
         return None
+
+    def find_value(self, name, default=None):
+        """Parent-chain value lookup (FindVar semantics, raw value)."""
+        scope = self
+        while scope is not None:
+            if name in scope._vars:
+                return scope._vars[name]
+            scope = scope._parent
+        return default
+
+    def update(self, name, value):
+        """Write to the scope in the chain that owns `name` (the reference
+        executor updates the variable FindVar resolves, not a shadow copy
+        in the child scope); falls back to a local set for new names."""
+        scope = self
+        while scope is not None:
+            if name in scope._vars:
+                scope._vars[name] = value
+                return
+            scope = scope._parent
+        self._vars[name] = value
 
     def var(self, name):
         return _TensorView(self, name)
@@ -169,7 +190,11 @@ class Executor:
         rng = self._next_rng(program)
         entry = self._cache.get(sig) if use_program_cache else None
         if entry is None:
-            step = build_step_fn(program, list(feed_arrays.keys()), fetch_names)
+            platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
+            step = build_step_fn(
+                program, list(feed_arrays.keys()), fetch_names,
+                platform=platform,
+            )
             jitted = jax.jit(step, donate_argnums=(0,))
             # AOT-compile: freezes one executable for this signature. Without
             # this, the donated state outputs come back in compiler-chosen
@@ -179,6 +204,8 @@ class Executor:
             # device, so run 2+ reuse the same binary.
             try:
                 entry = jitted.lower(state, feed_arrays, rng).compile()
+            except OpLoweringError:
+                raise  # user graph error (missing feed, bad shape, ...)
             except Exception as e:
                 global _aot_warned
                 if not _aot_warned:
@@ -194,7 +221,7 @@ class Executor:
 
         fetches, new_state = entry(state, feed_arrays, rng)
         for k, v in new_state.items():
-            scope.set(k, v)
+            scope.update(k, v)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
@@ -234,8 +261,11 @@ class Executor:
     def _gather_state(self, program, scope):
         state = {}
         for v in program.global_block().vars.values():
-            if v.persistable and v.name in scope:
-                state[v.name] = scope[v.name]
+            if not v.persistable:
+                continue
+            val = scope.find_value(v.name)
+            if val is not None:
+                state[v.name] = val
         return state
 
     def _next_rng(self, program):

@@ -611,18 +611,27 @@ class Program:
             target_names.add(t.name if isinstance(t, Variable) else t)
         gb = p.global_block()
 
+        # every attr that references a body block (while/scan/conditional_block
+        # ops use sub_block; cond/ifelse use true_block/false_block)
+        _BLOCK_ATTRS = ("sub_block", "true_block", "false_block")
+
+        def _sub_blocks(op):
+            return [
+                p.block(op.attr(a)) for a in _BLOCK_ATTRS if op.has_attr(a)
+            ]
+
         def _op_reads(op):
             """All names an op reads, including reads made by ops inside its
             sub-blocks (while/cond bodies reference global-block vars that
             never appear on the outer op's input list)."""
             reads = set(op.input_arg_names)
-            if op.has_attr("sub_block"):
-                sub = p.block(op.attr("sub_block"))
+            for sub in _sub_blocks(op):
+                sub_reads = set()
                 produced = set()
                 for sop in sub.ops:
-                    reads.update(_op_reads(sop) - produced)
+                    sub_reads.update(_op_reads(sop) - produced)
                     produced.update(sop.output_arg_names)
-                reads -= set(sub.vars)  # locals of the sub-block
+                reads |= sub_reads - set(sub.vars)  # minus sub-block locals
             return reads
 
         needed = set(target_names)
@@ -643,8 +652,8 @@ class Program:
             for op in ops:
                 referenced.update(op.input_arg_names)
                 referenced.update(op.output_arg_names)
-                if op.has_attr("sub_block"):
-                    _mark(p.block(op.attr("sub_block")).ops)
+                for sub in _sub_blocks(op):
+                    _mark(sub.ops)
 
         _mark(gb.ops)
         for name in list(gb.vars):

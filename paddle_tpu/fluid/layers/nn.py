@@ -43,6 +43,7 @@ __all__ = [
     "log_loss", "kldiv_loss", "npair_loss", "mse_loss", "roi_pool",
     "roi_align", "add_position_encoding", "continuous_value_model",
     "fsp_matrix", "data_norm", "filter_by_instag", "group_norm",
+    "fused_multihead_attention",
 ]
 
 
@@ -2250,3 +2251,25 @@ def gaussian_random_batch_size_like(
         },
     )
     return out
+
+
+def fused_multihead_attention(query, key, value, key_padding_mask=None,
+                              causal=False, dropout_rate=0.0, name=None):
+    """Fused scaled-dot-product multi-head attention.
+
+    TPU-native fusion of the reference's matmul->softmax->dropout->matmul
+    chain (ref: fluid/nets.py scaled_dot_product_attention); lowers to the
+    FlashAttention-2 pallas kernels in ops/pallas_attention.py on a single
+    TPU device, and to a partitionable einsum formulation elsewhere.
+
+    query/key/value: (B, H, T, D) Variables. key_padding_mask: optional
+    additive (B, T_k) float mask (-1e30 at padded keys).
+    """
+    inputs = {"Q": query, "K": key, "V": value}
+    if key_padding_mask is not None:
+        inputs["KeyPaddingMask"] = key_padding_mask
+    return _layer(
+        "fused_multihead_attention",
+        inputs,
+        {"causal": causal, "dropout_prob": dropout_rate},
+    )

@@ -175,7 +175,7 @@ def persistable_names(program):
 
 
 def build_step_fn(program, feed_names, fetch_names, is_test=False,
-                  extra_env=None):
+                  extra_env=None, mesh_axes=None, platform=None):
     """Return a pure function step(state, feeds, rng) -> (fetches, new_state).
 
     ``state`` / ``feeds`` are dicts name->array. ``new_state`` contains every
@@ -187,7 +187,8 @@ def build_step_fn(program, feed_names, fetch_names, is_test=False,
     persist = set(persistable_names(program))
 
     def step(state, feeds, rng):
-        ctx = LowerContext(rng=rng, is_test=is_test, program=program)
+        ctx = LowerContext(rng=rng, is_test=is_test, program=program,
+                           mesh_axes=mesh_axes, platform=platform)
         ctx.run_ops = run_ops  # control-flow ops recurse through this
         env = {}
         if extra_env:

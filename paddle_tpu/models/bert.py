@@ -22,7 +22,8 @@ __all__ = ["BertConfig", "build_bert_pretrain", "tp_rules", "bert_base",
 
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, num_layers=12, heads=12,
-                 ffn=3072, max_seq=512, type_vocab=2, dropout=0.1):
+                 ffn=3072, max_seq=512, type_vocab=2, dropout=0.1,
+                 use_fused_attention=True):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.num_layers = num_layers
@@ -31,6 +32,9 @@ class BertConfig:
         self.max_seq = max_seq
         self.type_vocab = type_vocab
         self.dropout = dropout
+        # fused_multihead_attention op (pallas flash kernels on TPU); the
+        # unfused path keeps the reference-shaped matmul/softmax graph
+        self.use_fused_attention = use_fused_attention
 
 
 def bert_base():
@@ -66,15 +70,20 @@ def _encoder_layer(x, cfg, i, attn_mask, is_test):
     q = layers.transpose(layers.squeeze(q, [2]), [0, 2, 1, 3])  # (B,nh,T,dh)
     k = layers.transpose(layers.squeeze(k, [2]), [0, 2, 1, 3])
     v = layers.transpose(layers.squeeze(v, [2]), [0, 2, 1, 3])
-    scores = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
-    if attn_mask is not None:
-        scores = layers.elementwise_add(scores, attn_mask)
-    probs = layers.softmax(scores)
-    if cfg.dropout and not is_test:
-        probs = layers.dropout(
-            probs, cfg.dropout, dropout_implementation="upscale_in_train"
-        )
-    ctxv = layers.matmul(probs, v)                       # (B,nh,T,dh)
+    if getattr(cfg, "use_fused_attention", False) and attn_mask is None:
+        ctxv = layers.fused_multihead_attention(
+            q, k, v, dropout_rate=cfg.dropout if not is_test else 0.0,
+        )                                                # (B,nh,T,dh)
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+        if attn_mask is not None:
+            scores = layers.elementwise_add(scores, attn_mask)
+        probs = layers.softmax(scores)
+        if cfg.dropout and not is_test:
+            probs = layers.dropout(
+                probs, cfg.dropout, dropout_implementation="upscale_in_train"
+            )
+        ctxv = layers.matmul(probs, v)                   # (B,nh,T,dh)
     ctxv = layers.transpose(ctxv, [0, 2, 1, 3])          # (B,T,nh,dh)
     ctxv = layers.reshape(ctxv, [0, 0, h])
     attn_out = layers.fc(

@@ -18,6 +18,7 @@ from . import misc_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import control_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
+from . import pallas_attention  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 

@@ -235,6 +235,11 @@ def _density_prior_box(ctx, ins, attrs):
     cx0 = jnp.arange(w) * sw + offset * sw
     cy0 = jnp.arange(h) * sh + offset * sh
     cxg, cyg = jnp.meshgrid(cx0, cy0)  # (H, W)
+    if len(densities) != len(fixed_sizes):
+        raise ValueError(
+            "density_prior_box: densities (%d) and fixed_sizes (%d) must "
+            "align one-to-one" % (len(densities), len(fixed_sizes))
+        )
     boxes = []
     for d, s in zip(densities, fixed_sizes):
         shift_w = sw / d
@@ -338,6 +343,57 @@ def _iou_matrix(a, b):
     return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-10)
 
 
+def _nms_adaptive(flat_scores, flat_box, flat_cls, n_cls, keep_top_k,
+                  nms_thresh, nms_eta, dtype):
+    """Adaptive NMS (nms_eta < 1), matching the reference NMSFast order:
+    every candidate is tested at ITS turn in score order against the kept
+    set, with the per-class threshold decayed once per kept box (while the
+    threshold stays > 0.5). O(C·M·keep_top_k) — the eta<1 path only."""
+    order = jnp.argsort(-flat_scores)
+    k = keep_top_k
+    slots = jnp.arange(k)
+
+    def body(carry, idx):
+        kept_box, kept_cls, kept_score, n_kept, thresh = carry
+        sc = flat_scores[idx]
+        box = flat_box[idx]
+        cls = flat_cls[idx]
+        ious = _iou_matrix(box[None], kept_box)[0]          # (K,)
+        overlapped = jnp.any(
+            (ious > thresh[cls]) & (kept_cls == cls) & (slots < n_kept)
+        )
+        keep = (sc > 0) & ~overlapped & (n_kept < k)
+        write = keep & (slots == n_kept)
+        kept_box = jnp.where(write[:, None], box[None], kept_box)
+        kept_cls = jnp.where(write, cls, kept_cls)
+        kept_score = jnp.where(write, sc, kept_score)
+        thresh = jnp.where(
+            keep & (jnp.arange(n_cls) == cls) & (thresh > 0.5),
+            thresh * nms_eta, thresh,
+        )
+        n_kept = n_kept + keep.astype(jnp.int32)
+        return (kept_box, kept_cls, kept_score, n_kept, thresh), None
+
+    init = (
+        jnp.zeros((k, 4), dtype),
+        jnp.full((k,), -1, jnp.int32),
+        jnp.zeros((k,), dtype),
+        jnp.asarray(0, jnp.int32),
+        jnp.full((n_cls,), nms_thresh, dtype),
+    )
+    (kept_box, kept_cls, kept_score, _, _), _ = lax.scan(
+        body, init, order
+    )
+    return jnp.concatenate(
+        [
+            jnp.where(kept_score > 0, kept_cls, -1)[:, None].astype(dtype),
+            kept_score[:, None],
+            kept_box,
+        ],
+        axis=1,
+    )
+
+
 @register_op("multiclass_nms")
 def _multiclass_nms(ctx, ins, attrs):
     """Static-shape greedy NMS (ref detection/multiclass_nms_op.cc): output
@@ -367,22 +423,24 @@ def _multiclass_nms(ctx, ins, attrs):
         valid = (flat_scores > score_thresh) & (flat_cls != background)
         flat_scores = jnp.where(valid, flat_scores, -1.0)
 
+        if nms_eta < 1.0:
+            return _nms_adaptive(
+                flat_scores, flat_box, flat_cls, c, keep_top_k, nms_thresh,
+                nms_eta, boxes.dtype,
+            )
+
         def body(carry, _):
-            cur_scores, thresh = carry
+            cur_scores = carry
             best = jnp.argmax(cur_scores)
             best_score = cur_scores[best]
             best_box = flat_box[best]
             best_cls = flat_cls[best]
             # suppress same-class overlapping candidates + self
             ious = _iou_matrix(best_box[None], flat_box)[0]
-            suppress = ((ious > thresh) & (flat_cls == best_cls)) | (
+            suppress = ((ious > nms_thresh) & (flat_cls == best_cls)) | (
                 jnp.arange(flat_scores.shape[0]) == best
             )
             cur_scores = jnp.where(suppress, -1.0, cur_scores)
-            # adaptive NMS (ref: threshold decays by nms_eta while > 0.5)
-            thresh = jnp.where(
-                (nms_eta < 1.0) & (thresh > 0.5), thresh * nms_eta, thresh
-            )
             row = jnp.concatenate(
                 [
                     jnp.where(best_score > 0, best_cls, -1)[None].astype(
@@ -392,10 +450,9 @@ def _multiclass_nms(ctx, ins, attrs):
                     best_box,
                 ]
             )
-            return (cur_scores, thresh), row
+            return cur_scores, row
 
-        init = (flat_scores, jnp.asarray(nms_thresh, boxes.dtype))
-        _, rows = lax.scan(body, init, None, length=keep_top_k)
+        _, rows = lax.scan(body, flat_scores, None, length=keep_top_k)
         return rows
 
     out = jax.vmap(per_image)(bboxes, scores)

@@ -1,0 +1,594 @@
+"""Fused multi-head attention as Pallas TPU kernels (FlashAttention-2 style).
+
+TPU-native replacement for the reference's attention pattern (ref:
+python/paddle/fluid/nets.py:scaled_dot_product_attention and the
+matmul+softmax+dropout+matmul chain in its transformer models). Instead of
+materialising the (B, H, T, T) score tensor in HBM, the forward kernel keeps
+one (block_q, block_k) tile in VMEM at a time with online-softmax
+accumulation; backward recomputes tiles flash-style from the saved
+log-sum-exp, so attention memory is O(T·D) instead of O(T²).
+
+Design notes (TPU):
+- grid = (B*H, Tq/block_q); K and V for one (batch, head) ride whole in VMEM
+  (T·D ≤ ~1M elements covers T=16k at D=64 — beyond that, sequence
+  parallelism via parallel/ring_attention.py splits T across chips anyway).
+- QK^T and P·V hit the MXU via dot_general with f32 accumulation; the
+  running max/sum rescale is VPU work fused around them.
+- dropout uses a counter-based hash PRNG written in plain integer jnp ops
+  (murmur3 finalizer over absolute tile coordinates), NOT pltpu.prng_*:
+  the same bits are regenerated bit-exactly in the backward kernels and in
+  interpret mode on CPU, which makes the dropout path unit-testable off-TPU.
+- backward = two kernels (FlashAttention-2 split): dq over q-tiles, dk/dv
+  over k-tiles, both re-forming P from the saved lse.
+
+`flash_attention` carries a custom_vjp; `reference_attention` is the plain
+jax oracle used by tests and by the CPU lowering fallback.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "reference_attention"]
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# counter-based dropout bits (identical in fwd/bwd kernels and on CPU)
+# ---------------------------------------------------------------------------
+def fold_bh_seed(seed, bh):
+    """Mix the (batch·head) grid index into the dropout seed so every head
+    draws independent bits (also used by tests to rebuild the mask)."""
+    return seed + bh.astype(jnp.int32) * jnp.int32(1000003)
+
+
+def _tile_random_bits(seed, qi, kj, bq, bk):
+    """uint32 bits for the (qi, kj) score tile; pure jnp integer ops."""
+    rows = lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
+    cols = lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
+    h = (
+        seed.astype(jnp.uint32)
+        ^ (qi.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+        ^ (kj.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    )
+    h = h + rows * jnp.uint32(0x27D4EB2F) + cols * jnp.uint32(0x165667B1)
+    # murmur3 fmix32
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _keep_mask(seed, qi, kj, bq, bk, dropout_p):
+    bits = _tile_random_bits(seed, qi, kj, bq, bk)
+    threshold = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return bits >= threshold
+
+
+def _causal_mask_tile(qi, kj, bq, bk):
+    rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = kj * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows >= cols
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+def _fwd_kernel(seed_ref, kpm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale, causal, dropout_p, block_k, nk):
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0]                                     # (bq, D)
+    seed = fold_bh_seed(seed_ref[0, 0], pl.program_id(0))
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]         # (bk, D)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                                          # (bq, bk)
+        if kpm_ref is not None:
+            s = s + kpm_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            s = jnp.where(_causal_mask_tile(qi, j, bq, block_k), s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                                # (bq, bk)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed, qi, j, bq, block_k, dropout_p)
+            p_use = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+        else:
+            p_use = p
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]          # (bk, D)
+        pv = lax.dot_general(
+            p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha + pv
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        # only tiles that intersect the lower triangle of this q block
+        upper = ((qi + 1) * bq + block_k - 1) // block_k
+        upper = jnp.minimum(upper, nk)
+    else:
+        upper = nk
+    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    # fully-masked query rows (m never rose above the mask floor) output 0 —
+    # the framework-defined semantic for degenerate causal/padding combos
+    dead = m <= _NEG_INF * 0.5
+    o_ref[0] = jnp.where(dead, 0.0, acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(dead, _NEG_INF, m + jnp.log(l_safe))[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2 split)
+# ---------------------------------------------------------------------------
+def _p_tile(q, k, kpm_row, lse, qi, j, bq, bk, sm_scale, causal):
+    """Recompute P = exp(S - lse) for tile (qi, j); f32."""
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    if kpm_row is not None:
+        s = s + kpm_row[None, :]
+    if causal:
+        s = jnp.where(_causal_mask_tile(qi, j, bq, bk), s, _NEG_INF)
+    # dead rows carry lse = _NEG_INF (see fwd); their P must be 0, not e^0
+    return jnp.where(lse <= _NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
+
+
+def _dq_kernel(seed_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, *, sm_scale, causal, dropout_p, block_k,
+               nk):
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    q = q_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    seed = fold_bh_seed(seed_ref[0, 0], pl.program_id(0))
+
+    def body(j, dq_acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        kpm_row = (
+            kpm_ref[0, pl.ds(j * block_k, block_k)]
+            if kpm_ref is not None else None
+        )
+        p = _p_tile(q, k, kpm_row, lse, qi, j, bq, block_k, sm_scale, causal)
+        dpd = lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                     # (bq, bk)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed, qi, j, bq, block_k, dropout_p)
+            dp = jnp.where(keep, dpd, 0.0) * (1.0 / (1.0 - dropout_p))
+        else:
+            dp = dpd
+        ds = p * (dp - delta)                                 # (bq, bk)
+        dq_acc = dq_acc + lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        return dq_acc
+
+    if causal:
+        upper = ((qi + 1) * bq + block_k - 1) // block_k
+        upper = jnp.minimum(upper, nk)
+    else:
+        upper = nk
+    dq = lax.fori_loop(
+        0, upper, body, jnp.zeros((bq, q_ref.shape[2]), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(seed_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                 delta_ref, dk_ref, dv_ref, dkpm_ref=None, *, sm_scale,
+                 causal, dropout_p, block_q, nq):
+    kj = pl.program_id(1)
+    bk = k_ref.shape[1]
+    k = k_ref[0]
+    v = v_ref[0]
+    kpm_row = kpm_ref[0] if kpm_ref is not None else None
+    seed = fold_bh_seed(seed_ref[0, 0], pl.program_id(0))
+
+    def body(i, carry):
+        dk_acc, dv_acc, dkpm_acc = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        p = _p_tile(q, k, kpm_row, lse, i, kj, block_q, bk, sm_scale, causal)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed, i, kj, block_q, bk, dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            pd = jnp.where(keep, p, 0.0) * inv
+        else:
+            pd = p
+        dv_acc = dv_acc + lax.dot_general(
+            pd.astype(do_ref.dtype), do.astype(do_ref.dtype),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                     # (bk, D)
+        dpd = lax.dot_general(
+            do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                     # (bq, bk)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dpd, 0.0) * inv
+        else:
+            dp = dpd
+        ds = p * (dp - delta)                                 # (bq, bk)
+        dk_acc = dk_acc + lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        # kpm enters every S row additively -> dkpm[k] = sum over q of dS
+        dkpm_acc = dkpm_acc + jnp.sum(ds, axis=0, keepdims=True)
+        return dk_acc, dv_acc, dkpm_acc
+
+    if causal:
+        lower = (kj * bk) // block_q
+    else:
+        lower = 0
+    d = k_ref.shape[2]
+    dk, dv, dkpm = lax.fori_loop(
+        lower, nq, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32),
+         jnp.zeros((1, bk), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if dkpm_ref is not None:
+        dkpm_ref[0] = dkpm[0]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+def _specs(bh, t, d, block, have_kpm, heads):
+    """Common in_specs for (seed, kpm?, q, k, v) with q blocked over axis 1."""
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
+    kpm_spec = (
+        pl.BlockSpec((1, t), lambda b, i: (b // heads, 0))
+        if have_kpm else None
+    )
+    return seed_spec, kpm_spec, q_spec, kv_spec
+
+
+def _fwd_call(q, k, v, kpm, seed, sm_scale, causal, dropout_p, block_q,
+              block_k, heads, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq = tq // block_q
+    nk = tk // block_k
+    seed_spec, kpm_spec, q_spec, kv_spec = _specs(
+        bh, tk, d, block_q, kpm is not None, heads
+    )
+    kernel = functools.partial(
+        _fwd_kernel if kpm is not None else _fwd_kernel_nokpm,
+        sm_scale=sm_scale, causal=causal, dropout_p=dropout_p,
+        block_k=block_k, nk=nk,
+    )
+    in_specs = [seed_spec]
+    args = [seed]
+    if kpm is not None:
+        in_specs.append(kpm_spec)
+        args.append(kpm)
+    in_specs += [q_spec, kv_spec, kv_spec]
+    args += [q, k, v]
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+        ),
+        interpret=interpret,
+    )(*args)
+    return out, lse
+
+
+def _fwd_kernel_nokpm(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, **kw):
+    _fwd_kernel(seed_ref, None, q_ref, k_ref, v_ref, o_ref, lse_ref, **kw)
+
+
+def _dq_kernel_nokpm(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dq_ref, **kw):
+    _dq_kernel(seed_ref, None, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, **kw)
+
+
+def _dkdv_kernel_nokpm(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                       delta_ref, dk_ref, dv_ref, **kw):
+    _dkdv_kernel(seed_ref, None, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                 delta_ref, dk_ref, dv_ref, None, **kw)
+
+
+def _bwd_call(q, k, v, kpm, seed, do, lse, delta, sm_scale, causal,
+              dropout_p, block_q, block_k, heads, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq = tq // block_q
+    nk = tk // block_k
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    kpm_spec = pl.BlockSpec((1, tk), lambda b, i: (b // heads, 0))
+    full_q = pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0))
+    full_k = pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0))
+    row_q = pl.BlockSpec((1, tq), lambda b, i: (b, 0))
+
+    # dq: grid over q tiles
+    qb = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    lse_b = pl.BlockSpec((1, block_q), lambda b, i: (b, i))
+    in_specs = [seed_spec]
+    args = [seed]
+    if kpm is not None:
+        in_specs.append(kpm_spec)
+        args.append(kpm)
+    in_specs += [qb, full_k, full_k, qb, lse_b, lse_b]
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel if kpm is not None else _dq_kernel_nokpm,
+            sm_scale=sm_scale, causal=causal, dropout_p=dropout_p,
+            block_k=block_k, nk=nk,
+        ),
+        grid=(bh, nq),
+        in_specs=in_specs,
+        out_specs=qb,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(*(args + [q, k, v, do, lse, delta]))
+
+    # dk/dv: grid over k tiles
+    kb = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))
+    kpm_b = pl.BlockSpec((1, block_k), lambda b, i: (b // heads, i))
+    in_specs = [seed_spec]
+    args = [seed]
+    if kpm is not None:
+        in_specs.append(kpm_b)
+        args.append(kpm)
+    in_specs += [full_q, kb, kb, full_q, row_q, row_q]
+    out_specs = [kb, kb]
+    out_shape = [
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    if kpm is not None:
+        # per-(b·h) partial dkpm rows; summed over heads by the caller
+        out_specs.append(pl.BlockSpec((1, block_k), lambda b, i: (b, i)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, tk), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(
+            _dkdv_kernel if kpm is not None else _dkdv_kernel_nokpm,
+            sm_scale=sm_scale, causal=causal, dropout_p=dropout_p,
+            block_q=block_q, nq=nq,
+        ),
+        grid=(bh, nk),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        interpret=interpret,
+    )(*(args + [q, k, v, do, lse, delta]))
+    if kpm is not None:
+        dk, dv, dkpm_bh = outs
+    else:
+        (dk, dv), dkpm_bh = outs, None
+    return dq, dk, dv, dkpm_bh
+
+
+# ---------------------------------------------------------------------------
+# public entry: (B, H, T, D) with custom vjp
+# ---------------------------------------------------------------------------
+def _pick_block(t, want):
+    b = min(want, t)
+    while t % b:
+        b -= 1
+    return b
+
+
+def _pad_len(t, block):
+    """Padded length: pad up to a block multiple rather than shrinking the
+    tile (a divisor-poor T like a prime would otherwise degrade to 1-wide
+    tiles and O(T²) grid steps)."""
+    return (t + block - 1) // block * block
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
+)
+def _flash(q, k, v, kpm, seed, sm_scale, causal, dropout_p, block_q,
+           block_k, interpret):
+    return _flash_fwd(
+        q, k, v, kpm, seed, sm_scale, causal, dropout_p, block_q, block_k,
+        interpret,
+    )[0]
+
+
+def _flash_fwd(q, k, v, kpm, seed, sm_scale, causal, dropout_p, block_q,
+               block_k, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    out, lse = _fwd_call(
+        qf, kf, vf, kpm, seed, sm_scale, causal, dropout_p, block_q,
+        block_k, h, interpret,
+    )
+    return out.reshape(b, h, tq, d), (q, k, v, kpm, seed, out, lse)
+
+
+def _flash_bwd(sm_scale, causal, dropout_p, block_q, block_k, interpret,
+               res, g):
+    q, k, v, kpm, seed, out_f, lse = res
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    gf = g.reshape(b * h, tq, d)
+    delta = jnp.sum(
+        gf.astype(jnp.float32) * out_f.astype(jnp.float32), axis=-1
+    )
+    dq, dk, dv, dkpm_bh = _bwd_call(
+        qf, kf, vf, kpm, seed, gf, lse, delta, sm_scale, causal,
+        dropout_p, block_q, block_k, h, interpret,
+    )
+    dkpm = None
+    if kpm is not None:
+        dkpm = dkpm_bh.reshape(b, h, tk).sum(axis=1).astype(kpm.dtype)
+    return (
+        dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape),
+        dkpm, jnp.zeros_like(seed),
+    )
+
+
+_flash.defvjp(
+    lambda *a: _flash_fwd(*a),
+    _flash_bwd,
+)
+
+
+def flash_attention(q, k, v, key_padding_mask=None, seed=None, sm_scale=None,
+                    causal=False, dropout_p=0.0, block_q=128, block_k=128,
+                    interpret=False):
+    """Flash multi-head attention.
+
+    q: (B, H, Tq, D); k, v: (B, H, Tk, D).
+    key_padding_mask: optional additive f32 (B, Tk) (-inf/-1e30 at pads).
+    seed: int32 scalar array driving dropout bits (ignored if dropout_p=0).
+    Returns (B, H, Tq, D) in q.dtype.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    tq, tk = q.shape[2], k.shape[2]
+    # prefer exact tiling; for divisor-poor lengths pad up to the block
+    # (padding + masking beats shrinking tiles to degenerate widths)
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    pad_q = pad_k = 0
+    if bq < min(block_q, tq) // 2:
+        bq = min(block_q, tq)
+        pad_q = _pad_len(tq, bq) - tq
+    if bk < min(block_k, tk) // 2:
+        bk = min(block_k, tk)
+        pad_k = _pad_len(tk, bk) - tk
+    if seed is None:
+        if dropout_p > 0.0:
+            raise ValueError(
+                "flash_attention(dropout_p>0) needs an explicit integer "
+                "seed (vary it per step, or dropout masks repeat)"
+            )
+        seed = jnp.zeros((1, 1), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape((1, 1))
+    kpm = None
+    if key_padding_mask is not None:
+        kpm = jnp.asarray(key_padding_mask, jnp.float32)
+    if pad_k:
+        # padded keys are masked out; pad/slice sit OUTSIDE the custom_vjp
+        # so autodiff zeroes the pad cotangents for free
+        if kpm is None:
+            kpm = jnp.zeros((q.shape[0], tk), jnp.float32)
+        kpm = jnp.pad(kpm, ((0, 0), (0, pad_k)), constant_values=_NEG_INF)
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    out = _flash(
+        q, k, v, kpm, seed, float(sm_scale), bool(causal), float(dropout_p),
+        bq, bk, interpret,
+    )
+    if pad_q:
+        out = out[:, :, :tq, :]
+    return out
+
+
+def reference_attention(q, k, v, key_padding_mask=None, sm_scale=None,
+                        causal=False, dropout_p=0.0, dropout_rng=None):
+    """Plain-jax oracle with the same semantics (dropout via jax.random —
+    bits differ from the pallas kernel; use dropout_p=0 for exact compares).
+    Used as the CPU lowering fallback of the fused_multihead_attention op."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * sm_scale
+    if key_padding_mask is not None:
+        s = s + key_padding_mask[:, None, None, :]
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        rows = lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # match the kernel semantic: fully-masked rows produce 0, not uniform
+    dead = jnp.max(s, axis=-1, keepdims=True) <= _NEG_INF * 0.5
+    p = jnp.where(dead, 0.0, p)
+    if dropout_p > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p, 0.0) / (1.0 - dropout_p)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# op registration (layer API: fluid.layers.fused_multihead_attention)
+# ---------------------------------------------------------------------------
+from .registry import register_op, single  # noqa: E402
+
+
+@register_op("fused_multihead_attention")
+def _fused_mha_lowering(ctx, ins, attrs):
+    """Q/K/V: (B, H, T, D). Pallas flash kernels on a single TPU device;
+    the plain-jax path otherwise (CPU, and under a device mesh — a
+    pallas_call is an opaque custom call the SPMD partitioner can't split,
+    while the einsum formulation partitions over (dp, tp) for free)."""
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    kpm = ins["KeyPaddingMask"][0] if ins.get("KeyPaddingMask") else None
+    causal = bool(attrs.get("causal", False))
+    p = float(attrs.get("dropout_prob", 0.0))
+    if attrs.get("is_test", False) or ctx.is_test:
+        p = 0.0
+    key = ctx.next_rng() if p > 0.0 else None
+    import os
+    platform = ctx.platform or jax.default_backend()
+    use_pallas = (
+        platform == "tpu"
+        and not ctx.mesh_axes
+        and not os.environ.get("PADDLE_TPU_DISABLE_PALLAS")
+    )
+    if use_pallas:
+        seed = None
+        if key is not None:
+            seed = jax.random.randint(
+                key, (), 0, 2 ** 31 - 1, dtype=jnp.int32
+            )
+        out = flash_attention(
+            q, k, v, kpm, seed=seed, causal=causal, dropout_p=p
+        )
+    else:
+        out = reference_attention(
+            q, k, v, kpm, causal=causal, dropout_p=p, dropout_rng=key
+        )
+    return single(out)

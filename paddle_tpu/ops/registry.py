@@ -47,7 +47,8 @@ def has_lowering(op_type):
 class LowerContext:
     """Carries trace-time state through a block lowering."""
 
-    def __init__(self, rng=None, is_test=False, mesh_axes=None, program=None):
+    def __init__(self, rng=None, is_test=False, mesh_axes=None, program=None,
+                 platform=None):
         self._rng = rng
         self._rng_count = 0
         self._op_tag = 0
@@ -58,6 +59,11 @@ class LowerContext:
         self.is_test = is_test
         self.mesh_axes = mesh_axes or {}  # logical axis name -> mesh axis
         self.program = program
+        # target platform of the computation ('cpu'/'tpu'); lowerings that
+        # pick platform-specific kernels (pallas) must use this, NOT
+        # jax.default_backend() — an Executor(CPUPlace()) on a TPU host
+        # compiles for cpu
+        self.platform = platform
 
     def set_op_tag(self, tag):
         """Key PRNG draws by op position so a vjp replay of the same op

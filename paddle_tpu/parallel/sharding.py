@@ -148,7 +148,13 @@ class DistributedProgram:
         )
         entry = self._cache.get(sig)
         if entry is None:
-            step = build_step_fn(program, list(feed_arrays), fetch_names)
+            # mesh_axes marks this lowering as SPMD-partitioned so ops with
+            # partitioner-opaque kernels (pallas attention) pick their
+            # einsum formulations instead
+            step = build_step_fn(
+                program, list(feed_arrays), fetch_names,
+                mesh_axes={a: a for a in self._mesh.axis_names},
+            )
             entry = jax.jit(step, donate_argnums=(0,))
             self._cache[sig] = entry
         rng = jax.device_put(
@@ -156,7 +162,7 @@ class DistributedProgram:
         )
         fetches, new_state = entry(state, feed_arrays, rng)
         for k, v in new_state.items():
-            scope.set(k, v)
+            scope.update(k, v)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)

@@ -1,0 +1,83 @@
+"""Dataset readers: schema/shape checks mirroring the reference's
+python/paddle/dataset/tests — every reader yields the documented tuple
+layout and is deterministic across re-instantiation."""
+import numpy as np
+
+from paddle_tpu import dataset
+
+
+def _first(reader, n=3):
+    out = []
+    for i, s in enumerate(reader()):
+        out.append(s)
+        if i + 1 >= n:
+            break
+    return out
+
+
+def test_mnist_schema():
+    img, label = _first(dataset.mnist.train())[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert 0 <= label < 10
+
+
+def test_cifar_schema():
+    for reader, ncls in ((dataset.cifar.train10(), 10),
+                         (dataset.cifar.train100(), 100)):
+        img, label = _first(reader)[0]
+        assert img.shape == (3072,) and 0 <= label < ncls
+
+
+def test_imikolov_ngram_and_seq():
+    d = dataset.imikolov.build_dict()
+    assert "<unk>" in d
+    grams = _first(dataset.imikolov.train(d, 5))
+    assert all(len(g) == 5 for g in grams)
+    src, trg = _first(dataset.imikolov.train(d, 2, dataset.imikolov.Seq))[0]
+    assert len(src) == len(trg)
+
+
+def test_movielens_schema():
+    s = _first(dataset.movielens.train())[0]
+    u, gender, age, job, m, cats, title, rating = s
+    assert 1 <= u <= dataset.movielens.max_user_id()
+    assert 1 <= m <= dataset.movielens.max_movie_id()
+    assert job <= dataset.movielens.max_job_id()
+    assert isinstance(cats, list) and isinstance(title, list)
+    assert 1.0 <= rating <= 5.0
+
+
+def test_wmt16_framing():
+    src, trg_in, trg_next = _first(dataset.wmt16.train())[0]
+    assert trg_in[0] == 0            # <s>
+    assert trg_next[-1] == 1         # <e>
+    assert trg_in[1:] == trg_next[:-1]
+    assert dataset.wmt16.get_dict("en")["<s>"] == 0
+
+
+def test_sentiment_polarity_signal():
+    samples = _first(dataset.sentiment.train(), 100)
+    pos = [w for words, y in samples if y == 1 for w in words]
+    neg = [w for words, y in samples if y == 0 for w in words]
+    # positive band enriched in positive samples
+    pos_hits = sum(10 <= w < 60 for w in pos) / len(pos)
+    neg_hits = sum(10 <= w < 60 for w in neg) / len(neg)
+    assert pos_hits > neg_hits
+
+
+def test_conll05_alignment():
+    s = _first(dataset.conll05.test())[0]
+    n = len(s[0])
+    assert all(len(col) == n for col in s)
+    assert sum(s[7]) == 1            # exactly one predicate mark
+
+
+def test_flowers_schema():
+    img, label = _first(dataset.flowers.train(), 1)[0]
+    assert img.shape == (3, 224, 224) and 0 <= label < 102
+
+
+def test_determinism():
+    a = _first(dataset.wmt16.train(), 5)
+    b = _first(dataset.wmt16.train(), 5)
+    assert a == b

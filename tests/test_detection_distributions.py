@@ -161,3 +161,45 @@ def test_yolov3_loss_runs():
         fetch_list=[loss],
     )[0]
     assert np.isfinite(out).all() and out[0] > 0
+
+
+def test_multiclass_nms_adaptive_eta():
+    """nms_eta<1 must follow NMSFast candidate-order semantics: a candidate
+    is tested at ITS turn against the already-decayed per-class threshold.
+    A,B,C scores 0.9/0.8/0.7; IoU(A,C)=0.55, IoU(B,C)=0: with thresh 0.6 and
+    eta=0.9, C faces 0.6*0.9^2=0.486 < 0.55 -> discarded; with eta=1, kept."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+
+    boxes = np.array(
+        [[[0.0, 0.0, 10.0, 10.0],      # A
+          [20.0, 20.0, 30.0, 30.0],    # B (no overlap)
+          [0.0, 0.0, 10.0, 5.5]]],     # C: IoU with A = 0.55
+        np.float32,
+    )
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]     # class 1 (0 = background)
+
+    kept = {}
+    for eta in (1.0, 0.9):
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        b = fluid.data(name="b", shape=[3, 4], dtype="float32",
+                       append_batch_size=False)
+        b.shape = (1, 3, 4)
+        s = fluid.data(name="s", shape=[2, 3], dtype="float32",
+                       append_batch_size=False)
+        s.shape = (1, 2, 3)
+        out = fluid.layers.detection.multiclass_nms(
+            b, s, score_threshold=0.1, nms_top_k=3, keep_top_k=3,
+            nms_threshold=0.6, nms_eta=eta,
+        )
+        exe = fluid.Executor(fluid.CPUPlace())
+        res = exe.run(feed={"b": boxes, "s": scores}, fetch_list=[out])[0]
+        kept[eta] = sorted(
+            float(r[1]) for r in res[0] if r[0] >= 0
+        )
+    np.testing.assert_allclose(kept[1.0], [0.7, 0.8, 0.9], rtol=1e-5)
+    np.testing.assert_allclose(kept[0.9], [0.8, 0.9], rtol=1e-5)

@@ -151,3 +151,39 @@ def test_nmt_trains_eos_but_masks_pad():
     assert (labels == cfg.eos_id).any(), "labels must contain real EOS"
     assert not (labels == cfg.pad_id).any()
     assert src.min() > cfg.pad_id
+
+
+def test_prune_keeps_cond_branch_params():
+    """_prune must follow true_block/false_block attrs: params used only
+    inside a cond branch survive pruning (save_inference_model path)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, layers, unique_name
+    from paddle_tpu.fluid.param_attr import ParamAttr
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 2
+
+    x = fluid.data(name="x", shape=[4], dtype="float32")
+    pred = layers.greater_than(
+        layers.reduce_sum(x), layers.fill_constant([1], "float32", 0.0)
+    )
+    out = layers.cond(
+        pred,
+        lambda: layers.fc(x, 4, param_attr=ParamAttr(name="w_cond")),
+        lambda: layers.scale(x, 2.0),
+    )
+    prog = fluid.default_main_program()
+    pruned = prog._prune([out])
+    assert "w_cond" in pruned.global_block().vars
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    res = exe.run(
+        pruned,
+        feed={"x": np.ones((2, 4), np.float32)},
+        fetch_list=[out.name],
+    )[0]
+    assert res.shape == (2, 4)

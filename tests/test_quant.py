@@ -1,0 +1,129 @@
+"""QAT fake-quant ops + QuantizationTransformPass (ref parity:
+contrib/slim/quantization tests — fake quant numerics, STE gradients,
+transform-then-train convergence)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, layers, unique_name
+from paddle_tpu.fluid.contrib import quant
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 5
+    fluid.default_main_program().random_seed = 5
+    yield
+
+
+def test_fake_qdq_abs_max_numeric():
+    x = fluid.data(name="x", shape=[4], dtype="float32")
+    y = quant.fake_quant_dequant_abs_max(x, bit_length=8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[0.5, -1.0, 0.25, 0.124], [1.27, -0.3, 0.0, 2.0]],
+                  np.float32)
+    out = exe.run(feed={"x": xv}, fetch_list=[y])[0]
+    scale = np.abs(xv).max()
+    expect = np.clip(np.round(xv / scale * 127), -127, 127) * scale / 127
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    # quantization error bounded by half a step
+    assert np.abs(out - xv).max() <= scale / 127
+
+
+def test_fake_qdq_ste_gradient():
+    """STE: d(qdq(x))/dx == 1 -> grad of sum(qdq(w*x)) wrt w equals x."""
+    x = fluid.data(name="x", shape=[3], dtype="float32")
+    w = layers.create_parameter(shape=[3], dtype="float32", name="w_q",
+                                default_initializer=fluid.initializer.Constant(2.0))
+    y = quant.fake_quant_dequant_abs_max(x * w)
+    loss = layers.reduce_sum(y)
+    grads = fluid.backward.gradients([loss], [w])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.array([[1.0, -2.0, 0.5]], np.float32)
+    g = exe.run(feed={"x": xv}, fetch_list=grads)[0]
+    np.testing.assert_allclose(g, xv.sum(0), rtol=1e-6)
+
+
+def test_transform_pass_inserts_fake_quant():
+    x = fluid.data(name="x", shape=[8], dtype="float32")
+    h = layers.fc(x, size=16, act="relu")
+    out = layers.fc(h, size=4)
+    loss = layers.mean(out)
+    prog = fluid.default_main_program()
+    n_mul_before = sum(op.type == "mul" for op in prog.global_block().ops)
+    quant.quantize_program(prog)
+    types = [op.type for op in prog.global_block().ops]
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+    # every mul now consumes .quantized inputs
+    for op in prog.global_block().ops:
+        if op.type == "mul":
+            assert all(n.endswith(".quantized") for ns in op.inputs.values()
+                       for n in ns), op
+    assert sum(t == "mul" for t in types) == n_mul_before
+
+
+def test_qat_training_converges_and_updates_scale():
+    x = fluid.data(name="x", shape=[4], dtype="float32")
+    label = fluid.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, size=8, act="relu")
+    pred = layers.fc(h, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, label))
+    quant.quantize_program(fluid.default_main_program())
+    opt = fluid.optimizer.Adam(learning_rate=0.05)
+    opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(32, 4)).astype(np.float32)
+    yv = (xv @ np.array([1.0, -2.0, 0.5, 0.3], np.float32))[:, None] * 0.5
+
+    first = last = None
+    for i in range(60):
+        out = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        if first is None:
+            first = float(out[0])
+        last = float(out[0])
+    assert last < first * 0.2, (first, last)
+
+    # moving-average scale state moved off its init value
+    from paddle_tpu.fluid.executor import global_scope
+    scales = {k: np.asarray(v) for k, v in global_scope().items()
+              if k.endswith(".quant_scale_state")}
+    assert scales and all(
+        abs(float(s.ravel()[0]) - 1e-3) > 1e-4 for s in scales.values()
+    )
+
+
+def test_transform_quantizes_sub_blocks():
+    """Quantizable ops inside cond branches get fake-quant too (the pass
+    walks every block, like the reference QuantizationTransformPass)."""
+    x = fluid.data(name="x", shape=[4], dtype="float32")
+    pred = layers.greater_than(
+        layers.reduce_sum(x), layers.fill_constant([1], "float32", 0.0)
+    )
+    out = layers.cond(
+        pred,
+        lambda: layers.fc(x, 4),
+        lambda: layers.scale(x, 2.0),
+    )
+    loss = layers.mean(out)
+    prog = fluid.default_main_program()
+    quant.quantize_program(prog)
+    sub_types = [
+        op.type for blk in prog.blocks[1:] for op in blk.ops
+    ]
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in sub_types
+    # the quantized graph still runs and trains
+    import numpy as np
+    fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    v = exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])[0]
+    assert np.isfinite(v).all()
