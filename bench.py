@@ -15,6 +15,7 @@ published number, so this documented constant is the comparison point.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -22,9 +23,69 @@ import numpy as np
 
 V100_BASELINE_TOKENS_PER_SEC = 5300.0
 
+_FLASH_PROBE = r"""
+import jax, jax.numpy as jnp, numpy as np
+from paddle_tpu.ops.pallas_attention import flash_attention
+q = jnp.asarray(np.ones((2, 4, 128, 64), np.float32), jnp.bfloat16)
+out = jax.jit(lambda q: flash_attention(q, q, q, seed=1, dropout_p=0.1))(q)
+g = jax.jit(jax.grad(lambda q: jnp.sum(
+    flash_attention(q, q, q, seed=1, dropout_p=0.1).astype(jnp.float32))))(q)
+jax.block_until_ready((out, g))
+print("FLASH_OK")
+"""
+
+
+def _sub(code, timeout_s, tag):
+    """Run a probe in a subprocess so the parent never holds the (single)
+    TPU while probing, and a Mosaic/tunnel hang is bounded by the watchdog
+    instead of wedging the bench (an in-process XLA compile can't be
+    interrupted). Failures are loud on stderr — a silent fallback would
+    publish a wrong-config benchmark number."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if r.returncode != 0:
+            print(
+                "bench: %s probe exited %d: %s"
+                % (tag, r.returncode, r.stderr.strip()[-500:]),
+                file=sys.stderr,
+            )
+        return r.stdout
+    except subprocess.TimeoutExpired:
+        print("bench: %s probe timed out after %ds" % (tag, timeout_s),
+              file=sys.stderr)
+        return ""
+    except Exception as e:
+        print("bench: %s probe failed: %r" % (tag, e), file=sys.stderr)
+        return ""
+
+
+def _probe_backend():
+    out = _sub(
+        "import jax; print('BACKEND='+jax.devices()[0].platform)", 180,
+        "backend",
+    )
+    for line in out.splitlines():
+        if line.startswith("BACKEND="):
+            return line.split("=", 1)[1]
+    return None
+
 
 def main():
     t_setup = time.time()
+    # all device probing happens in subprocesses BEFORE this process inits
+    # the backend — two processes contending for the tunneled chip deadlock
+    backend = _probe_backend() or "cpu"
+    on_accel = backend != "cpu"
+    use_flash = False
+    if on_accel and not os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        use_flash = "FLASH_OK" in _sub(_FLASH_PROBE, 300, "flash-attention")
+        if not use_flash:
+            os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+
     import jax
 
     import paddle_tpu.fluid as fluid
@@ -37,11 +98,10 @@ def main():
     fluid.default_startup_program().random_seed = 7
     fluid.default_main_program().random_seed = 7
 
-    backend = jax.devices()[0].platform
-    on_accel = backend != "cpu"
     cfg = bert.bert_base() if on_accel else bert.bert_tiny()
+    cfg.use_fused_attention = use_flash
     seq = 128 if on_accel else 64
-    batch = 32 if on_accel else 8
+    batch = 64 if on_accel else 8
 
     vs = bert.build_bert_pretrain(cfg, seq)
     opt = fluid.optimizer.Adam(learning_rate=1e-4)
@@ -87,6 +147,7 @@ def main():
             "backend": backend,
             "batch": batch,
             "seq_len": seq,
+            "flash_attention": use_flash,
             "steps": n_steps,
             "step_ms": round(1000 * dt / n_steps, 2),
             "compile_s": round(compile_s, 1),

@@ -56,6 +56,8 @@ from .parallel_executor import ParallelExecutor  # noqa: F401
 from . import dygraph
 from . import profiler
 from . import contrib
+from . import evaluator
+from . import inference
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, memory_optimize, release_memory  # noqa: F401
 
@@ -72,7 +74,7 @@ __all__ = [
     "LoDTensor", "create_lod_tensor", "data", "layers", "initializer",
     "optimizer", "regularizer", "clip", "unique_name", "io", "nets",
     "metrics", "DataLoader", "CompiledProgram", "ParallelExecutor",
-    "dygraph", "profiler", "contrib",
+    "dygraph", "profiler", "contrib", "evaluator", "inference",
 ]
 
 

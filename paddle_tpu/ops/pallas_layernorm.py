@@ -1,0 +1,160 @@
+"""Fused LayerNorm as pallas TPU kernels.
+
+TPU-native fused form of the reference's layer_norm op (ref:
+paddle/fluid/operators/layer_norm_op.cc / .cu — a dedicated fused CUDA
+kernel there too). One VMEM pass computes mean/var/normalize/affine per row
+block; the backward kernel re-normalizes from saved (mean, rstd) and emits
+per-block partial sums for d(scale)/d(bias) that the wrapper reduces — the
+cross-row reduction is the only part XLA sees, so it fuses into neighbours.
+
+Used by the layer_norm lowering when PADDLE_TPU_PALLAS_LN=1 on TPU
+(default off: XLA's own LN fusion is already strong; flip after profiling
+shows a win for your shape mix). Exact parity with the jnp lowering is
+covered by tests in interpret mode.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_layer_norm"]
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                 # (bm, H)
+    mean = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    mean_ref[...] = mean[:, 0]
+    rstd_ref[...] = rstd[:, 0]
+
+
+def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref, dx_ref, dg_ref,
+                db_ref):
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    mean = mean_ref[...][:, None]
+    rstd = rstd_ref[...][:, None]
+    xhat = (x - mean) * rstd
+    wdy = dy * g
+    c1 = jnp.mean(wdy, axis=1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=1, keepdims=True)
+    dx = (wdy - c1 - xhat * c2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    # per-block partials; wrapper sums over the grid axis
+    dg_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+
+
+def _row_block(n):
+    for b in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln(x, gamma, beta, eps, interpret):
+    """Returns (y, mean, rstd); mean/rstd are diagnostics — their
+    cotangents are ignored in the backward (like the reference op's
+    Mean/Variance outputs, which carry no gradient)."""
+    return _ln_fwd(x, gamma, beta, eps, interpret)[0]
+
+
+def _ln_fwd(x, gamma, beta, eps, interpret):
+    n, h = x.shape
+    bm = _row_block(n)
+    grid = (n // bm,)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+        ],
+        out_specs=(
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+            pl.BlockSpec((bm,), lambda i: (i,)),
+            pl.BlockSpec((bm,), lambda i: (i,)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, h), x.dtype),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+        ),
+        interpret=interpret,
+    )(x, gamma, beta)
+    return (y, mean, rstd), (x, gamma, mean, rstd)
+
+
+def _ln_bwd(eps, interpret, res, dys):
+    dy = dys[0]  # stats cotangents (dys[1:]) are ignored by design
+    x, gamma, mean, rstd = res
+    n, h = x.shape
+    bm = _row_block(n)
+    grid = (n // bm,)
+    dx, dg_part, db_part = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((bm,), lambda i: (i,)),
+            pl.BlockSpec((bm,), lambda i: (i,)),
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, h), x.dtype),
+            jax.ShapeDtypeStruct((grid[0], h), jnp.float32),
+            jax.ShapeDtypeStruct((grid[0], h), jnp.float32),
+        ),
+        interpret=interpret,
+    )(x, gamma, mean, rstd, dy)
+    dg = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
+    db = jnp.sum(db_part, axis=0).astype(gamma.dtype)
+    return dx, dg, db
+
+
+_ln.defvjp(_ln_fwd, _ln_bwd)
+
+
+def fused_layer_norm(x, gamma=None, beta=None, eps=1e-5, interpret=False,
+                     return_stats=False):
+    """LayerNorm over the last axis of a 2D-reshapeable x.
+
+    x: (..., H); gamma/beta: (H,) or None. With return_stats=True also
+    returns (mean, rstd) shaped like x's leading axes — the kernel computed
+    them anyway; callers must not recompute (that would double the memory
+    passes this kernel exists to avoid).
+    """
+    shape = x.shape
+    h = shape[-1]
+    xf = x.reshape(-1, h)
+    if gamma is None:
+        gamma = jnp.ones((h,), jnp.float32)
+    if beta is None:
+        beta = jnp.zeros((h,), jnp.float32)
+    y, mean, rstd = _ln(
+        xf, gamma.reshape(h), beta.reshape(h), float(eps), interpret
+    )
+    if return_stats:
+        return (
+            y.reshape(shape),
+            mean.reshape(shape[:-1]),
+            rstd.reshape(shape[:-1]),
+        )
+    return y.reshape(shape)

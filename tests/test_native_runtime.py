@@ -1,0 +1,134 @@
+"""Native C++ host runtime: g++-built ring queue + pinned arena (ref parity:
+operators/reader/blocking_queue.h tests + memory allocator tests). Skips
+only if no g++ toolchain is present (never expected in CI)."""
+import ctypes
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.native import build, pipeline
+
+
+def _lib_or_skip():
+    lib = build.load_native()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def test_native_lib_builds():
+    assert _lib_or_skip() is not None
+
+
+def test_token_queue_fifo_and_blocking():
+    lib = _lib_or_skip()
+    q = pipeline._NativeQueue(capacity=2, lib=lib)
+    q.put("a")
+    q.put("b")
+
+    got = []
+    blocked = threading.Event()
+
+    def producer():
+        blocked.set()
+        q.put("c")             # must block until a get frees a slot
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    blocked.wait(2.0)
+    time.sleep(0.1)
+    assert t.is_alive()        # capacity 2 full -> producer blocked
+    got.append(q.get())
+    t.join(2.0)
+    assert not t.is_alive()
+    got += [q.get(), q.get()]
+    assert got == ["a", "b", "c"]
+
+
+def test_arena_alignment_and_reset():
+    lib = _lib_or_skip()
+    a = lib.arena_create(1 << 16)
+    p1 = lib.arena_alloc(a, 100)
+    p2 = lib.arena_alloc(a, 100)
+    assert p1 % 64 == 0 and p2 % 64 == 0
+    assert p2 - p1 == 128                   # 100 rounded up to 64-multiple
+    # exhaustion returns NULL, reset recycles
+    assert lib.arena_alloc(a, 1 << 17) in (None, 0)
+    lib.arena_reset(a)
+    assert lib.arena_alloc(a, 100) == p1
+    lib.arena_destroy(a)
+
+
+def test_dataloader_uses_native_pipe_and_trains():
+    q = pipeline.make_queue(capacity=4)
+    # when the toolchain exists, make_queue must pick the native path
+    if build.load_native() is not None:
+        assert isinstance(q, pipeline._NativeQueue)
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, layers, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 4
+
+    x = fluid.data(name="dl_x", shape=[4], dtype="float32")
+    y = fluid.data(name="dl_y", shape=[1], dtype="float32")
+    loss = layers.mean(
+        layers.square_error_cost(layers.fc(x, 1), y)
+    )
+    fluid.optimizer.SGD(0.05).minimize(loss)
+
+    rng = np.random.default_rng(0)
+
+    def reader():
+        for _ in range(10):
+            xv = rng.normal(size=(4,)).astype(np.float32)
+            yield xv, np.array([xv.sum()], np.float32)
+
+    loader = fluid.DataLoader.from_generator(feed_list=[x, y], capacity=4)
+    loader.set_sample_generator(reader, batch_size=2)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for feed in loader():
+        losses.append(float(exe.run(feed=feed, fetch_list=[loss])[0]))
+    assert len(losses) == 5
+    assert np.isfinite(losses).all()
+
+
+def test_evaluator_shim_legacy_flow():
+    """Deprecated fluid.evaluator.Accuracy: the fetch->update->eval loop
+    works, and eval() without updates raises a migration error."""
+    import warnings
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, layers, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 4
+
+    x = fluid.data(name="ev_x", shape=[4], dtype="float32")
+    y = fluid.data(name="ev_y", shape=[1], dtype="int64")
+    pred = layers.fc(x, 3, act="softmax")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        ev = fluid.evaluator.Accuracy(input=pred, label=y)
+
+    with pytest.raises(RuntimeError, match="migrate"):
+        ev.eval()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    yv = np.zeros((8, 1), np.int64)
+    acc = exe.run(feed={"ev_x": xv, "ev_y": yv},
+                  fetch_list=[ev.metrics[0]])[0]
+    ev.update(value=float(acc), weight=8)
+    assert 0.0 <= ev.eval() <= 1.0

@@ -158,3 +158,26 @@ def test_prime_length_pads_not_degrades():
     gr = jax.grad(lambda a: jnp.sum(
         pa.reference_attention(a, k, v) ** 2))(q)
     assert float(jnp.max(jnp.abs(g - gr))) < 5e-4
+
+
+def test_fused_layer_norm_matches_jnp():
+    from paddle_tpu.ops.pallas_layernorm import fused_layer_norm
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(6, 7, 32)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+
+    def ref(x, g, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    y = fused_layer_norm(x, g, b, interpret=True)
+    assert float(jnp.max(jnp.abs(y - ref(x, g, b)))) < 1e-5
+
+    gf = jax.grad(lambda *a: jnp.sum(fused_layer_norm(
+        *a, interpret=True) ** 2), argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2), argnums=(0, 1, 2))(x, g, b)
+    for a_, b_ in zip(gf, gr):
+        assert float(jnp.max(jnp.abs(a_ - b_))) < 1e-3
