@@ -8,6 +8,18 @@ Runs the full framework path — fluid Program -> single-XLA-module train step
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
 
+Robustness design (round-2 rewrite after the round-1 rc:124/no-output run):
+  * ONE process, ONE jax init. Round 1 probed the backend in a subprocess
+    with a 180s watchdog; over the tunneled single chip that subprocess
+    timed out, was killed mid-init, and the parent's own init then wedged
+    for 25+ minutes — two processes must never touch the chip.
+  * A watchdog thread banks the best result measured so far and prints the
+    JSON line before the driver's wall clock can kill us, so a partial run
+    still produces a number (value 0.0 + stage detail in the worst case).
+  * The safe configuration (plain-jax attention) is measured FIRST so a
+    throughput number is banked before the pallas flash-attention variant
+    — whose in-process Mosaic compile cannot be interrupted — is tried.
+
 vs_baseline denominator: the reference stack's published-era BERT-base
 single-GPU training throughput on V100 (fp32/amp mixed era) ≈ 5300
 tokens/sec (batch 32 × seq 128 at ~1.3 steps/s). BASELINE.json carries no
@@ -15,82 +27,106 @@ published number, so this documented constant is the comparison point.
 """
 import json
 import os
-import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
 V100_BASELINE_TOKENS_PER_SEC = 5300.0
 
-_FLASH_PROBE = r"""
-import jax, jax.numpy as jnp, numpy as np
-from paddle_tpu.ops.pallas_attention import flash_attention
-q = jnp.asarray(np.ones((2, 4, 128, 64), np.float32), jnp.bfloat16)
-out = jax.jit(lambda q: flash_attention(q, q, q, seed=1, dropout_p=0.1))(q)
-g = jax.jit(jax.grad(lambda q: jnp.sum(
-    flash_attention(q, q, q, seed=1, dropout_p=0.1).astype(jnp.float32))))(q)
-jax.block_until_ready((out, g))
-print("FLASH_OK")
-"""
+# Wall-clock budget before the watchdog emits the best-so-far result and
+# exits 0. The round-1 driver killed the bench at >=29 min; leave margin.
+DEADLINE_S = float(os.environ.get("PADDLE_TPU_BENCH_DEADLINE_S", 1560))
+
+# bf16 peak FLOPs/s per chip by device_kind substring (public figures).
+_PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+_T0 = time.time()
+_STATE = {
+    "stage": "boot",
+    "best": None,          # best full result dict measured so far
+    "detail": {"variants": [], "errors": []},
+    "done": threading.Event(),
+}
 
 
-def _sub(code, timeout_s, tag):
-    """Run a probe in a subprocess so the parent never holds the (single)
-    TPU while probing, and a Mosaic/tunnel hang is bounded by the watchdog
-    instead of wedging the bench (an in-process XLA compile can't be
-    interrupted). Failures are loud on stderr — a silent fallback would
-    publish a wrong-config benchmark number."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        if r.returncode != 0:
-            print(
-                "bench: %s probe exited %d: %s"
-                % (tag, r.returncode, r.stderr.strip()[-500:]),
-                file=sys.stderr,
-            )
-        return r.stdout
-    except subprocess.TimeoutExpired:
-        print("bench: %s probe timed out after %ds" % (tag, timeout_s),
-              file=sys.stderr)
-        return ""
-    except Exception as e:
-        print("bench: %s probe failed: %r" % (tag, e), file=sys.stderr)
-        return ""
+def _elapsed():
+    return time.time() - _T0
 
 
-def _probe_backend():
-    out = _sub(
-        "import jax; print('BACKEND='+jax.devices()[0].platform)", 180,
-        "backend",
+def _compose(best):
+    detail = dict(_STATE["detail"])
+    detail["stage"] = _STATE["stage"]
+    detail["elapsed_s"] = round(_elapsed(), 1)
+    if best is None:
+        return {
+            "metric": "bert_pretrain_throughput",
+            "value": 0.0,
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,
+            "detail": detail,
+        }
+    detail.update(best["detail"])
+    return {
+        "metric": best["metric"],
+        "value": best["value"],
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(best["value"] / V100_BASELINE_TOKENS_PER_SEC, 3),
+        "detail": detail,
+    }
+
+
+def _emit_and_exit(code=0):
+    print(json.dumps(_compose(_STATE["best"])), flush=True)
+    os._exit(code)
+
+
+def _watchdog():
+    if _STATE["done"].wait(timeout=DEADLINE_S):
+        return
+    _STATE["detail"]["errors"].append(
+        "watchdog fired at %ds during stage %r"
+        % (int(DEADLINE_S), _STATE["stage"])
     )
-    for line in out.splitlines():
-        if line.startswith("BACKEND="):
-            return line.split("=", 1)[1]
+    _emit_and_exit(0)
+
+
+def _flops_per_token_train(cfg, seq):
+    """Analytic matmul FLOPs per trained token (fwd + bwd ~= 3x fwd)."""
+    d, L, V = cfg.hidden, cfg.num_layers, cfg.vocab_size
+    per_layer = 12 * d * d          # qkv (3d^2) + proj (d^2) + mlp (8d^2)
+    attn = 4 * seq * d              # QK^T and AV rows for one token
+    fwd = 2 * (L * (per_layer + attn) + d * V)
+    return 3 * fwd
+
+
+def _peak_flops(device_kind):
+    dk = (device_kind or "").lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in dk:
+            return peak
     return None
 
 
-def main():
-    t_setup = time.time()
-    # all device probing happens in subprocesses BEFORE this process inits
-    # the backend — two processes contending for the tunneled chip deadlock
-    backend = _probe_backend() or "cpu"
-    on_accel = backend != "cpu"
-    use_flash = False
-    if on_accel and not os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
-        use_flash = "FLASH_OK" in _sub(_FLASH_PROBE, 300, "flash-attention")
-        if not use_flash:
-            os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
-
-    import jax
-
+def _measure(tag, on_accel, use_flash, batch, seq, n_steps):
+    """Build the program fresh and measure steady-state throughput."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import framework, unique_name
     from paddle_tpu.models import bert
+
+    if use_flash:
+        os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
+    else:
+        os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
 
     framework.switch_main_program(framework.Program())
     framework.switch_startup_program(framework.Program())
@@ -100,9 +136,6 @@ def main():
 
     cfg = bert.bert_base() if on_accel else bert.bert_tiny()
     cfg.use_fused_attention = use_flash
-    seq = 128 if on_accel else 64
-    batch = 64 if on_accel else 8
-
     vs = bert.build_bert_pretrain(cfg, seq)
     opt = fluid.optimizer.Adam(learning_rate=1e-4)
     if on_accel:
@@ -127,7 +160,6 @@ def main():
 
     # timed steps; keep fetches on device so the loop isn't serialized on
     # per-step host readbacks (sync once at the end)
-    n_steps = 30 if on_accel else 5
     t0 = time.time()
     for _ in range(n_steps):
         out = exe.run(feed=feed, fetch_list=fetch, return_numpy=False)
@@ -135,29 +167,108 @@ def main():
     dt = time.time() - t0
     tokens_per_sec = n_steps * batch * seq / dt
 
-    result = {
+    return {
+        "tag": tag,
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "batch": batch,
+        "seq_len": seq,
+        "flash_attention": use_flash,
+        "steps": n_steps,
+        "step_ms": round(1000 * dt / n_steps, 2),
+        "compile_s": round(compile_s, 1),
+        "loss_first": round(loss0, 4),
+        "loss_last": round(last, 4),
+    }, cfg
+
+
+def _bank(variant, cfg, on_accel, backend, device_kind):
+    _STATE["detail"]["variants"].append(variant)
+    tps = variant["tokens_per_sec"]
+    best = _STATE["best"]
+    if best is not None and best["value"] >= tps:
+        return
+    detail = {
+        "backend": backend,
+        "device_kind": device_kind,
+        "batch": variant["batch"],
+        "seq_len": variant["seq_len"],
+        "flash_attention": variant["flash_attention"],
+        "step_ms": variant["step_ms"],
+        "compile_s": variant["compile_s"],
+        "loss_first": variant["loss_first"],
+        "loss_last": variant["loss_last"],
+    }
+    flops = _flops_per_token_train(cfg, variant["seq_len"])
+    detail["train_flops_per_token"] = flops
+    peak = _peak_flops(device_kind)
+    if peak:
+        detail["mfu"] = round(tps * flops / peak, 4)
+        detail["peak_flops_assumed"] = peak
+    _STATE["best"] = {
         "metric": "bert_base_pretrain_throughput" if on_accel
         else "bert_tiny_pretrain_throughput_cpu",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(
-            tokens_per_sec / V100_BASELINE_TOKENS_PER_SEC, 3
-        ),
-        "detail": {
-            "backend": backend,
-            "batch": batch,
-            "seq_len": seq,
-            "flash_attention": use_flash,
-            "steps": n_steps,
-            "step_ms": round(1000 * dt / n_steps, 2),
-            "compile_s": round(compile_s, 1),
-            "loss_first": round(loss0, 4),
-            "loss_last": round(last, 4),
-            "setup_s": round(t0 - t_setup, 1),
-        },
+        "value": tps,
+        "detail": detail,
     }
-    print(json.dumps(result))
+
+
+def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    _STATE["stage"] = "jax-init"
+    import jax
+
+    if os.environ.get("PADDLE_TPU_BENCH_CPU"):
+        # local validation path; the JAX_PLATFORMS env var is not a
+        # reliable override in this environment, config.update is
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    backend = devs[0].platform
+    device_kind = getattr(devs[0], "device_kind", "") or os.environ.get(
+        "PALLAS_AXON_TPU_GEN", ""
+    )
+    _STATE["detail"]["init_s"] = round(_elapsed(), 1)
+    _STATE["detail"]["n_devices"] = len(devs)
+    on_accel = backend != "cpu"
+
+    if on_accel:
+        # Safe config first: a number is banked before pallas is attempted.
+        plan = [
+            ("noflash-b64", False, 64, 128, 30),
+            ("flash-b64", True, 64, 128, 30),
+            ("flash-b128", True, 128, 128, 30),
+        ]
+    else:
+        plan = [("cpu-tiny", False, 8, 64, 5)]
+
+    for tag, use_flash, batch, seq, n_steps in plan:
+        # don't start a variant that can't finish before the watchdog:
+        # leave headroom for one more full compile + timed loop
+        if _STATE["best"] is not None and _elapsed() > DEADLINE_S * 0.62:
+            _STATE["detail"]["errors"].append(
+                "skipped %s: %.0fs elapsed" % (tag, _elapsed())
+            )
+            continue
+        _STATE["stage"] = tag
+        try:
+            variant, cfg = _measure(tag, on_accel, use_flash, batch, seq,
+                                    n_steps)
+            _bank(variant, cfg, on_accel, backend, device_kind)
+        except Exception as e:  # noqa: BLE001 — bank the failure, keep going
+            _STATE["detail"]["errors"].append(
+                "%s failed: %s: %s" % (tag, type(e).__name__, str(e)[:300])
+            )
+
+    _STATE["stage"] = "done"
+    _STATE["done"].set()
+    _emit_and_exit(0)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — always print the JSON line
+        _STATE["detail"]["errors"].append(
+            "fatal: %s: %s" % (type(e).__name__, str(e)[:300])
+        )
+        _emit_and_exit(0)

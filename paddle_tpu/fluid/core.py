@@ -22,7 +22,15 @@ class Place:
     def jax_device(self):
         import jax
 
-        devs = jax.devices(self._backend)
+        if self._backend == "cpu":
+            devs = jax.devices("cpu")
+        else:
+            # accelerator: any non-cpu platform (tpu, or the tunneled
+            # "axon" TPU plugin) — jax.devices(name) only accepts exact
+            # platform names, so filter the default device list instead
+            devs = [d for d in jax.devices() if d.platform != "cpu"]
+            if not devs:
+                devs = jax.devices()
         return devs[self._device_id % len(devs)]
 
     def __eq__(self, other):
@@ -63,7 +71,9 @@ def _default_backend():
         plats = {d.platform for d in jax.devices()}
     except RuntimeError:
         return "cpu"
-    if "tpu" in plats:
+    # any non-cpu platform is the accelerator (real TPU reports "tpu";
+    # the tunneled chip in this environment reports "axon")
+    if plats - {"cpu"}:
         return "tpu"
     return "cpu"
 
