@@ -222,7 +222,26 @@ def main():
         # local validation path; the JAX_PLATFORMS env var is not a
         # reliable override in this environment, config.update is
         jax.config.update("jax_platforms", "cpu")
-    devs = jax.devices()
+
+    # the tunneled chip's relay can be slow/wedged right after another
+    # process died holding it; retry init instead of giving up
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            devs = jax.devices()
+            break
+        except RuntimeError as e:
+            _STATE["detail"]["errors"].append(
+                "init attempt %d failed: %s" % (attempt, str(e)[:200])
+            )
+            if _elapsed() > DEADLINE_S * 0.55:
+                raise
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(45)
     backend = devs[0].platform
     device_kind = getattr(devs[0], "device_kind", "") or os.environ.get(
         "PALLAS_AXON_TPU_GEN", ""

@@ -139,7 +139,7 @@ class CompiledProgram:
         }
 
         sig = (
-            id(program), program._version,
+            program._uid, program._version,
             tuple(sorted((k, v.shape, str(v.dtype))
                          for k, v in feed_arrays.items())),
             tuple(fetch_names), ndev,

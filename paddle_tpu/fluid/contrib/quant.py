@@ -153,14 +153,14 @@ class QuantizationTransformPass:
         qvar = block.create_var(
             name=var.name + ".quantized", dtype=var.dtype, shape=var.shape
         )
-        scale_var = block.create_var(
-            name=var.name + ".quant_scale", dtype="float32",
-            shape=(1,),
-        )
         if is_weight:
             # conv weights quant per output-channel (axis 0); mul/matmul
             # weights per column (axis 1) — ref quantization_pass.py
             axis = 0 if "conv" in op_type else max(0, len(var.shape) - 1)
+            scale_var = block.create_var(
+                name=var.name + ".quant_scale", dtype="float32",
+                shape=(int(var.shape[axis]),) if var.shape else (1,),
+            )
             block._insert_op(
                 idx,
                 type="fake_channel_wise_quantize_dequantize_abs_max",

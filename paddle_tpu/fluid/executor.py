@@ -172,6 +172,18 @@ class Executor:
             return program._executor_run(
                 self, feed, fetch_list, scope, return_numpy
             )
+        # PipelineOptimizer-annotated programs run the gpipe schedule
+        info = getattr(program, "_parallel_info", None)
+        if info and info.get("mode") == "pipeline" and not getattr(
+            program, "_is_start_up_program", False
+        ):
+            from .pipeline_executor import run_pipeline_program
+
+            return run_pipeline_program(
+                self, program, feed or {}, fetch_list or [],
+                scope if scope is not None else global_scope(),
+                return_numpy,
+            )
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -181,7 +193,7 @@ class Executor:
         state = self._gather_state(program, scope)
 
         sig = (
-            id(program),
+            program._uid,
             program._version,
             tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items())),
             tuple(fetch_names),
@@ -272,7 +284,7 @@ class Executor:
         self._run_counter += 1
         seed = program.random_seed
         if seed == 0:
-            seed = abs(hash(("paddle_tpu", id(program)))) % (2**31)
+            seed = abs(hash(("paddle_tpu", program._uid))) % (2**31)
         return jax.random.PRNGKey(seed + 1000003 * self._run_counter)
 
     def close(self):

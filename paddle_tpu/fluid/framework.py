@@ -10,6 +10,7 @@ compiles with XLA — whole-block fusion, static shapes, donated state.
 import collections
 import contextlib
 import copy
+import itertools
 import json
 import traceback
 
@@ -473,10 +474,16 @@ class Program:
     executor's compile cache whenever the graph mutates.
     """
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
+        # monotonic identity for executor compile-cache keys: unlike
+        # id(self), a UID is never reused after GC, so a new Program can
+        # never replay a dead Program's stale executable
+        self._uid = next(Program._uid_counter)
         self._version = 0
         self._seed_counter = 0
         self._is_start_up_program = False

@@ -52,8 +52,10 @@ class Predictor:
                     arr = jax.numpy.asarray(arr, jax.numpy.bfloat16)
                 persist[v.name] = jax.device_put(arr)
         self._state = persist
+        platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
         step = build_step_fn(
-            program, self.feed_names, self.fetch_names, is_test=True
+            program, self.feed_names, self.fetch_names, is_test=True,
+            platform=platform,
         )
 
         def fwd(state, feeds):

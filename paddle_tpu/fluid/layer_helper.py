@@ -131,6 +131,11 @@ class LayerHelper:
                 attr, shape, dtype, self.startup_program
             )
 
+        from .param_attr import WeightNormParamAttr
+
+        if isinstance(attr, WeightNormParamAttr):
+            return self._create_weight_normed_parameter(attr, shape, dtype)
+
         startup_block = self.startup_program.global_block()
         if not startup_block.has_var(attr.name):
             sp = startup_block.create_parameter(
@@ -153,6 +158,64 @@ class LayerHelper:
             dtype=dtype,
             **{k: v for k, v in attr._to_kwargs().items() if k != "name"}
         )
+
+    def _create_weight_normed_parameter(self, attr, shape, dtype):
+        """Weight normalisation (ref layer_helper_base.py:88): the layer's
+        weight is not a free parameter — it is computed each step as
+        w = g * v / ||v|| from direction v (the initialised tensor) and
+        magnitude g (seeded to ||v|| so w == v at step 0). Gradients flow
+        to g and v; the optimizer updates those."""
+        dim = attr.dim
+        if dim is not None and dim < 0:
+            dim += len(shape)
+        attr_dim = -1 if dim is None else int(dim)
+        g_shape = [1] if dim is None else [int(shape[dim])]
+
+        v_attr = ParamAttr(
+            name=attr.name + ".w_v", initializer=attr.initializer,
+            learning_rate=attr.learning_rate, regularizer=attr.regularizer,
+            trainable=attr.trainable, gradient_clip=attr.gradient_clip,
+            do_model_average=attr.do_model_average,
+        )
+        v = self.create_parameter(v_attr, shape, dtype)
+
+        # g parameter: created raw, then seeded in startup from ||v|| so
+        # the startup value of w equals the plain initialised weight
+        g_name = attr.name + ".w_g"
+        startup_block = self.startup_program.global_block()
+        if not startup_block.has_var(g_name):
+            g_sp = startup_block.create_parameter(
+                name=g_name, shape=g_shape, dtype=dtype,
+                **{k: val for k, val in attr._to_kwargs().items()
+                   if k != "name"}
+            )
+            startup_block.append_op(
+                type="norm_except_dim",
+                inputs={"V": [startup_block.var(v_attr.name)]},
+                outputs={"Out": [g_sp]},
+                attrs={"dim": attr_dim},
+            )
+        main_block = self.main_program.global_block()
+        if main_block.has_var(g_name):
+            g = main_block.var(g_name)
+        else:
+            g = main_block.create_parameter(
+                name=g_name, shape=g_shape, dtype=dtype,
+                **{k: val for k, val in attr._to_kwargs().items()
+                   if k != "name"}
+            )
+
+        w = self.create_variable_for_type_inference(dtype)
+        w.shape = tuple(int(s) for s in shape)
+        self.append_op(
+            type="weight_norm_reparam",
+            inputs={"V": [v], "G": [g]},
+            outputs={"Out": [w]},
+            attrs={"dim": attr_dim},
+        )
+        WeightNormParamAttr = type(attr)
+        WeightNormParamAttr.params_with_weight_norm.append(w.name)
+        return w
 
     def create_variable_for_type_inference(self, dtype, stop_gradient=False):
         if in_dygraph_mode():

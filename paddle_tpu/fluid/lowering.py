@@ -132,16 +132,52 @@ def run_ops(block, op_list, env, ctx):
                 )
             primals.append(env0[n])
 
+        # Recompute (ref optimizer.py:3491 RecomputeOptimizer): split the
+        # forward region into segments ending at each checkpoint var's
+        # producing op and wrap each in jax.checkpoint. The env handed
+        # across a boundary is thinned to the variables genuinely needed
+        # downstream — without thinning every intermediate would be a
+        # segment output and nothing would be rematerialised.
+        ckpt_names = [c for c in (bw_op.attrs.get("checkpoints") or []) if c]
+        cuts = []
+        needed_after = {}
+        if ckpt_names:
+            cuts = segment_cuts(region, ckpt_names)
+            keep = set(getattr(ctx, "keep_names", ()) or ())
+            keep.add(loss_name)
+            need = set(keep)
+            for j in range(len(op_list) - 1, -1, -1):
+                needed_after[j] = set(need)
+                for names in op_list[j].inputs.values():
+                    need.update(names)
+
         def fwd(primal_vals, _region=region, _tn=target_names,
-                _ln=loss_name):
+                _ln=loss_name, _cuts=tuple(cuts)):
             e = dict(env0)
             e.update(zip(_tn, primal_vals))
-            for j, rop in enumerate(_region):
-                if rop.type == "backward":
-                    for gn in rop.output("Grads"):
-                        e[gn] = lax.stop_gradient(cached_grads[gn])
-                    continue
-                e = apply_op(rop, e, ctx, var_lookup, op_tag=tag_base + j)
+
+            def run_span(e_in, lo, hi):
+                for j in range(lo, hi):
+                    rop = _region[j]
+                    if rop.type == "backward":
+                        for gn in rop.output("Grads"):
+                            e_in[gn] = lax.stop_gradient(cached_grads[gn])
+                        continue
+                    e_in = apply_op(rop, e_in, ctx, var_lookup,
+                                    op_tag=tag_base + j)
+                return e_in
+
+            prev = 0
+            for cut in _cuts:
+                live = needed_after[cut]
+
+                def seg(e_in, _lo=prev, _hi=cut + 1, _live=live):
+                    ee = run_span(dict(e_in), _lo, _hi)
+                    return {k: v for k, v in ee.items() if k in _live}
+
+                e = jax.checkpoint(seg)(e)
+                prev = cut + 1
+            e = run_span(e, prev, len(_region))
             return e[_ln], e
 
         (loss_val, vjp_fn, env) = jax.vjp(fwd, primals, has_aux=True)
@@ -151,6 +187,22 @@ def run_ops(block, op_list, env, ctx):
             env[n] = g
             cached_grads[n] = g
     return env
+
+
+def segment_cuts(region, cut_var_names):
+    """Indices of ops ending a segment: each cut var's producing op closes
+    its segment. A cut at the final op is dropped (no-op boundary). Shared
+    by the recompute pass and the pipeline executor so stage/segment
+    semantics can't diverge."""
+    produce = {}
+    for j, rop in enumerate(region):
+        for names in rop.outputs.values():
+            for n in names:
+                produce[n] = j
+    cuts = sorted({produce[c] for c in cut_var_names if c in produce})
+    if cuts and cuts[-1] == len(region) - 1:
+        cuts = cuts[:-1]
+    return cuts
 
 
 def _make_var_lookup(block):
@@ -190,6 +242,9 @@ def build_step_fn(program, feed_names, fetch_names, is_test=False,
         ctx = LowerContext(rng=rng, is_test=is_test, program=program,
                            mesh_axes=mesh_axes, platform=platform)
         ctx.run_ops = run_ops  # control-flow ops recurse through this
+        # names the recompute pass must keep live across jax.checkpoint
+        # segment boundaries even if no later op consumes them
+        ctx.keep_names = set(fetch_names) | persist
         env = {}
         if extra_env:
             env.update(extra_env)

@@ -773,6 +773,7 @@ class ExponentialMovingAverage:
         self._name = name or ""
         self._ema_vars = {}
         self._params = []
+        self._backup = {}
 
     def update(self):
         block = framework.default_main_program().global_block()
@@ -826,15 +827,24 @@ class ExponentialMovingAverage:
                 if ema.name in scope and pname in scope:
                     self.backup[pname] = scope[pname]
                     scope.set(pname, scope[ema.name])
+            # bank on the instance so a standalone restore() call after
+            # apply(need_restore=False) can put training weights back
+            self._banked = dict(self.backup)
+            self.outer._backup = self._banked
             return self
 
         def __exit__(self, *exc):
-            from .executor import global_scope
-
             if self.need_restore:
+                # restore from the guard-local snapshot (nested guards /
+                # a manual restore() inside the guard must not lose the
+                # outer training weights)
+                from .executor import global_scope
+
                 scope = global_scope()
                 for name, val in self.backup.items():
                     scope.set(name, val)
+                if self.outer._backup is self._banked:
+                    self.outer._backup = {}
 
     def apply(self, executor=None, need_restore=True):
         return ExponentialMovingAverage._ApplyGuard(
@@ -842,7 +852,14 @@ class ExponentialMovingAverage:
         )
 
     def restore(self, executor=None):
-        pass
+        """Swap the training weights saved by the last apply() back into
+        the scope (ref optimizer.py:2959 EMA.restore)."""
+        from .executor import global_scope
+
+        scope = global_scope()
+        for name, val in self._backup.items():
+            scope.set(name, val)
+        self._backup = {}
 
 
 class RecomputeOptimizer(Optimizer):
@@ -965,13 +982,14 @@ class PipelineOptimizer:
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
-                 start_cpu_core_id=0):
+                 start_cpu_core_id=0, num_microbatches=None):
         self._optimizer = optimizer
         self._cut_list = cut_list
         self._place_list = place_list
         self._concurrency_list = concurrency_list
         self._queue_size = queue_size
         self._sync_steps = sync_steps
+        self._num_microbatches = num_microbatches
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -983,6 +1001,7 @@ class PipelineOptimizer:
             "mode": "pipeline",
             "cut_list": self._cut_list,
             "sync_steps": self._sync_steps,
+            "n_microbatches": self._num_microbatches,
         }
         return out
 

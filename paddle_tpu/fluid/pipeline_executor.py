@@ -1,0 +1,395 @@
+"""Pipeline-parallel execution of a fluid Program (PipelineOptimizer path).
+
+TPU-native rework of the reference's pipeline trainer
+(ref: python/paddle/fluid/optimizer.py:3193 PipelineOptimizer, which splits
+the program at ``cut_list`` vars and runs section workers over blocking
+queues on different devices). Here:
+
+  * the forward region is split at the cut vars' producing ops into S
+    heterogeneous stage functions;
+  * all S stages run under one ``shard_map`` over the 'pp' mesh axis —
+    each device executes its own stage via ``lax.switch`` on its axis
+    index, activations circulate with ``lax.ppermute`` inside a
+    ``lax.scan`` over (microbatches + stages - 1) ticks (GPipe schedule);
+  * the BACKWARD pipeline is not hand-written: ``jax.vjp`` through the
+    scan + ppermute forward yields the reverse schedule mechanically
+    (ppermute transposes to the inverse permutation, scan to a reverse
+    scan) — the payoff of building the pipeline as a pure jax function;
+  * grads are bound to the program's ``p@GRAD`` vars and the post-backward
+    ops (optimizer updates, LR schedules) run replicated as usual.
+
+Semantics: with M microbatches of equal size, mean-reduced losses match
+sequential full-batch execution exactly (mean of microbatch means). v1
+limitations (documented, loud): stage bodies must be stateless in the
+persistable sense (no batch-norm running-stat updates inside the pipeline)
+and fetches must be producible by the last stage.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.registry import LowerContext
+from .lowering import (
+    OpLoweringError, apply_op, run_ops, segment_cuts, _make_var_lookup,
+)
+
+__all__ = ["run_pipeline_program"]
+
+
+def _cut_names(cut_list):
+    names = []
+    for c in cut_list or []:
+        if isinstance(c, (list, tuple)):
+            names.extend(_cut_names(c))
+        else:
+            names.append(c.name if hasattr(c, "name") else str(c))
+    return names
+
+
+def _split_stages(region, cut_list):
+    """Partition the forward op span at each cut var's producing op
+    (the cut op ends its stage, like the reference's section split)."""
+    cuts = segment_cuts(region, _cut_names(cut_list))
+    spans = []
+    prev = 0
+    for c in cuts:
+        spans.append((prev, c + 1))
+        prev = c + 1
+    spans.append((prev, len(region)))
+    return spans
+
+
+def _boundary_vars(region, spans):
+    """Vars produced in stage <= b and consumed in a later stage — the
+    union over boundaries is the ring buffer's (uniform) structure."""
+    stage_of = {}
+    for s, (lo, hi) in enumerate(spans):
+        for j in range(lo, hi):
+            for ns in region[j].outputs.values():
+                for n in ns:
+                    stage_of[n] = s
+    crossing = set()
+    for s, (lo, hi) in enumerate(spans):
+        for j in range(lo, hi):
+            for ns in region[j].inputs.values():
+                for n in ns:
+                    if n in stage_of and stage_of[n] < s:
+                        crossing.add(n)
+    return sorted(crossing), stage_of
+
+
+def run_pipeline_program(executor, program, feed, fetch_list, scope,
+                         return_numpy):
+    info = program._parallel_info
+    block = program.global_block()
+    op_list = list(block.ops)
+
+    bw_idx = next(
+        (i for i, op in enumerate(op_list) if op.type == "backward"), None
+    )
+    if bw_idx is None:
+        raise OpLoweringError(
+            "pipeline mode needs a backward op: call "
+            "PipelineOptimizer.minimize(loss) before Executor.run"
+        )
+    region = op_list[:bw_idx]
+    bw_op = op_list[bw_idx]
+    post_ops = op_list[bw_idx + 1:]
+
+    spans = _split_stages(region, info.get("cut_list"))
+    n_stages = len(spans)
+    if n_stages < 2:
+        raise OpLoweringError(
+            "PipelineOptimizer cut_list produced %d stage(s); pass the "
+            "boundary activation vars as cut_list=[...]" % n_stages
+        )
+    devices = jax.devices()
+    if len(devices) < n_stages:
+        raise OpLoweringError(
+            "pipeline needs one device per stage: %d stages but only %d "
+            "device(s) visible" % (n_stages, len(devices))
+        )
+    ring_names, stage_of = _boundary_vars(region, spans)
+
+    from .executor import _as_name
+
+    fetch_names = [_as_name(f) for f in fetch_list or []]
+    loss_name = bw_op.input("Loss")[0]
+    last_lo, last_hi = spans[-1]
+    last_stage_produced = {
+        n for j in range(last_lo, last_hi)
+        for ns in region[j].outputs.values() for n in ns
+    }
+    post_produced = {
+        n for op in post_ops for ns in op.outputs.values() for n in ns
+    }
+    persist_names = {
+        v.name for v in block.vars.values() if v.persistable
+    }
+    for f in fetch_names:
+        if (f != loss_name and f not in last_stage_produced
+                and f not in post_produced and f not in persist_names):
+            raise OpLoweringError(
+                "pipeline fetch '%s' is produced mid-pipeline; only "
+                "last-stage vars (loss, metrics), post-backward vars "
+                "(lr, counters) and persistable state are fetchable in "
+                "pipeline mode" % f
+            )
+    record_names = sorted(
+        (set(fetch_names) & last_stage_produced) | {loss_name}
+    )
+
+    feed_arrays = executor._prepare_feeds(program, feed)
+    state = executor._gather_state(program, scope)
+    target_names = bw_op.attrs["targets"]
+    for n in target_names:
+        if n not in state:
+            raise OpLoweringError(
+                "pipeline backward target '%s' missing from scope — run the "
+                "startup program first" % n
+            )
+
+    n_micro = info.get("n_microbatches") or n_stages
+    batch_sizes = {
+        k: v.shape[0] for k, v in feed_arrays.items() if v.ndim > 0
+    }
+    for k, b in batch_sizes.items():
+        if b % n_micro:
+            raise OpLoweringError(
+                "feed '%s' batch %d not divisible by %d microbatches"
+                % (k, b, n_micro)
+            )
+
+    mesh = Mesh(np.array(devices[:n_stages]), ("pp",))
+    from jax.sharding import NamedSharding
+
+    repl = NamedSharding(mesh, P())
+    feed_arrays = {k: jax.device_put(v, repl) for k, v in feed_arrays.items()}
+    state = {k: jax.device_put(v, repl) for k, v in state.items()}
+    rng = jax.device_put(executor._next_rng(program), repl)
+
+    sig = (
+        "pipeline", program._uid, program._version, n_stages, n_micro,
+        tuple(sorted((k, v.shape, str(v.dtype))
+                     for k, v in feed_arrays.items())),
+        tuple(fetch_names),
+        tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
+    )
+    entry = executor._cache.get(sig)
+    if entry is None:
+        entry = _build_pipeline_fn(
+            program, region, spans, ring_names, record_names, target_names,
+            bw_op, post_ops, loss_name, mesh, n_micro,
+            {k: v.shape for k, v in feed_arrays.items()},
+        )
+        executor._cache[sig] = entry
+
+    fetches, new_state = entry(state, feed_arrays, rng)
+    for k, v in new_state.items():
+        scope.update(k, v)
+    out = [fetches[n] for n in fetch_names]
+    if return_numpy:
+        return [np.asarray(v) for v in out]
+    return out
+
+
+def _build_pipeline_fn(program, region, spans, ring_names, record_names,
+                       target_names, bw_op, post_ops, loss_name, mesh,
+                       n_micro, feed_shapes):
+    from jax.experimental.shard_map import shard_map
+
+    block = program.global_block()
+    var_lookup = _make_var_lookup(block)
+    n_stages = len(spans)
+    persist = {
+        v.name for v in block.vars.values() if v.persistable
+    }
+
+    def step(state, feeds, rng):
+        ctx = LowerContext(rng=rng, is_test=False, program=program,
+                           mesh_axes={}, platform=None)
+        ctx.run_ops = run_ops
+
+        # microbatch the feeds: (B, ...) -> (M, B//M, ...); scalars and
+        # feeds without a batch dim are replicated per tick
+        feeds_mb = {}
+        for k, v in feeds.items():
+            if v.ndim > 0 and v.shape[0] % n_micro == 0:
+                feeds_mb[k] = v.reshape(
+                    (n_micro, v.shape[0] // n_micro) + v.shape[1:]
+                )
+            else:
+                feeds_mb[k] = jnp.broadcast_to(
+                    v, (n_micro,) + v.shape
+                )
+
+        # ring buffer template: zeros in every boundary var's
+        # microbatch-sized shape (trace stage-by-stage to get shapes)
+        shapes = _infer_boundary_shapes(
+            region, spans, ring_names, record_names, state, feeds_mb,
+            program, var_lookup,
+        )
+
+        nontarget_state = {
+            k: v for k, v in state.items() if k not in set(target_names)
+        }
+
+        def pipelined_loss(params):
+            def local(params_l, nt_state_l, feeds_mb_l):
+                idx = lax.axis_index("pp")
+                perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+                def stage_body(s, env_base, buf):
+                    lo, hi = spans[s]
+                    e = dict(env_base)
+                    e.update(buf)
+                    for j in range(lo, hi):
+                        e = apply_op(region[j], e, ctx, var_lookup,
+                                     op_tag=1000 + j)
+                    new_buf = {
+                        n: e.get(n, buf[n]) for n in ring_names
+                    }
+                    rec = {
+                        n: e[n] if n in e else jnp.zeros(shapes["rec"][n][0],
+                                                         shapes["rec"][n][1])
+                        for n in record_names
+                    }
+                    return new_buf, rec
+
+                def tick(carry, t):
+                    buf, recs = carry
+                    mb_idx = jnp.clip(t - idx, 0, n_micro - 1)
+                    env_base = dict(params_l)
+                    env_base.update(nt_state_l)
+                    for k, v in feeds_mb_l.items():
+                        env_base[k] = v[mb_idx]
+                    branches = [
+                        (lambda b, _s=s: stage_body(_s, env_base, b))
+                        for s in range(n_stages)
+                    ]
+                    # distinct PRNG per microbatch: without the traced
+                    # token, dropout in a stage would reuse one mask for
+                    # every microbatch (fold_in of a constant op tag is
+                    # itself a compile-time constant inside this scan)
+                    ctx._iter_token = mb_idx
+                    try:
+                        new_buf, rec = lax.switch(idx, branches, buf)
+                    finally:
+                        ctx._iter_token = None
+                    done = t - (n_stages - 1)
+                    is_last = idx == n_stages - 1
+                    valid = is_last & (done >= 0) & (done < n_micro)
+                    di = jnp.clip(done, 0, n_micro - 1)
+                    recs = jax.tree_util.tree_map(
+                        lambda acc, r: lax.cond(
+                            valid,
+                            lambda a: a.at[di].set(r),
+                            lambda a: a,
+                            acc,
+                        ),
+                        recs, rec,
+                    )
+                    new_buf = jax.tree_util.tree_map(
+                        lambda x: lax.ppermute(x, "pp", perm), new_buf
+                    )
+                    return (new_buf, recs), None
+
+                buf0 = {
+                    n: jnp.zeros(shapes["ring"][n][0], shapes["ring"][n][1])
+                    for n in ring_names
+                }
+                recs0 = {
+                    n: jnp.zeros((n_micro,) + shapes["rec"][n][0],
+                                 shapes["rec"][n][1])
+                    for n in record_names
+                }
+                (_, recs), _ = lax.scan(
+                    tick, (buf0, recs0),
+                    jnp.arange(n_micro + n_stages - 1),
+                )
+                # only the last stage recorded; psum broadcasts to all
+                return jax.tree_util.tree_map(
+                    lambda x: lax.psum(x, "pp"), recs
+                )
+
+            recs = shard_map(
+                local, mesh=mesh,
+                in_specs=(P(), P(), P()),
+                out_specs=P(),
+                check_rep=False,
+            )(params, nontarget_state, feeds_mb)
+            loss_mb = recs[loss_name]
+            loss = jnp.mean(loss_mb.astype(jnp.float32))
+            return loss, recs
+
+        params = {n: state[n] for n in target_names}
+        (loss_val, vjp_fn, recs) = jax.vjp(
+            pipelined_loss, params, has_aux=True
+        )
+        (grads,) = vjp_fn(jnp.ones_like(loss_val))
+
+        # bind grads + recorded fetches, then run optimizer/post ops
+        env = dict(state)
+        env.update(feeds)
+        env[loss_name] = loss_val
+        for n in record_names:
+            if n != loss_name:
+                # microbatch-mean for float metrics (exact for means)
+                r = recs[n]
+                env[n] = jnp.mean(r.astype(jnp.float32), axis=0) \
+                    if jnp.issubdtype(r.dtype, jnp.floating) else r[-1]
+        grad_names = bw_op.output("Grads")
+        for tname, gname in zip(target_names, grad_names):
+            env[gname] = grads[tname]
+        for k, op in enumerate(post_ops):
+            env = apply_op(op, env, ctx, var_lookup, op_tag=50000 + k)
+
+        fetch_all = set(record_names) | (persist & set(env))
+        for op in post_ops:
+            for ns in op.outputs.values():
+                fetch_all.update(ns)
+        fetches = {n: env[n] for n in fetch_all if n in env}
+        fetches[loss_name] = loss_val
+        new_state = {n: env[n] for n in persist if n in env}
+        return fetches, new_state
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def _infer_boundary_shapes(region, spans, ring_names, record_names, state,
+                           feeds_mb, program, var_lookup):
+    """Abstractly evaluate one microbatch through the stages to learn the
+    shapes/dtypes of boundary + recorded vars. Uses a private ctx with a
+    constant rng so no outer-trace tracers leak into eval_shape."""
+    probe_ctx = LowerContext(rng=jax.random.PRNGKey(0), is_test=False,
+                             program=program, mesh_axes={}, platform=None)
+    probe_ctx.run_ops = run_ops
+
+    def probe(state_s, feeds_one):
+        e = dict(state_s)
+        e.update(feeds_one)
+        for lo, hi in spans:
+            for j in range(lo, hi):
+                e = apply_op(region[j], e, probe_ctx, var_lookup,
+                             op_tag=1000 + j)
+        return (
+            {n: e[n] for n in ring_names},
+            {n: e[n] for n in record_names},
+        )
+
+    state_s = {
+        k: jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+        for k, v in state.items()
+    }
+    feeds_one = {
+        k: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+        for k, v in feeds_mb.items()
+    }
+    ring, rec = jax.eval_shape(probe, state_s, feeds_one)
+    return {
+        "ring": {k: (tuple(v.shape), v.dtype) for k, v in ring.items()},
+        "rec": {k: (tuple(v.shape), v.dtype) for k, v in rec.items()},
+    }

@@ -349,7 +349,27 @@ def _nms_adaptive(flat_scores, flat_box, flat_cls, n_cls, keep_top_k,
     every candidate is tested at ITS turn in score order against the kept
     set, with the per-class threshold decayed once per kept box (while the
     threshold stays > 0.5). O(C·M·keep_top_k) — the eta<1 path only."""
-    order = jnp.argsort(-flat_scores)
+    # Pre-truncate to the top keep_top_k candidates PER CLASS: the scan is
+    # sequential, and C*M steps (80 classes x 1000 boxes = 80k) would crawl
+    # on TPU. Suppression is within-class and at most keep_top_k boxes are
+    # kept in total, so capping each class at keep_top_k (rather than a
+    # global score cut that one dense class could monopolise) bounds the
+    # scan at keep_top_k*C steps while keeping every realistic keeper.
+    total = flat_scores.shape[0]
+    cap = min(total, max(int(keep_top_k), 1) * max(int(n_cls), 1))
+    sorted_idx = jnp.argsort(-flat_scores)
+    cls_sorted = flat_cls[sorted_idx]
+    onehot = jax.nn.one_hot(cls_sorted, n_cls, dtype=jnp.int32)
+    rank_in_class = (
+        jnp.take_along_axis(
+            jnp.cumsum(onehot, axis=0), cls_sorted[:, None], axis=1
+        )[:, 0]
+        - 1
+    )
+    eligible = rank_in_class < keep_top_k
+    keyed = jnp.where(eligible, flat_scores[sorted_idx], -jnp.inf)
+    _, sel = lax.top_k(keyed, cap)
+    order = sorted_idx[sel]
     k = keep_top_k
     slots = jnp.arange(k)
 

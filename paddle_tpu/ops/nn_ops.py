@@ -519,6 +519,37 @@ def _norm(ctx, ins, attrs):
     return {"Out": [x / norm], "Norm": [norm]}
 
 
+def _wn_norm(v, dim):
+    """||v|| over all axes except `dim` (dim=-1 → over everything)."""
+    if dim is None or dim < 0:
+        return jnp.sqrt(jnp.sum(v * v)).reshape((1,))
+    axes = tuple(a for a in range(v.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes))
+
+
+@register_op("norm_except_dim")
+def _norm_except_dim(ctx, ins, attrs):
+    """g0 = ||v|| keeping axis `dim` (ref layer_helper_base.py
+    __norm_except_dim); used by startup to seed weight-norm g so the
+    initial effective weight equals the initialised v."""
+    return single(_wn_norm(ins["V"][0], attrs.get("dim", -1)))
+
+
+@register_op("weight_norm_reparam")
+def _weight_norm_reparam(ctx, ins, attrs):
+    """w = g * v / ||v|| (ref layer_helper_base.py:88 create_parameter
+    weight-norm path). Differentiable in g and v via the jax vjp."""
+    v = ins["V"][0]
+    g = ins["G"][0]
+    dim = attrs.get("dim", -1)
+    norm = _wn_norm(v, dim)
+    if dim is None or dim < 0:
+        return single(v * (g[0] / norm[0]))
+    bshape = [1] * v.ndim
+    bshape[dim] = v.shape[dim]
+    return single(v * (g / norm).reshape(bshape))
+
+
 @register_op("lrn")
 def _lrn(ctx, ins, attrs):
     x = ins["X"][0]

@@ -26,6 +26,8 @@ jax oracle used by tests and by the CPU lowering fallback.
 """
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -458,9 +460,12 @@ def _flash_bwd(sm_scale, causal, dropout_p, block_q, block_k, interpret,
     dkpm = None
     if kpm is not None:
         dkpm = dkpm_bh.reshape(b, h, tk).sum(axis=1).astype(kpm.dtype)
+    # the int32 seed's formal tangent type is float0 — returning an int32
+    # zero relies on lenient custom_vjp checking and can break on upgrades
+    dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
     return (
         dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape),
-        dkpm, jnp.zeros_like(seed),
+        dkpm, dseed,
     )
 
 
