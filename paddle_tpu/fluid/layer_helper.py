@@ -217,6 +217,15 @@ class LayerHelper:
         WeightNormParamAttr.params_with_weight_norm.append(w.name)
         return w
 
+    def get_parameter(self, name):
+        """Look up an existing parameter by name (ref layer_helper_base
+        get_parameter) — e.g. crf_decoding reusing linear_chain_crf's
+        transition matrix."""
+        block = self.main_program.global_block()
+        if not block.has_var(name):
+            raise ValueError("parameter %r not found" % name)
+        return block.var(name)
+
     def create_variable_for_type_inference(self, dtype, stop_gradient=False):
         if in_dygraph_mode():
             from .dygraph.tracer import VarBase

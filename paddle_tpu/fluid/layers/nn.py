@@ -2273,3 +2273,153 @@ def fused_multihead_attention(query, key, value, key_padding_mask=None,
         inputs,
         {"causal": causal, "dropout_prob": dropout_rate},
     )
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF family (ref nn.py:534 linear_chain_crf, :654 crf_decoding,
+# :1380 chunk_eval, :4652 ctc_greedy_decoder)
+# ---------------------------------------------------------------------------
+def _length_or_companion(helper, var, length):
+    """Explicit length var, else the LoD @SEQ_LEN companion, else None."""
+    if length is not None:
+        return length
+    from .sequence_lod import _seq_len_var
+
+    return _seq_len_var(var)
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Linear-chain CRF negative log likelihood (ref nn.py:534).
+
+    input: (B, T, D) padded emissions (or a LoD var with an @SEQ_LEN
+    companion); label: (B, T) or (B, T, 1) int; length: (B,) or (B, 1)
+    int lengths (optional when input carries LoD lengths). Creates the
+    (D+2, D) transition parameter (row 0 start, row 1 end, rows 2+
+    tag->tag) and returns the per-sequence cost (B, 1).
+    """
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size],
+        dtype=helper.input_dtype(),
+    )
+    alpha = helper.create_variable_for_type_inference(helper.input_dtype())
+    emission_exps = helper.create_variable_for_type_inference(
+        helper.input_dtype()
+    )
+    transition_exps = helper.create_variable_for_type_inference(
+        helper.input_dtype()
+    )
+    log_likelihood = helper.create_variable_for_type_inference(
+        helper.input_dtype()
+    )
+    log_likelihood.shape = (input.shape[0], 1)
+    ins = {"Emission": [input], "Transition": [transition],
+           "Label": [label]}
+    length = _length_or_companion(helper, input, length)
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs=ins,
+        outputs={
+            "Alpha": [alpha],
+            "EmissionExps": [emission_exps],
+            "TransitionExps": [transition_exps],
+            "LogLikelihood": [log_likelihood],
+        },
+    )
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with the linear_chain_crf transition parameter
+    (ref nn.py:654). Returns (B, T) int64 best tags (or, when `label` is
+    given, a per-token correctness indicator)."""
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.get_parameter(param_attr.name)
+    viterbi_path = helper.create_variable_for_type_inference("int64")
+    if input.shape is not None and len(input.shape) >= 2:
+        viterbi_path.shape = tuple(input.shape[:-1])
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    length = _length_or_companion(helper, input, length)
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="crf_decoding",
+        inputs=ins,
+        outputs={"ViterbiPath": [viterbi_path]},
+    )
+    return viterbi_path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk-level precision/recall/F1 for sequence labeling
+    (ref nn.py:1380; op: chunk_eval_op.h). Returns (precision, recall,
+    f1, num_infer_chunks, num_label_chunks, num_correct_chunks)."""
+    helper = LayerHelper("chunk_eval", **locals())
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1_score = helper.create_variable_for_type_inference("float32")
+    num_infer_chunks = helper.create_variable_for_type_inference("int64")
+    num_label_chunks = helper.create_variable_for_type_inference("int64")
+    num_correct_chunks = helper.create_variable_for_type_inference("int64")
+    for v in (precision, recall, f1_score):
+        v.shape = (1,)
+    for v in (num_infer_chunks, num_label_chunks, num_correct_chunks):
+        v.shape = (1,)
+    ins = {"Inference": [input], "Label": [label]}
+    seq_length = _length_or_companion(helper, input, seq_length)
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval",
+        inputs=ins,
+        outputs={
+            "Precision": [precision],
+            "Recall": [recall],
+            "F1-Score": [f1_score],
+            "NumInferChunks": [num_infer_chunks],
+            "NumLabelChunks": [num_label_chunks],
+            "NumCorrectChunks": [num_correct_chunks],
+        },
+        attrs={
+            "num_chunk_types": num_chunk_types,
+            "chunk_scheme": chunk_scheme,
+            "excluded_chunk_types": list(excluded_chunk_types or []),
+        },
+    )
+    return (precision, recall, f1_score, num_infer_chunks,
+            num_label_chunks, num_correct_chunks)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decoding (ref nn.py:4652): per-frame argmax, merge
+    repeats, drop blanks. input: (B, T, C) probs/logits. Returns
+    (decoded (B, T) int64 padded with padding_value, out_length (B, 1))
+    — always padded-mode outputs (the TPU LoD rep is dense-padded)."""
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int32")
+    if input.shape is not None and len(input.shape) >= 2:
+        out.shape = tuple(input.shape[:-1])
+        out_len.shape = (input.shape[0], 1)
+    ins = {"Input": [input]}
+    input_length = _length_or_companion(helper, input, input_length)
+    if input_length is not None:
+        ins["InputLength"] = [input_length]
+    helper.append_op(
+        type="ctc_greedy_decoder",
+        inputs=ins,
+        outputs={"Out": [out], "OutLength": [out_len]},
+        attrs={"blank": blank, "padding_value": padding_value},
+    )
+    return out, out_len
+
+
+__all__ += ["linear_chain_crf", "crf_decoding", "chunk_eval",
+            "ctc_greedy_decoder"]

@@ -145,11 +145,11 @@ def run_ops(block, op_list, env, ctx):
             cuts = segment_cuts(region, ckpt_names)
             keep = set(getattr(ctx, "keep_names", ()) or ())
             keep.add(loss_name)
+            program = getattr(ctx, "program", None)
             need = set(keep)
             for j in range(len(op_list) - 1, -1, -1):
                 needed_after[j] = set(need)
-                for names in op_list[j].inputs.values():
-                    need.update(names)
+                need.update(op_read_names(op_list[j], program))
 
         def fwd(primal_vals, _region=region, _tn=target_names,
                 _ln=loss_name, _cuts=tuple(cuts)):
@@ -187,6 +187,36 @@ def run_ops(block, op_list, env, ctx):
             env[n] = g
             cached_grads[n] = g
     return env
+
+
+_BLOCK_ATTRS = ("sub_block", "true_block", "false_block")
+
+
+def op_read_names(op, program):
+    """All var names an op may READ, including outer vars resolved inside
+    its while/cond sub-blocks through the env closure (those never appear
+    in the op's declared inputs). Needed by liveness analyses: thinning
+    the env at a recompute/pipeline boundary using declared inputs alone
+    would starve sub-block reads."""
+    names = set()
+    for ns in op.inputs.values():
+        names.update(ns)
+    if program is None:
+        return names
+    for attr in _BLOCK_ATTRS:
+        idx = op.attrs.get(attr)
+        if idx is None:
+            continue
+        try:
+            blk = program.block(idx)
+        except Exception:
+            continue
+        produced = set()
+        for sop in blk.ops:
+            names |= op_read_names(sop, program) - produced
+            for ns in sop.outputs.values():
+                produced.update(ns)
+    return names
 
 
 def segment_cuts(region, cut_var_names):

@@ -24,6 +24,8 @@ limitations (documented, loud): stage bodies must be stateless in the
 persistable sense (no batch-norm running-stat updates inside the pipeline)
 and fetches must be producible by the last stage.
 """
+import warnings
+
 import numpy as np
 
 import jax
@@ -62,9 +64,13 @@ def _split_stages(region, cut_list):
     return spans
 
 
-def _boundary_vars(region, spans):
+def _boundary_vars(region, spans, program):
     """Vars produced in stage <= b and consumed in a later stage — the
-    union over boundaries is the ring buffer's (uniform) structure."""
+    union over boundaries is the ring buffer's (uniform) structure. Reads
+    include while/cond sub-block closure reads (op_read_names), which the
+    op's declared inputs would miss."""
+    from .lowering import op_read_names
+
     stage_of = {}
     for s, (lo, hi) in enumerate(spans):
         for j in range(lo, hi):
@@ -74,10 +80,9 @@ def _boundary_vars(region, spans):
     crossing = set()
     for s, (lo, hi) in enumerate(spans):
         for j in range(lo, hi):
-            for ns in region[j].inputs.values():
-                for n in ns:
-                    if n in stage_of and stage_of[n] < s:
-                        crossing.add(n)
+            for n in op_read_names(region[j], program):
+                if n in stage_of and stage_of[n] < s:
+                    crossing.add(n)
     return sorted(crossing), stage_of
 
 
@@ -112,7 +117,7 @@ def run_pipeline_program(executor, program, feed, fetch_list, scope,
             "pipeline needs one device per stage: %d stages but only %d "
             "device(s) visible" % (n_stages, len(devices))
         )
-    ring_names, stage_of = _boundary_vars(region, spans)
+    ring_names, stage_of = _boundary_vars(region, spans, program)
 
     from .executor import _as_name
 
@@ -153,15 +158,16 @@ def run_pipeline_program(executor, program, feed, fetch_list, scope,
             )
 
     n_micro = info.get("n_microbatches") or n_stages
-    batch_sizes = {
-        k: v.shape[0] for k, v in feed_arrays.items() if v.ndim > 0
-    }
-    for k, b in batch_sizes.items():
-        if b % n_micro:
-            raise OpLoweringError(
-                "feed '%s' batch %d not divisible by %d microbatches"
-                % (k, b, n_micro)
-            )
+    # the batch dimension is the largest leading dim among feeds; only
+    # feeds carrying it are microbatched — smaller leading dims are
+    # non-batch constants (im_info vectors etc.) and get replicated
+    dim0s = [v.shape[0] for v in feed_arrays.values() if v.ndim > 0]
+    batch_dim = max(dim0s) if dim0s else 0
+    if batch_dim and batch_dim % n_micro:
+        raise OpLoweringError(
+            "feed batch %d not divisible by %d microbatches"
+            % (batch_dim, n_micro)
+        )
 
     mesh = Mesh(np.array(devices[:n_stages]), ("pp",))
     from jax.sharding import NamedSharding
@@ -180,11 +186,24 @@ def run_pipeline_program(executor, program, feed, fetch_list, scope,
     )
     entry = executor._cache.get(sig)
     if entry is None:
-        entry = _build_pipeline_fn(
+        jitted = _build_pipeline_fn(
             program, region, spans, ring_names, record_names, target_names,
-            bw_op, post_ops, loss_name, mesh, n_micro,
-            {k: v.shape for k, v in feed_arrays.items()},
+            bw_op, post_ops, loss_name, mesh, n_micro, batch_dim,
         )
+        # AOT-compile like the main executor path: without this the
+        # donated state comes back in compiler-chosen layouts and run 2
+        # would retrace+recompile the whole shard_map/scan module
+        try:
+            entry = jitted.lower(state, feed_arrays, rng).compile()
+        except OpLoweringError:
+            raise
+        except Exception as e:
+            warnings.warn(
+                "pipeline AOT compile failed (%s: %s); falling back to "
+                "traced jit — expect one redundant recompile"
+                % (type(e).__name__, e)
+            )
+            entry = jitted
         executor._cache[sig] = entry
 
     fetches, new_state = entry(state, feed_arrays, rng)
@@ -198,7 +217,7 @@ def run_pipeline_program(executor, program, feed, fetch_list, scope,
 
 def _build_pipeline_fn(program, region, spans, ring_names, record_names,
                        target_names, bw_op, post_ops, loss_name, mesh,
-                       n_micro, feed_shapes):
+                       n_micro, batch_dim):
     from jax.experimental.shard_map import shard_map
 
     block = program.global_block()
@@ -213,11 +232,11 @@ def _build_pipeline_fn(program, region, spans, ring_names, record_names,
                            mesh_axes={}, platform=None)
         ctx.run_ops = run_ops
 
-        # microbatch the feeds: (B, ...) -> (M, B//M, ...); scalars and
-        # feeds without a batch dim are replicated per tick
+        # microbatch the batch-dim feeds: (B, ...) -> (M, B//M, ...);
+        # scalars and non-batch feeds are replicated per tick
         feeds_mb = {}
         for k, v in feeds.items():
-            if v.ndim > 0 and v.shape[0] % n_micro == 0:
+            if v.ndim > 0 and v.shape[0] == batch_dim and batch_dim:
                 feeds_mb[k] = v.reshape(
                     (n_micro, v.shape[0] // n_micro) + v.shape[1:]
                 )
@@ -337,10 +356,14 @@ def _build_pipeline_fn(program, region, spans, ring_names, record_names,
         env[loss_name] = loss_val
         for n in record_names:
             if n != loss_name:
-                # microbatch-mean for float metrics (exact for means)
+                # microbatch-mean for float metrics (exact for means);
+                # SUM for integer fetches — counts (accuracy Correct,
+                # chunk totals) are additive over microbatches, and the
+                # last microbatch alone would be silently ~M× too small
                 r = recs[n]
                 env[n] = jnp.mean(r.astype(jnp.float32), axis=0) \
-                    if jnp.issubdtype(r.dtype, jnp.floating) else r[-1]
+                    if jnp.issubdtype(r.dtype, jnp.floating) \
+                    else jnp.sum(r, axis=0)
         grad_names = bw_op.output("Grads")
         for tname, gname in zip(target_names, grad_names):
             env[gname] = grads[tname]

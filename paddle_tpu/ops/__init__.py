@@ -21,6 +21,7 @@ from . import collective_ops  # noqa: F401
 from . import pallas_attention  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import crf_ops  # noqa: F401
 
 
 def _register_late_modules():

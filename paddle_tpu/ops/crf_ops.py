@@ -1,0 +1,336 @@
+"""Linear-chain CRF ops: linear_chain_crf, crf_decoding, chunk_eval
+(ref: paddle/fluid/operators/{linear_chain_crf_op.h, crf_decoding_op.h,
+chunk_eval_op.h}).
+
+TPU-native design notes:
+  * the reference computes the forward algorithm per sequence in exp
+    domain with per-step L1 renormalisation on the CPU; here the whole
+    batch runs one log-domain lax.scan over time (logsumexp is the
+    stable equivalent of the reference's normalise-and-log accounting),
+    so XLA can tile the (B, D, D) transition broadcasts on the MXU;
+  * viterbi decoding is a scan storing a (T, B, D) backpointer table and
+    a reverse scan to walk it — no per-sequence host loops;
+  * chunk_eval's tag state machine (ChunkBegin/ChunkEnd branch ladders in
+    the reference) is precomputed into dense (L, L) boolean lookup
+    tables over (prev_label, label) pairs at trace time, so the T-step
+    evaluation is pure gathers + a tiny matching scan.
+
+Transition layout matches the reference: row 0 = start weights, row 1 =
+end weights, rows 2.. = tag->tag transition scores, shape (D+2, D).
+LogLikelihood output is the per-sequence NEGATIVE log likelihood
+(a cost to minimise), exactly as the reference returns it.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, single
+
+NEG = -1e30
+
+
+def _crf_inputs(ins):
+    x = ins["Emission"][0]
+    w = ins["Transition"][0]
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[None]
+    b, t, d = x.shape
+    if ins.get("Length"):
+        lens = ins["Length"][0].astype(jnp.int32).reshape(-1)
+    else:
+        lens = jnp.full((b,), t, jnp.int32)
+    return x, w, lens, squeeze
+
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ctx, ins, attrs):
+    """Negative log likelihood of a linear-chain CRF
+    (ref linear_chain_crf_op.h ForwardOneSequence)."""
+    x, w, lens, _ = _crf_inputs(ins)
+    b, t, d = x.shape
+    label = ins["Label"][0].astype(jnp.int32).reshape(b, t)
+    start_w, end_w, trans = w[0], w[1], w[2:]
+
+    # ---- log partition via batched forward scan
+    a0 = start_w[None, :] + x[:, 0, :]                       # (B, D)
+
+    def fwd(carry, k):
+        a = carry
+        nxt = jax.nn.logsumexp(
+            a[:, :, None] + trans[None, :, :], axis=1
+        ) + x[:, k, :]
+        a = jnp.where((k < lens)[:, None], nxt, a)
+        return a, a
+
+    a_last, a_hist = lax.scan(fwd, a0, jnp.arange(1, t))
+    log_z = jax.nn.logsumexp(a_last + end_w[None, :], axis=-1)   # (B,)
+
+    # ---- gold path score
+    pos = jnp.arange(t)[None, :]
+    valid = pos < lens[:, None]
+    emit = jnp.take_along_axis(x, label[:, :, None], axis=2)[:, :, 0]
+    emit_sum = jnp.sum(jnp.where(valid, emit, 0.0), axis=1)
+    trans_sc = trans[label[:, :-1], label[:, 1:]]                # (B, T-1)
+    trans_sum = jnp.sum(
+        jnp.where(valid[:, 1:], trans_sc, 0.0), axis=1
+    )
+    last_idx = jnp.maximum(lens - 1, 0)
+    last_lab = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    gold = (
+        start_w[label[:, 0]] + emit_sum + trans_sum + end_w[last_lab]
+    )
+    nll = jnp.where(lens > 0, log_z - gold, 0.0)
+
+    alpha = jnp.concatenate([a0[:, None, :], a_hist.transpose(1, 0, 2)],
+                            axis=1)
+    return {
+        "LogLikelihood": [nll[:, None]],
+        "Alpha": [alpha],
+        "EmissionExps": [jnp.exp(x - jnp.max(x, -1, keepdims=True))],
+        "TransitionExps": [jnp.exp(w)],
+    }
+
+
+@register_op("crf_decoding")
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (ref crf_decoding_op.h Decode). Output: (B, T) int64
+    best path, zero past each length; with a Label input the output is a
+    per-token correctness indicator instead (ref behavior)."""
+    x, w, lens, squeeze = _crf_inputs(ins)
+    b, t, d = x.shape
+    start_w, end_w, trans = w[0], w[1], w[2:]
+
+    a0 = start_w[None, :] + x[:, 0, :]
+
+    def fwd(carry, k):
+        a = carry
+        scores = a[:, :, None] + trans[None, :, :]       # (B, Dprev, D)
+        best = jnp.max(scores, axis=1) + x[:, k, :]
+        track = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        live = (k < lens)[:, None]
+        a = jnp.where(live, best, a)
+        track = jnp.where(live, track, jnp.arange(d)[None, :])
+        return a, track
+
+    a_last, tracks = lax.scan(fwd, a0, jnp.arange(1, t))  # tracks (T-1,B,D)
+    last_tag = jnp.argmax(a_last + end_w[None, :], axis=-1).astype(jnp.int32)
+
+    def back(carry, track_k):
+        tag = carry
+        prev = jnp.take_along_axis(track_k, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first, path_rev = lax.scan(back, last_tag, tracks, reverse=True)
+    # ys[i] is the carry before consuming tracks[i] = the tag at position
+    # i+1; the final carry is the tag at position 0. Steps past each
+    # sequence's length used identity tracks, so the walk passes through
+    # them unchanged and the sub-length positions decode correctly.
+    path = jnp.concatenate([first[:, None], path_rev.transpose(1, 0)],
+                           axis=1)
+    pos = jnp.arange(t)[None, :]
+    valid = pos < lens[:, None]
+    path = jnp.where(valid, path, 0).astype(jnp.int64)
+    if ins.get("Label"):
+        label = ins["Label"][0].astype(jnp.int64).reshape(b, t)
+        path = jnp.where(valid, (label == path).astype(jnp.int64), 0)
+    out = path[0] if squeeze else path
+    return {"ViterbiPath": [out]}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+_SCHEMES = {
+    # scheme -> (num_tag_types, begin, inside, end, single) tag roles
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_tables(scheme, num_chunk_types):
+    """Dense (L, L) begin/end tables over (prev_label, label) pairs,
+    mirroring the reference's ChunkBegin/ChunkEnd predicates. L includes
+    the 'other' (O) label = num_chunk_types * num_tag_types."""
+    ntag, t_beg, t_in, t_end, t_sin = _SCHEMES[scheme]
+    other = num_chunk_types
+    n_labels = num_chunk_types * ntag + 1
+
+    def tag_type(lab):
+        return lab % ntag, lab // ntag
+
+    def chunk_begin(prev, cur):
+        ptag, ptype = tag_type(prev)
+        tag, typ = tag_type(cur)
+        if ptype == other:
+            return typ != other
+        if typ == other:
+            return False
+        if typ != ptype:
+            return True
+        if tag == t_beg:
+            return True
+        if tag == t_in:
+            return ptag in (t_end, t_sin)
+        if tag == t_end:
+            return ptag in (t_end, t_sin)
+        if tag == t_sin:
+            return True
+        return False
+
+    def chunk_end(prev, cur):
+        ptag, ptype = tag_type(prev)
+        tag, typ = tag_type(cur)
+        if ptype == other:
+            return False
+        if typ == other:
+            return True
+        if typ != ptype:
+            return True
+        if ptag == t_beg or ptag == t_in:
+            return tag in (t_beg, t_sin)
+        if ptag in (t_end, t_sin):
+            return True
+        return False
+
+    beg = np.zeros((n_labels, n_labels), np.bool_)
+    end = np.zeros((n_labels, n_labels), np.bool_)
+    for p in range(n_labels):
+        for c in range(n_labels):
+            beg[p, c] = chunk_begin(p, c)
+            end[p, c] = chunk_end(p, c)
+    return beg, end, other, ntag, n_labels
+
+
+def _chunk_masks(labels, lens, beg_t, end_t, other, ntag, n_labels):
+    """Per-position begin/end booleans + chunk type, vectorised over
+    (B, T) via the lookup tables. Out-of-range labels are clamped to O."""
+    b, t = labels.shape
+    lab = jnp.clip(labels, 0, n_labels - 1)
+    pos = jnp.arange(t)[None, :]
+    valid = pos < lens[:, None]
+    lab = jnp.where(valid, lab, n_labels - 1)          # pads act as O
+    o_col = jnp.full((b, 1), n_labels - 1, lab.dtype)
+    prev = jnp.concatenate([o_col, lab[:, :-1]], axis=1)
+    nxt = jnp.concatenate([lab[:, 1:], o_col], axis=1)
+    beg = jnp.asarray(beg_t)[prev, lab] & valid
+    end = jnp.asarray(end_t)[lab, nxt] & valid
+    typ = lab // ntag
+    return beg, end, typ, valid
+
+
+@register_op("chunk_eval")
+def _chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 (ref chunk_eval_op.h). Inference and
+    Label: (B, T) int labels, padded; SeqLength: (B,) int."""
+    inf = ins["Inference"][0].astype(jnp.int32)
+    lab = ins["Label"][0].astype(jnp.int32)
+    if inf.ndim == 3:
+        inf = inf[:, :, 0]
+    if lab.ndim == 3:
+        lab = lab[:, :, 0]
+    if inf.ndim == 1:
+        inf, lab = inf[None], lab[None]
+    b, t = inf.shape
+    if ins.get("SeqLength"):
+        lens = ins["SeqLength"][0].astype(jnp.int32).reshape(-1)
+    else:
+        lens = jnp.full((b,), t, jnp.int32)
+
+    scheme = attrs.get("chunk_scheme", "IOB")
+    nct = int(attrs["num_chunk_types"])
+    excluded = list(attrs.get("excluded_chunk_types") or [])
+    beg_t, end_t, other, ntag, n_labels = _chunk_tables(scheme, nct)
+
+    ib, ie, ityp, valid = _chunk_masks(
+        inf, lens, beg_t, end_t, other, ntag, n_labels
+    )
+    lb, le, ltyp, _ = _chunk_masks(
+        lab, lens, beg_t, end_t, other, ntag, n_labels
+    )
+    include_i = jnp.ones_like(ityp, jnp.bool_)
+    include_l = jnp.ones_like(ltyp, jnp.bool_)
+    for e in excluded:
+        include_i &= ityp != e
+        include_l &= ltyp != e
+
+    n_infer = jnp.sum((ib & include_i).astype(jnp.int64))
+    n_label = jnp.sum((lb & include_l).astype(jnp.int64))
+
+    # matching scan: a candidate match is alive from a shared begin (same
+    # type, not excluded) until any end; counted when both end together
+    def match(carry, k):
+        alive, cnt = carry
+        start = lb[:, k] & ib[:, k] & (ltyp[:, k] == ityp[:, k]) \
+            & include_l[:, k]
+        alive = start | (alive & ~lb[:, k] & ~ib[:, k])
+        both_end = le[:, k] & ie[:, k]
+        cnt = cnt + (alive & both_end).astype(jnp.int64)
+        alive = alive & ~le[:, k] & ~ie[:, k]
+        return (alive, cnt), None
+
+    (_, cnt), _ = lax.scan(
+        match,
+        (jnp.zeros((b,), jnp.bool_), jnp.zeros((b,), jnp.int64)),
+        jnp.arange(t),
+    )
+    n_correct = jnp.sum(cnt)
+
+    prec = jnp.where(
+        n_infer > 0, n_correct / jnp.maximum(n_infer, 1), 0.0
+    ).astype(jnp.float32)
+    rec = jnp.where(
+        n_label > 0, n_correct / jnp.maximum(n_label, 1), 0.0
+    ).astype(jnp.float32)
+    f1 = jnp.where(
+        n_correct > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0
+    ).astype(jnp.float32)
+    return {
+        "Precision": [prec[None]],
+        "Recall": [rec[None]],
+        "F1-Score": [f1[None]],
+        "NumInferChunks": [n_infer[None]],
+        "NumLabelChunks": [n_label[None]],
+        "NumCorrectChunks": [n_correct[None]],
+    }
+
+
+@register_op("ctc_greedy_decoder")
+def _ctc_greedy_decoder(ctx, ins, attrs):
+    """Greedy CTC decode (ref ctc_align_op / layers ctc_greedy_decoder):
+    argmax per frame, merge repeats, drop blanks. Padded mode: Input
+    (B, T, C) + optional InputLength; outputs (B, T) tokens padded with
+    `padding_value` and OutLength (B, 1)."""
+    x = ins["Input"][0]
+    blank = int(attrs["blank"])
+    pad_val = attrs.get("padding_value", 0)
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[None]
+    b, t, c = x.shape
+    if ins.get("InputLength"):
+        lens = ins["InputLength"][0].astype(jnp.int32).reshape(-1)
+    else:
+        lens = jnp.full((b,), t, jnp.int32)
+    tok = jnp.argmax(x, axis=-1).astype(jnp.int32)         # (B, T)
+    pos = jnp.arange(t)[None, :]
+    valid = pos < lens[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((b, 1), -1, jnp.int32), tok[:, :-1]], axis=1
+    )
+    keep = valid & (tok != blank) & (tok != prev)
+    # stable left-compaction: sort positions by (dropped, position)
+    key = jnp.where(keep, pos, t + pos)
+    order = jnp.argsort(key, axis=1)
+    gathered = jnp.take_along_axis(tok, order, axis=1)
+    n_keep = jnp.sum(keep.astype(jnp.int32), axis=1)
+    out = jnp.where(
+        pos < n_keep[:, None], gathered, jnp.asarray(pad_val, jnp.int32)
+    ).astype(jnp.int64)
+    if squeeze:
+        return {"Out": [out[0]], "OutLength": [n_keep[:, None]]}
+    return {"Out": [out], "OutLength": [n_keep[:, None]]}

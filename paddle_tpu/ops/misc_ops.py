@@ -196,12 +196,12 @@ def _warpctc(ctx, ins, attrs):
     T = logits.shape[1]
     L = label.shape[1]
     logits_len = (
-        ins["LogitsLength"][0].astype(jnp.int32)
+        ins["LogitsLength"][0].astype(jnp.int32).reshape(-1)
         if ins.get("LogitsLength")
         else jnp.full((B,), T, jnp.int32)
     )
     label_len = (
-        ins["LabelLength"][0].astype(jnp.int32)
+        ins["LabelLength"][0].astype(jnp.int32).reshape(-1)
         if ins.get("LabelLength")
         else jnp.sum((label != blank).astype(jnp.int32), axis=1)
     )
