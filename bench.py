@@ -235,7 +235,7 @@ def main():
             _STATE["detail"]["errors"].append(
                 "init attempt %d failed: %s" % (attempt, str(e)[:200])
             )
-            if _elapsed() > DEADLINE_S * 0.55:
+            if _elapsed() > DEADLINE_S * 0.8:
                 raise
             try:
                 jax.extend.backend.clear_backends()
