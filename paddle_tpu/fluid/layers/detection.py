@@ -11,7 +11,11 @@ from ..param_attr import ParamAttr
 __all__ = [
     "box_coder", "iou_similarity", "prior_box", "yolo_box", "yolov3_loss",
     "multiclass_nms", "bipartite_match", "ssd_loss", "density_prior_box",
-    "box_clip", "detection_output",
+    "box_clip", "detection_output", "anchor_generator", "sigmoid_focal_loss",
+    "rpn_target_assign", "retinanet_target_assign", "generate_proposals",
+    "target_assign", "detection_map", "polygon_box_transform",
+    "box_decoder_and_assign", "multi_box_head", "retinanet_detection_output",
+    "distribute_fpn_proposals", "collect_fpn_proposals",
 ]
 
 
@@ -55,6 +59,12 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
     helper = LayerHelper("prior_box", **locals())
     box = helper.create_variable_for_type_inference(input.dtype)
     var = helper.create_variable_for_type_inference(input.dtype)
+    if input.shape is not None and len(input.shape) == 4:
+        ars = list(aspect_ratios)
+        n_ar = len(ars) + sum(1 for r in ars if flip and abs(r - 1.0) > 1e-6)
+        np_per_cell = len(min_sizes) * n_ar + len(max_sizes or [])
+        box.shape = (input.shape[2], input.shape[3], np_per_cell, 4)
+        var.shape = box.shape
     helper.append_op(
         type="prior_box",
         inputs={"Input": [input], "Image": [image]},
@@ -181,6 +191,398 @@ def detection_output(loc, scores, prior_box, prior_box_var,
         decoded, scores, score_threshold, nms_top_k, keep_top_k,
         nms_threshold, background_label=background_label,
     )
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    """Faster-RCNN anchors (ref detection.py:2259): (H, W, A, 4) absolute
+    xyxy anchors + broadcast variances; A = len(sizes) * len(ratios),
+    aspect_ratios loop outer."""
+    if not isinstance(anchor_sizes, (list, tuple)):
+        anchor_sizes = [anchor_sizes]
+    if not isinstance(aspect_ratios, (list, tuple)):
+        aspect_ratios = [aspect_ratios]
+    if not (isinstance(stride, (list, tuple)) and len(stride) == 2):
+        raise ValueError(
+            "anchor_generator: stride must be a 2-list (stride_w, stride_h)"
+        )
+    helper = LayerHelper("anchor_generator", **locals())
+    anchor = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    na = len(anchor_sizes) * len(aspect_ratios)
+    if input.shape is not None and len(input.shape) == 4:
+        anchor.shape = (input.shape[2], input.shape[3], na, 4)
+        var.shape = anchor.shape
+    helper.append_op(
+        type="anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchor], "Variances": [var]},
+        attrs={
+            "anchor_sizes": list(map(float, anchor_sizes)),
+            "aspect_ratios": list(map(float, aspect_ratios)),
+            "variances": list(variance),
+            "stride": list(map(float, stride)),
+            "offset": offset,
+        },
+    )
+    anchor.stop_gradient = True
+    var.stop_gradient = True
+    return anchor, var
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2, alpha=0.25):
+    """Focal loss for RetinaNet (ref detection.py:436): elementwise
+    (R, C) loss; label is the 1-indexed class per row (0 bg, -1 ignore),
+    normalized by fg_num."""
+    helper = LayerHelper("sigmoid_focal_loss", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(
+        type="sigmoid_focal_loss",
+        inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+        outputs={"Out": [out]},
+        attrs={"gamma": float(gamma), "alpha": float(alpha)},
+    )
+    return out
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN target assign (ref detection.py:289), TPU-native dense form:
+    instead of the reference's gathered LoD subsets this returns the FULL
+    per-anchor tensors —
+      (score_pred (N,M,1), loc_pred (N,M,4), score_target (N,M) in
+       {1,0,-1}, loc_target (N,M,4), bbox_inside_weight (N,M,4))
+    — apply score_target >= 0 as the cls-loss mask and the inside weight
+    on the reg loss. gt_boxes is the zero-padded (N, G, 4) dense batch.
+    Sampling is deterministic (the reference's use_random=False rule)."""
+    helper = LayerHelper("rpn_target_assign", **locals())
+    score_t = helper.create_variable_for_type_inference("int32")
+    loc_t = helper.create_variable_for_type_inference(gt_boxes.dtype)
+    w = helper.create_variable_for_type_inference(gt_boxes.dtype)
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+           "IsCrowd": [is_crowd], "ImInfo": [im_info]}
+    if anchor_var is not None:
+        ins["AnchorVar"] = [anchor_var]
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs=ins,
+        outputs={"ScoreTarget": [score_t], "LocationTarget": [loc_t],
+                 "BBoxInsideWeight": [w]},
+        attrs={
+            "rpn_batch_size_per_im": rpn_batch_size_per_im,
+            "rpn_straddle_thresh": rpn_straddle_thresh,
+            "rpn_fg_fraction": rpn_fg_fraction,
+            "rpn_positive_overlap": rpn_positive_overlap,
+            "rpn_negative_overlap": rpn_negative_overlap,
+        },
+    )
+    for v in (score_t, loc_t, w):
+        v.stop_gradient = True
+    return cls_logits, bbox_pred, score_t, loc_t, w
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet target assign (ref detection.py:65), dense form (see
+    rpn_target_assign): returns (score_pred, loc_pred, score_target with
+    1-indexed class labels / 0 bg / -1 ignore, loc_target,
+    bbox_inside_weight, fg_num (N,1))."""
+    helper = LayerHelper("retinanet_target_assign", **locals())
+    score_t = helper.create_variable_for_type_inference("int32")
+    loc_t = helper.create_variable_for_type_inference(gt_boxes.dtype)
+    w = helper.create_variable_for_type_inference(gt_boxes.dtype)
+    fg_num = helper.create_variable_for_type_inference("int32")
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+           "GtLabels": [gt_labels], "IsCrowd": [is_crowd],
+           "ImInfo": [im_info]}
+    if anchor_var is not None:
+        ins["AnchorVar"] = [anchor_var]
+    helper.append_op(
+        type="retinanet_target_assign",
+        inputs=ins,
+        outputs={"ScoreTarget": [score_t], "LocationTarget": [loc_t],
+                 "BBoxInsideWeight": [w], "ForegroundNumber": [fg_num]},
+        attrs={
+            "positive_overlap": positive_overlap,
+            "negative_overlap": negative_overlap,
+        },
+    )
+    for v in (score_t, loc_t, w, fg_num):
+        v.stop_gradient = True
+    return cls_logits, bbox_pred, score_t, loc_t, w, fg_num
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposals (ref detection.py:2713). Static-shape output: exactly
+    (N, post_nms_top_n, 4) rois + (N, post_nms_top_n, 1) probs, zero-padded
+    (the reference emits variable-length LoD)."""
+    helper = LayerHelper("generate_proposals", **locals())
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    probs = helper.create_variable_for_type_inference(scores.dtype)
+    if scores.shape is not None:
+        rois.shape = (scores.shape[0], post_nms_top_n, 4)
+        probs.shape = (scores.shape[0], post_nms_top_n, 1)
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs]},
+        attrs={
+            "pre_nms_topN": pre_nms_top_n,
+            "post_nms_topN": post_nms_top_n,
+            "nms_thresh": nms_thresh,
+            "min_size": min_size,
+            "eta": eta,
+        },
+    )
+    return rois, probs
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """Dense target assign (ref detection.py:1286): input is the padded
+    per-image gt tensor (N, G, K) (LoD rows -> batch dim); negative_indices
+    is a dense (N, P) mask tensor (entries >= 0 mark negative slots)."""
+    helper = LayerHelper("target_assign", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference("float32")
+    ins = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices]
+    helper.append_op(
+        type="target_assign",
+        inputs=ins,
+        outputs={"Out": [out], "OutWeight": [w]},
+        attrs={"mismatch_value": mismatch_value or 0.0},
+    )
+    return out, w
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """Batch mAP (ref detection.py:1105): detect_res is the padded
+    (N, D, 6) NMS output, label the padded (N, G, 5|6) gt. Cross-batch
+    state accumulation (input_states) is not carried through the graph —
+    use fluid.metrics.DetectionMAP for streaming evaluation."""
+    if input_states is not None or out_states is not None:
+        raise NotImplementedError(
+            "detection_map: streaming states are host-side on TPU; "
+            "accumulate with fluid.metrics.DetectionMAP instead"
+        )
+    helper = LayerHelper("detection_map", **locals())
+    out = helper.create_variable_for_type_inference("float32")
+    out.shape = ()
+    helper.append_op(
+        type="detection_map",
+        inputs={"DetectRes": [detect_res], "Label": [label]},
+        outputs={"MAP": [out]},
+        attrs={
+            "class_num": class_num,
+            "background_label": background_label,
+            "overlap_threshold": overlap_threshold,
+            "evaluate_difficult": evaluate_difficult,
+            "ap_type": ap_version,
+        },
+    )
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    """EAST geometry offsets -> absolute quad coords (ref detection.py:858)."""
+    helper = LayerHelper("polygon_box_transform", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type="polygon_box_transform",
+        inputs={"Input": [input]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    """Cascade-RCNN per-class decode + argmax assign (ref detection.py:3358)."""
+    helper = LayerHelper("box_decoder_and_assign", **locals())
+    decoded = helper.create_variable_for_type_inference(prior_box.dtype)
+    assigned = helper.create_variable_for_type_inference(prior_box.dtype)
+    helper.append_op(
+        type="box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+        outputs={"DecodeBox": [decoded], "OutputAssignBox": [assigned]},
+        attrs={"box_clip": box_clip},
+    )
+    return decoded, assigned
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet decode + NMS (ref detection.py:2877): bboxes/scores/anchors
+    are per-FPN-level lists. Static-shape output (N, keep_top_k, 6), rows
+    [label, score, x1, y1, x2, y2], padded with label=-1."""
+    helper = LayerHelper("retinanet_detection_output", **locals())
+    out = helper.create_variable_for_type_inference(bboxes[0].dtype)
+    if bboxes[0].shape is not None:
+        out.shape = (bboxes[0].shape[0], keep_top_k, 6)
+    helper.append_op(
+        type="retinanet_detection_output",
+        inputs={"BBoxes": list(bboxes), "Scores": list(scores),
+                "Anchors": list(anchors), "ImInfo": [im_info]},
+        outputs={"Out": [out]},
+        attrs={
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "nms_eta": nms_eta,
+        },
+    )
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multi-box head (ref detection.py:1970): per feature map, a conv
+    for locations (A*4 ch) and confidences (A*C ch) + prior boxes; outputs
+    concatenated (N, total_priors, 4) locs, (N, total_priors, C) confs,
+    (total_priors, 4) boxes and variances."""
+    from . import nn, tensor
+
+    n_in = len(inputs)
+    if min_sizes is None:
+        # evenly spread ratios between min_ratio and max_ratio (percent)
+        min_sizes, max_sizes = [], []
+        if n_in > 2:
+            step = int(np.floor((max_ratio - min_ratio) / (n_in - 2)))
+        else:
+            step = 0
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+        min_sizes = min_sizes[:n_in]
+        max_sizes = max_sizes[:n_in]
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        mx = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        if not isinstance(ar, (list, tuple)):
+            ar = [ar]
+        if steps is not None:
+            st = steps[i] if isinstance(steps[i], (list, tuple)) \
+                else [steps[i], steps[i]]
+        elif step_w is not None:
+            st = [step_w[i], step_h[i]]
+        else:
+            st = [0.0, 0.0]
+        box, var = prior_box(
+            feat, image, [ms] if not isinstance(ms, (list, tuple)) else ms,
+            [mx] if mx and not isinstance(mx, (list, tuple)) else mx,
+            ar, variance, flip, clip, st, offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order,
+        )
+        num_priors = 0
+        n_ar = len(ar) + sum(
+            1 for r in ar if flip and abs(r - 1.0) > 1e-6
+        )
+        num_priors = n_ar + (1 if mx else 0)
+        loc = nn.conv2d(feat, num_priors * 4, kernel_size, stride=stride,
+                        padding=pad)
+        conf = nn.conv2d(feat, num_priors * num_classes, kernel_size,
+                         stride=stride, padding=pad)
+        # NCHW -> NHWC -> (N, priors_on_map, K)
+        loc = nn.transpose(loc, [0, 2, 3, 1])
+        conf = nn.transpose(conf, [0, 2, 3, 1])
+        locs.append(nn.reshape(loc, [0, -1, 4]))
+        confs.append(nn.reshape(conf, [0, -1, num_classes]))
+        boxes_l.append(nn.reshape(box, [-1, 4]))
+        vars_l.append(nn.reshape(var, [-1, 4]))
+
+    mbox_locs = tensor.concat(locs, axis=1)
+    mbox_confs = tensor.concat(confs, axis=1)
+    boxes = tensor.concat(boxes_l, axis=0)
+    variances = tensor.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """FPN level routing (ref detection.py:3274), static form: every output
+    level keeps the full (R, 4) shape with non-member rows zeroed (the
+    reference emits variable-length LoD splits); restore_ind maps the
+    concat-by-level order back to the input order."""
+    from . import nn, tensor
+    from . import ops as act_ops
+
+    num_level = max_level - min_level + 1
+    w = nn.elementwise_sub(
+        nn.slice(fpn_rois, [1], [2], [3]), nn.slice(fpn_rois, [1], [0], [1])
+    )
+    h = nn.elementwise_sub(
+        nn.slice(fpn_rois, [1], [3], [4]), nn.slice(fpn_rois, [1], [1], [2])
+    )
+    scale = act_ops.sqrt(nn.elementwise_mul(w, h))
+    # level = floor(refer_level + log2(scale / refer_scale))
+    log2_ratio = nn.elementwise_div(
+        nn.log(nn.elementwise_max(
+            nn.scale(scale, scale=1.0 / refer_scale),
+            tensor.fill_constant([1], "float32", 1e-6),
+        )),
+        tensor.fill_constant([1], "float32", float(np.log(2.0))),
+    )
+    lvl = act_ops.floor(
+        nn.scale(log2_ratio, scale=1.0, bias=float(refer_level))
+    )
+    lvl = nn.clip(lvl, float(min_level), float(max_level))
+    from .control_flow import equal
+
+    outs = []
+    for i in range(num_level):
+        mask = tensor.cast(
+            equal(lvl, tensor.fill_constant([1], "float32",
+                                            float(min_level + i))),
+            "float32",
+        )
+        outs.append(nn.elementwise_mul(fpn_rois, mask))
+    restore_ind = tensor.cast(lvl, "int32")
+    return outs, restore_ind
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    """FPN proposal collection (ref detection.py:3423): concat per-level
+    rois/scores and keep the global top post_nms_top_n by score (static
+    (post_nms_top_n, 4) output)."""
+    from . import nn, tensor
+
+    num_level = max_level - min_level + 1
+    rois = tensor.concat(multi_rois[:num_level], axis=0)
+    scores = tensor.concat(multi_scores[:num_level], axis=0)
+    flat = nn.reshape(scores, [-1])
+    _, idx = nn.topk(flat, post_nms_top_n)
+    return nn.gather(rois, idx)
 
 
 def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,

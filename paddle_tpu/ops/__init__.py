@@ -20,6 +20,7 @@ from . import control_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import pallas_attention  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import rcnn_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
 
