@@ -170,3 +170,9 @@ def np_dtype(dtype_str):
 
 def globals_flags():
     return dict(os.environ)
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when an attached py_reader is exhausted
+    (ref: paddle/fluid/framework/reader.h EOFException) — catch it to end
+    the epoch, then reader.reset()."""

@@ -167,6 +167,18 @@ class Executor:
         if self._closed:
             raise RuntimeError("Executor is closed")
         program = program if program is not None else default_main_program()
+        if not feed:
+            # a started py_reader attached to the program supplies the
+            # batch (ref: reader ops pulling from the C++ blocking queue);
+            # raises core.EOFException at end of epoch. Checked BEFORE the
+            # CompiledProgram/pipeline dispatch so every execution path
+            # auto-feeds. CompiledProgram wraps the underlying Program.
+            src = getattr(program, "_program", program)
+            for reader in getattr(src, "_py_readers", []):
+                batch = reader._next_feed()
+                if batch is not None:
+                    feed = dict(batch)
+                    break
         # CompiledProgram (data-parallel) delegates to its own runner
         if hasattr(program, "_executor_run"):
             return program._executor_run(

@@ -585,6 +585,10 @@ class Program:
         p.current_block_idx = 0
         p._loss_name = None if for_test else self._loss_name
         p._lr_schedulers = list(self._lr_schedulers)
+        # attached py_readers keep feeding clones (the reference's reader
+        # ops live in the graph and survive clone; ours are program state)
+        if getattr(self, "_py_readers", None):
+            p._py_readers = list(self._py_readers)
         if for_test:
             # drop backward + optimizer ops, then iteratively drop any op
             # whose inputs can no longer be produced (regularizer/clip ops

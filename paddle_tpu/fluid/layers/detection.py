@@ -503,11 +503,13 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
             ar, variance, flip, clip, st, offset,
             min_max_aspect_ratios_order=min_max_aspect_ratios_order,
         )
-        num_priors = 0
         n_ar = len(ar) + sum(
             1 for r in ar if flip and abs(r - 1.0) > 1e-6
         )
-        num_priors = n_ar + (1 if mx else 0)
+        ms_list = ms if isinstance(ms, (list, tuple)) else [ms]
+        mx_list = (mx if isinstance(mx, (list, tuple)) else [mx]) \
+            if mx else []
+        num_priors = len(ms_list) * n_ar + len(mx_list)
         loc = nn.conv2d(feat, num_priors * 4, kernel_size, stride=stride,
                         padding=pad)
         conf = nn.conv2d(feat, num_priors * num_classes, kernel_size,
@@ -531,8 +533,10 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
                              refer_scale, name=None):
     """FPN level routing (ref detection.py:3274), static form: every output
     level keeps the full (R, 4) shape with non-member rows zeroed (the
-    reference emits variable-length LoD splits); restore_ind maps the
-    concat-by-level order back to the input order."""
+    reference emits variable-length LoD splits). restore_ind[i] is the row
+    of input roi i inside concat(outs) — i.e. (level_i - min_level) * R + i
+    — so gather(concat(head_outs), restore_ind) restores input order, as
+    with the reference's restore index."""
     from . import nn, tensor
     from . import ops as act_ops
 
@@ -566,7 +570,27 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
             "float32",
         )
         outs.append(nn.elementwise_mul(fpn_rois, mask))
-    restore_ind = tensor.cast(lvl, "int32")
+    r = fpn_rois.shape[0] if fpn_rois.shape else None
+    if r in (None, -1):
+        raise ValueError(
+            "distribute_fpn_proposals needs a static roi count to build "
+            "the restore index (rois come from the static-shape "
+            "generate_proposals output)"
+        )
+    row_in_batch = tensor.assign(np.arange(r, dtype="float32")[:, None])
+    restore_ind = tensor.cast(
+        nn.elementwise_add(
+            nn.scale(
+                nn.elementwise_sub(
+                    lvl,
+                    tensor.fill_constant([1], "float32", float(min_level)),
+                ),
+                scale=float(r),
+            ),
+            row_in_batch,
+        ),
+        "int32",
+    )
     return outs, restore_ind
 
 
@@ -574,10 +598,19 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
                           post_nms_top_n, name=None):
     """FPN proposal collection (ref detection.py:3423): concat per-level
     rois/scores and keep the global top post_nms_top_n by score (static
-    (post_nms_top_n, 4) output)."""
+    (post_nms_top_n, 4) output). Inputs are per-level (R_i, 4) rois with
+    (R_i, 1) scores; slice the batch dim off generate_proposals outputs
+    first (its rois are (N, P, 4))."""
     from . import nn, tensor
 
     num_level = max_level - min_level + 1
+    for v in list(multi_rois[:num_level]) + list(multi_scores[:num_level]):
+        if v.shape is not None and len(v.shape) > 2:
+            raise ValueError(
+                "collect_fpn_proposals takes per-image (R, 4)/(R, 1) "
+                "levels; got rank-%d %r — slice the batch dim first"
+                % (len(v.shape), v.name)
+            )
     rois = tensor.concat(multi_rois[:num_level], axis=0)
     scores = tensor.concat(multi_scores[:num_level], axis=0)
     flat = nn.reshape(scores, [-1])

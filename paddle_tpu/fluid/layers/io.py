@@ -1,8 +1,17 @@
-"""Data input layers (ref: python/paddle/fluid/layers/io.py data())."""
+"""Data input layers (ref: python/paddle/fluid/layers/io.py).
+
+py_reader / double_buffer rebuild the reference's C++ reader-op pipeline
+(ref io.py:537, :815, operators/reader/) host-side: a background producer
+thread feeds a bounded queue (the native C++ pipeline when built); the
+Executor pops a batch per run() when a started reader is attached to the
+program — same decoupled-producer behavior without graph-embedded reader
+ops, which can't live inside one jitted XLA module."""
 from .. import core
 from ..framework import default_main_program, default_startup_program
+from ..unique_name import generate as _unique_name
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "create_py_reader_by_data",
+           "double_buffer", "read_file", "load"]
 
 
 def data(
@@ -42,3 +51,176 @@ def data(
             is_data=True,
         )
     return main
+
+
+class _ProgramReader:
+    """The object py_reader/create_py_reader_by_data return: owns the data
+    vars, a bounded prefetch queue and the producer thread. While started
+    and attached, `Executor.run(program)` with no feed pops one batch per
+    step and raises core.EOFException at end of epoch."""
+
+    def __init__(self, feed_list, capacity, use_double_buffer=True,
+                 name=None):
+        self._feed_list = list(feed_list)
+        # double buffering = one extra prefetch slot beyond the queue depth
+        self._capacity = capacity + (2 if use_double_buffer else 0)
+        self._name = name or "py_reader"
+        self._paddle_reader = None
+        self._queue = None
+        self._generation = 0   # bumped by reset() so stale pumps abandon
+        self._started = False
+        program = default_main_program()
+        program._py_readers = getattr(program, "_py_readers", [])
+        program._py_readers.append(self)
+
+    # -- decoration (same surface as ref py_reader) ----------------------
+    def decorate_paddle_reader(self, reader, places=None):
+        from ..data_feeder import DataFeeder
+
+        def _feeder():
+            feeder = DataFeeder(self._feed_list, places)
+            for samples in reader():
+                yield feeder.feed(samples)
+
+        self._paddle_reader = _feeder
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader, places=None):
+        import numpy as np
+
+        def _named():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield {
+                        v.name: np.asarray(b)
+                        for v, b in zip(self._feed_list, batch)
+                    }
+
+        self._paddle_reader = _named
+        return self
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        import queue as _queue_mod
+        import threading
+
+        if self._paddle_reader is None:
+            raise RuntimeError(
+                "%s: decorate a reader before start()" % self._name
+            )
+        self._generation += 1
+        gen = self._generation
+        # the queue is BOUND into the pump closure: a later reset()+start()
+        # creates a fresh queue and the stale thread can never write into it
+        q = _queue_mod.Queue(self._capacity)
+        self._queue = q
+        self._started = True
+
+        def _put(item):
+            # bounded put that abandons when this epoch was reset
+            while self._generation == gen:
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue_mod.Full:
+                    continue
+            return False
+
+        def _pump():
+            try:
+                for item in self._paddle_reader():
+                    if not _put(item):
+                        return
+            except BaseException as e:  # surface producer errors, not EOF
+                _put(("__error__", e))
+                return
+            _put(None)
+
+        threading.Thread(target=_pump, daemon=True).start()
+
+    def reset(self):
+        self._generation += 1  # stale pump threads see this and abandon
+        self._started = False
+        self._queue = None
+
+    def _next_feed(self):
+        from .. import core as _core
+
+        if not self._started or self._queue is None:
+            return None
+        item = self._queue.get()
+        if isinstance(item, tuple) and len(item) == 2 and \
+                item[0] == "__error__":
+            self._started = False
+            raise item[1]  # the producer's exception, at the training loop
+        if item is None:
+            self._started = False
+            raise _core.EOFException(
+                "%s exhausted — catch fluid.core.EOFException and call "
+                "reader.reset()" % self._name
+            )
+        return item
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Create feed vars + a prefetching reader (ref layers/io.py:537).
+    Returns the reader object; read_file(reader) yields the data vars."""
+    name = name or _unique_name("py_reader")
+    lod_levels = lod_levels or [0] * len(shapes)
+    feed_vars = []
+    for i, (shape, dtype, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+        feed_vars.append(
+            data(
+                name="%s_slot%d" % (name, i),
+                shape=list(shape),
+                append_batch_size=False,
+                dtype=dtype,
+                lod_level=lod,
+            )
+        )
+    return _ProgramReader(feed_vars, capacity, use_double_buffer, name)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """ref layers/io.py:706 — reader over pre-declared fluid.data vars."""
+    return _ProgramReader(feed_list, capacity, use_double_buffer, name)
+
+
+def double_buffer(reader, place=None, name=None):
+    """ref layers/io.py:815. Prefetch-ahead is already built into every
+    reader's bounded queue; widen it by the double-buffer depth."""
+    if isinstance(reader, _ProgramReader):
+        reader._capacity += 2
+    return reader
+
+
+def read_file(reader):
+    """ref layers/io.py:846 — the data vars this reader feeds."""
+    vs = reader._feed_list
+    return vs[0] if len(vs) == 1 else vs
+
+
+def load(out, file_path, load_as_fp16=None):
+    """ref layers/io.py:884 (load op). Loads a single saved variable's
+    value into `out` in the global scope — host-side at build, since a
+    file read can't live inside the jitted step."""
+    import numpy as np
+
+    from ..executor import global_scope
+
+    arr = np.load(file_path, allow_pickle=False)
+    if hasattr(arr, "files"):  # npz archive: take the sole entry
+        names = list(arr.files)
+        arr = arr[names[0]]
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    global_scope().update(out.name, arr)
+    return out

@@ -15,7 +15,23 @@ from .sequence_lod import _seq_inputs, _alias_seq_len
 __all__ = [
     "dynamic_lstm", "dynamic_gru", "gru_unit", "lstm_unit", "lstm",
     "beam_search", "beam_search_decode", "birnn_is_supported",
+    "gather_tree",
 ]
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref operators/gather_tree_op.cc): ids and
+    parents are (max_time, batch, beam); returns the full predicted
+    sequences re-chained through the parent pointers."""
+    helper = LayerHelper("gather_tree", **locals())
+    out = helper.create_variable_for_type_inference(ids.dtype)
+    out.shape = ids.shape
+    helper.append_op(
+        type="gather_tree",
+        inputs={"Ids": [ids], "Parents": [parents]},
+        outputs={"Out": [out]},
+    )
+    return out
 
 
 def dynamic_lstm(

@@ -6,6 +6,8 @@ fluid.data, fed automatically from LoDTensor feeds). Sequence layers wire
 the companion into the op's SeqLen slot and propagate it to their outputs
 where the sequence structure is preserved.
 """
+import numpy as np
+
 from ..layer_helper import LayerHelper
 from ..framework import Variable, in_dygraph_mode
 
@@ -15,6 +17,7 @@ __all__ = [
     "sequence_expand", "sequence_expand_as", "sequence_pad",
     "sequence_unpad", "sequence_reshape", "sequence_scatter",
     "sequence_enumerate", "sequence_mask", "sequence_reverse",
+    "lod_reset", "lod_append",
 ]
 
 
@@ -282,6 +285,65 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         type="sequence_mask", inputs=ins, outputs={"Y": [out]}, attrs=attrs
     )
     return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Replace x's sequence structure (ref layers/nn.py lod_reset). In the
+    dense-padded rep this swaps the `@SEQ_LEN` companion: from y's when y
+    is a lod-carrying Variable, from y's int values when y is a plain
+    1-D int Variable, or from the target_lod python list (length form,
+    like the reference's recursive_seq_lens). The payload tensor is
+    passed through unchanged — re-bucketing flat tokens into a different
+    padding layout is a host-side reshape in this design."""
+    from . import tensor as tensor_layers
+
+    helper = LayerHelper("lod_reset", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(
+        type="assign", inputs={"X": [x]}, outputs={"Out": [out]}
+    )
+    if isinstance(y, Variable):
+        src_sl = _seq_len_var(y)
+        if src_sl is None:
+            # y IS the lengths vector
+            src_sl = y
+        block = out.block
+        sl_out = block.create_var(
+            name=out.name + "@SEQ_LEN", shape=src_sl.shape,
+            dtype="int32", stop_gradient=True,
+        )
+        helper.append_op(
+            type="cast", inputs={"X": [src_sl]}, outputs={"Out": [sl_out]},
+            attrs={"in_dtype": src_sl.dtype, "out_dtype": "int32"},
+        )
+    elif target_lod is not None:
+        lens = tensor_layers.assign(
+            np.asarray(list(target_lod), dtype="int32")
+        )
+        block = out.block
+        sl_out = block.create_var(
+            name=out.name + "@SEQ_LEN", shape=(len(list(target_lod)),),
+            dtype="int32", stop_gradient=True,
+        )
+        helper.append_op(
+            type="assign", inputs={"X": [lens]}, outputs={"Out": [sl_out]}
+        )
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    return out
+
+
+def lod_append(x, level):
+    """Append a LoD level (ref layers/nn.py lod_append). Only the deepest
+    level is materialized in the dense rep (see fluid/lod.py), so this
+    replaces the companion lengths with `level` — same observable
+    behavior for every sequence op, which only reads the deepest level."""
+    if level is None:
+        raise ValueError("lod_append needs a non-None level")
+    if isinstance(level, (list, tuple)):
+        return lod_reset(x, target_lod=list(level))
+    return lod_reset(x, y=level)
 
 
 def sequence_reverse(x, name=None):

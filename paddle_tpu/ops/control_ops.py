@@ -142,6 +142,86 @@ def _static_rnn(ctx, ins, attrs):
     return {"Out": list(stacked)}
 
 
+@register_op("dynamic_rnn")
+def _dynamic_rnn(ctx, ins, attrs):
+    """DynamicRNN (ref control_flow.py DynamicRNN / C++ rnn_memory_helper):
+    lax.scan over the padded time axis of batch-major (B, T, ...) step
+    inputs. Per-sequence lengths mask the memory carry (finished sequences
+    freeze) and the stacked outputs (padding emits zeros) — equivalent to
+    the reference's batch-shrinking without dynamic shapes."""
+    block = _sub_block(ctx, attrs["sub_block"])
+    mem_names = attrs["mem_names"]
+    mem_updated = attrs["mem_updated"]
+    x_names = attrs["x_names"]
+    out_names = attrs["out_names"]
+    outer_env = dict(ctx.current_env)
+    mems = ins["Mem"]
+    xs = [jnp.moveaxis(x, 1, 0) for x in ins["X"]]   # (T, B, ...)
+    tsteps = xs[0].shape[0]
+    batch = xs[0].shape[1]
+    if ins.get("SeqLen"):
+        seq_len = ins["SeqLen"][0].astype(jnp.int32)
+    else:
+        seq_len = jnp.full((batch,), tsteps, jnp.int32)
+
+    def _mask_to(alive, val):
+        m = alive.astype(val.dtype).reshape(
+            (batch,) + (1,) * (val.ndim - 1)
+        )
+        return m
+
+    def step(carry, inp):
+        t, xt = inp
+        env = dict(outer_env)
+        env.update(zip(mem_names, carry))
+        env.update(zip(x_names, xt))
+        prev_token = ctx._iter_token
+        ctx._iter_token = t
+        try:
+            env = _run_block_ops(ctx, block, env)
+        finally:
+            ctx._iter_token = prev_token
+        alive = t < seq_len                          # (B,)
+        new_carry = tuple(
+            jnp.where(_mask_to(alive, env[n]) > 0, env[n], old)
+            for n, old in zip(mem_updated, carry)
+        )
+        outs = tuple(
+            env[n] * _mask_to(alive, env[n]) for n in out_names
+        )
+        return new_carry, outs
+
+    _, stacked = lax.scan(
+        step, tuple(mems), (jnp.arange(tsteps), tuple(xs))
+    )
+    # (T, B, ...) -> (B, T, ...)
+    return {"Out": [jnp.moveaxis(s, 0, 1) for s in stacked]}
+
+
+@register_op("gather_tree")
+def _gather_tree(ctx, ins, attrs):
+    """Beam-search backtrace (ref operators/gather_tree_op): ids/parents
+    are (T, B, W); walk parent pointers from the last step backwards."""
+    ids = ins["Ids"][0]
+    parents = ins["Parents"][0].astype(jnp.int32)
+    tsteps, batch, beam = ids.shape
+    bidx = jnp.arange(batch)[:, None]
+
+    def step(par, inp):
+        id_t, par_t = inp                            # (B, W) each
+        out_t = id_t[bidx, par]                      # follow current pointer
+        par = par_t[bidx, par]
+        return par, out_t
+
+    init = jnp.tile(jnp.arange(beam)[None, :], (batch, 1))
+    # last step emits its own ids; earlier steps follow the pointer chain
+    par, _ = step(init, (ids[-1], parents[-1]))
+    rev = (jnp.flip(ids[:-1], 0), jnp.flip(parents[:-1], 0))
+    _, rows = lax.scan(step, par, rev)
+    out = jnp.concatenate([jnp.flip(rows, 0), ids[-1:]], axis=0)
+    return {"Out": [out]}
+
+
 @register_op("is_empty")
 def _is_empty(ctx, ins, attrs):
     x = ins["X"][0]

@@ -507,17 +507,10 @@ def _detection_map(ctx, ins, attrs):
     det_valid = det_label >= 0
 
     # plain (not +1) IoU: detection_map matches SSD-style normalized boxes
+    from .detection_ops import _iou_matrix
+
     def iou_plain(a, b):
-        area_a = jnp.maximum(a[2] - a[0], 0) * jnp.maximum(a[3] - a[1], 0)
-        area_b = (
-            jnp.maximum(b[:, 2] - b[:, 0], 0)
-            * jnp.maximum(b[:, 3] - b[:, 1], 0)
-        )
-        lt = jnp.maximum(a[:2], b[:, :2])
-        rb = jnp.minimum(a[2:], b[:, 2:])
-        wh = jnp.maximum(rb - lt, 0.0)
-        inter = wh[:, 0] * wh[:, 1]
-        return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+        return _iou_matrix(a[None], b)[0]
 
     aps = []
     has_gt = []

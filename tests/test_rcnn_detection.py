@@ -414,7 +414,9 @@ def test_fpn_distribute_and_collect():
     np.testing.assert_allclose(o3[1], rois_np[1])
     np.testing.assert_allclose(o4[2], rois_np[2])
     np.testing.assert_allclose(o4[3], rois_np[3])
-    assert list(ridx[:, 0]) == [2, 3, 4, 4]
+    # restore_ind: gather(concat(outs), restore_ind) == input order
+    concat = np.concatenate([o2, o3, o4], axis=0)
+    np.testing.assert_allclose(concat[ridx[:, 0]], rois_np)
     # collect keeps the 2 highest-scoring rois
     np.testing.assert_allclose(col[0], rois_np[1])
     np.testing.assert_allclose(col[1], rois_np[3])
