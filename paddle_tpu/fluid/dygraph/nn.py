@@ -13,7 +13,8 @@ from .tracer import VarBase, call_op
 __all__ = [
     "Conv2D", "Conv3D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
     "GRUUnit", "LayerNorm", "NCE", "PRelu", "BilinearTensorProduct",
-    "Conv2DTranspose", "GroupNorm", "SpectralNorm", "Dropout",
+    "Conv2DTranspose", "Conv3DTranspose", "SequenceConv", "RowConv",
+    "GroupNorm", "SpectralNorm", "Dropout",
 ]
 
 
@@ -167,6 +168,156 @@ class Conv2DTranspose(Layer):
             out = call_op(
                 "elementwise_add", {"X": [out], "Y": [self.bias]}, {"axis": 1}
             )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class Conv3DTranspose(Layer):
+    """ref dygraph/nn.py:491 Conv3DTranspose → conv3d_transpose lowering."""
+
+    def __init__(self, name_scope, num_filters, filter_size, output_size=None,
+                 padding=0, stride=1, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = _pair(filter_size, 3)
+        self._output_size = (
+            _pair(output_size, 3) if output_size is not None else None
+        )
+        self._padding = _pair(padding, 3)
+        self._stride = _pair(stride, 3)
+        self._dilation = _pair(dilation, 3)
+        self._groups = groups or 1
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input):
+        if self.weight is None:
+            channels = input.shape[1]
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[channels, self._num_filters // self._groups]
+                + self._filter_size,
+                dtype=self._dtype,
+            )
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr,
+                    shape=[self._num_filters],
+                    dtype=self._dtype,
+                    is_bias=True,
+                )
+        from ..layers.nn import _resolve_output_padding
+
+        out_padding = _resolve_output_padding(
+            self._output_size, self._filter_size, input.shape[2:5],
+            self._padding, self._stride, self._dilation, 3, _pair,
+            lambda i, k, p, s, d: (i - 1) * s - 2 * p + d * (k - 1) + 1,
+        )
+        out = call_op(
+            "conv3d_transpose",
+            {"Input": [input], "Filter": [self.weight]},
+            {
+                "strides": self._stride,
+                "paddings": self._padding,
+                "dilations": self._dilation,
+                "groups": self._groups,
+                "output_padding": out_padding,
+            },
+            out_slots=("Output",),
+        )
+        if self.bias is not None:
+            out = call_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]}, {"axis": 1}
+            )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class SequenceConv(Layer):
+    """ref dygraph/nn.py:2591 SequenceConv. Input is the dense-padded
+    (B, T, D) sequence batch; optional seq_len vector masks the padding."""
+
+    def __init__(self, name_scope, num_filters, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if filter_stride != 1:
+            # reference restriction (sequence_lod.py:106)
+            raise ValueError("SequenceConv only supports filter_stride=1")
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._filter_stride = filter_stride
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input, seq_len=None):
+        if self.weight is None:
+            d = input.shape[-1]
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[self._filter_size * d, self._num_filters],
+                dtype=self._dtype,
+            )
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr,
+                    shape=[self._num_filters],
+                    dtype=self._dtype,
+                    is_bias=True,
+                )
+        ins = {"X": [input], "Filter": [self.weight]}
+        if seq_len is not None:
+            ins["SeqLen"] = [seq_len]
+        out = call_op(
+            "sequence_conv",
+            ins,
+            {
+                "contextStride": self._filter_stride,
+                "contextStart": -(self._filter_size // 2),
+                "contextLength": self._filter_size,
+            },
+        )
+        if self.bias is not None:
+            out = call_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]},
+                {"axis": 2},
+            )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class RowConv(Layer):
+    """ref dygraph/nn.py:2685 RowConv (lookahead conv over time)."""
+
+    def __init__(self, name_scope, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._future_context_size = future_context_size
+        self._param_attr = param_attr
+        self._act = act
+        self.weight = None
+
+    def forward(self, input):
+        if self.weight is None:
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[self._future_context_size + 1, input.shape[-1]],
+                dtype=self._dtype,
+            )
+        out = call_op(
+            "row_conv", {"X": [input], "Filter": [self.weight]}, {}
+        )
         if self._act:
             out = call_op(self._act, {"X": [out]})
         return out

@@ -3,7 +3,8 @@ from ..layer_helper import LayerHelper
 from .nn import _layer, reshape, reduce_sum, reduce_mean, transpose, matmul
 
 __all__ = [
-    "center_loss", "bpr_loss", "cross_entropy", "square_error_cost",
+    "center_loss", "bpr_loss", "cross_entropy", "cross_entropy2",
+    "square_error_cost", "edit_distance",
     "warpctc", "nce", "hsigmoid", "sampled_softmax_with_cross_entropy",
     "softmax_with_cross_entropy", "rank_loss", "margin_rank_loss",
     "sigmoid_cross_entropy_with_logits", "teacher_student_sigmoid_loss",
@@ -261,6 +262,60 @@ def hsigmoid(
         attrs={"num_classes": num_classes},
     )
     return cost
+
+
+def cross_entropy2(input, label, ignore_index=-100):
+    """Hard-label cross entropy over probabilities (ref loss.py:253
+    cross_entropy2 op): -log(input[label]), 0 where label == ignore_index."""
+    helper = LayerHelper("cross_entropy2", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, True)
+    match_x = helper.create_variable_for_type_inference(input.dtype, True)
+    if input.shape is not None:
+        out.shape = tuple(input.shape[:-1]) + (1,)
+    helper.append_op(
+        type="cross_entropy2",
+        inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out], "MatchX": [match_x], "XShape": [xshape]},
+        attrs={"ignore_index": ignore_index},
+    )
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance (ref loss.py:340). Sequences travel dense
+    padded (B, T) with explicit length vectors (the LoD companion is used
+    when lengths aren't passed). Returns (distance (B, 1), sequence_num)."""
+    from .sequence_lod import _seq_len_var
+
+    helper = LayerHelper("edit_distance", **locals())
+    if ignored_tokens:
+        raise NotImplementedError(
+            "edit_distance ignored_tokens: filter tokens host-side (or via "
+            "ctc_greedy_decoder's compaction) before this op — dense "
+            "removal changes sequence lengths"
+        )
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64", True)
+    ins = {"Hyps": [input], "Refs": [label]}
+    in_len = input_length if input_length is not None \
+        else _seq_len_var(input)
+    lab_len = label_length if label_length is not None \
+        else _seq_len_var(label)
+    if in_len is not None:
+        ins["HypsLength"] = [in_len]
+    if lab_len is not None:
+        ins["RefsLength"] = [lab_len]
+    if input.shape is not None:
+        out.shape = (input.shape[0], 1)
+    helper.append_op(
+        type="edit_distance",
+        inputs=ins,
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized},
+    )
+    return out, seq_num
 
 
 def warpctc(input, label, blank=0, norm_by_times=False,

@@ -15,8 +15,9 @@ from ..param_attr import ParamAttr
 
 __all__ = [
     "fc", "embedding", "dropout", "softmax", "conv2d", "conv3d", "pool2d",
-    "pool3d", "adaptive_pool2d", "batch_norm", "instance_norm", "layer_norm",
-    "group_norm", "spectral_norm", "conv2d_transpose", "reduce_sum",
+    "pool3d", "adaptive_pool2d", "adaptive_pool3d", "batch_norm",
+    "instance_norm", "layer_norm", "group_norm", "spectral_norm",
+    "conv2d_transpose", "conv3d_transpose", "hard_swish", "reduce_sum",
     "reduce_mean", "reduce_max", "reduce_min", "reduce_prod", "reduce_all",
     "reduce_any", "split", "l2_normalize", "matmul", "topk", "transpose",
     "reshape", "squeeze", "unsqueeze", "flatten", "stack", "unstack",
@@ -229,6 +230,14 @@ def pow(x, factor=1.0, name=None):
 
 def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
     return _unary("hard_sigmoid", x, {"slope": slope, "offset": offset})
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    """x * relu6(x + offset) / scale (ref nn.py hard_swish)."""
+    return _unary(
+        "hard_swish", x,
+        {"threshold": threshold, "scale": scale, "offset": offset},
+    )
 
 
 def swish(x, beta=1.0, name=None):
@@ -502,11 +511,17 @@ def conv2d_transpose(
         if i in (None, -1):
             return -1
         return (i - 1) * s - 2 * p + d * (k - 1) + 1
+    out_padding = _resolve_output_padding(
+        output_size, filter_size, input.shape[2:4], padding, stride,
+        dilation, 2, _pair, _o,
+    )
     out.shape = (
         input.shape[0],
         num_filters,
-        _o(input.shape[2], filter_size[0], padding[0], stride[0], dilation[0]),
-        _o(input.shape[3], filter_size[1], padding[1], stride[1], dilation[1]),
+        _o(input.shape[2], filter_size[0], padding[0], stride[0],
+           dilation[0]) + out_padding[0],
+        _o(input.shape[3], filter_size[1], padding[1], stride[1],
+           dilation[1]) + out_padding[1],
     )
     helper.append_op(
         type="conv2d_transpose",
@@ -517,6 +532,106 @@ def conv2d_transpose(
             "paddings": padding,
             "dilations": dilation,
             "groups": groups,
+            "output_padding": out_padding,
+        },
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def _resolve_output_padding(output_size, filter_size, in_spatial, padding,
+                            stride, dilation, ndim, pair, out_fn):
+    """When output_size is given, the stride>1 ambiguity is resolved by
+    extending the bottom/right edge (ref conv_transpose_op.cc): returns
+    the per-dim extra rows, validated to lie in [0, stride)."""
+    if output_size is None:
+        return [0] * ndim
+    output_size = pair(output_size, ndim)
+    extra = []
+    for i in range(ndim):
+        base = out_fn(in_spatial[i], filter_size[i], padding[i], stride[i],
+                      dilation[i])
+        e = output_size[i] - base
+        if base != -1 and not 0 <= e < stride[i]:
+            raise ValueError(
+                "conv_transpose output_size[%d]=%d unreachable: valid "
+                "range is [%d, %d)" % (i, output_size[i], base,
+                                       base + stride[i])
+            )
+        extra.append(max(e, 0) if base != -1 else 0)
+    return extra
+
+
+def conv3d_transpose(
+    input,
+    num_filters,
+    output_size=None,
+    filter_size=None,
+    padding=0,
+    stride=1,
+    dilation=1,
+    groups=None,
+    param_attr=None,
+    bias_attr=None,
+    use_cudnn=True,
+    act=None,
+    name=None,
+    data_format="NCDHW",
+):
+    """3-D transposed convolution (ref nn.py conv3d_transpose) →
+    lax.conv_transpose over NCDHW."""
+    helper = LayerHelper("conv3d_transpose", **locals())
+    dtype = helper.input_dtype()
+    groups = groups or 1
+    num_channels = input.shape[1]
+    stride = _pair(stride, 3)
+    padding = _pair(padding, 3)
+    dilation = _pair(dilation, 3)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size required")
+        output_size = _pair(output_size, 3)
+        filter_size = [
+            (output_size[i] + 2 * padding[i]
+             - (input.shape[i + 2] - 1) * stride[i] - 1) // dilation[i] + 1
+            for i in range(3)
+        ]
+    else:
+        filter_size = _pair(filter_size, 3)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[num_channels, num_filters // groups] + filter_size,
+        dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+
+    def _o(i, k, p, s, d):
+        if i in (None, -1):
+            return -1
+        return (i - 1) * s - 2 * p + d * (k - 1) + 1
+
+    out_padding = _resolve_output_padding(
+        output_size, filter_size, input.shape[2:5], padding, stride,
+        dilation, 3, _pair, _o,
+    )
+    out.shape = tuple(
+        [input.shape[0], num_filters]
+        + [
+            _o(input.shape[i + 2], filter_size[i], padding[i], stride[i],
+               dilation[i]) + out_padding[i]
+            for i in range(3)
+        ]
+    )
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "output_padding": out_padding,
         },
     )
     pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
@@ -620,8 +735,48 @@ def pool3d(
     return out
 
 
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """Adaptive 3-D pooling to a fixed (D, H, W) output (ref nn.py
+    adaptive_pool3d) — pool3d op with adaptive windows."""
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool3d(require_index=True): the max-index mask is "
+            "not emitted by the pool lowering — compute argmax windows "
+            "explicitly if needed"
+        )
+    helper = LayerHelper("adaptive_pool3d", **locals())
+    pool_size = _pair(pool_size, 3)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = tuple(
+        [input.shape[0], input.shape[1]] + list(pool_size)
+    )
+    helper.append_op(
+        type="pool3d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": list(pool_size),
+            "strides": [1, 1, 1],
+            "paddings": [0, 0, 0],
+            "adaptive": True,
+            "global_pooling": False,
+            "ceil_mode": False,
+            "exclusive": True,
+        },
+    )
+    return out
+
+
 def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
                     name=None):
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool2d(require_index=True): the max-index mask is "
+            "not emitted by the pool lowering — compute argmax windows "
+            "explicitly if needed"
+        )
     helper = LayerHelper("adaptive_pool2d", **locals())
     pool_size = _pair(pool_size)
     out = helper.create_variable_for_type_inference(input.dtype)

@@ -103,6 +103,10 @@ def sequence_conv(
     act=None,
     name=None,
 ):
+    if filter_stride != 1:
+        # same restriction as the reference (sequence_lod.py:106:
+        # "Currently only supports stride = 1")
+        raise ValueError("sequence_conv only supports filter_stride=1")
     helper = LayerHelper("sequence_conv", **locals())
     dtype = helper.input_dtype()
     w = helper.create_parameter(

@@ -271,14 +271,51 @@ def _conv2d_transpose(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
-    # gradient of conv2d == transposed conv (ref conv2d_transpose_op.cc)
+    if (attrs.get("groups", 1) or 1) != 1:
+        raise NotImplementedError(
+            "conv2d_transpose groups>1: lax.conv_transpose has no grouped "
+            "mode — split channels and concat results, or use groups=1"
+        )
+    # gradient of conv2d == transposed conv (ref conv2d_transpose_op.cc).
+    # Paddle filter layout is (C_in, C_out, kh, kw); with
+    # transpose_kernel=True the spec names the FORWARD-conv roles, so the
+    # C_in axis sits in the 'O' slot (verified vs torch conv_transpose2d).
+    # output_padding (from the layer's output_size) extends the bottom/right
+    # edge by shrinking the high-side implicit crop, like the reference.
+    opad = _pair(attrs.get("output_padding", [0, 0]))
     out = lax.conv_transpose(
         x,
         w,
         strides=strides,
-        padding=[(p, p) for p in pads],
+        padding=[(p, p - o) for p, o in zip(pads, opad)],
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    return {"Output": [out]}
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """3-D transposed conv (ref conv3d_transpose_op.cc) — the gradient of
+    conv3d, via lax.conv_transpose over NCDHW."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    if (attrs.get("groups", 1) or 1) != 1:
+        raise NotImplementedError(
+            "conv3d_transpose groups>1: lax.conv_transpose has no grouped "
+            "mode — split channels and concat results, or use groups=1"
+        )
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    pads = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    opad = _pair(attrs.get("output_padding", [0, 0, 0]), 3)
+    out = lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=[(p, p - o) for p, o in zip(pads, opad)],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         transpose_kernel=True,
     )
     return {"Output": [out]}

@@ -38,6 +38,14 @@ __all__ = ["flash_attention", "reference_attention"]
 
 _NEG_INF = -1e30
 
+# Mosaic tiles f32 as (8, 128) sublanes x lanes. Row-vector arrays (lse,
+# delta, key-padding mask, dkpm) can't ride a (1, block) block shape on a
+# real TPU, so — like jax's official flash kernel (MIN_BLOCK_SIZE) — they
+# carry a broadcast trailing lane axis (.., 128) or a sublane axis (8, ..)
+# and the kernels slice lane/sublane 0.
+_LANES = 128
+_SUBLANES = 8
+
 
 # ---------------------------------------------------------------------------
 # counter-based dropout bits (identical in fwd/bwd kernels and on CPU)
@@ -98,7 +106,8 @@ def _fwd_kernel(seed_ref, kpm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             preferred_element_type=jnp.float32,
         ) * sm_scale                                          # (bq, bk)
         if kpm_ref is not None:
-            s = s + kpm_ref[0, pl.ds(j * block_k, block_k)][None, :]
+            # kpm block is (1, SUBLANES, tk) broadcast rows; take row 0
+            s = s + kpm_ref[0, 0:1, pl.ds(j * block_k, block_k)]
         if causal:
             s = jnp.where(_causal_mask_tile(qi, j, bq, block_k), s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
@@ -133,19 +142,21 @@ def _fwd_kernel(seed_ref, kpm_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     # the framework-defined semantic for degenerate causal/padding combos
     dead = m <= _NEG_INF * 0.5
     o_ref[0] = jnp.where(dead, 0.0, acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(dead, _NEG_INF, m + jnp.log(l_safe))[:, 0]
+    lse_val = jnp.where(dead, _NEG_INF, m + jnp.log(l_safe))   # (bq, 1)
+    lse_ref[0] = jnp.broadcast_to(lse_val, (bq, _LANES))
 
 
 # ---------------------------------------------------------------------------
 # backward kernels (FlashAttention-2 split)
 # ---------------------------------------------------------------------------
 def _p_tile(q, k, kpm_row, lse, qi, j, bq, bk, sm_scale, causal):
-    """Recompute P = exp(S - lse) for tile (qi, j); f32."""
+    """Recompute P = exp(S - lse) for tile (qi, j); f32. kpm_row is a
+    (1, bk) row (sliced from the sublane-broadcast layout)."""
     s = lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
     if kpm_row is not None:
-        s = s + kpm_row[None, :]
+        s = s + kpm_row
     if causal:
         s = jnp.where(_causal_mask_tile(qi, j, bq, bk), s, _NEG_INF)
     # dead rows carry lse = _NEG_INF (see fwd); their P must be 0, not e^0
@@ -159,15 +170,15 @@ def _dq_kernel(seed_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     bq = q_ref.shape[1]
     q = q_ref[0]
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0][:, 0:1]                # (bq, 1) from lane-broadcast
+    delta = delta_ref[0][:, 0:1]
     seed = fold_bh_seed(seed_ref[0, 0], pl.program_id(0))
 
     def body(j, dq_acc):
         k = k_ref[0, pl.ds(j * block_k, block_k), :]
         v = v_ref[0, pl.ds(j * block_k, block_k), :]
         kpm_row = (
-            kpm_ref[0, pl.ds(j * block_k, block_k)]
+            kpm_ref[0, 0:1, pl.ds(j * block_k, block_k)]
             if kpm_ref is not None else None
         )
         p = _p_tile(q, k, kpm_row, lse, qi, j, bq, block_k, sm_scale, causal)
@@ -205,15 +216,15 @@ def _dkdv_kernel(seed_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     bk = k_ref.shape[1]
     k = k_ref[0]
     v = v_ref[0]
-    kpm_row = kpm_ref[0] if kpm_ref is not None else None
+    kpm_row = kpm_ref[0, 0:1, :] if kpm_ref is not None else None
     seed = fold_bh_seed(seed_ref[0, 0], pl.program_id(0))
 
     def body(i, carry):
         dk_acc, dv_acc, dkpm_acc = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :][:, 0:1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :][:, 0:1]
         p = _p_tile(q, k, kpm_row, lse, i, kj, block_q, bk, sm_scale, causal)
         if dropout_p > 0.0:
             keep = _keep_mask(seed, i, kj, block_q, bk, dropout_p)
@@ -256,7 +267,7 @@ def _dkdv_kernel(seed_ref, kpm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
     if dkpm_ref is not None:
-        dkpm_ref[0] = dkpm[0]
+        dkpm_ref[0] = jnp.broadcast_to(dkpm, (_SUBLANES, bk))
 
 
 # ---------------------------------------------------------------------------
@@ -268,10 +279,17 @@ def _specs(bh, t, d, block, have_kpm, heads):
     q_spec = pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0))
     kv_spec = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
     kpm_spec = (
-        pl.BlockSpec((1, t), lambda b, i: (b // heads, 0))
+        pl.BlockSpec((1, _SUBLANES, t), lambda b, i: (b // heads, 0, 0))
         if have_kpm else None
     )
     return seed_spec, kpm_spec, q_spec, kv_spec
+
+
+def _kpm3(kpm):
+    """(B, T) additive mask -> sublane-broadcast (B, SUBLANES, T)."""
+    return jnp.broadcast_to(
+        kpm[:, None, :], (kpm.shape[0], _SUBLANES, kpm.shape[1])
+    )
 
 
 def _fwd_call(q, k, v, kpm, seed, sm_scale, causal, dropout_p, block_q,
@@ -292,7 +310,7 @@ def _fwd_call(q, k, v, kpm, seed, sm_scale, causal, dropout_p, block_q,
     args = [seed]
     if kpm is not None:
         in_specs.append(kpm_spec)
-        args.append(kpm)
+        args.append(_kpm3(kpm))
     in_specs += [q_spec, kv_spec, kv_spec]
     args += [q, k, v]
     out, lse = pl.pallas_call(
@@ -301,11 +319,11 @@ def _fwd_call(q, k, v, kpm, seed, sm_scale, causal, dropout_p, block_q,
         in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i: (b, i, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, _LANES), jnp.float32),
         ),
         interpret=interpret,
     )(*args)
@@ -335,19 +353,22 @@ def _bwd_call(q, k, v, kpm, seed, do, lse, delta, sm_scale, causal,
     nq = tq // block_q
     nk = tk // block_k
     seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
-    kpm_spec = pl.BlockSpec((1, tk), lambda b, i: (b // heads, 0))
+    kpm_spec = pl.BlockSpec(
+        (1, _SUBLANES, tk), lambda b, i: (b // heads, 0, 0)
+    )
     full_q = pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0))
     full_k = pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0))
-    row_q = pl.BlockSpec((1, tq), lambda b, i: (b, 0))
+    row_q = pl.BlockSpec((1, tq, _LANES), lambda b, i: (b, 0, 0))
 
+    kpm3 = _kpm3(kpm) if kpm is not None else None
     # dq: grid over q tiles
     qb = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
-    lse_b = pl.BlockSpec((1, block_q), lambda b, i: (b, i))
+    lse_b = pl.BlockSpec((1, block_q, _LANES), lambda b, i: (b, i, 0))
     in_specs = [seed_spec]
     args = [seed]
     if kpm is not None:
         in_specs.append(kpm_spec)
-        args.append(kpm)
+        args.append(kpm3)
     in_specs += [qb, full_k, full_k, qb, lse_b, lse_b]
     dq = pl.pallas_call(
         functools.partial(
@@ -364,12 +385,14 @@ def _bwd_call(q, k, v, kpm, seed, do, lse, delta, sm_scale, causal,
 
     # dk/dv: grid over k tiles
     kb = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))
-    kpm_b = pl.BlockSpec((1, block_k), lambda b, i: (b // heads, i))
+    kpm_b = pl.BlockSpec(
+        (1, _SUBLANES, block_k), lambda b, i: (b // heads, 0, i)
+    )
     in_specs = [seed_spec]
     args = [seed]
     if kpm is not None:
         in_specs.append(kpm_b)
-        args.append(kpm)
+        args.append(kpm3)
     in_specs += [full_q, kb, kb, full_q, row_q, row_q]
     out_specs = [kb, kb]
     out_shape = [
@@ -377,9 +400,14 @@ def _bwd_call(q, k, v, kpm, seed, do, lse, delta, sm_scale, causal,
         jax.ShapeDtypeStruct(v.shape, v.dtype),
     ]
     if kpm is not None:
-        # per-(b·h) partial dkpm rows; summed over heads by the caller
-        out_specs.append(pl.BlockSpec((1, block_k), lambda b, i: (b, i)))
-        out_shape.append(jax.ShapeDtypeStruct((bh, tk), jnp.float32))
+        # per-(b·h) partial dkpm rows (sublane-broadcast); summed over
+        # heads by the caller
+        out_specs.append(
+            pl.BlockSpec((1, _SUBLANES, block_k), lambda b, i: (b, 0, i))
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, _SUBLANES, tk), jnp.float32)
+        )
     outs = pl.pallas_call(
         functools.partial(
             _dkdv_kernel if kpm is not None else _dkdv_kernel_nokpm,
@@ -453,13 +481,17 @@ def _flash_bwd(sm_scale, causal, dropout_p, block_q, block_k, interpret,
     delta = jnp.sum(
         gf.astype(jnp.float32) * out_f.astype(jnp.float32), axis=-1
     )
+    # same lane-broadcast layout as lse (see _LANES note at the top)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
     dq, dk, dv, dkpm_bh = _bwd_call(
         qf, kf, vf, kpm, seed, gf, lse, delta, sm_scale, causal,
         dropout_p, block_q, block_k, h, interpret,
     )
     dkpm = None
     if kpm is not None:
-        dkpm = dkpm_bh.reshape(b, h, tk).sum(axis=1).astype(kpm.dtype)
+        dkpm = (
+            dkpm_bh[:, 0, :].reshape(b, h, tk).sum(axis=1).astype(kpm.dtype)
+        )
     # the int32 seed's formal tangent type is float0 — returning an int32
     # zero relies on lenient custom_vjp checking and can break on upgrades
     dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
@@ -578,10 +610,16 @@ def _fused_mha_lowering(ctx, ins, attrs):
     key = ctx.next_rng() if p > 0.0 else None
     import os
     platform = ctx.platform or jax.default_backend()
+    # measured on v5e (BERT-base, T=128): XLA's own fusion beats the flash
+    # kernel at short T (104k vs 80k tok/s) — the T x T tile is tiny and
+    # flash's lse/stats traffic dominates. The kernel pays off once the
+    # score tensor stops fitting cache-friendly sizes.
+    min_t = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", 512))
     use_pallas = (
         platform == "tpu"
         and not ctx.mesh_axes
         and not os.environ.get("PADDLE_TPU_DISABLE_PALLAS")
+        and q.shape[2] >= min_t
     )
     if use_pallas:
         seed = None

@@ -1,0 +1,202 @@
+"""Round-2 parity sweep: hard_swish, conv3d_transpose, adaptive_pool3d,
+cross_entropy2, edit_distance layer, dygraph Conv3DTranspose/SequenceConv/
+RowConv, datasets wmt14/voc2012/mq2007/image."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    yield
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_hard_swish_numeric():
+    x = fluid.data(name="x", shape=[5], dtype="float32",
+                   append_batch_size=False)
+    out = fluid.layers.hard_swish(x)
+    xv = np.array([-4.0, -1.0, 0.0, 2.0, 7.0], "float32")
+    o = _exe().run(feed={"x": xv}, fetch_list=[out])[0]
+    oracle = xv * np.clip(xv + 3.0, 0, 6.0) / 6.0
+    np.testing.assert_allclose(o, oracle, rtol=1e-5)
+
+
+def test_conv3d_transpose_vs_torch():
+    torch = pytest.importorskip("torch")
+    n, c, d, h, w = 1, 2, 3, 4, 4
+    x = fluid.data(name="x", shape=[n, c, d, h, w], dtype="float32",
+                   append_batch_size=False)
+    out = fluid.layers.conv3d_transpose(
+        x, num_filters=3, filter_size=3, stride=2, padding=1,
+        bias_attr=False,
+    )
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(0).rand(n, c, d, h, w).astype("float32")
+    # read the initialized filter back to drive the torch oracle
+    scope = fluid.global_scope()
+    import paddle_tpu.fluid.framework as fw
+
+    wname = [
+        v.name
+        for v in fw.default_main_program().global_block().vars.values()
+        if isinstance(v, fw.Parameter)
+    ][0]
+    o = exe.run(feed={"x": xv}, fetch_list=[out])[0]
+    wv = np.asarray(scope.find_var(wname))
+    ref = torch.nn.functional.conv_transpose3d(
+        torch.tensor(xv), torch.tensor(wv), stride=2, padding=1,
+    ).numpy()
+    assert o.shape == ref.shape
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_vs_torch():
+    """Regression: the IOHW spec silently mis-oriented weights whenever
+    C_in != C_out (masked before because no numeric test existed)."""
+    torch = pytest.importorskip("torch")
+    n, c, h, w = 1, 2, 5, 5
+    x = fluid.data(name="x2", shape=[n, c, h, w], dtype="float32",
+                   append_batch_size=False)
+    out = fluid.layers.conv2d_transpose(
+        x, num_filters=3, filter_size=3, stride=2, padding=1,
+        bias_attr=False,
+    )
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    import paddle_tpu.fluid.framework as fw
+
+    xv = np.random.RandomState(5).rand(n, c, h, w).astype("float32")
+    wname = [
+        v.name
+        for v in fw.default_main_program().global_block().vars.values()
+        if isinstance(v, fw.Parameter)
+    ][0]
+    o = exe.run(feed={"x2": xv}, fetch_list=[out])[0]
+    wv = np.asarray(fluid.global_scope().find_var(wname))
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(xv), torch.tensor(wv), stride=2, padding=1,
+    ).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_pool3d():
+    x = fluid.data(name="x", shape=[1, 2, 4, 4, 4], dtype="float32",
+                   append_batch_size=False)
+    out = fluid.layers.adaptive_pool3d(x, pool_size=2, pool_type="avg")
+    xv = np.arange(128, dtype="float32").reshape(1, 2, 4, 4, 4)
+    o = _exe().run(feed={"x": xv}, fetch_list=[out])[0]
+    assert o.shape == (1, 2, 2, 2, 2)
+    # each output cell = mean of its 2x2x2 block
+    oracle = xv.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(o, oracle, rtol=1e-5)
+
+
+def test_cross_entropy2_matches_manual():
+    x = fluid.data(name="x", shape=[3, 4], dtype="float32",
+                   append_batch_size=False)
+    lab = fluid.data(name="lab", shape=[3, 1], dtype="int64",
+                     append_batch_size=False)
+    out = fluid.layers.cross_entropy2(x, lab)
+    probs = np.array(
+        [[0.1, 0.7, 0.1, 0.1], [0.25, 0.25, 0.25, 0.25],
+         [0.9, 0.05, 0.03, 0.02]],
+        "float32",
+    )
+    lv = np.array([[1], [3], [0]], "int64")
+    o = _exe().run(feed={"x": probs, "lab": lv}, fetch_list=[out])[0]
+    oracle = -np.log(probs[np.arange(3), lv[:, 0]])
+    np.testing.assert_allclose(o[:, 0], oracle, rtol=1e-5)
+
+
+def test_edit_distance_layer():
+    hyp = fluid.data(name="hyp", shape=[2, 5], dtype="int64",
+                     append_batch_size=False)
+    ref = fluid.data(name="ref", shape=[2, 6], dtype="int64",
+                     append_batch_size=False)
+    hl = fluid.data(name="hl", shape=[2], dtype="int64",
+                    append_batch_size=False)
+    rl = fluid.data(name="rl", shape=[2], dtype="int64",
+                    append_batch_size=False)
+    dist, seq_num = fluid.layers.edit_distance(
+        hyp, ref, normalized=False, input_length=hl, label_length=rl,
+    )
+    # "kitten" vs "sitting"-style check with token ids
+    hv = np.array([[1, 2, 3, 3, 4], [1, 2, 0, 0, 0]], "int64")
+    rv = np.array([[5, 2, 3, 3, 2, 4], [1, 2, 0, 0, 0, 0]], "int64")
+    o, n = _exe().run(
+        feed={"hyp": hv, "ref": rv, "hl": np.array([5, 2], "int64"),
+              "rl": np.array([6, 2], "int64")},
+        fetch_list=[dist, seq_num],
+    )
+    assert o[0, 0] == 2.0   # substitute k->s, insert i
+    assert o[1, 0] == 0.0
+    assert int(n) == 2
+
+
+def test_dygraph_conv3dtranspose_seqconv_rowconv():
+    with fluid.dygraph.guard():
+        x3 = fluid.dygraph.to_variable(
+            np.random.RandomState(0).rand(1, 2, 3, 4, 4).astype("float32")
+        )
+        m = fluid.dygraph.nn.Conv3DTranspose(
+            "c3t", num_filters=3, filter_size=3, stride=2, padding=1,
+        )
+        y = m(x3)
+        assert y.shape[:2] == (1, 3)
+
+        seq = fluid.dygraph.to_variable(
+            np.random.RandomState(1).rand(2, 6, 4).astype("float32")
+        )
+        sc = fluid.dygraph.nn.SequenceConv("sc", num_filters=5,
+                                           filter_size=3)
+        ys = sc(seq)
+        assert ys.shape == (2, 6, 5)
+
+        rc = fluid.dygraph.nn.RowConv("rc", future_context_size=2)
+        yr = rc(seq)
+        assert yr.shape == seq.shape
+
+
+def test_datasets_wmt14_voc2012_mq2007():
+    from paddle_tpu.dataset import wmt14, voc2012, mq2007
+
+    s = next(iter(wmt14.train(100)()))
+    assert len(s) == 3 and s[1][0] == 0 and s[2][-1] == 1
+    src_d, trg_d = wmt14.get_dict(100)
+    assert src_d[0] == "<s>"
+
+    img, lab = next(iter(voc2012.train()()))
+    assert img.shape == (3, 64, 64) and lab.shape == (64, 64)
+    assert lab.max() >= 1
+
+    pt = next(iter(mq2007.train(format="pointwise")()))
+    assert pt[1].shape == (46,)
+    pr = next(iter(mq2007.train(format="pairwise")()))
+    assert pr[1].shape == (46,) and pr[2].shape == (46,)
+    labels, feats = next(iter(mq2007.train(format="listwise")()))
+    assert len(labels) == len(feats)
+
+
+def test_dataset_image_transforms():
+    from paddle_tpu.dataset import image as img_utils
+
+    im = np.arange(48 * 32 * 3, dtype="uint8").reshape(48, 32, 3)
+    r = img_utils.resize_short(im, 16)
+    assert min(r.shape[:2]) == 16 and r.shape[0] == 24
+    c = img_utils.center_crop(r, 12)
+    assert c.shape[:2] == (12, 12)
+    f = img_utils.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, 0], c[:, -1])
+    t = img_utils.simple_transform(im, 20, 12, is_train=False,
+                                   mean=[1.0, 2.0, 3.0])
+    assert t.shape == (3, 12, 12) and t.dtype == np.float32
