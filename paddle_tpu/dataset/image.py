@@ -71,7 +71,17 @@ def to_chw(im, order=(2, 0, 1)):
     return im.transpose(order)
 
 
+def _check_crop(im, size):
+    h, w = im.shape[:2]
+    if size > h or size > w:
+        raise ValueError(
+            "crop size %d exceeds image dims (%d, %d) — resize first"
+            % (size, h, w)
+        )
+
+
 def center_crop(im, size, is_color=True):
+    _check_crop(im, size)
     h, w = im.shape[:2]
     y0 = max((h - size) // 2, 0)
     x0 = max((w - size) // 2, 0)
@@ -79,6 +89,7 @@ def center_crop(im, size, is_color=True):
 
 
 def random_crop(im, size, is_color=True):
+    _check_crop(im, size)
     h, w = im.shape[:2]
     y0 = np.random.randint(0, max(h - size, 0) + 1)
     x0 = np.random.randint(0, max(w - size, 0) + 1)

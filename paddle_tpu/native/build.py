@@ -43,10 +43,38 @@ def load_native():
             lib.ptq_destroy.argtypes = [ctypes.c_void_p]
             lib.arena_create.restype = ctypes.c_void_p
             lib.arena_create.argtypes = [ctypes.c_size_t]
+            lib.arena_is_locked.restype = ctypes.c_int
+            lib.arena_is_locked.argtypes = [ctypes.c_void_p]
             lib.arena_alloc.restype = ctypes.c_void_p
             lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
             lib.arena_reset.argtypes = [ctypes.c_void_p]
             lib.arena_destroy.argtypes = [ctypes.c_void_p]
+            lib.pipe_create.restype = ctypes.c_void_p
+            lib.pipe_create.argtypes = [
+                ctypes.c_int, ctypes.c_size_t, ctypes.c_int,
+            ]
+            lib.pipe_is_pinned.restype = ctypes.c_int
+            lib.pipe_is_pinned.argtypes = [ctypes.c_void_p]
+            lib.pipe_acquire_write.restype = ctypes.c_int
+            lib.pipe_acquire_write.argtypes = [ctypes.c_void_p]
+            lib.pipe_slot_ptr.restype = ctypes.c_void_p
+            lib.pipe_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.pipe_write.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_size_t,
+            ]
+            lib.pipe_submit_write.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_size_t,
+            ]
+            lib.pipe_wait_writes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.pipe_commit.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.pipe_acquire_read.restype = ctypes.c_int
+            lib.pipe_acquire_read.argtypes = [ctypes.c_void_p]
+            lib.pipe_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.pipe_abort.argtypes = [ctypes.c_void_p]
+            lib.pipe_reset.argtypes = [ctypes.c_void_p]
+            lib.pipe_destroy.argtypes = [ctypes.c_void_p]
             _lib = lib
             return _lib
         except Exception:

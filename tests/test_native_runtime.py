@@ -132,3 +132,160 @@ def test_evaluator_shim_legacy_flow():
                   fetch_list=[ev.metrics[0]])[0]
     ev.update(value=float(acc), weight=8)
     assert 0.0 <= ev.eval() <= 1.0
+
+
+def test_native_batch_pipe_zero_copy_round_trip():
+    """Batch bytes stage through the C++ arena and come back bit-exact as
+    zero-copy views (VERDICT #5: the data actually crosses into C++)."""
+    from paddle_tpu.native.pipeline import NativeBatchPipe
+
+    pipe = NativeBatchPipe(capacity=2, slot_bytes=1 << 20, n_workers=2)
+    try:
+        rng = np.random.default_rng(3)
+        batch = {
+            "x": rng.normal(size=(64, 32)).astype(np.float32),
+            "y": rng.integers(0, 9, size=(64, 1)).astype(np.int64),
+        }
+        pipe.put(batch)
+        out, release = pipe.get()
+        np.testing.assert_array_equal(out["x"], batch["x"])
+        np.testing.assert_array_equal(out["y"], batch["y"])
+        # the view is NOT a copy of the producer array: it lives in the
+        # arena slab (different buffer than the input)
+        assert out["x"].__array_interface__["data"][0] != \
+            batch["x"].__array_interface__["data"][0]
+        release()
+        # sentinel passes through
+        pipe.put(None)
+        item, rel = pipe.get()
+        assert item is None
+        rel()
+    finally:
+        pipe.close()
+
+
+def test_native_batch_pipe_overlap():
+    """Producer prep overlaps consumer steps (VERDICT #5 'done' bar:
+    wall < sum of produce + consume)."""
+    import threading
+    import time
+
+    from paddle_tpu.native.pipeline import NativeBatchPipe
+
+    n_batches = 8
+    prep_s = 0.02
+    step_s = 0.02
+    pipe = NativeBatchPipe(capacity=4, slot_bytes=1 << 20, n_workers=2)
+    try:
+        data = np.ones((256, 64), np.float32)
+
+        def produce():
+            for _ in range(n_batches):
+                time.sleep(prep_s)          # host IO / augmentation
+                pipe.put({"x": data})
+            pipe.put(None)
+
+        t0 = time.time()
+        threading.Thread(target=produce, daemon=True).start()
+        seen = 0
+        release_prev = None
+        while True:
+            item, release = pipe.get()
+            if release_prev is not None:
+                release_prev()
+            release_prev = release
+            if item is None:
+                break
+            time.sleep(step_s)              # device step
+            seen += 1
+        release_prev()
+        wall = time.time() - t0
+        assert seen == n_batches
+        serial = n_batches * (prep_s + step_s)
+        # overlapped pipeline must beat the serial sum with clear margin
+        assert wall < serial * 0.85, (wall, serial)
+    finally:
+        pipe.close()
+
+
+def test_dataloader_uses_native_pipe_and_overlaps():
+    """DataLoader end-to-end through the C++ staging path."""
+    import time
+
+    import paddle_tpu.fluid as fluid
+
+    loader = fluid.reader.DataLoader.from_generator(feed_list=[],
+                                                    capacity=4)
+    n, prep_s, step_s = 12, 0.02, 0.02
+
+    def gen():
+        for i in range(n):
+            time.sleep(prep_s)
+            yield {"x": np.full((128, 16), float(i), np.float32)}
+
+    loader.set_batch_generator(gen)
+    t0 = time.time()
+    vals = []
+    for batch in loader():
+        time.sleep(step_s)
+        vals.append(float(batch["x"][0, 0]))
+    wall = time.time() - t0
+    assert vals == [float(i) for i in range(n)]
+    assert wall < n * (prep_s + step_s) * 0.9, wall
+
+
+def test_dataloader_early_exit_and_restart():
+    """Breaking out of an epoch must not corrupt the next one (C++ abort
+    handshake + pipe reset)."""
+    import paddle_tpu.fluid as fluid
+
+    loader = fluid.reader.DataLoader.from_generator(feed_list=[],
+                                                    capacity=2)
+
+    def gen():
+        for i in range(50):
+            yield {"x": np.full((4,), float(i), np.float32)}
+
+    loader.set_batch_generator(gen)
+    for batch in loader():
+        assert float(batch["x"][0]) == 0.0
+        break                     # early exit mid-epoch
+    vals = [float(b["x"][0]) for b in loader()]
+    assert vals == [float(i) for i in range(50)]
+
+
+def test_dataloader_producer_error_is_loud():
+    """A generator exception surfaces in the training loop, not as a
+    silent short epoch."""
+    import paddle_tpu.fluid as fluid
+
+    loader = fluid.reader.DataLoader.from_generator(feed_list=[],
+                                                    capacity=2)
+
+    def gen():
+        yield {"x": np.zeros((4,), np.float32)}
+        raise IOError("disk gone")
+
+    loader.set_batch_generator(gen)
+    with pytest.raises(RuntimeError, match="disk gone"):
+        for _ in loader():
+            pass
+
+
+def test_dataloader_batches_safe_to_retain():
+    """Yielded batches are copies — retaining all of them across the epoch
+    must not alias recycled ring slots."""
+    import paddle_tpu.fluid as fluid
+
+    loader = fluid.reader.DataLoader.from_generator(feed_list=[],
+                                                    capacity=2)
+    n = 12
+
+    def gen():
+        for i in range(n):
+            yield {"x": np.full((1024,), float(i), np.float32)}
+
+    loader.set_batch_generator(gen)
+    kept = [b["x"] for b in loader()]
+    assert [float(a[0]) for a in kept] == [float(i) for i in range(n)]
+    assert all(float(a[0]) == float(a[-1]) for a in kept)
