@@ -217,21 +217,31 @@ def test_dataloader_uses_native_pipe_and_overlaps():
     loader = fluid.reader.DataLoader.from_generator(feed_list=[],
                                                     capacity=4)
     n, prep_s, step_s = 12, 0.02, 0.02
+    prep_total = [0.0]
 
     def gen():
         for i in range(n):
+            t = time.time()
             time.sleep(prep_s)
+            prep_total[0] += time.time() - t
             yield {"x": np.full((128, 16), float(i), np.float32)}
 
     loader.set_batch_generator(gen)
     t0 = time.time()
     vals = []
+    step_total = 0.0
     for batch in loader():
+        t = time.time()
         time.sleep(step_s)
+        step_total += time.time() - t
         vals.append(float(batch["x"][0, 0]))
     wall = time.time() - t0
     assert vals == [float(i) for i in range(n)]
-    assert wall < n * (prep_s + step_s) * 0.9, wall
+    # overlap: wall must beat the MEASURED serial sum (sleeps stretch
+    # under load on the 1-core CI box; both sides stretch together)
+    assert wall < (prep_total[0] + step_total) * 0.9, (
+        wall, prep_total[0], step_total,
+    )
 
 
 def test_dataloader_early_exit_and_restart():
