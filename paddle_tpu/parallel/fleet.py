@@ -156,11 +156,18 @@ class Fleet:
             axes["sp"] = sp
         self._mesh = build_mesh(axes)
         rules = [ShardingRule(p, spec) for p, spec in s.tensor_parallel_rules]
+        opt_rules = []
         if s.sharding_degree > 1:
-            # ZeRO-ish: shard every large parameter's first dim over dp
-            rules.append(ShardingRule(r".*", P("dp")))
+            # ZeRO-1: optimizer state (moments etc.) sharded over dp;
+            # params keep their tp/replicated layout — XLA partitions the
+            # optimizer update accordingly (reduce-scatter'd in effect).
+            # Any degree > 1 shards over the FULL dp axis (GSPMD shards
+            # whole mesh axes; a partial group would need a split axis) —
+            # strictly more memory saving than the requested degree.
+            opt_rules.append(ShardingRule(r".*", P("dp")))
         self._distributed_program = DistributedProgram(
-            program, self._mesh, param_rules=rules
+            program, self._mesh, param_rules=rules,
+            opt_state_rules=opt_rules,
         )
         return self._distributed_program
 

@@ -42,6 +42,32 @@ def batch_sharded(mesh, axis="dp"):
     return NamedSharding(mesh, P(axis))
 
 
+def _merge_axis_into(base_spec, extra_spec, shape, mesh):
+    """Place extra_spec's (single) mesh axis onto the first free,
+    evenly-divisible dim of base_spec. Returns the merged PartitionSpec or
+    None when it can't be merged (base is None, axis taken, nothing
+    divides)."""
+    if base_spec is None:
+        return None
+    extra_axes = [a for a in extra_spec if a is not None]
+    if len(extra_axes) != 1:
+        return None
+    axis = extra_axes[0]
+    entries = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    if any(a == axis or (isinstance(a, tuple) and axis in a)
+           for a in entries if a is not None):
+        return None
+    size = mesh.shape[axis]
+    for dim in range(len(shape)):
+        if entries[dim] is None and shape[dim] % size == 0:
+            entries[dim] = axis
+            merged = P(*entries)
+            if _spec_fits(merged, shape, mesh):
+                return merged
+            entries[dim] = None
+    return None
+
+
 def _spec_fits(spec, shape, mesh):
     """A PartitionSpec only applies if every sharded dim divides evenly."""
     for dim, axis in enumerate(spec):
@@ -62,10 +88,21 @@ class DistributedProgram:
     ordinary Executor (same hook as CompiledProgram)."""
 
     def __init__(self, program, mesh, param_rules=None, feed_axis="dp",
-                 feed_specs=None):
+                 feed_specs=None, opt_state_rules=None):
         self._program = program
         self._mesh = mesh
         self._param_rules = list(param_rules or [])
+        # ZeRO-style rules applied ONLY to optimizer state (moments etc.):
+        # params/grads stay wherever param_rules put them while the
+        # optimizer state + its update shard over 'dp' — the memory win of
+        # ZeRO-1 expressed as GSPMD shardings instead of manual
+        # reduce-scatter/all-gather (XLA inserts those on ICI itself)
+        self._opt_state_rules = list(opt_state_rules or [])
+        self._opt_state_names = {
+            v.name
+            for v in program.global_block().vars.values()
+            if getattr(v, "belong_to_optimizer", False)
+        }
         # honor sharding annotations left by DistributeTranspiler.transpile
         for name, spec in (getattr(program, "_sharding_spec", None) or []):
             # exact-name anchor: a bare suffix pattern would also capture
@@ -77,7 +114,31 @@ class DistributedProgram:
         self._cache = {}
 
     # -- sharding resolution --------------------------------------------
+    def _param_rule_spec(self, name, shape):
+        for rule in self._param_rules:
+            if rule.match(name) and _spec_fits(rule.spec, shape, self._mesh):
+                return rule.spec
+        return None
+
     def param_sharding(self, name, shape):
+        if name in self._opt_state_names and self._opt_state_rules:
+            base = self._param_rule_spec(name, shape)
+            for rule in self._opt_state_rules:
+                if not rule.match(name):
+                    continue
+                # moments of tp-sharded params keep the tp layout AND gain
+                # the ZeRO axis on a free dim (P('dp','tp') beats either
+                # alone); fall back to the plain ZeRO spec, then to the
+                # param layout
+                merged = _merge_axis_into(
+                    base, rule.spec, shape, self._mesh
+                )
+                if merged is not None:
+                    return NamedSharding(self._mesh, merged)
+                if _spec_fits(rule.spec, shape, self._mesh):
+                    return NamedSharding(self._mesh, rule.spec)
+            if base is not None:
+                return NamedSharding(self._mesh, base)
         for rule in self._param_rules:
             if rule.match(name) and _spec_fits(rule.spec, shape, self._mesh):
                 return NamedSharding(self._mesh, rule.spec)

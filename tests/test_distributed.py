@@ -197,6 +197,77 @@ def test_fleet_distributed_optimizer_runs():
     assert np.isfinite(float(np.asarray(out[0])))
 
 
+def test_fleet_zero_shards_optimizer_state():
+    """sharding_degree=2 (ZeRO-1): optimizer moments shard over dp while
+    the parameters stay replicated (VERDICT #8 'done' bar)."""
+    from paddle_tpu.fluid.executor import global_scope
+    from paddle_tpu.parallel import fleet
+
+    fleet.init(is_collective=True)
+    x = fluid.data("zx", [16], dtype="float32")
+    y = fluid.layers.fc(x, size=8)
+    loss = fluid.layers.reduce_mean(y)
+    strategy = fleet.DistributedStrategy()
+    strategy.sharding_degree = 2
+    opt = fleet.distributed_optimizer(
+        fluid.optimizer.Adam(learning_rate=0.01), strategy,
+    )
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    out = exe.run(fleet.fleet.main_program,
+                  feed={"zx": np.ones((8, 16), "float32")},
+                  fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(out[0])))
+    scope = global_scope()
+    moment_specs = []
+    ndev = len(jax.devices())
+    prog = fleet.fleet.main_program._program
+    for name, var in prog.global_block().vars.items():
+        arr = scope.find_value(name)
+        shape = np.shape(arr)
+        if (
+            getattr(var, "belong_to_optimizer", False)
+            and "moment" in name
+            and shape
+            and shape[0] % ndev == 0
+        ):
+            moment_specs.append((name, getattr(arr, "sharding", None)))
+    assert moment_specs, "no shardable optimizer moments found in scope"
+    # every dp-divisible moment lives sharded over dp in HBM — the ZeRO
+    # memory win (XLA propagation may additionally shard params, which is
+    # FSDP-like and also fine)
+    for name, sh in moment_specs:
+        assert sh is not None and "dp" in str(sh.spec), (name, sh)
+
+
+def test_zero_merges_with_tp_layout():
+    """Moments of tp-sharded params keep tp AND gain the dp axis."""
+    from jax.sharding import PartitionSpec as P2
+
+    mesh = build_mesh({"dp": 2, "tp": 4})
+    import paddle_tpu.fluid.framework as fw
+
+    prog = fw.Program()
+    blk = prog.global_block()
+    blk.create_var(name="w", shape=(16, 8), dtype="float32")
+    mvar = blk.create_var(name="w_moment1_0", shape=(16, 8),
+                          dtype="float32")
+    mvar.belong_to_optimizer = True
+    dist = DistributedProgram(
+        prog, mesh,
+        param_rules=[ShardingRule(r"^w", P2(None, "tp"))],
+        opt_state_rules=[ShardingRule(r".*", P2("dp"))],
+    )
+    msh = dist.param_sharding("w_moment1_0", (16, 8))
+    assert str(msh.spec) in (
+        "PartitionSpec('dp', 'tp')", "PartitionSpec('dp', 'tp',)",
+    ), msh
+    # the param itself keeps its plain tp layout
+    wsh = dist.param_sharding("w", (16, 8))
+    assert "dp" not in str(wsh.spec) and "tp" in str(wsh.spec)
+
+
 def test_pipeline_parallel_forward_matches_sequential():
     from paddle_tpu.parallel.pipeline import gpipe_sharded
 
