@@ -87,8 +87,10 @@ def append_backward(
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """Compute gradients of ``targets`` w.r.t. arbitrary ``inputs``
-    (ref backward.py gradients())."""
+    """Compute gradients of ``targets`` w.r.t. arbitrary ``inputs`` —
+    params, feeds, or INTERMEDIATE vars (a zero probe is injected after
+    the intermediate's producing op in the vjp replay; see lowering
+    run_ops). Ref backward.py gradients()."""
     if isinstance(targets, Variable):
         targets = [targets]
     if isinstance(inputs, Variable):

@@ -39,6 +39,7 @@ __all__ = [
     "temporal_shift", "cos_sim", "cross_entropy", "square_error_cost",
     "smooth_l1", "multiplex", "unique", "unique_with_counts", "gelu",
     "elementwise_equal", "flatten_contiguous", "im2sequence", "row_conv",
+    "py_func",
     "one_hot_v2", "shard_index", "hash", "swish", "mish", "unfold",
     "bilinear_tensor_product", "lrn", "shuffle_channel", "dice_loss",
     "log_loss", "kldiv_loss", "npair_loss", "mse_loss", "roi_pool",
@@ -2021,6 +2022,48 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
         inputs={"X": [input]},
         outputs={"Out": [out]},
         attrs={"kernels": fs, "strides": st, "paddings": pd},
+    )
+    return out
+
+
+_PY_FUNC_REGISTRY = {}
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Custom python op (ref nn.py:12191 py_func). TPU-native: lowers to
+    jax.pure_callback — the jitted step calls back to host python with
+    numpy arrays and resumes with the returned arrays (static shapes from
+    the pre-declared `out` vars; -1 dims resolve to the first input's
+    batch dim). backward_func(x..., out..., dout...) supplies the custom
+    VJP; functions live in a process-local registry, so programs using
+    py_func serialize structurally but need the functions re-registered
+    after deserialization."""
+    helper = LayerHelper("py_func", **locals())
+    xs = [x] if isinstance(x, Variable) else list(x)
+    outs = [out] if isinstance(out, Variable) else list(out)
+    for o in outs:
+        if o.shape is None:
+            raise ValueError(
+                "py_func out var '%s' needs a declared shape (the "
+                "callback's result buffer is pre-allocated)" % o.name
+            )
+    skip = set()
+    for v in (skip_vars_in_backward_input or []):
+        skip.add(v.name if isinstance(v, Variable) else str(v))
+    func_id = len(_PY_FUNC_REGISTRY)
+    _PY_FUNC_REGISTRY[func_id] = (func, backward_func, skip)
+    helper.append_op(
+        type="py_func",
+        inputs={"X": xs},
+        outputs={"Out": outs},
+        attrs={
+            "func_id": func_id,
+            "out_shapes": [list(o.shape) for o in outs],
+            "out_dtypes": [str(o.dtype) for o in outs],
+            "x_names": [v.name for v in xs],
+            "out_names": [o.name for o in outs],
+        },
     )
     return out
 

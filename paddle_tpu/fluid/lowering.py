@@ -120,17 +120,45 @@ def run_ops(block, op_list, env, ctx):
         loss_name = bw_op.input("Loss")[0]
         region = op_list[:idx]
 
-        # targets must be bindable at program start (params/feeds/state);
-        # differentiating w.r.t. mid-program intermediates isn't supported
+        # Targets bindable at program start (params/feeds/state) become
+        # plain vjp primals. INTERMEDIATE targets (e.g. a GAN's fake
+        # image) get a zero "probe" added right after their producing op:
+        # d loss/d probe == d loss/d intermediate at that program point
+        # (ref backward.py gradients() supports arbitrary targets).
+        producer = producer_map(region)
+        inter_targets = [n for n in target_names if n not in env0]
+        for n in inter_targets:
+            if n not in producer:
+                raise OpLoweringError(
+                    "backward target '%s' is neither a parameter/feed/"
+                    "state var nor produced before the backward op" % n
+                )
+        probe_at = {}
+        for n in inter_targets:
+            probe_at.setdefault(producer[n], []).append(n)
+
+        probe_shapes = {}
+        if inter_targets:
+            def _shapes_probe():
+                e = dict(env0)
+                for j, rop in enumerate(region):
+                    if rop.type == "backward":
+                        for gn in rop.output("Grads"):
+                            e[gn] = cached_grads[gn]
+                        continue
+                    e = apply_op(rop, e, ctx, var_lookup,
+                                 op_tag=tag_base + j)
+                return tuple(e[n] for n in inter_targets)
+
+            shaped = jax.eval_shape(_shapes_probe)
+            probe_shapes = {
+                n: jnp.zeros(s.shape, s.dtype)
+                for n, s in zip(inter_targets, shaped)
+            }
+
         primals = []
         for n in target_names:
-            if n not in env0:
-                raise OpLoweringError(
-                    "backward target '%s' is not a parameter/feed/state var; "
-                    "differentiating w.r.t. intermediate vars is not "
-                    "supported — pass the producing inputs instead" % n
-                )
-            primals.append(env0[n])
+            primals.append(env0[n] if n in env0 else probe_shapes[n])
 
         # Recompute (ref optimizer.py:3491 RecomputeOptimizer): split the
         # forward region into segments ending at each checkpoint var's
@@ -153,8 +181,11 @@ def run_ops(block, op_list, env, ctx):
 
         def fwd(primal_vals, _region=region, _tn=target_names,
                 _ln=loss_name, _cuts=tuple(cuts)):
+            by_name = dict(zip(_tn, primal_vals))
             e = dict(env0)
-            e.update(zip(_tn, primal_vals))
+            for n, v in by_name.items():
+                if n in env0:
+                    e[n] = v
 
             def run_span(e_in, lo, hi):
                 for j in range(lo, hi):
@@ -162,9 +193,15 @@ def run_ops(block, op_list, env, ctx):
                     if rop.type == "backward":
                         for gn in rop.output("Grads"):
                             e_in[gn] = lax.stop_gradient(cached_grads[gn])
-                        continue
-                    e_in = apply_op(rop, e_in, ctx, var_lookup,
-                                    op_tag=tag_base + j)
+                    else:
+                        e_in = apply_op(rop, e_in, ctx, var_lookup,
+                                        op_tag=tag_base + j)
+                    for n in probe_at.get(j, ()):
+                        # zero probe: identity on the value, carrier of
+                        # d loss/d intermediate for the vjp. Also applies
+                        # to Grads outputs of earlier backward ops so
+                        # grad-of-grad targets work.
+                        e_in[n] = e_in[n] + by_name[n]
                 return e_in
 
             prev = 0
@@ -219,16 +256,23 @@ def op_read_names(op, program):
     return names
 
 
-def segment_cuts(region, cut_var_names):
-    """Indices of ops ending a segment: each cut var's producing op closes
-    its segment. A cut at the final op is dropped (no-op boundary). Shared
-    by the recompute pass and the pipeline executor so stage/segment
-    semantics can't diverge."""
+def producer_map(region):
+    """name -> index of the op producing it (last writer wins). Shared by
+    the recompute cut pass and the gradient probe placement."""
     produce = {}
     for j, rop in enumerate(region):
         for names in rop.outputs.values():
             for n in names:
                 produce[n] = j
+    return produce
+
+
+def segment_cuts(region, cut_var_names):
+    """Indices of ops ending a segment: each cut var's producing op closes
+    its segment. A cut at the final op is dropped (no-op boundary). Shared
+    by the recompute pass and the pipeline executor so stage/segment
+    semantics can't diverge."""
+    produce = producer_map(region)
     cuts = sorted({produce[c] for c in cut_var_names if c in produce})
     if cuts and cuts[-1] == len(region) - 1:
         cuts = cuts[:-1]

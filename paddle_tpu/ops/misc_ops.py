@@ -10,6 +10,110 @@ from jax import lax
 from .registry import register_op, single
 
 
+@register_op("py_func")
+def _py_func(ctx, ins, attrs):
+    """Custom python op (ref operators/py_func_op.cc) via
+    jax.pure_callback: the host function runs outside the XLA module with
+    numpy arrays; backward_func (when given) becomes the custom VJP, also
+    a callback."""
+    import os
+
+    from ..fluid.layers.nn import _PY_FUNC_REGISTRY
+
+    platform = getattr(ctx, "platform", None) or jax.default_backend()
+    if platform == "tpu" and not os.environ.get(
+        "PADDLE_TPU_ALLOW_CALLBACKS"
+    ):
+        # the tunneled axon PJRT runtime rejects host send/recv callbacks
+        # at execution time with an opaque UNIMPLEMENTED; fail at lowering
+        # with guidance instead (cloud TPU runtimes that do support
+        # callbacks can opt in via PADDLE_TPU_ALLOW_CALLBACKS=1)
+        raise NotImplementedError(
+            "py_func executes host python via jax.pure_callback, which "
+            "this TPU runtime does not support — run py_func graphs on "
+            "CPU, rewrite the function with fluid ops, or set "
+            "PADDLE_TPU_ALLOW_CALLBACKS=1 on a runtime with host-callback "
+            "support"
+        )
+    func, backward_func, skip = _PY_FUNC_REGISTRY[attrs["func_id"]]
+    xs = list(ins["X"])
+    out_dtypes = [np.dtype(d) for d in attrs["out_dtypes"]]
+    batch = xs[0].shape[0] if xs and xs[0].ndim else 1
+    out_shapes = []
+    for s in attrs["out_shapes"]:
+        out_shapes.append(tuple(batch if d == -1 else d for d in s))
+    structs = tuple(
+        jax.ShapeDtypeStruct(s, d) for s, d in zip(out_shapes, out_dtypes)
+    )
+
+    def host_fwd(*arrays):
+        res = func(*arrays)
+        if res is None:  # debugging/printing use (ref allows it)
+            res = arrays[: len(structs)]
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        return tuple(
+            np.asarray(r, dtype=d).reshape(s)
+            for r, s, d in zip(res, out_shapes, out_dtypes)
+        )
+
+    if backward_func is None:
+        outs = jax.pure_callback(host_fwd, structs, *xs)
+        return {"Out": list(outs)}
+
+    x_names = attrs["x_names"]
+    out_names = attrs["out_names"]
+
+    @jax.custom_vjp
+    def fwd(*xs_):
+        return jax.pure_callback(host_fwd, structs, *xs_)
+
+    def fwd_fwd(*xs_):
+        outs = jax.pure_callback(host_fwd, structs, *xs_)
+        return outs, (xs_, outs)
+
+    def fwd_bwd(res, gouts):
+        xs_, outs = res
+
+        def host_bwd(*arrays):
+            n_in = len(xs_)
+            n_out = len(outs)
+            call_args = []
+            it = iter(arrays)
+            arr_x = [next(it) for _ in range(n_in)]
+            arr_out = [next(it) for _ in range(n_out)]
+            arr_g = [next(it) for _ in range(n_out)]
+            # ref py_func backward signature: x..., out..., dout...
+            # with skip_vars_in_backward_input removed
+            for name, a in zip(x_names, arr_x):
+                if name not in skip:
+                    call_args.append(a)
+            for name, a in zip(out_names, arr_out):
+                if name not in skip:
+                    call_args.append(a)
+            call_args.extend(arr_g)
+            res_ = backward_func(*call_args)
+            if not isinstance(res_, (tuple, list)):
+                res_ = (res_,)
+            return tuple(
+                np.zeros(x.shape, x.dtype) if r is None
+                else np.asarray(r, x.dtype).reshape(x.shape)
+                for r, x in zip(res_, xs_)
+            )
+
+        gx_structs = tuple(
+            jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs_
+        )
+        gxs = jax.pure_callback(
+            host_bwd, gx_structs, *(list(xs_) + list(outs) + list(gouts))
+        )
+        return tuple(gxs)
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    outs = fwd(*xs)
+    return {"Out": list(outs)}
+
+
 @register_op("isinf_any")
 def _isinf_any(ctx, ins, attrs):
     return single(jnp.any(jnp.isinf(ins["X"][0])))

@@ -162,9 +162,19 @@ class Fleet:
             # params keep their tp/replicated layout — XLA partitions the
             # optimizer update accordingly (reduce-scatter'd in effect).
             # Any degree > 1 shards over the FULL dp axis (GSPMD shards
-            # whole mesh axes; a partial group would need a split axis) —
-            # strictly more memory saving than the requested degree.
-            opt_rules.append(ShardingRule(r".*", P("dp")))
+            # whole mesh axes; a partial group would need a split axis).
+            dp_size = axes.get("dp", 1)
+            if dp_size <= 1:
+                import warnings
+
+                warnings.warn(
+                    "sharding_degree=%d has no effect: the dp mesh axis "
+                    "is size %d (all devices consumed by tp/sp) — "
+                    "optimizer state stays replicated"
+                    % (s.sharding_degree, dp_size)
+                )
+            else:
+                opt_rules.append(ShardingRule(r".*", P("dp")))
         self._distributed_program = DistributedProgram(
             program, self._mesh, param_rules=rules,
             opt_state_rules=opt_rules,

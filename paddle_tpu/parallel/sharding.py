@@ -139,10 +139,8 @@ class DistributedProgram:
                     return NamedSharding(self._mesh, rule.spec)
             if base is not None:
                 return NamedSharding(self._mesh, base)
-        for rule in self._param_rules:
-            if rule.match(name) and _spec_fits(rule.spec, shape, self._mesh):
-                return NamedSharding(self._mesh, rule.spec)
-        return NamedSharding(self._mesh, P())
+        spec = self._param_rule_spec(name, shape)
+        return NamedSharding(self._mesh, spec if spec is not None else P())
 
     def feed_sharding(self, name, shape):
         if name in self._feed_specs:

@@ -1,0 +1,143 @@
+"""gradients() w.r.t. intermediate vars (GAN-style) and py_func."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    yield
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_gradients_wrt_intermediate_matches_manual():
+    """d loss/d h for h = x*w (intermediate), loss = sum(h^2):
+    grad must be 2h, evaluated at the actual forward value."""
+    x = fluid.data(name="x", shape=[3], dtype="float32",
+                   append_batch_size=False)
+    w = fluid.layers.create_parameter([3], "float32", name="gw")
+    h = fluid.layers.elementwise_mul(x, w)          # intermediate
+    loss = fluid.layers.reduce_sum(fluid.layers.square(h))
+    (g_h,) = fluid.gradients(loss, h)
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    xv = np.array([1.0, -2.0, 3.0], "float32")
+    gh, hv = exe.run(feed={"x": xv}, fetch_list=[g_h, h])
+    np.testing.assert_allclose(gh, 2.0 * hv, rtol=1e-5)
+
+
+def test_gradients_gan_style_training():
+    """Classic GAN pattern: generator grads flow through d(D(fake))/d fake
+    computed w.r.t. the intermediate fake tensor."""
+    z = fluid.data(name="z", shape=[4, 8], dtype="float32",
+                   append_batch_size=False)
+    fake = fluid.layers.fc(z, size=16, act="tanh",
+                           param_attr=fluid.ParamAttr(name="gen_w"))
+    d_out = fluid.layers.fc(fake, size=1,
+                            param_attr=fluid.ParamAttr(name="disc_w"))
+    g_loss = fluid.layers.reduce_mean(
+        fluid.layers.sigmoid_cross_entropy_with_logits(
+            d_out,
+            fluid.layers.fill_constant_batch_size_like(
+                d_out, [-1, 1], "float32", 1.0
+            ),
+        )
+    )
+    (g_fake,) = fluid.gradients(g_loss, fake)
+    penalty = fluid.layers.reduce_mean(fluid.layers.square(g_fake))
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    zv = np.random.RandomState(0).rand(4, 8).astype("float32")
+    gf, p = exe.run(feed={"z": zv}, fetch_list=[g_fake, penalty])
+    assert gf.shape == (4, 16)
+    assert np.isfinite(p) and p > 0
+
+
+def test_gradients_of_gradients():
+    """Second-order: d/dg sum(g^2) where g = d loss/d h (regression for
+    the probe skipping backward-op outputs)."""
+    x = fluid.data(name="x", shape=[3], dtype="float32",
+                   append_batch_size=False)
+    w = fluid.layers.create_parameter([3], "float32", name="ggw")
+    h = fluid.layers.elementwise_mul(x, w)
+    loss = fluid.layers.reduce_sum(fluid.layers.square(h))
+    (g_h,) = fluid.gradients(loss, h)          # g = 2h
+    meta = fluid.layers.reduce_sum(fluid.layers.square(g_h))
+    (g_g,) = fluid.gradients(meta, g_h)        # d meta/d g = 2g = 4h
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    xv = np.array([1.0, -2.0, 3.0], "float32")
+    gg, hv = exe.run(feed={"x": xv}, fetch_list=[g_g, h])
+    np.testing.assert_allclose(gg, 4.0 * hv, rtol=1e-5)
+    assert np.any(gg != 0.0)
+
+
+def test_py_func_forward_and_custom_backward():
+    def forward(a):
+        return np.tanh(a)
+
+    def backward(a, out, dout):
+        return dout * (1.0 - out * out)     # d tanh
+
+    x = fluid.data(name="x", shape=[2, 3], dtype="float32",
+                   append_batch_size=False)
+    out_var = fluid.default_main_program().current_block().create_var(
+        name="pyf_out", dtype="float32", shape=(2, 3),
+    )
+    out = fluid.layers.py_func(forward, x, out_var, backward_func=backward)
+    loss = fluid.layers.reduce_sum(out)
+    (gx,) = fluid.gradients(loss, x)
+    exe = _exe()
+    xv = np.array([[0.1, -0.5, 2.0], [0.0, 1.0, -1.5]], "float32")
+    o, g = exe.run(feed={"x": xv}, fetch_list=[out, gx])
+    np.testing.assert_allclose(o, np.tanh(xv), rtol=1e-5)
+    np.testing.assert_allclose(g, 1.0 - np.tanh(xv) ** 2, rtol=1e-5)
+
+
+def test_py_func_multi_io_no_backward():
+    def forward(a, b):
+        return a + b, a * b
+
+    x = fluid.data(name="x", shape=[4], dtype="float32",
+                   append_batch_size=False)
+    y = fluid.data(name="y", shape=[4], dtype="float32",
+                   append_batch_size=False)
+    blk = fluid.default_main_program().current_block()
+    o1 = blk.create_var(name="s_out", dtype="float32", shape=(4,))
+    o2 = blk.create_var(name="p_out", dtype="float32", shape=(4,))
+    outs = fluid.layers.py_func(forward, [x, y], [o1, o2])
+    exe = _exe()
+    xv = np.array([1, 2, 3, 4], "float32")
+    yv = np.array([10, 20, 30, 40], "float32")
+    s, p = exe.run(feed={"x": xv, "y": yv}, fetch_list=list(outs))
+    np.testing.assert_allclose(s, xv + yv)
+    np.testing.assert_allclose(p, xv * yv)
+
+
+def test_py_func_in_training_graph():
+    """py_func with a custom grad participates in a real optimizer step."""
+    x = fluid.data(name="x", shape=[4, 2], dtype="float32",
+                   append_batch_size=False)
+    h = fluid.layers.fc(x, size=2)
+    blk = fluid.default_main_program().current_block()
+    sq = blk.create_var(name="sq_out", dtype="float32", shape=(4, 2))
+    sq = fluid.layers.py_func(
+        lambda a: a * a, h, sq,
+        backward_func=lambda a, out, dout: 2.0 * a * dout,
+    )
+    loss = fluid.layers.reduce_mean(sq)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.random.RandomState(1).rand(4, 2).astype("float32")}
+    losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
+              for _ in range(5)]
+    assert losses[-1] < losses[0]

@@ -216,32 +216,35 @@ def test_dataloader_uses_native_pipe_and_overlaps():
 
     loader = fluid.reader.DataLoader.from_generator(feed_list=[],
                                                     capacity=4)
-    n, prep_s, step_s = 12, 0.02, 0.02
-    prep_total = [0.0]
+    # sleeps sized to dominate scheduler noise on a loaded 1-core box
+    n, prep_s, step_s = 10, 0.05, 0.05
+    prep_times = []
 
     def gen():
         for i in range(n):
             t = time.time()
             time.sleep(prep_s)
-            prep_total[0] += time.time() - t
+            prep_times.append(time.time() - t)
             yield {"x": np.full((128, 16), float(i), np.float32)}
 
     loader.set_batch_generator(gen)
+    it = iter(loader())
+    # first batch pays one-time costs (arena alloc + mlock, thread spinup)
+    # that say nothing about steady-state overlap — exclude from timing
+    vals = [float(next(it)["x"][0, 0])]
     t0 = time.time()
-    vals = []
     step_total = 0.0
-    for batch in loader():
+    for batch in it:
         t = time.time()
         time.sleep(step_s)
         step_total += time.time() - t
         vals.append(float(batch["x"][0, 0]))
     wall = time.time() - t0
     assert vals == [float(i) for i in range(n)]
-    # overlap: wall must beat the MEASURED serial sum (sleeps stretch
-    # under load on the 1-core CI box; both sides stretch together)
-    assert wall < (prep_total[0] + step_total) * 0.9, (
-        wall, prep_total[0], step_total,
-    )
+    # overlap: steady-state wall must beat the MEASURED serial sum
+    # (sleeps stretch under load; both sides stretch together)
+    serial = sum(prep_times[1:]) + step_total
+    assert wall < serial * 0.9, (wall, serial)
 
 
 def test_dataloader_early_exit_and_restart():
