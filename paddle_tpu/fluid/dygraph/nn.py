@@ -14,7 +14,7 @@ __all__ = [
     "Conv2D", "Conv3D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
     "GRUUnit", "LayerNorm", "NCE", "PRelu", "BilinearTensorProduct",
     "Conv2DTranspose", "Conv3DTranspose", "SequenceConv", "RowConv",
-    "GroupNorm", "SpectralNorm", "Dropout",
+    "TreeConv", "GroupNorm", "SpectralNorm", "Dropout",
 ]
 
 
@@ -318,6 +318,59 @@ class RowConv(Layer):
         out = call_op(
             "row_conv", {"X": [input], "Filter": [self.weight]}, {}
         )
+        if self._act:
+            out = call_op(self._act, {"X": [out]})
+        return out
+
+
+class TreeConv(Layer):
+    """ref dygraph/nn.py:2970 TreeConv (TBCNN continuous binary tree) →
+    tree_conv lowering (reachability matmuls)."""
+
+    def __init__(self, name_scope, feature_size=None, output_size=None,
+                 num_filters=1, max_depth=2, act="tanh", param_attr=None,
+                 bias_attr=None, name=None, dtype="float32"):
+        # also accept the 1.7+ signature TreeConv(feature_size, output_size)
+        if output_size is None and isinstance(name_scope, int):
+            feature_size, output_size = name_scope, feature_size
+            name_scope = "tree_conv"
+        super().__init__(name_scope, dtype)
+        self._feature_size = feature_size
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, nodes_vector, edge_set):
+        if self.weight is None:
+            f = self._feature_size or nodes_vector.shape[-1]
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[f, 3, self._output_size, self._num_filters],
+                dtype=self._dtype,
+            )
+            if self._bias_attr:
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr,
+                    shape=[self._num_filters],
+                    dtype=self._dtype,
+                    is_bias=True,
+                )
+        out = call_op(
+            "tree_conv",
+            {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+             "Filter": [self.weight]},
+            {"max_depth": self._max_depth},
+        )
+        if self.bias is not None:
+            out = call_op(
+                "elementwise_add", {"X": [out], "Y": [self.bias]},
+                {"axis": 3},
+            )
         if self._act:
             out = call_op(self._act, {"X": [out]})
         return out

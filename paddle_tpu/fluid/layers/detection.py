@@ -16,6 +16,8 @@ __all__ = [
     "target_assign", "detection_map", "polygon_box_transform",
     "box_decoder_and_assign", "multi_box_head", "retinanet_detection_output",
     "distribute_fpn_proposals", "collect_fpn_proposals",
+    "locality_aware_nms", "generate_proposal_labels",
+    "roi_perspective_transform",
 ]
 
 
@@ -616,6 +618,101 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
     flat = nn.reshape(scores, [-1])
     _, idx = nn.topk(flat, post_nms_top_n)
     return nn.gather(rois, idx)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """EAST locality-aware NMS (ref detection.py:3156): merge pass over
+    row-ordered boxes, then greedy NMS. Static (N, keep_top_k, 6) output
+    with label=-1 padding."""
+    helper = LayerHelper("locality_aware_nms", **locals())
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    if bboxes.shape is not None:
+        out.shape = (bboxes.shape[0], keep_top_k, 6)
+    helper.append_op(
+        type="locality_aware_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "normalized": normalized,
+            "nms_eta": nms_eta,
+            "background_label": background_label,
+        },
+    )
+    return out
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Fast-RCNN roi sampling (ref detection.py:2441), dense static form:
+    every roi (gt boxes appended) gets a label (class / 0 bg / -1
+    unsampled), encoded bbox targets and inside/outside weights —
+    downstream losses mask with the weights instead of gathering.
+    Sampling is deterministic (the reference's use_random=False rule)."""
+    helper = LayerHelper("generate_proposal_labels", **locals())
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference("int32")
+    targets = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    w_in = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    w_out = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+                "ImInfo": [im_info]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [targets],
+                 "BboxInsideWeights": [w_in],
+                 "BboxOutsideWeights": [w_out]},
+        attrs={
+            "batch_size_per_im": batch_size_per_im,
+            "fg_fraction": fg_fraction,
+            "fg_thresh": fg_thresh,
+            "bg_thresh_hi": bg_thresh_hi,
+            "bg_thresh_lo": bg_thresh_lo,
+            "bbox_reg_weights": list(bbox_reg_weights),
+        },
+    )
+    for v in (rois, labels, targets, w_in, w_out):
+        v.stop_gradient = True
+    return rois, labels, targets, w_in, w_out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch_idx=None):
+    """Perspective-warp quad rois (ref detection.py:2360). rois are
+    (R, 8) quads; companion rois_batch_idx (R,) int32 maps each roi to
+    its batch image (LoD → dense)."""
+    helper = LayerHelper("roi_perspective_transform", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if input.shape is not None and rois.shape is not None:
+        out.shape = (rois.shape[0], input.shape[1], transformed_height,
+                     transformed_width)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch_idx is not None:
+        ins["RoisBatchIdx"] = [rois_batch_idx]
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs=ins,
+        outputs={"Out": [out]},
+        attrs={
+            "transformed_height": transformed_height,
+            "transformed_width": transformed_width,
+            "spatial_scale": spatial_scale,
+        },
+    )
+    return out
 
 
 def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,

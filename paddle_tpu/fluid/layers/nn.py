@@ -39,7 +39,7 @@ __all__ = [
     "temporal_shift", "cos_sim", "cross_entropy", "square_error_cost",
     "smooth_l1", "multiplex", "unique", "unique_with_counts", "gelu",
     "elementwise_equal", "flatten_contiguous", "im2sequence", "row_conv",
-    "py_func",
+    "py_func", "tree_conv",
     "one_hot_v2", "shard_index", "hash", "swish", "mish", "unfold",
     "bilinear_tensor_product", "lrn", "shuffle_channel", "dice_loss",
     "log_loss", "kldiv_loss", "npair_loss", "mse_loss", "roi_pool",
@@ -2024,6 +2024,36 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
         attrs={"kernels": fs, "strides": st, "paddings": pd},
     )
     return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution (TBCNN; ref operators/tree_conv_op.h, used
+    by dygraph TreeConv ref dygraph/nn.py:2970). nodes_vector (B, N, F),
+    edge_set (B, E, 2) int32 1-indexed (parent, child); returns
+    (B, N, output_size, num_filters)."""
+    helper = LayerHelper("tree_conv", **locals())
+    dtype = helper.input_dtype("nodes_vector")
+    f = nodes_vector.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[f, 3, output_size, num_filters],
+        dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    if nodes_vector.shape is not None:
+        out.shape = (nodes_vector.shape[0], nodes_vector.shape[1],
+                     output_size, num_filters)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": max_depth},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=3, dim_end=4)
+    return helper.append_activation(pre_act)
 
 
 _PY_FUNC_REGISTRY = {}

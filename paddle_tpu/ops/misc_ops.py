@@ -114,6 +114,85 @@ def _py_func(ctx, ins, attrs):
     return {"Out": list(outs)}
 
 
+@register_op("tree_conv")
+def _tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (ref operators/tree_conv_op.h + math/
+    tree2col.cc, TBCNN continuous binary tree). TPU redesign: the
+    reference's per-node BFS patch walk becomes max_depth reachability
+    matmuls (reach_{d+1} = reach_d @ A) with per-(node, depth) eta
+    coefficients — all MXU work, no host tree traversal.
+
+    NodesVector (B, N, F); EdgeSet (B, E, 2) int32 (parent, child) pairs,
+    1-indexed, zero rows = padding; Filter (F, 3, output_size,
+    num_filters) with dim1 ordered (eta_l, eta_r, eta_t) like tree2col's
+    patch layout. Out (B, N, output_size, num_filters)."""
+    nodes = ins["NodesVector"][0]       # (B, N, F)
+    edges = ins["EdgeSet"][0].astype(jnp.int32)  # (B, E, 2)
+    w = ins["Filter"][0]                # (F, 3, S, M)
+    max_depth = int(attrs.get("max_depth", 2))
+    b, n, f = nodes.shape
+    e = edges.shape[1]
+    fs, _, s_out, m_out = w.shape
+
+    def per_graph(feat, edge):
+        parent = edge[:, 0]
+        child = edge[:, 1]
+        valid = (parent > 0) & (child > 0)
+        p0 = jnp.where(valid, parent - 1, n)     # dump row
+        c0 = jnp.where(valid, child - 1, n)
+        # adjacency with a dump row/col for padded edges
+        adj = jnp.zeros((n + 1, n + 1), nodes.dtype).at[p0, c0].set(
+            jnp.where(valid, 1.0, 0.0)
+        )[:n, :n]
+        # index of each child among its parent's children = 1 + number of
+        # EARLIER edge rows with the same parent (tree2col uses the
+        # child-list order, which is edge-row order)
+        same_parent_before = (
+            (parent[None, :] == parent[:, None])
+            & valid[None, :] & valid[:, None]
+            & (jnp.arange(e)[None, :] < jnp.arange(e)[:, None])
+        )
+        index_e = 1.0 + jnp.sum(same_parent_before, axis=1)
+        pclen_e = jnp.sum(
+            (parent[None, :] == parent[:, None]) & valid[None, :]
+            & valid[:, None],
+            axis=1,
+        ).astype(nodes.dtype)
+        # scatter per-child (index, pclen) to node ids
+        idx_n = jnp.ones((n + 1,), nodes.dtype).at[c0].set(
+            jnp.where(valid, index_e, 1.0))[:n]
+        pcl_n = jnp.ones((n + 1,), nodes.dtype).at[c0].set(
+            jnp.where(valid, pclen_e, 1.0))[:n]
+
+        out = jnp.zeros((n, f * 3), nodes.dtype)
+        reach = jnp.eye(n, dtype=nodes.dtype)
+        for d in range(max_depth):
+            eta_t = (max_depth - d) / max_depth
+            if d == 0:
+                # the root enters its own patch as TreeNode(index=1,
+                # pclen=1) regardless of its position under its parent
+                lfac = jnp.full((n,), 0.5, nodes.dtype)
+            else:
+                lfac = jnp.where(
+                    pcl_n == 1.0, 0.5,
+                    (idx_n - 1.0) / jnp.maximum(pcl_n - 1.0, 1.0),
+                )
+            eta_l = (1.0 - eta_t) * lfac
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            coefs = jnp.stack(
+                [eta_l, eta_r, jnp.full((n,), eta_t, nodes.dtype)], axis=1
+            )                                     # (N, 3)
+            weighted = feat[:, :, None] * coefs[:, None, :]  # (N, F, 3)
+            out = out + reach @ weighted.reshape(n, f * 3)
+            reach = reach @ adj
+        return out
+
+    patches = jax.vmap(per_graph)(nodes, edges)   # (B, N, F*3)
+    wk = w.reshape(fs * 3, s_out * m_out)
+    out = (patches.reshape(b, n, fs * 3) @ wk).reshape(b, n, s_out, m_out)
+    return single(out)
+
+
 @register_op("isinf_any")
 def _isinf_any(ctx, ins, attrs):
     return single(jnp.any(jnp.isinf(ins["X"][0])))

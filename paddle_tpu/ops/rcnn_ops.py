@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .detection_ops import _iou_matrix
 from .registry import register_op, single
 
 
@@ -473,6 +474,256 @@ def _retinanet_detection_output(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register_op("locality_aware_nms")
+def _locality_aware_nms(ctx, ins, attrs):
+    """EAST locality-aware NMS (ref detection/locality_aware_nms_op.cc):
+    pass 1 merges consecutive same-class boxes with IoU > threshold by
+    score-weighted averaging (row order = geometric locality); pass 2 is
+    standard greedy NMS. Static (N, keep_top_k, 6) output like
+    multiclass_nms."""
+    bboxes = ins["BBoxes"][0]   # (N, M, 4)
+    scores = ins["Scores"][0]   # (N, C, M)
+    score_thresh = attrs["score_threshold"]
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", -1)
+    nms_eta = attrs.get("nms_eta", 1.0)
+    normalized = attrs.get("normalized", True)
+    keep_top_k = attrs["keep_top_k"]
+    background = attrs.get("background_label", -1)
+    n, c, m = scores.shape
+    # +1 pixel convention for unnormalized (pixel-coordinate) boxes
+    iou_fn = _iou_matrix if normalized else _iou_xyxy
+
+    def merge_pass(boxes, sc):
+        """Sequential left-to-right merge (the EAST row-order pass)."""
+        if nms_top_k is not None and 0 < nms_top_k < m:
+            kth = lax.top_k(sc, nms_top_k)[0][-1]
+            sc = jnp.where(sc >= kth, sc, -1.0)
+
+        def body(carry, inp):
+            cur_box, cur_score, have = carry
+            box, s = inp
+            valid = s > score_thresh
+            iou = iou_fn(box[None], cur_box[None])[0, 0]
+            mergeable = have & valid & (iou > nms_thresh)
+            w_old = jnp.maximum(cur_score, 1e-12)
+            w_new = jnp.maximum(s, 1e-12)
+            merged_box = (cur_box * w_old + box * w_new) / (w_old + w_new)
+            merged_score = cur_score + s
+            # emit the finished cluster when the new box doesn't merge
+            emit_box = jnp.where(have & valid & ~mergeable, cur_box, 0.0)
+            emit_score = jnp.where(have & valid & ~mergeable, cur_score,
+                                   -1.0)
+            cur_box = jnp.where(
+                mergeable, merged_box, jnp.where(valid, box, cur_box)
+            )
+            cur_score = jnp.where(
+                mergeable, merged_score,
+                jnp.where(valid, s, cur_score),
+            )
+            have = have | valid
+            return (cur_box, cur_score, have), (emit_box, emit_score)
+
+        init = (jnp.zeros((4,), boxes.dtype), jnp.asarray(-1.0, boxes.dtype),
+                jnp.asarray(False))
+        (last_box, last_score, have), (eb, es) = lax.scan(
+            body, init, (boxes, sc)
+        )
+        eb = jnp.concatenate([eb, last_box[None]], axis=0)
+        es = jnp.concatenate(
+            [es, jnp.where(have, last_score, -1.0)[None]], axis=0
+        )
+        return eb, es
+
+    def per_image(boxes, sc_all):
+        all_boxes, all_scores, all_cls = [], [], []
+        for cls in range(c):
+            if cls == background:
+                continue
+            eb, es = merge_pass(boxes, sc_all[cls])
+            all_boxes.append(eb)
+            all_scores.append(es)
+            all_cls.append(jnp.full(es.shape, cls, jnp.int32))
+        flat_box = jnp.concatenate(all_boxes, axis=0)
+        flat_scores = jnp.concatenate(all_scores, axis=0)
+        flat_cls = jnp.concatenate(all_cls, axis=0)
+        total = flat_scores.shape[0]
+
+        def body(carry, _):
+            cur, thresh = carry
+            best = jnp.argmax(cur)
+            best_score = cur[best]
+            best_box = flat_box[best]
+            best_cls = flat_cls[best]
+            ious = iou_fn(best_box[None], flat_box)[0]
+            suppress = ((ious > thresh) & (flat_cls == best_cls)) | (
+                jnp.arange(total) == best
+            )
+            cur = jnp.where(suppress, -1.0, cur)
+            # adaptive NMS: decay the threshold per kept box while > 0.5
+            thresh = jnp.where(
+                (best_score > 0) & (thresh > 0.5) & (nms_eta < 1.0),
+                thresh * nms_eta, thresh,
+            )
+            row = jnp.concatenate(
+                [
+                    jnp.where(best_score > 0, best_cls, -1)[None].astype(
+                        boxes.dtype
+                    ),
+                    jnp.maximum(best_score, 0.0)[None],
+                    jnp.where(best_score > 0, best_box, 0.0),
+                ]
+            )
+            return (cur, thresh), row
+
+        init = (flat_scores, jnp.asarray(nms_thresh, boxes.dtype))
+        _, rows = lax.scan(body, init, None, length=keep_top_k)
+        return rows
+
+    out = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out]}
+
+
+@register_op("generate_proposal_labels")
+def _generate_proposal_labels(ctx, ins, attrs):
+    """Fast-RCNN head sampling (ref detection/generate_proposal_labels_op
+    .cc), dense static form: for every input roi (+ gt boxes appended),
+    labels (fg class / 0 bg / -1 unsampled), per-roi encoded regression
+    targets and inside weights. Sampling is deterministic index-order
+    (use_random=False path)."""
+    rois = ins["RpnRois"][0]            # (N, R, 4)
+    gt_classes = ins["GtClasses"][0].astype(jnp.int32)   # (N, G)
+    is_crowd = ins["IsCrowd"][0]        # (N, G)
+    gt_boxes = ins["GtBoxes"][0]        # (N, G, 4)
+    im_info = ins["ImInfo"][0]          # (N, 3)
+    batch_per_im = attrs.get("batch_size_per_im", 256)
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thresh = attrs.get("fg_thresh", 0.25)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    weights = jnp.asarray(
+        attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2]), jnp.float32
+    )
+    fg_cap = int(batch_per_im * fg_frac)
+
+    def per_image(roi, gt_cls, crowd, gt, info):
+        # gt boxes join the candidate pool (ref appends them); crowd and
+        # zero-padding gt rows are NOT candidates (the reference filters
+        # crowd before sampling — letting them in would label crowd
+        # regions as background and burn bg quota)
+        valid_gt = ((gt[:, 2] - gt[:, 0]) > 0) & (~(crowd > 0))
+        cand = jnp.concatenate([roi, gt], axis=0)            # (R+G, 4)
+        row_valid = jnp.concatenate(
+            [jnp.ones((roi.shape[0],), bool), valid_gt]
+        )
+        iou = _iou_xyxy(cand, gt)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        max_iou = jnp.max(iou, axis=1)
+        argmax_gt = jnp.argmax(iou, axis=1)
+        fg = row_valid & (max_iou >= fg_thresh)
+        bg = row_valid & (max_iou < bg_hi) & (max_iou >= bg_lo)
+        fg_rank = jnp.cumsum(fg.astype(jnp.int32)) - 1
+        fg_keep = fg & (fg_rank < fg_cap)
+        n_fg = jnp.sum(fg_keep.astype(jnp.int32))
+        bg_rank = jnp.cumsum(bg.astype(jnp.int32)) - 1
+        bg_keep = bg & (bg_rank < batch_per_im - n_fg)
+        labels = jnp.where(
+            fg_keep, gt_cls[argmax_gt],
+            jnp.where(bg_keep, 0, -1),
+        ).astype(jnp.int32)
+        matched = gt[argmax_gt]
+        targets = _encode_boxes(cand, matched) / weights
+        w = fg_keep.astype(jnp.float32)[:, None] * jnp.ones((1, 4))
+        return cand, labels, targets * w, w
+
+    rois_o, labels, targets, w = jax.vmap(per_image)(
+        rois, gt_classes, is_crowd, gt_boxes, im_info
+    )
+    return {
+        "Rois": [rois_o],
+        "LabelsInt32": [labels],
+        "BboxTargets": [targets],
+        "BboxInsideWeights": [w],
+        "BboxOutsideWeights": [w],
+    }
+
+
+@register_op("roi_perspective_transform")
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Perspective-warp quad ROIs to a fixed grid (ref detection/
+    roi_perspective_transform_op.cc, EAST OCR): each ROI is 8 coords
+    (x1..y4 clockwise); the exact homography (square -> quad, handles
+    foreshortening) maps output pixels to source points, sampled
+    bilinearly."""
+    x = ins["X"][0]                      # (N, C, H, W)
+    rois = ins["ROIs"][0]                # (R, 8)
+    bidx = (
+        ins["RoisBatchIdx"][0].astype(jnp.int32)
+        if ins.get("RoisBatchIdx")
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    )
+    th = attrs.get("transformed_height", 1)
+    tw = attrs.get("transformed_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def warp_one(quad, bi):
+        q = quad.reshape(4, 2) * scale   # (x, y) clockwise from top-left
+        # TRUE perspective transform (ref get_transform_matrix): the
+        # homography mapping the unit square's corners (0,0),(1,0),(1,1),
+        # (0,1) onto the quad, closed form for a square source. A
+        # ruled-surface blend would only coincide for parallelograms.
+        p0, p1, p2, p3 = q[0], q[1], q[2], q[3]
+        s = p0 - p1 + p2 - p3
+        d1 = p1 - p2
+        d2 = p3 - p2
+        den = d1[0] * d2[1] - d2[0] * d1[1]
+        den = jnp.where(jnp.abs(den) < 1e-12, 1e-12, den)
+        g = (s[0] * d2[1] - d2[0] * s[1]) / den
+        hh = (d1[0] * s[1] - s[0] * d1[1]) / den
+        affine = jnp.all(jnp.abs(s) < 1e-9)
+        g = jnp.where(affine, 0.0, g)
+        hh = jnp.where(affine, 0.0, hh)
+        H = jnp.array(
+            [
+                [p1[0] - p0[0] + g * p1[0], p3[0] - p0[0] + hh * p3[0],
+                 p0[0]],
+                [p1[1] - p0[1] + g * p1[1], p3[1] - p0[1] + hh * p3[1],
+                 p0[1]],
+                [g, hh, 1.0],
+            ]
+        )
+        us = (jnp.arange(tw) + 0.5) / tw
+        vs = (jnp.arange(th) + 0.5) / th
+        ug, vg = jnp.meshgrid(us, vs)    # (th, tw)
+        ones = jnp.ones_like(ug)
+        uv1 = jnp.stack([ug, vg, ones], axis=-1)        # (th, tw, 3)
+        xyw = uv1 @ H.T                                  # (th, tw, 3)
+        px = xyw[..., 0] / xyw[..., 2]
+        py = xyw[..., 1] / xyw[..., 2]
+        x0 = jnp.floor(px).astype(jnp.int32)
+        y0 = jnp.floor(py).astype(jnp.int32)
+        wx = px - x0
+        wy = py - y0
+        img = x[bi]
+
+        def at(yy, xx):
+            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            v = img[:, jnp.clip(yy, 0, h - 1), jnp.clip(xx, 0, w - 1)]
+            return v * inb.astype(img.dtype)
+
+        out = (
+            at(y0, x0) * (1 - wy) * (1 - wx)
+            + at(y0, x0 + 1) * (1 - wy) * wx
+            + at(y0 + 1, x0) * wy * (1 - wx)
+            + at(y0 + 1, x0 + 1) * wy * wx
+        )
+        return out                        # (C, th, tw)
+
+    out = jax.vmap(warp_one)(rois, bidx)
+    return {"Out": [out]}
+
+
 @register_op("detection_map")
 def _detection_map(ctx, ins, attrs):
     """VOC-style mAP (ref detection/detection_map_op.h) over one padded
@@ -507,8 +758,6 @@ def _detection_map(ctx, ins, attrs):
     det_valid = det_label >= 0
 
     # plain (not +1) IoU: detection_map matches SSD-style normalized boxes
-    from .detection_ops import _iou_matrix
-
     def iou_plain(a, b):
         return _iou_matrix(a[None], b)[0]
 

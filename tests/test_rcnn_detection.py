@@ -386,6 +386,160 @@ def test_retinanet_detection_output_basic():
     assert o[0, 2, 0] == -1.0  # padding
 
 
+def test_locality_aware_nms_merges_adjacent():
+    """Two overlapping high-score boxes merge into a weighted average
+    before NMS (the EAST pass); a distant box survives separately."""
+    bb = fluid.data(name="bb", shape=[1, 3, 4], dtype="float32",
+                    append_batch_size=False)
+    sc = fluid.data(name="sc", shape=[1, 1, 3], dtype="float32",
+                    append_batch_size=False)
+    out = fluid.layers.detection.locality_aware_nms(
+        bb, sc, score_threshold=0.1, nms_top_k=3, keep_top_k=2,
+        nms_threshold=0.3,
+    )
+    bbv = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                   "float32")
+    scv = np.array([[[0.8, 0.4, 0.9]]], "float32")
+    o = _exe().run(feed={"bb": bbv, "sc": scv}, fetch_list=[out])[0]
+    assert o.shape == (1, 2, 6)
+    kept = o[0]
+    # merged cluster score = 0.8 + 0.4; boxes averaged by score weight
+    merged_row = kept[np.argmax(kept[:, 1])]
+    np.testing.assert_allclose(merged_row[1], 1.2, atol=1e-5)
+    exp_box = (np.array([0, 0, 10, 10]) * 0.8
+               + np.array([1, 1, 11, 11]) * 0.4) / 1.2
+    np.testing.assert_allclose(merged_row[2:], exp_box, rtol=1e-4)
+    # the distant box is also kept
+    assert any(abs(r[2] - 50) < 1e-3 for r in kept)
+
+
+def test_generate_proposal_labels_dense():
+    r, g = 4, 2
+    rois = fluid.data(name="rois", shape=[1, r, 4], dtype="float32",
+                      append_batch_size=False)
+    gtc = fluid.data(name="gtc", shape=[1, g], dtype="int32",
+                     append_batch_size=False)
+    crowd = fluid.data(name="crowd", shape=[1, g], dtype="int32",
+                       append_batch_size=False)
+    gtb = fluid.data(name="gtb", shape=[1, g, 4], dtype="float32",
+                     append_batch_size=False)
+    info = fluid.data(name="info", shape=[1, 3], dtype="float32",
+                      append_batch_size=False)
+    outs = fluid.layers.detection.generate_proposal_labels(
+        rois, gtc, crowd, gtb, info, batch_size_per_im=6,
+        fg_fraction=0.5, fg_thresh=0.5,
+    )
+    rois_np = np.array([[[0, 0, 10, 10], [30, 30, 50, 50],
+                         [100, 100, 120, 120], [1, 1, 9, 9]]], "float32")
+    gtb_np = np.array([[[0, 0, 10, 10], [30, 30, 50, 50]]], "float32")
+    ro, lab, tgt, w_in, w_out = _exe().run(
+        feed={"rois": rois_np, "gtc": np.array([[3, 5]], "int32"),
+              "crowd": np.zeros((1, g), "int32"),
+              "info": np.array([[200, 200, 1]], "float32"),
+              "gtb": gtb_np},
+        fetch_list=list(outs),
+    )
+    assert ro.shape == (1, r + g, 4)     # gt appended to the roi pool
+    assert lab[0, 0] == 3                # roi 0 matches gt 0 -> class 3
+    assert lab[0, 1] == 5                # roi 1 matches gt 1 -> class 5
+    assert lab[0, 2] == 0                # distant roi -> background
+    # fg rois carry unit weights + finite targets; bg rois zero weights
+    assert np.all(w_in[0, 0] == 1.0) and np.all(w_in[0, 2] == 0.0)
+    assert np.all(np.isfinite(tgt))
+
+
+def test_roi_perspective_transform_identity_quad():
+    """An axis-aligned quad warps to a plain crop-resize."""
+    x = fluid.data(name="x", shape=[1, 1, 8, 8], dtype="float32",
+                   append_batch_size=False)
+    rois = fluid.data(name="rois", shape=[1, 8], dtype="float32",
+                      append_batch_size=False)
+    out = fluid.layers.detection.roi_perspective_transform(
+        x, rois, transformed_height=4, transformed_width=4,
+    )
+    xv = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    # the quad covering [2,6)x[2,6), clockwise from top-left
+    quad = np.array([[2, 2, 6, 2, 6, 6, 2, 6]], "float32")
+    o = _exe().run(feed={"x": xv, "rois": quad}, fetch_list=[out])[0]
+    assert o.shape == (1, 1, 4, 4)
+    # sampling the center of each output cell maps to input rows 2.5..5.5
+    expected00 = xv[0, 0, 2, 2] * 0.25 + xv[0, 0, 2, 3] * 0.25 \
+        + xv[0, 0, 3, 2] * 0.25 + xv[0, 0, 3, 3] * 0.25
+    np.testing.assert_allclose(o[0, 0, 0, 0], expected00, rtol=1e-4)
+
+
+def test_roi_perspective_transform_trapezoid_homography():
+    """A trapezoid quad must warp with true perspective foreshortening:
+    the midline sample point is NOT the uniform (ruled-surface) midpoint."""
+    h = w = 32
+    x = fluid.data(name="x", shape=[1, 2, h, w], dtype="float32",
+                   append_batch_size=False)
+    rois = fluid.data(name="rois", shape=[1, 8], dtype="float32",
+                      append_batch_size=False)
+    out = fluid.layers.detection.roi_perspective_transform(
+        x, rois, transformed_height=8, transformed_width=8,
+    )
+    # gradient image so sampled positions are recoverable from values
+    xv = np.zeros((1, 2, h, w), "float32")
+    xv[0, 0] = np.arange(w, dtype="float32")[None, :]   # channel0 = x pos
+    xv[0, 1] = np.arange(h, dtype="float32")[:, None]   # channel1 = y pos
+    quad = np.array([[4, 4, 28, 4, 24, 20, 8, 20]], "float32")  # trapezoid
+    o = _exe().run(feed={"x": xv, "rois": quad}, fetch_list=[out])[0]
+    # numpy homography oracle (square -> quad, Heckbert closed form)
+    q = quad[0].reshape(4, 2)
+    p0, p1, p2, p3 = q
+    s = p0 - p1 + p2 - p3
+    d1, d2 = p1 - p2, p3 - p2
+    den = d1[0] * d2[1] - d2[0] * d1[1]
+    g = (s[0] * d2[1] - d2[0] * s[1]) / den
+    hh = (d1[0] * s[1] - s[0] * d1[1]) / den
+    H = np.array([
+        [p1[0] - p0[0] + g * p1[0], p3[0] - p0[0] + hh * p3[0], p0[0]],
+        [p1[1] - p0[1] + g * p1[1], p3[1] - p0[1] + hh * p3[1], p0[1]],
+        [g, hh, 1.0],
+    ])
+    for (oy, ox) in [(0, 0), (3, 5), (7, 7), (4, 2)]:
+        u, v = (ox + 0.5) / 8, (oy + 0.5) / 8
+        xyw = H @ np.array([u, v, 1.0])
+        ex, ey = xyw[0] / xyw[2], xyw[1] / xyw[2]
+        np.testing.assert_allclose(o[0, 0, oy, ox], ex, atol=0.02)
+        np.testing.assert_allclose(o[0, 1, oy, ox], ey, atol=0.02)
+
+
+def test_generate_proposal_labels_excludes_crowd_rows():
+    """Crowd gt rows appended to the pool must not become bg samples."""
+    r, g = 2, 2
+    rois = fluid.data(name="crois", shape=[1, r, 4], dtype="float32",
+                      append_batch_size=False)
+    gtc = fluid.data(name="cgtc", shape=[1, g], dtype="int32",
+                     append_batch_size=False)
+    crowd = fluid.data(name="ccrowd", shape=[1, g], dtype="int32",
+                       append_batch_size=False)
+    gtb = fluid.data(name="cgtb", shape=[1, g, 4], dtype="float32",
+                     append_batch_size=False)
+    info = fluid.data(name="cinfo", shape=[1, 3], dtype="float32",
+                      append_batch_size=False)
+    outs = fluid.layers.detection.generate_proposal_labels(
+        rois, gtc, crowd, gtb, info, batch_size_per_im=6, fg_thresh=0.5,
+        fg_fraction=0.5,
+    )
+    _, lab, _, w_in, _ = _exe().run(
+        feed={"crois": np.array([[[0, 0, 10, 10],
+                                  [60, 60, 80, 80]]], "float32"),
+              "cgtc": np.array([[3, 7]], "int32"),
+              "ccrowd": np.array([[0, 1]], "int32"),   # gt1 is crowd
+              "cgtb": np.array([[[0, 0, 10, 10],
+                                 [100, 100, 140, 140]]], "float32"),
+              "cinfo": np.array([[200, 200, 1]], "float32")},
+        fetch_list=list(outs),
+    )
+    # appended rows: index r+0 (real gt -> fg with its class),
+    # r+1 (crowd -> excluded entirely, label -1)
+    assert lab[0, r + 0] == 3
+    assert lab[0, r + 1] == -1
+    assert np.all(w_in[0, r + 1] == 0.0)
+
+
 def test_fpn_distribute_and_collect():
     rois = fluid.data(name="rois", shape=[4, 4], dtype="float32",
                       append_batch_size=False)

@@ -1,0 +1,147 @@
+"""tree_conv vs a numpy oracle implementing the reference BFS+eta
+algorithm (math/tree2col.cc) literally."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    yield
+
+
+def _oracle_tree_conv(feat, edges, w, max_depth):
+    """Literal port of Tree2ColUtil + the patch x filter matmul."""
+    n, f = feat.shape
+    # adjacency as child lists in edge order, 1-indexed nodes
+    tr = {u: [] for u in range(1, n + 1)}
+    for p, c in edges:
+        if p > 0 and c > 0:
+            tr[int(p)].append(int(c))
+
+    def patch_of(root):
+        # DFS with visited, recording (node, index, pclen, depth)
+        patch = [(root, 1, 1, 0)]
+        visited = {root}
+        stack = [(root, 0)]
+        while stack:
+            node, depth = stack[-1]
+            advanced = False
+            kids = tr.get(node, [])
+            for i, v in enumerate(kids):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, depth + 1))
+                    patch.append((v, i + 1, len(kids), depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        return patch
+
+    fs, _, s_out, m_out = w.shape
+    out = np.zeros((n, s_out, m_out), np.float64)
+    for u in range(1, n + 1):
+        row = np.zeros((f, 3), np.float64)
+        for node, index, pclen, depth in patch_of(u):
+            eta_t = (max_depth - depth) / max_depth
+            lfac = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * lfac
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            fv = feat[node - 1]
+            row[:, 0] += eta_l * fv
+            row[:, 1] += eta_r * fv
+            row[:, 2] += eta_t * fv
+        out[u - 1] = np.einsum("fk,fkso->so", row, w)
+    return out
+
+
+def test_tree_conv_matches_bfs_oracle():
+    n, f, s, m, depth = 6, 4, 5, 2, 2
+    rng = np.random.RandomState(0)
+    feat = rng.rand(1, n, f).astype("float32")
+    #       1
+    #      / \
+    #     2   3
+    #    /|   |
+    #   4 5   6
+    edges = np.array(
+        [[[1, 2], [1, 3], [2, 4], [2, 5], [3, 6], [0, 0]]], "int32"
+    )
+    nv = fluid.data(name="nv", shape=[1, n, f], dtype="float32",
+                    append_batch_size=False)
+    es = fluid.data(name="es", shape=[1, 6, 2], dtype="int32",
+                    append_batch_size=False)
+    out = fluid.layers.tree_conv(nv, es, output_size=s, num_filters=m,
+                                 max_depth=depth, act=None,
+                                 bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    import paddle_tpu.fluid.framework as fw
+
+    wname = [
+        v.name
+        for v in fw.default_main_program().global_block().vars.values()
+        if isinstance(v, fw.Parameter)
+    ][0]
+    o = exe.run(feed={"nv": feat, "es": edges}, fetch_list=[out])[0]
+    wv = np.asarray(fluid.global_scope().find_var(wname))
+    oracle = _oracle_tree_conv(feat[0], edges[0], wv, depth)
+    np.testing.assert_allclose(o[0], oracle, rtol=1e-4, atol=1e-6)
+
+
+def test_tree_conv_depth3_and_training():
+    n, f = 5, 3
+    rng = np.random.RandomState(1)
+    feat = rng.rand(2, n, f).astype("float32")
+    edges = np.array(
+        [[[1, 2], [2, 3], [3, 4], [4, 5]],     # a chain
+         [[1, 2], [1, 3], [1, 4], [1, 5]]],    # a star
+        "int32",
+    )
+    nv = fluid.data(name="nv", shape=[2, n, f], dtype="float32",
+                    append_batch_size=False)
+    es = fluid.data(name="es", shape=[2, 4, 2], dtype="int32",
+                    append_batch_size=False)
+    out = fluid.layers.tree_conv(nv, es, output_size=4, num_filters=2,
+                                 max_depth=3, act=None, bias_attr=False)
+    loss = fluid.layers.reduce_mean(fluid.layers.square(out))
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    import paddle_tpu.fluid.framework as fw
+
+    wname = [
+        v.name
+        for v in fw.default_main_program().global_block().vars.values()
+        if isinstance(v, fw.Parameter)
+    ][0]
+    wv = np.asarray(fluid.global_scope().find_var(wname))
+    feed = {"nv": feat, "es": edges}
+    o = exe.run(feed=feed, fetch_list=[out])[0]
+    for g in range(2):
+        oracle = _oracle_tree_conv(feat[g], edges[g], wv, 3)
+        np.testing.assert_allclose(o[g], oracle, rtol=1e-4, atol=1e-6)
+    l0 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    for _ in range(3):
+        l1 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    assert l1 < l0
+
+
+def test_dygraph_tree_conv():
+    with fluid.dygraph.guard():
+        nv = fluid.dygraph.to_variable(
+            np.random.RandomState(2).rand(1, 4, 3).astype("float32")
+        )
+        es = fluid.dygraph.to_variable(
+            np.array([[[1, 2], [1, 3], [3, 4]]], "int32")
+        )
+        m = fluid.dygraph.nn.TreeConv(
+            "tc", feature_size=3, output_size=5, num_filters=2, max_depth=2,
+        )
+        out = m(nv, es)
+        assert out.shape == (1, 4, 5, 2)
