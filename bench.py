@@ -136,8 +136,24 @@ def supervise():
             break
         time.sleep(2)
 
+    last_good_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_last_good.json"
+    )
     try:
         if "json" in child_line:
+            # bank the successful result: the tunneled chip is
+            # intermittently UNAVAILABLE, and a later infra-failed run
+            # should still surface the last real measurement (clearly
+            # labeled) instead of silently reporting 0
+            try:
+                parsed = json.loads(child_line["json"])
+                if parsed.get("value", 0) > 0 and parsed.get(
+                    "detail", {}
+                ).get("backend") not in (None, "cpu"):
+                    with open(last_good_path, "w") as f:
+                        json.dump(parsed, f)
+            except Exception:  # noqa: BLE001
+                pass
             print(child_line["json"], flush=True)
             return 0
 
@@ -153,7 +169,16 @@ def supervise():
             "child exited rc=%s at %.0fs without a result line"
             % (rc, time.time() - t0)
         )
-        print(json.dumps(_compose(status)), flush=True)
+        result = _compose(status)
+        # an infra failure (chip relay UNAVAILABLE) shouldn't erase the
+        # last real measurement — attach it, clearly labeled
+        if result["value"] == 0.0:
+            try:
+                with open(last_good_path) as f:
+                    result["detail"]["last_known_good"] = json.load(f)
+            except Exception:  # noqa: BLE001
+                pass
+        print(json.dumps(result), flush=True)
         return 0
     finally:
         for p in (status_path, status_path + ".tmp"):

@@ -43,7 +43,8 @@ __all__ = [
     "one_hot_v2", "shard_index", "hash", "swish", "mish", "unfold",
     "bilinear_tensor_product", "lrn", "shuffle_channel", "dice_loss",
     "log_loss", "kldiv_loss", "npair_loss", "mse_loss", "roi_pool",
-    "roi_align", "add_position_encoding", "continuous_value_model",
+    "roi_align", "psroi_pool", "prroi_pool", "deformable_conv",
+    "add_position_encoding", "continuous_value_model",
     "fsp_matrix", "data_norm", "filter_by_instag", "group_norm",
     "fused_multihead_attention",
 ]
@@ -2211,6 +2212,107 @@ def mean_iou(input, label, num_classes):
         attrs={"num_classes": num_classes},
     )
     return miou, wrong, correct
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """Position-sensitive ROI pooling for R-FCN (ref nn.py:12409)."""
+    helper = LayerHelper("psroi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if rois.shape is not None:
+        out.shape = (rois.shape[0], output_channels, pooled_height,
+                     pooled_width)
+    helper.append_op(
+        type="psroi_pool",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={
+            "output_channels": output_channels,
+            "spatial_scale": spatial_scale,
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+        },
+    )
+    return out
+
+
+def prroi_pool(input, rois, output_channels=None, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, name=None):
+    """Precise ROI pooling (ref nn.py:12475): integral of the bilinear
+    surface over each bin, differentiable in the roi coordinates."""
+    helper = LayerHelper("prroi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if rois.shape is not None and input.shape is not None:
+        out.shape = (rois.shape[0], input.shape[1], pooled_height,
+                     pooled_width)
+    helper.append_op(
+        type="prroi_pool",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={
+            "spatial_scale": spatial_scale,
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+        },
+    )
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """Deformable convolution v2 (modulated=True) / v1 (ref nn.py:12868):
+    samples at offset-shifted tap positions, optionally mask-modulated."""
+    helper = LayerHelper("deformable_conv", **locals())
+    dtype = helper.input_dtype()
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    filter_size = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    num_channels = input.shape[1]
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[num_filters, num_channels // groups] + filter_size,
+        dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+
+    def _o(i, k, p, s, d):
+        if i in (None, -1):
+            return -1
+        return (i + 2 * p - d * (k - 1) - 1) // s + 1
+
+    if input.shape is not None:
+        out.shape = (
+            input.shape[0], num_filters,
+            _o(input.shape[2], filter_size[0], padding[0], stride[0],
+               dilation[0]),
+            _o(input.shape[3], filter_size[1], padding[1], stride[1],
+               dilation[1]),
+        )
+    ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    if modulated:
+        if mask is None:
+            raise ValueError("deformable_conv(modulated=True) needs a mask")
+        ins["Mask"] = [mask]
+    helper.append_op(
+        type="deformable_conv",
+        inputs=ins,
+        outputs={"Output": [out]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "deformable_groups": deformable_groups,
+        },
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
 
 
 def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0):

@@ -23,6 +23,7 @@ __all__ = [
     "AdadeltaOptimizer", "ModelAverage", "LarsMomentum",
     "LarsMomentumOptimizer", "LambOptimizer", "ExponentialMovingAverage",
     "PipelineOptimizer", "RecomputeOptimizer", "LookaheadOptimizer",
+    "DGCMomentumOptimizer", "DGCMomentum",
 ]
 
 
@@ -241,6 +242,71 @@ class MomentumOptimizer(Optimizer):
             outputs={"ParamOut": [param], "VelocityOut": [velocity]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
         )
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (ref optimizer.py:876,
+    arXiv:1712.01887). The reference sparsifies gradients to cut NCCL
+    bandwidth; on TPU the ICI collectives make that moot, but the
+    OPTIMIZER semantics (momentum correction + local accumulation of
+    untransmitted gradients + rampup sparsity schedule) change training
+    dynamics, so they are reproduced faithfully: top-(1-s) magnitudes
+    update the param now, the rest accumulate locally until large."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "dgc_momentum"
+        self._momentum = momentum
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = max(int(rampup_step), 1)
+        self._sparsity = [float(s) for s in sparsity]
+        self._local_grad_clip_norm = local_grad_clip_norm
+        self._use_nesterov = bool(use_nesterov)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+            self._add_accumulator("dgc_step", p, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        u = self._get_accumulator("dgc_u", param)
+        v = self._get_accumulator("dgc_v", param)
+        step = self._get_accumulator("dgc_step", param)
+        return block.append_op(
+            type="dgc_momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "U": [u],
+                "V": [v],
+                "CurrentStep": [step],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "UOut": [u],
+                "VOut": [v],
+                "StepOut": [step],
+            },
+            attrs={
+                "mu": self._momentum,
+                "rampup_begin_step": self._rampup_begin_step,
+                "rampup_step": self._rampup_step,
+                "sparsity": self._sparsity,
+                "local_grad_clip_norm": (
+                    float(self._local_grad_clip_norm)
+                    if self._local_grad_clip_norm else -1.0
+                ),
+            },
+        )
+
+
+DGCMomentum = DGCMomentumOptimizer
 
 
 class LarsMomentumOptimizer(Optimizer):

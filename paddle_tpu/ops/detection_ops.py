@@ -101,6 +101,173 @@ def _roi_align(ctx, ins, attrs):
     return single(out)
 
 
+@register_op("psroi_pool")
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI pooling (ref detection/psroi_pool_op.h,
+    R-FCN): input channels = output_channels * ph * pw; bin (i, j) of
+    output channel c average-pools input channel c*ph*pw + i*pw + j."""
+    x = ins["X"][0]            # (N, C*ph*pw, H, W)
+    rois = ins["ROIs"][0]      # (R, 4)
+    bidx = _roi_batch_idx(ins, rois.shape[0])
+    out_c = attrs["output_channels"]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c_in, h, w = x.shape
+
+    def pool_one(roi, bi):
+        x1 = roi[0] * scale
+        y1 = roi[1] * scale
+        x2 = roi[2] * scale
+        y2 = roi[3] * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # dense 4-sample grid per bin, averaged (static shapes)
+        gh, gw = ph * 4, pw * 4
+        ys = y1 + (jnp.arange(gh) + 0.5) * rh / gh
+        xs = x1 + (jnp.arange(gw) + 0.5) * rw / gw
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        patch = x[bi][:, yi][:, :, xi]          # (C_in, gh, gw)
+        patch = patch.reshape(c_in, ph, 4, pw, 4).mean(axis=(2, 4))
+        # position-sensitive channel select: out[c, i, j] =
+        # patch[c*ph*pw + i*pw + j, i, j]
+        ci = jnp.arange(out_c)[:, None, None]
+        ii = jnp.arange(ph)[None, :, None]
+        jj = jnp.arange(pw)[None, None, :]
+        chan = ci * ph * pw + ii * pw + jj
+        return patch[chan, ii, jj]
+
+    out = jax.vmap(pool_one)(rois, bidx)
+    return {"Out": [out]}
+
+
+@register_op("prroi_pool")
+def _prroi_pool(ctx, ins, attrs):
+    """Precise ROI pooling (ref detection/prroi_pool_op.h): exact
+    integral of the bilinearly-interpolated feature over each bin —
+    approximated here with a dense 8x8 sample average per bin (the
+    closed-form integral's quadrature; differentiable in the rois)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    bidx = _roi_batch_idx(ins, rois.shape[0])
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    ss = 8  # sub-samples per bin side
+
+    def pool_one(roi, bi):
+        x1 = roi[0] * scale
+        y1 = roi[1] * scale
+        x2 = roi[2] * scale
+        y2 = roi[3] * scale
+        rh = jnp.maximum(y2 - y1, 1e-6)
+        rw = jnp.maximum(x2 - x1, 1e-6)
+        gh, gw = ph * ss, pw * ss
+        ys = y1 + (jnp.arange(gh) + 0.5) * rh / gh - 0.5
+        xs = x1 + (jnp.arange(gw) + 0.5) * rw / gw - 0.5
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        wy = (ys - y0)[:, None]
+        wx = (xs - x0)[None, :]
+        img = x[bi]
+
+        def at(yy, xx):
+            return img[:, jnp.clip(yy, 0, h - 1)][:, :, jnp.clip(xx, 0, w - 1)]
+
+        val = (
+            at(y0, x0) * (1 - wy) * (1 - wx)
+            + at(y0, x0 + 1) * (1 - wy) * wx
+            + at(y0 + 1, x0) * wy * (1 - wx)
+            + at(y0 + 1, x0 + 1) * wy * wx
+        )                                        # (C, gh, gw)
+        return val.reshape(c, ph, ss, pw, ss).mean(axis=(2, 4))
+
+    out = jax.vmap(pool_one)(rois, bidx)
+    return {"Out": [out]}
+
+
+@register_op("deformable_conv")
+def _deformable_conv(ctx, ins, attrs):
+    """Deformable convolution v1/v2 (ref operators/deformable_conv_op.h):
+    per output position and kernel tap, sample the input bilinearly at
+    (p + p_k + delta p_k), optionally modulated (v2); then contract with
+    the weights — the gather/matmul form XLA tiles well, instead of the
+    reference's im2col loop."""
+    x = ins["Input"][0]        # (N, C, H, W)
+    offset = ins["Offset"][0]  # (N, 2*dg*kh*kw, Ho, Wo), (dy, dx) pairs
+    mask = ins["Mask"][0] if ins.get("Mask") else None  # (N, dg*kh*kw, ...)
+    w = ins["Filter"][0]       # (Co, C/g, kh, kw)
+    strides = _pair2(attrs.get("strides", [1, 1]))
+    pads = _pair2(attrs.get("paddings", [1, 1]))
+    dils = _pair2(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    dg = attrs.get("deformable_groups", 1) or 1
+    n, c, h, wd = x.shape
+    co, cg, kh, kw = w.shape
+    ho = (h + 2 * pads[0] - dils[0] * (kh - 1) - 1) // strides[0] + 1
+    wo = (wd + 2 * pads[1] - dils[1] * (kw - 1) - 1) // strides[1] + 1
+
+    def per_image(xi, off, mk):
+        # base sampling grid per tap
+        oy = jnp.arange(ho) * strides[0] - pads[0]
+        ox = jnp.arange(wo) * strides[1] - pads[1]
+        ky = jnp.arange(kh) * dils[0]
+        kx = jnp.arange(kw) * dils[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        off = off.reshape(dg, kh, kw, 2, ho, wo)
+        dy = jnp.moveaxis(off[:, :, :, 0], (1, 2), (3, 4))  # (dg,ho,wo,kh,kw)
+        dx = jnp.moveaxis(off[:, :, :, 1], (1, 2), (3, 4))
+        py = base_y[None] + dy                      # (dg, ho, wo, kh, kw)
+        px = base_x[None] + dx
+        y0 = jnp.floor(py).astype(jnp.int32)
+        x0 = jnp.floor(px).astype(jnp.int32)
+        wy = py - y0
+        wx = px - x0
+        cpd = c // dg                                # channels per dgroup
+        xg = xi.reshape(dg, cpd, h, wd)
+
+        def at(yy, xx):
+            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < wd)
+            yy = jnp.clip(yy, 0, h - 1)
+            xx = jnp.clip(xx, 0, wd - 1)
+            # gather per deformable group
+            v = jax.vmap(lambda img, y_, x_: img[:, y_, x_])(xg, yy, xx)
+            return v * inb[:, None].astype(xi.dtype)  # (dg,cpd,ho,wo,kh,kw)
+
+        val = (
+            at(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+            + at(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+            + at(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+            + at(y0 + 1, x0 + 1) * (wy * wx)[:, None]
+        )
+        if mk is not None:
+            m = jnp.moveaxis(
+                mk.reshape(dg, kh, kw, ho, wo), (1, 2), (3, 4)
+            )
+            val = val * m[:, None]
+        val = val.reshape(c, ho, wo, kh, kw)
+        # grouped contraction with the filter
+        vg = val.reshape(groups, c // groups, ho, wo, kh, kw)
+        wg = w.reshape(groups, co // groups, cg, kh, kw)
+        out = jnp.einsum("gchwkl,gockl->gohw", vg, wg)
+        return out.reshape(co, ho, wo)
+
+    if mask is None:
+        out = jax.vmap(lambda a, b: per_image(a, b, None))(x, offset)
+    else:
+        out = jax.vmap(per_image)(x, offset, mask)
+    return {"Output": [out]}
+
+
+def _pair2(v, k=2):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * k
+
+
 @register_op("box_coder")
 def _box_coder(ctx, ins, attrs):
     """Encode/decode boxes vs priors (ref: detection/box_coder_op.cc)."""

@@ -38,6 +38,64 @@ def _momentum(ctx, ins, attrs):
     return {"ParamOut": [p_new], "VelocityOut": [v_new]}
 
 
+@register_op("dgc_momentum")
+def _dgc_momentum(ctx, ins, attrs):
+    """Deep Gradient Compression momentum (ref operators/optimizers/
+    dgc_momentum_op.h + dgc_op): before rampup_begin_step this is plain
+    momentum; after, the momentum-corrected gradient accumulates locally
+    and only the top-(1-sparsity) magnitudes update the parameter this
+    step (the rest stay banked in V). Sparsity threshold via quantile so
+    the rampup schedule can stay a traced value."""
+    p = ins["Param"][0]
+    g = ins["Grad"][0].astype(p.dtype)
+    u = ins["U"][0]
+    v = ins["V"][0]
+    step = ins["CurrentStep"][0].reshape(())
+    lr = ins["LearningRate"][0].astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    begin = attrs.get("rampup_begin_step", 0)
+    rampup = max(attrs.get("rampup_step", 1), 1)
+    sparsity = jnp.asarray(
+        attrs.get("sparsity", [0.999]), jnp.float32
+    )
+    clip_norm = attrs.get("local_grad_clip_norm", -1.0)
+    if clip_norm and clip_norm > 0:
+        gn = jnp.sqrt(jnp.sum(g * g))
+        g = g * jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+
+    # plain momentum branch (pre-rampup)
+    vel = mu * u + g
+    p_plain = p - lr * vel
+
+    # DGC branch
+    u_new = mu * u + g
+    v_new = v + u_new
+    seg = jnp.clip(
+        ((step - begin) * len(attrs.get("sparsity", [0.999])) // rampup)
+        .astype(jnp.int32),
+        0, len(attrs.get("sparsity", [0.999])) - 1,
+    )
+    s = sparsity[seg]
+    absv = jnp.abs(v_new)
+    thr = jnp.quantile(absv.reshape(-1).astype(jnp.float32), s)
+    mask = (absv >= thr.astype(p.dtype)).astype(p.dtype)
+    transmitted = v_new * mask
+    p_dgc = p - lr * transmitted
+    v_keep = v_new * (1.0 - mask)
+    u_keep = u_new * (1.0 - mask)
+
+    use_dgc = step >= begin
+    p_out = jnp.where(use_dgc, p_dgc, p_plain)
+    u_out = jnp.where(use_dgc, u_keep, vel)
+    v_out = jnp.where(use_dgc, v_keep, v)
+    return {
+        "ParamOut": [p_out],
+        "UOut": [u_out],
+        "VOut": [v_out],
+        "StepOut": [(step + 1).reshape(1)],
+    }
+
+
 @register_op("lars_momentum")
 def _lars_momentum(ctx, ins, attrs):
     p, g, v, lr = (

@@ -1,0 +1,176 @@
+"""deformable_conv, psroi_pool, prroi_pool, DGCMomentum tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    yield
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_deformable_conv_zero_offset_matches_conv2d():
+    """With zero offsets and unit mask, deformable conv == plain conv."""
+    torch = pytest.importorskip("torch")
+    n, c, h, w, co, k = 1, 2, 6, 6, 3, 3
+    x = fluid.data(name="x", shape=[n, c, h, w], dtype="float32",
+                   append_batch_size=False)
+    off = fluid.data(name="off", shape=[n, 2 * k * k, h, w],
+                     dtype="float32", append_batch_size=False)
+    mask = fluid.data(name="mask", shape=[n, k * k, h, w],
+                      dtype="float32", append_batch_size=False)
+    out = fluid.layers.deformable_conv(
+        x, off, mask, num_filters=co, filter_size=k, padding=1,
+        bias_attr=False,
+    )
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    import paddle_tpu.fluid.framework as fw
+
+    wname = [
+        v.name
+        for v in fw.default_main_program().global_block().vars.values()
+        if isinstance(v, fw.Parameter)
+    ][0]
+    xv = np.random.RandomState(0).rand(n, c, h, w).astype("float32")
+    o = exe.run(
+        feed={"x": xv, "off": np.zeros((n, 2 * k * k, h, w), "float32"),
+              "mask": np.ones((n, k * k, h, w), "float32")},
+        fetch_list=[out],
+    )[0]
+    wv = np.asarray(fluid.global_scope().find_var(wname))
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(xv), torch.tensor(wv), padding=1
+    ).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """An integer offset of (0, +1) samples one pixel to the right."""
+    n, c, h, w, k = 1, 1, 5, 5, 1
+    x = fluid.data(name="x", shape=[n, c, h, w], dtype="float32",
+                   append_batch_size=False)
+    off = fluid.data(name="off", shape=[n, 2, h, w], dtype="float32",
+                     append_batch_size=False)
+    mask = fluid.data(name="mask", shape=[n, 1, h, w], dtype="float32",
+                      append_batch_size=False)
+    out = fluid.layers.deformable_conv(
+        x, off, mask, num_filters=1, filter_size=1, padding=0,
+        bias_attr=False,
+        param_attr=fluid.ParamAttr(
+            initializer=fluid.initializer.Constant(1.0)),
+    )
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    xv = np.arange(25, dtype="float32").reshape(1, 1, 5, 5)
+    offv = np.zeros((1, 2, 5, 5), "float32")
+    offv[0, 1] = 1.0      # dx = +1 (offset pairs are (dy, dx))
+    o = exe.run(
+        feed={"x": xv, "off": offv,
+              "mask": np.ones((1, 1, 5, 5), "float32")},
+        fetch_list=[out],
+    )[0]
+    # interior columns shift left by one; the last column samples x=5 (OOB->0)
+    np.testing.assert_allclose(o[0, 0, :, :-1], xv[0, 0, :, 1:], rtol=1e-5)
+    np.testing.assert_allclose(o[0, 0, :, -1], 0.0)
+
+
+def test_psroi_pool_position_sensitive_channels():
+    out_c, ph, pw = 2, 2, 2
+    c_in = out_c * ph * pw
+    x = fluid.data(name="x", shape=[1, c_in, 8, 8], dtype="float32",
+                   append_batch_size=False)
+    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32",
+                      append_batch_size=False)
+    out = fluid.layers.psroi_pool(x, rois, out_c, 1.0, ph, pw)
+    # each input channel is constant = its channel index
+    xv = np.broadcast_to(
+        np.arange(c_in, dtype="float32")[None, :, None, None], (1, c_in, 8, 8)
+    ).copy()
+    o = _exe().run(
+        feed={"x": xv, "rois": np.array([[0, 0, 8, 8]], "float32")},
+        fetch_list=[out],
+    )[0]
+    assert o.shape == (1, out_c, ph, pw)
+    # out[c, i, j] pools channel c*ph*pw + i*pw + j
+    for cc in range(out_c):
+        for i in range(ph):
+            for j in range(pw):
+                assert o[0, cc, i, j] == cc * ph * pw + i * pw + j
+
+
+def test_prroi_pool_constant_region():
+    x = fluid.data(name="x", shape=[1, 1, 8, 8], dtype="float32",
+                   append_batch_size=False)
+    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32",
+                      append_batch_size=False)
+    out = fluid.layers.prroi_pool(x, rois, pooled_height=2, pooled_width=2)
+    xv = np.full((1, 1, 8, 8), 3.0, "float32")
+    o = _exe().run(
+        feed={"x": xv, "rois": np.array([[1, 1, 7, 7]], "float32")},
+        fetch_list=[out],
+    )[0]
+    np.testing.assert_allclose(o, 3.0, rtol=1e-4)
+
+
+class TestDGCMomentum:
+    def _run(self, begin_step, steps=4):
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        fluid.default_startup_program().random_seed = 5
+        x = fluid.data(name="x", shape=[8], dtype="float32")
+        y = fluid.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y)
+        )
+        opt = fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=begin_step,
+            rampup_step=2, sparsity=[0.6, 0.9],
+        )
+        opt.minimize(loss)
+        exe = _exe()
+        exe.run(fluid.default_startup_program())
+        rs = np.random.RandomState(2)
+        feed = {"x": rs.rand(16, 8).astype("float32"),
+                "y": rs.rand(16, 1).astype("float32")}
+        return [float(exe.run(feed=feed, fetch_list=[loss])[0])
+                for _ in range(steps)]
+
+    def test_pre_rampup_matches_plain_momentum(self):
+        """With rampup far away, DGC must behave exactly like Momentum."""
+        dgc = self._run(begin_step=10 ** 6)
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        fluid.default_startup_program().random_seed = 5
+        x = fluid.data(name="x", shape=[8], dtype="float32")
+        y = fluid.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y)
+        )
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+        exe = _exe()
+        exe.run(fluid.default_startup_program())
+        rs = np.random.RandomState(2)
+        feed = {"x": rs.rand(16, 8).astype("float32"),
+                "y": rs.rand(16, 1).astype("float32")}
+        plain = [float(exe.run(feed=feed, fetch_list=[loss])[0])
+                 for _ in range(4)]
+        np.testing.assert_allclose(dgc, plain, rtol=1e-5)
+
+    def test_sparsified_still_converges(self):
+        losses = self._run(begin_step=0, steps=12)
+        assert losses[-1] < losses[0]
+        assert all(np.isfinite(v) for v in losses)
