@@ -23,6 +23,7 @@ from . import framework
 __all__ = [
     "DistributeTranspiler",
     "DistributeTranspilerConfig",
+    "GeoSgdTranspiler",
     "memory_optimize",
     "release_memory",
     "HashName",
@@ -134,6 +135,39 @@ class DistributeTranspiler:
     def get_startup_program(self, endpoint, pserver_program=None,
                             startup_program=None):
         return framework.default_startup_program()
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    """ref transpiler/geo_sgd_transpiler.py GeoSgdTranspiler.
+
+    Geo-SGD runs trainers asynchronously for ``sync_steps`` local updates,
+    then ships parameter DELTAS to pservers — a bandwidth optimization for
+    slow commodity links. On a TPU mesh the premise inverts: ICI makes the
+    per-step synchronous all-reduce (inserted by XLA inside the one
+    compiled module) faster than any delta-staging scheme, and there are
+    no pservers to stage through. This transpiler therefore keeps the
+    geo-SGD API (construction args, transpile, trainer program, the
+    sparse/dense update split) but executes as synchronous data-parallel:
+    the mathematically stronger special case (deltas exchanged every
+    step). The dist lookup-table path maps to vocab-sharded embeddings
+    over 'tp' exactly like DistributeTranspiler."""
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        self._sync_steps = 1
+
+    def transpile(self, trainer_id, program=None,
+                  pservers="127.0.0.1:6174", trainers=1, sync_mode=False,
+                  startup_program=None, current_endpoint="127.0.0.1:6174"):
+        # geo-sgd is async-only in the reference; sync_mode is accepted
+        # and ignored (we are always effectively synchronous — see class
+        # docstring)
+        return super().transpile(
+            trainer_id, program=program, pservers=pservers,
+            trainers=trainers, sync_mode=True,
+            startup_program=startup_program,
+            current_endpoint=current_endpoint,
+        )
 
 
 _mem_note = [False]
