@@ -17,7 +17,7 @@ __all__ = [
     "box_decoder_and_assign", "multi_box_head", "retinanet_detection_output",
     "distribute_fpn_proposals", "collect_fpn_proposals",
     "locality_aware_nms", "generate_proposal_labels",
-    "roi_perspective_transform",
+    "roi_perspective_transform", "generate_mask_labels",
 ]
 
 
@@ -686,6 +686,39 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     for v in (rois, labels, targets, w_in, w_out):
         v.stop_gradient = True
     return rois, labels, targets, w_in, w_out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_segm_lens=None):
+    """Mask-RCNN mask targets (ref detection.py:2568). Dense form:
+    gt_segms is the padded (N, G, P, 2) polygon tensor with per-gt vertex
+    counts in gt_segm_lens (the reference's 2-level LoD polygons);
+    returns (mask_rois, roi_has_mask_int32, mask_int32) with static
+    shapes — mask_int32 rows are -1 for non-foreground rois."""
+    if gt_segm_lens is None:
+        raise ValueError(
+            "generate_mask_labels needs gt_segm_lens (per-gt polygon "
+            "vertex counts; the dense form of the reference's LoD)"
+        )
+    helper = LayerHelper("generate_mask_labels", **locals())
+    mask_rois = helper.create_variable_for_type_inference(rois.dtype)
+    has_mask = helper.create_variable_for_type_inference("int32")
+    mask = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="generate_mask_labels",
+        inputs={"ImInfo": [im_info], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtSegms": [gt_segms],
+                "GtSegmLens": [gt_segm_lens], "Rois": [rois],
+                "LabelsInt32": [labels_int32]},
+        outputs={"MaskRois": [mask_rois],
+                 "RoiHasMaskInt32": [has_mask],
+                 "MaskInt32": [mask]},
+        attrs={"num_classes": num_classes, "resolution": resolution},
+    )
+    for v in (mask_rois, has_mask, mask):
+        v.stop_gradient = True
+    return mask_rois, has_mask, mask
 
 
 def roi_perspective_transform(input, rois, transformed_height,

@@ -150,24 +150,9 @@ class GeoSgdTranspiler(DistributeTranspiler):
     sparse/dense update split) but executes as synchronous data-parallel:
     the mathematically stronger special case (deltas exchanged every
     step). The dist lookup-table path maps to vocab-sharded embeddings
-    over 'tp' exactly like DistributeTranspiler."""
-
-    def __init__(self, config=None):
-        super().__init__(config)
-        self._sync_steps = 1
-
-    def transpile(self, trainer_id, program=None,
-                  pservers="127.0.0.1:6174", trainers=1, sync_mode=False,
-                  startup_program=None, current_endpoint="127.0.0.1:6174"):
-        # geo-sgd is async-only in the reference; sync_mode is accepted
-        # and ignored (we are always effectively synchronous — see class
-        # docstring)
-        return super().transpile(
-            trainer_id, program=program, pservers=pservers,
-            trainers=trainers, sync_mode=True,
-            startup_program=startup_program,
-            current_endpoint=current_endpoint,
-        )
+    over 'tp' exactly like DistributeTranspiler, whose transpile/
+    get_trainer_program this class inherits unchanged (sync_mode is
+    already immaterial there)."""
 
 
 _mem_note = [False]

@@ -648,6 +648,94 @@ def _generate_proposal_labels(ctx, ins, attrs):
     }
 
 
+@register_op("generate_mask_labels")
+def _generate_mask_labels(ctx, ins, attrs):
+    """Mask-RCNN mask targets (ref detection/generate_mask_labels_op.cc):
+    for each foreground roi, rasterize its matched instance polygon into
+    the roi-local resolution x resolution grid. TPU redesign: polygons
+    travel dense-padded (N, G, P, 2) with per-gt vertex counts; the
+    point-in-polygon test is a vectorized ray cast over all pixel centers
+    and edges — no host geometry library. One polygon per instance (the
+    reference's multi-part polygons pre-merge host-side)."""
+    gt_classes = ins["GtClasses"][0].astype(jnp.int32)   # (N, G)
+    is_crowd = ins["IsCrowd"][0]                          # (N, G)
+    gt_segms = ins["GtSegms"][0]                          # (N, G, P, 2)
+    segm_lens = ins["GtSegmLens"][0].astype(jnp.int32)    # (N, G)
+    rois = ins["Rois"][0]                                 # (N, R, 4)
+    labels = ins["LabelsInt32"][0].astype(jnp.int32)      # (N, R)
+    num_classes = attrs["num_classes"]
+    res = attrs["resolution"]
+    n, g, p_max, _ = gt_segms.shape
+    r = rois.shape[1]
+
+    def rasterize(poly, nverts, roi):
+        """(res, res) 0/1 mask of the polygon inside roi-local coords."""
+        x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+        w = jnp.maximum(x2 - x1, 1e-6)
+        h = jnp.maximum(y2 - y1, 1e-6)
+        px = x1 + (jnp.arange(res) + 0.5) * w / res
+        py = y1 + (jnp.arange(res) + 0.5) * h / res
+        gx, gy = jnp.meshgrid(px, py)                     # (res, res)
+        vi = poly                                          # (P, 2)
+        vj = jnp.roll(poly, -1, axis=0)
+        eidx = jnp.arange(p_max)
+        # closing edge connects vertex nverts-1 back to vertex 0
+        vj = jnp.where(
+            (eidx == nverts - 1)[:, None], poly[0][None, :], vj
+        )
+        valid_e = eidx < nverts
+        yi, yj = vi[:, 1], vj[:, 1]
+        xi, xj = vi[:, 0], vj[:, 0]
+        # ray cast to +x: edge crosses the horizontal line of the pixel
+        crosses = (yi[:, None, None] > gy[None]) != (
+            yj[:, None, None] > gy[None]
+        )
+        t = (gy[None] - yi[:, None, None]) / jnp.where(
+            jnp.abs(yj - yi)[:, None, None] < 1e-12,
+            1e-12, (yj - yi)[:, None, None],
+        )
+        x_at = xi[:, None, None] + t * (xj - xi)[:, None, None]
+        hit = crosses & (gx[None] < x_at) & valid_e[:, None, None]
+        inside = jnp.sum(hit.astype(jnp.int32), axis=0) % 2
+        return inside                                      # (res, res)
+
+    def per_image(segms, lens, cls, crowd, roi, lab):
+        valid_gt = (lens >= 3) & (~(crowd > 0))
+        # bbox per gt over its REAL vertices only (padding rows would
+        # otherwise drag the box toward the origin)
+        vmask = (
+            jnp.arange(p_max)[None, :, None] < lens[:, None, None]
+        )
+        lo = jnp.where(vmask, segms, jnp.inf).min(axis=1)
+        hi = jnp.where(vmask, segms, -jnp.inf).max(axis=1)
+        iou = _iou_xyxy(roi, jnp.concatenate([lo, hi], axis=-1))
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        match = jnp.argmax(iou, axis=1)                    # (R,)
+        fg = lab > 0
+
+        def one_roi(rb, m, l, is_fg):
+            mask = rasterize(segms[m], lens[m], rb)        # (res, res)
+            # class-specific slot: channel l gets the mask, others 0;
+            # non-fg rois are all -1 (ignore), like the reference
+            oh = (jnp.arange(num_classes) == l).astype(jnp.int32)
+            full = oh[:, None, None] * mask[None]
+            return jnp.where(is_fg, full, -1)
+
+        masks = jax.vmap(one_roi)(roi, match, lab, fg)
+        return roi, fg.astype(jnp.int32), masks.reshape(
+            r, num_classes * res * res
+        )
+
+    mask_rois, has_mask, mask_int32 = jax.vmap(per_image)(
+        gt_segms, segm_lens, gt_classes, is_crowd, rois, labels
+    )
+    return {
+        "MaskRois": [mask_rois],
+        "RoiHasMaskInt32": [has_mask],
+        "MaskInt32": [mask_int32],
+    }
+
+
 @register_op("roi_perspective_transform")
 def _roi_perspective_transform(ctx, ins, attrs):
     """Perspective-warp quad ROIs to a fixed grid (ref detection/

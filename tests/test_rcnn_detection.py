@@ -540,6 +540,52 @@ def test_generate_proposal_labels_excludes_crowd_rows():
     assert np.all(w_in[0, r + 1] == 0.0)
 
 
+def test_generate_mask_labels_rasterizes_polygon():
+    """A square polygon covering the left half of its roi rasterizes to a
+    half-on mask in the matched class channel; bg rois are all -1."""
+    # P=6 with only 4 real vertices: padding rows must not corrupt the
+    # gt bbox used for roi matching
+    n, g, p, r, res, ncls = 1, 1, 6, 2, 8, 3
+    info = fluid.data(name="minfo", shape=[n, 3], dtype="float32",
+                      append_batch_size=False)
+    gtc = fluid.data(name="mgtc", shape=[n, g], dtype="int32",
+                     append_batch_size=False)
+    crowd = fluid.data(name="mcrowd", shape=[n, g], dtype="int32",
+                       append_batch_size=False)
+    segms = fluid.data(name="msegms", shape=[n, g, p, 2], dtype="float32",
+                       append_batch_size=False)
+    slens = fluid.data(name="mslens", shape=[n, g], dtype="int32",
+                       append_batch_size=False)
+    rois = fluid.data(name="mrois", shape=[n, r, 4], dtype="float32",
+                      append_batch_size=False)
+    labs = fluid.data(name="mlabs", shape=[n, r], dtype="int32",
+                      append_batch_size=False)
+    outs = fluid.layers.detection.generate_mask_labels(
+        info, gtc, crowd, segms, rois, labs, num_classes=ncls,
+        resolution=res, gt_segm_lens=slens,
+    )
+    # polygon = left half of [0,16]x[0,16], zero-padded to 6 vertices
+    poly = np.zeros((1, 1, 6, 2), "float32")
+    poly[0, 0, :4] = [[0, 0], [8, 0], [8, 16], [0, 16]]
+    mr, hm, mk = _exe().run(
+        feed={"minfo": np.array([[32, 32, 1]], "float32"),
+              "mgtc": np.array([[2]], "int32"),
+              "mcrowd": np.zeros((n, g), "int32"),
+              "msegms": poly, "mslens": np.array([[4]], "int32"),
+              "mrois": np.array([[[0, 0, 16, 16],
+                                  [20, 20, 30, 30]]], "float32"),
+              "mlabs": np.array([[2, 0]], "int32")},
+        fetch_list=list(outs),
+    )
+    assert hm[0].tolist() == [1, 0]
+    m = mk[0, 0].reshape(ncls, res, res)
+    # class 2 channel: left half on, right half off
+    np.testing.assert_array_equal(m[2, :, : res // 2], 1)
+    np.testing.assert_array_equal(m[2, :, res // 2:], 0)
+    np.testing.assert_array_equal(m[1], 0)   # other classes empty
+    assert np.all(mk[0, 1] == -1)            # bg roi ignored
+
+
 def test_fpn_distribute_and_collect():
     rois = fluid.data(name="rois", shape=[4, 4], dtype="float32",
                       append_batch_size=False)
