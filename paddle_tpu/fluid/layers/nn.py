@@ -39,7 +39,9 @@ __all__ = [
     "temporal_shift", "cos_sim", "cross_entropy", "square_error_cost",
     "smooth_l1", "multiplex", "unique", "unique_with_counts", "gelu",
     "elementwise_equal", "flatten_contiguous", "im2sequence", "row_conv",
-    "py_func", "tree_conv",
+    "py_func", "tree_conv", "image_resize_short", "similarity_focus",
+    "merge_selected_rows", "get_tensor_from_selected_rows",
+    "deformable_roi_pooling",
     "one_hot_v2", "shard_index", "hash", "swish", "mish", "unfold",
     "bilinear_tensor_product", "lrn", "shuffle_channel", "dice_loss",
     "log_loss", "kldiv_loss", "npair_loss", "mse_loss", "roi_pool",
@@ -2023,6 +2025,110 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
         inputs={"X": [input]},
         outputs={"Out": [out]},
         attrs={"kernels": fs, "strides": st, "paddings": pd},
+    )
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORTER spatial edge equals out_short_len, keeping
+    aspect ratio (ref nn.py image_resize_short). Needs static H/W."""
+    h, w = input.shape[2], input.shape[3]
+    if h in (None, -1) or w in (None, -1):
+        raise ValueError(
+            "image_resize_short needs static spatial dims (XLA shapes "
+            "are fixed at trace time)"
+        )
+    if h < w:
+        out_shape = [out_short_len, int(round(w * out_short_len / h))]
+    else:
+        out_shape = [int(round(h * out_short_len / w)), out_short_len]
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Similarity focus mask (ref nn.py similarity_focus): greedy
+    distinct-row/col maxima of the selected channel slices, broadcast
+    over the focus axis."""
+    helper = LayerHelper("similarity_focus", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type="similarity_focus",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"axis": axis, "indexes": list(indexes)},
+    )
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    """SelectedRows row merge (ref nn.py merge_selected_rows). Gradients
+    here are dense jax arrays (the embedding vjp scatters duplicate rows
+    already), so this is an identity kept for script compatibility."""
+    helper = LayerHelper("merge_selected_rows", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(
+        type="merge_selected_rows", inputs={"X": [x]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows -> dense (ref nn.py): dense already; identity."""
+    helper = LayerHelper("get_tensor_from_selected_rows", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(
+        type="get_tensor_from_selected_rows", inputs={"X": [x]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=[1, 1],
+                           pooled_height=1, pooled_width=1,
+                           part_size=None, sample_per_part=1,
+                           trans_std=0.1, position_sensitive=False,
+                           name=None):
+    """Deformable (PS-)ROI pooling (ref nn.py deformable_roi_pooling):
+    bins sample at learned normalized offsets; position_sensitive selects
+    the psroi channel layout."""
+    helper = LayerHelper("deformable_roi_pooling", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    part_size = part_size or [pooled_height, pooled_width]
+    if position_sensitive:
+        gh = group_size[0] if isinstance(group_size, (list, tuple)) \
+            else group_size
+        gw = group_size[1] if isinstance(group_size, (list, tuple)) \
+            else group_size
+        out_dim = input.shape[1] // (gh * gw)
+    else:
+        out_dim = input.shape[1]
+    if rois.shape is not None:
+        out.shape = (rois.shape[0], out_dim, pooled_height, pooled_width)
+    ins = {"Input": [input], "ROIs": [rois]}
+    if not no_trans and trans is not None:
+        ins["Trans"] = [trans]
+    helper.append_op(
+        type="deformable_psroi_pooling",
+        inputs=ins,
+        outputs={"Output": [out]},
+        attrs={
+            "no_trans": no_trans,
+            "spatial_scale": spatial_scale,
+            "output_dim": out_dim,
+            "group_size": list(group_size)
+            if isinstance(group_size, (list, tuple)) else [group_size] * 2,
+            "pooled_height": pooled_height,
+            "pooled_width": pooled_width,
+            "part_size": list(part_size),
+            "sample_per_part": sample_per_part,
+            "trans_std": trans_std,
+            "position_sensitive": position_sensitive,
+        },
     )
     return out
 

@@ -13,6 +13,7 @@ __all__ = [
     "create_global_var",
     "cast",
     "concat",
+    "tensor_array_to_tensor",
     "sums",
     "assign",
     "fill_constant_batch_size_like",
@@ -115,6 +116,30 @@ def concat(input, axis=0, name=None):
         attrs={"axis": axis},
     )
     return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Concat/stack a LoDTensorArray into one tensor (ref tensor.py
+    tensor_array_to_tensor). Arrays here are build-time entry lists (see
+    control_flow.create_array), so this composes concat/stack directly;
+    also returns the per-entry sizes along axis like the reference."""
+    import numpy as np
+
+    from .nn import stack as _stack
+
+    entries = [v for v in getattr(input, "vars", input) if v is not None]
+    if not entries:
+        raise ValueError("tensor_array_to_tensor: the array is empty")
+    if use_stack:
+        out = _stack(entries, axis=axis)
+        sizes = [1] * len(entries)
+    else:
+        out = concat(entries, axis=axis)
+        sizes = [
+            (v.shape[axis] if v.shape is not None else -1) for v in entries
+        ]
+    out_index = assign(np.asarray(sizes, dtype="int32"))
+    return out, out_index
 
 
 def sums(input, out=None):

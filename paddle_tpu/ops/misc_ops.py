@@ -114,6 +114,168 @@ def _py_func(ctx, ins, attrs):
     return {"Out": list(outs)}
 
 
+@register_op("similarity_focus")
+def _similarity_focus(ctx, ins, attrs):
+    """Similarity focus mask (ref operators/similarity_focus_op.h): for
+    each selected channel slice T (B', C') greedily pick min(B', C')
+    maxima with distinct rows AND columns, OR the picks over indexes,
+    broadcast over the focus axis."""
+    x = ins["X"][0]              # (N, d1, d2, d3)
+    axis = attrs["axis"]
+    indexes = attrs["indexes"]
+    n = x.shape[0]
+    # move the focus axis next to batch: (N, A, B, C)
+    perm = [0, axis] + [d for d in range(1, 4) if d != axis]
+    xt = jnp.transpose(x, perm)
+    b_, c_ = xt.shape[2], xt.shape[3]
+    k = min(b_, c_)
+
+    def mask_of(t):
+        """(B', C') -> greedy distinct-row/col argmax mask."""
+        def body(carry, _):
+            cur, mask = carry
+            idx = jnp.argmax(cur)
+            ri, ci = idx // c_, idx % c_
+            mask = mask.at[ri, ci].set(1.0)
+            cur = jnp.where(jnp.arange(b_)[:, None] == ri, -jnp.inf, cur)
+            cur = jnp.where(jnp.arange(c_)[None, :] == ci, -jnp.inf, cur)
+            return (cur, mask), None
+
+        (_, mask), _ = lax.scan(
+            body, (t.astype(jnp.float32), jnp.zeros((b_, c_))), None,
+            length=k,
+        )
+        return mask
+
+    total = jnp.zeros((n, b_, c_))
+    for ind in indexes:
+        total = jnp.maximum(total, jax.vmap(mask_of)(xt[:, int(ind)]))
+    out = jnp.broadcast_to(total[:, None], xt.shape).astype(x.dtype)
+    inv = [0] * 4
+    for i, d in enumerate(perm):
+        inv[d] = i
+    return single(jnp.transpose(out, inv))
+
+
+@register_op("merge_selected_rows")
+def _merge_selected_rows(ctx, ins, attrs):
+    """SelectedRows duplicate-row merge (ref operators/
+    merge_selected_rows_op): gradients here are DENSE jax arrays (no
+    SelectedRows type — XLA scatters duplicate embedding rows at the
+    vjp), so rows are already merged; identity."""
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("get_tensor_from_selected_rows")
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    """SelectedRows -> dense (ref operators/
+    get_tensor_from_selected_rows_op): dense already; identity."""
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("deformable_psroi_pooling")
+def _deformable_psroi_pooling(ctx, ins, attrs):
+    """Deformable (PS-)ROI pooling (ref operators/deformable_psroi_pooling
+    _op.h): each bin samples at its roi-local position shifted by a
+    learned normalized offset, averaged over sample_per_part^2 bilinear
+    taps; position_sensitive selects the psroi channel."""
+    x = ins["Input"][0]          # (N, C, H, W)
+    rois = ins["ROIs"][0]        # (R, 4)
+    trans = ins["Trans"][0] if ins.get("Trans") else None
+    bidx = (
+        ins["RoisBatchIdx"][0].astype(jnp.int32)
+        if ins.get("RoisBatchIdx")
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    )
+    no_trans = attrs.get("no_trans", False)
+    scale = attrs.get("spatial_scale", 1.0)
+    out_c = attrs.get("output_dim")
+    group = attrs.get("group_size", [1, 1])
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    part = attrs.get("part_size", [ph, pw])
+    spp = max(attrs.get("sample_per_part", 1), 1)
+    trans_std = attrs.get("trans_std", 0.1)
+    pos_sensitive = attrs.get("position_sensitive", True)
+    n, c_in, h, w = x.shape
+    gh, gw = (group if isinstance(group, (list, tuple)) else [group] * 2)
+    part_h, part_w = (
+        part if isinstance(part, (list, tuple)) else [part] * 2
+    )
+
+    def pool_one(roi, bi, tr):
+        x1 = roi[0] * scale - 0.5
+        y1 = roi[1] * scale - 0.5
+        x2 = roi[2] * scale + 0.5
+        y2 = roi[3] * scale + 0.5
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = x[bi]
+        ii = jnp.arange(ph)[:, None]
+        jj = jnp.arange(pw)[None, :]
+        if no_trans or tr is None:
+            dy = jnp.zeros((ph, pw))
+            dx = jnp.zeros((ph, pw))
+        else:
+            pi = jnp.clip((ii * part_h) // ph, 0, part_h - 1)
+            pj = jnp.clip((jj * part_w) // pw, 0, part_w - 1)
+            dy = tr[0, pi, pj] * trans_std * rh
+            dx = tr[1, pi, pj] * trans_std * rw
+
+        def sample(sy, sx):
+            py = y1 + ii * bin_h + (sy + 0.5) * bin_h / spp + dy
+            px = x1 + jj * bin_w + (sx + 0.5) * bin_w / spp + dx
+            # out-of-image taps are SKIPPED (excluded from the count),
+            # matching the reference kernel — clamping-in would bias the
+            # average toward zero at the border
+            ok = (py > -1) & (py < h) & (px > -1) & (px < w)
+            py = jnp.clip(py, 0.0, h - 1.0)
+            px = jnp.clip(px, 0.0, w - 1.0)
+            y0 = jnp.floor(py).astype(jnp.int32)
+            x0 = jnp.floor(px).astype(jnp.int32)
+            wy = py - y0
+            wx = px - x0
+
+            def at(yy, xx):
+                return img[:, jnp.clip(yy, 0, h - 1),
+                           jnp.clip(xx, 0, w - 1)]
+
+            val = (
+                at(y0, x0) * (1 - wy) * (1 - wx)
+                + at(y0, x0 + 1) * (1 - wy) * wx
+                + at(y0 + 1, x0) * wy * (1 - wx)
+                + at(y0 + 1, x0 + 1) * wy * wx
+            )                                    # (C, ph, pw)
+            okf = ok.astype(img.dtype)
+            return val * okf, okf
+
+        acc = jnp.zeros((c_in, ph, pw), x.dtype)
+        cnt = jnp.zeros((ph, pw), x.dtype)
+        for sy in range(spp):
+            for sx in range(spp):
+                v, okf = sample(sy, sx)
+                acc = acc + v
+                cnt = cnt + okf
+        acc = acc / jnp.maximum(cnt, 1.0)
+        if pos_sensitive:
+            gi = jnp.clip((ii * gh) // ph, 0, gh - 1)
+            gj = jnp.clip((jj * gw) // pw, 0, gw - 1)
+            chan = (
+                jnp.arange(out_c)[:, None, None] * gh * gw
+                + gi[None] * gw + gj[None]
+            )
+            return acc[chan, ii[None], jj[None]]
+        return acc[:out_c]
+
+    if trans is None:
+        out = jax.vmap(lambda r_, b_: pool_one(r_, b_, None))(rois, bidx)
+    else:
+        out = jax.vmap(pool_one)(rois, bidx, trans)
+    return {"Output": [out]}
+
+
 @register_op("tree_conv")
 def _tree_conv(ctx, ins, attrs):
     """Tree-based convolution (ref operators/tree_conv_op.h + math/

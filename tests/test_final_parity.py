@@ -1,0 +1,141 @@
+"""Final nn/tensor parity stragglers: similarity_focus, selected-rows
+compat, deformable_roi_pooling, image_resize_short,
+tensor_array_to_tensor."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    yield
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_similarity_focus_reference_example():
+    """The documented example from the reference docstring."""
+    x = fluid.data(name="x", shape=[2, 3, 2, 2], dtype="float32",
+                   append_batch_size=False)
+    out = fluid.layers.similarity_focus(x, axis=1, indexes=[0])
+    xv = np.array(
+        [[[[0.8, 0.1], [0.4, 0.5]],
+          [[0.9, 0.7], [0.9, 0.9]],
+          [[0.8, 0.9], [0.1, 0.2]]],
+         [[[0.2, 0.5], [0.3, 0.4]],
+          [[0.9, 0.7], [0.8, 0.4]],
+          [[0.0, 0.2], [0.4, 0.7]]]],
+        "float32",
+    )
+    o = _exe().run(feed={"x": xv}, fetch_list=[out])[0]
+    expected0 = np.array([[1.0, 0.0], [0.0, 1.0]], "float32")
+    expected1 = np.array([[0.0, 1.0], [1.0, 0.0]], "float32")
+    for c in range(3):
+        np.testing.assert_allclose(o[0, c], expected0)
+        np.testing.assert_allclose(o[1, c], expected1)
+
+
+def test_selected_rows_compat_identity():
+    x = fluid.data(name="x", shape=[4, 3], dtype="float32",
+                   append_batch_size=False)
+    m = fluid.layers.merge_selected_rows(x)
+    t = fluid.layers.get_tensor_from_selected_rows(m)
+    xv = np.random.RandomState(0).rand(4, 3).astype("float32")
+    o = _exe().run(feed={"x": xv}, fetch_list=[t])[0]
+    np.testing.assert_allclose(o, xv)
+
+
+def test_deformable_roi_pooling_zero_trans_matches_avg():
+    """Zero offsets + non-position-sensitive == plain average pooling of
+    the roi bins."""
+    x = fluid.data(name="x", shape=[1, 2, 8, 8], dtype="float32",
+                   append_batch_size=False)
+    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32",
+                      append_batch_size=False)
+    trans = fluid.data(name="trans", shape=[1, 2, 2, 2], dtype="float32",
+                       append_batch_size=False)
+    out = fluid.layers.deformable_roi_pooling(
+        x, rois, trans, pooled_height=2, pooled_width=2,
+        sample_per_part=4, position_sensitive=False,
+    )
+    xv = np.full((1, 2, 8, 8), 5.0, "float32")
+    o = _exe().run(
+        feed={"x": xv, "rois": np.array([[1, 1, 7, 7]], "float32"),
+              "trans": np.zeros((1, 2, 2, 2), "float32")},
+        fetch_list=[out],
+    )[0]
+    assert o.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(o, 5.0, rtol=1e-4)
+
+
+def test_deformable_roi_pooling_position_sensitive():
+    out_c, gh, gw = 2, 2, 2
+    c_in = out_c * gh * gw
+    x = fluid.data(name="x", shape=[1, c_in, 8, 8], dtype="float32",
+                   append_batch_size=False)
+    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32",
+                      append_batch_size=False)
+    trans = fluid.data(name="trans", shape=[1, 2, 2, 2], dtype="float32",
+                       append_batch_size=False)
+    out = fluid.layers.deformable_roi_pooling(
+        x, rois, trans, pooled_height=2, pooled_width=2,
+        group_size=[gh, gw], sample_per_part=2, position_sensitive=True,
+    )
+    xv = np.broadcast_to(
+        np.arange(c_in, dtype="float32")[None, :, None, None],
+        (1, c_in, 8, 8),
+    ).copy()
+    o = _exe().run(
+        feed={"x": xv, "rois": np.array([[0, 0, 8, 8]], "float32"),
+              "trans": np.zeros((1, 2, 2, 2), "float32")},
+        fetch_list=[out],
+    )[0]
+    assert o.shape == (1, out_c, 2, 2)
+    for cc in range(out_c):
+        for i in range(2):
+            for j in range(2):
+                assert o[0, cc, i, j] == cc * gh * gw + i * gw + j
+
+
+def test_image_resize_short():
+    x = fluid.data(name="x", shape=[1, 3, 32, 48], dtype="float32",
+                   append_batch_size=False)
+    out = fluid.layers.image_resize_short(x, 16)
+    xv = np.random.RandomState(1).rand(1, 3, 32, 48).astype("float32")
+    o = _exe().run(feed={"x": xv}, fetch_list=[out])[0]
+    assert o.shape == (1, 3, 16, 24)
+
+
+def test_tensor_array_to_tensor():
+    x = fluid.data(name="x", shape=[2, 3], dtype="float32",
+                   append_batch_size=False)
+    y = fluid.data(name="y", shape=[2, 5], dtype="float32",
+                   append_batch_size=False)
+    arr = fluid.layers.create_array("float32")
+    fluid.layers.array_write(x, 0, arr)
+    fluid.layers.array_write(y, 1, arr)
+    out, idx = fluid.layers.tensor_array_to_tensor(arr, axis=1)
+    xv = np.ones((2, 3), "float32")
+    yv = np.full((2, 5), 2.0, "float32")
+    o, iv = _exe().run(feed={"x": xv, "y": yv}, fetch_list=[out, idx])
+    assert o.shape == (2, 8)
+    np.testing.assert_allclose(o[:, :3], 1.0)
+    np.testing.assert_allclose(o[:, 3:], 2.0)
+    np.testing.assert_array_equal(iv, [3, 5])
+
+    # stacked variant
+    arr2 = fluid.layers.create_array("float32")
+    fluid.layers.array_write(x, 0, arr2)
+    fluid.layers.array_write(x, 1, arr2)
+    out2, idx2 = fluid.layers.tensor_array_to_tensor(
+        arr2, axis=0, use_stack=True
+    )
+    o2 = _exe().run(feed={"x": xv, "y": yv}, fetch_list=[out2])[0]
+    assert o2.shape == (2, 2, 3)
