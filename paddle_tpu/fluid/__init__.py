@@ -61,6 +61,23 @@ from . import inference
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, memory_optimize, release_memory  # noqa: F401
 
+# top-level conveniences/aliases matching the reference fluid namespace
+from .dygraph.tracer import VarBase  # noqa: F401
+from .io import save, load  # noqa: F401
+from .layers import embedding, one_hot  # noqa: F401
+from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401
+from . import clip as dygraph_grad_clip  # noqa: F401  (same classes serve both modes)
+
+import numpy as _np
+
+Tensor = _np.ndarray  # host tensors ARE numpy arrays in this runtime
+
+
+class LoDTensorArray(list):
+    """ref core.LoDTensorArray: a plain list of tensors host-side (the
+    in-graph array type is layers.create_array's build-time list)."""
+
+
 # late op registrations that need fluid internals
 from ..ops import _register_late_modules as _late
 
@@ -75,6 +92,8 @@ __all__ = [
     "optimizer", "regularizer", "clip", "unique_name", "io", "nets",
     "metrics", "DataLoader", "CompiledProgram", "ParallelExecutor",
     "dygraph", "profiler", "contrib", "evaluator", "inference",
+    "VarBase", "Tensor", "LoDTensorArray", "save", "load", "embedding",
+    "one_hot", "learning_rate_decay", "dygraph_grad_clip",
 ]
 
 

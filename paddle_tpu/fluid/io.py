@@ -17,6 +17,7 @@ __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "batch", "save", "load",
+    "load_program_state", "set_program_state",
 ]
 
 
@@ -180,6 +181,35 @@ def load(program, model_path, executor=None, var_list=None):
     for name in names:
         if name in data:
             scope.set(name, np.asarray(data[name]))
+
+
+def load_program_state(model_path, var_list=None):
+    """ref io.py load_program_state: the saved persistables as a plain
+    {name: ndarray} dict, without touching any scope."""
+    data = np.load(model_path + ".pdparams.npz")
+    names = (
+        [v.name if isinstance(v, Variable) else v for v in var_list]
+        if var_list else list(data.files)
+    )
+    return {n: np.asarray(data[n]) for n in names if n in data}
+
+
+def set_program_state(program, state_dict):
+    """ref io.py set_program_state: write a {name: ndarray} dict into the
+    global scope for the program's persistable vars (shape-checked)."""
+    scope = global_scope()
+    for v in program.list_vars():
+        if not v.persistable or v.name not in state_dict:
+            continue
+        arr = np.asarray(state_dict[v.name])
+        if v.shape is not None and all(
+            s not in (None, -1) for s in v.shape
+        ) and tuple(arr.shape) != tuple(v.shape):
+            raise ValueError(
+                "set_program_state: shape mismatch for %r: program says "
+                "%s, state has %s" % (v.name, v.shape, arr.shape)
+            )
+        scope.set(v.name, arr)
 
 
 def batch(reader, batch_size, drop_last=False):
