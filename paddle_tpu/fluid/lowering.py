@@ -301,7 +301,7 @@ def persistable_names(program):
 
 
 def build_step_fn(program, feed_names, fetch_names, is_test=False,
-                  extra_env=None, mesh_axes=None, platform=None):
+                  extra_env=None, mesh_axes=None, platform=None, mesh=None):
     """Return a pure function step(state, feeds, rng) -> (fetches, new_state).
 
     ``state`` / ``feeds`` are dicts name->array. ``new_state`` contains every
@@ -314,7 +314,8 @@ def build_step_fn(program, feed_names, fetch_names, is_test=False,
 
     def step(state, feeds, rng):
         ctx = LowerContext(rng=rng, is_test=is_test, program=program,
-                           mesh_axes=mesh_axes, platform=platform)
+                           mesh_axes=mesh_axes, platform=platform,
+                           mesh=mesh)
         ctx.run_ops = run_ops  # control-flow ops recurse through this
         # names the recompute pass must keep live across jax.checkpoint
         # segment boundaries even if no later op consumes them

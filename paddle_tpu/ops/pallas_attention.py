@@ -630,8 +630,55 @@ def _fused_mha_lowering(ctx, ins, attrs):
         out = flash_attention(
             q, k, v, kpm, seed=seed, causal=causal, dropout_p=p
         )
-    else:
-        out = reference_attention(
-            q, k, v, kpm, causal=causal, dropout_p=p, dropout_rng=key
-        )
+        return single(out)
+
+    # Under an 'sp'-sharded mesh, exact RING attention keeps every chip
+    # holding only its sequence shard of K/V (rotated over ICI via
+    # ppermute) instead of the all-gather the einsum formulation would
+    # cost — the long-context path. Falls back to einsum for kpm/dropout
+    # or non-divisible shapes.
+    sp = ctx.mesh_axes.get("sp")
+    mesh = getattr(ctx, "mesh", None)
+    if (
+        sp is not None
+        and mesh is not None
+        and kpm is None
+        and p == 0.0
+        and q.shape == k.shape
+        and q.shape[2] % mesh.shape[sp] == 0
+    ):
+        from functools import partial
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.ring_attention import ring_attention
+
+        dp = ctx.mesh_axes.get("dp")
+        tp = ctx.mesh_axes.get("tp")
+        dp = dp if dp in mesh.shape else None
+        tp = tp if tp in mesh.shape else None
+        if dp is not None and q.shape[0] % mesh.shape[dp] != 0:
+            dp = None
+        if tp is not None and q.shape[1] % mesh.shape[tp] != 0:
+            tp = None
+        # q/k/v are (B, H, T, D); ring_attention wants (B, T, H, D)
+        spec = P(dp, tp, sp, None)
+
+        def body(q_, k_, v_):
+            qt = jnp.moveaxis(q_, 1, 2)
+            kt = jnp.moveaxis(k_, 1, 2)
+            vt = jnp.moveaxis(v_, 1, 2)
+            ot = ring_attention(qt, kt, vt, axis_name=sp, causal=causal)
+            return jnp.moveaxis(ot, 2, 1)
+
+        out = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_rep=False,
+        )(q, k, v)
+        return single(out)
+
+    out = reference_attention(
+        q, k, v, kpm, causal=causal, dropout_p=p, dropout_rng=key
+    )
     return single(out)

@@ -48,7 +48,7 @@ class LowerContext:
     """Carries trace-time state through a block lowering."""
 
     def __init__(self, rng=None, is_test=False, mesh_axes=None, program=None,
-                 platform=None):
+                 platform=None, mesh=None):
         self._rng = rng
         self._rng_count = 0
         self._op_tag = 0
@@ -58,6 +58,7 @@ class LowerContext:
         self._iter_token = None
         self.is_test = is_test
         self.mesh_axes = mesh_axes or {}  # logical axis name -> mesh axis
+        self.mesh = mesh  # the jax Mesh when lowering an SPMD program
         self.program = program
         # target platform of the computation ('cpu'/'tpu'); lowerings that
         # pick platform-specific kernels (pallas) must use this, NOT

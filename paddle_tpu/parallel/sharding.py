@@ -213,6 +213,7 @@ class DistributedProgram:
             step = build_step_fn(
                 program, list(feed_arrays), fetch_names,
                 mesh_axes={a: a for a in self._mesh.axis_names},
+                mesh=self._mesh,
             )
             entry = jax.jit(step, donate_argnums=(0,))
             self._cache[sig] = entry

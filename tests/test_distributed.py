@@ -241,6 +241,65 @@ def test_fleet_zero_shards_optimizer_state():
         assert sh is not None and "dp" in str(sh.spec), (name, sh)
 
 
+def test_fused_attention_rides_ring_under_sp_mesh():
+    """fused_multihead_attention through a dp x sp DistributedProgram
+    must route to ring attention (exact) — output matches the
+    single-device run bit-for-tolerance."""
+    import paddle_tpu.fluid.framework as fw
+    from paddle_tpu.fluid import unique_name
+
+    b, hds, t, d = 2, 2, 16, 8
+    rng = np.random.RandomState(0)
+    qv = rng.rand(b, hds, t, d).astype("float32")
+    kv = rng.rand(b, hds, t, d).astype("float32")
+    vv = rng.rand(b, hds, t, d).astype("float32")
+
+    def build():
+        fw.switch_main_program(fw.Program())
+        fw.switch_startup_program(fw.Program())
+        unique_name.switch()
+        q = fluid.data("aq", [b, hds, t, d], dtype="float32",
+                       append_batch_size=False)
+        k = fluid.data("ak", [b, hds, t, d], dtype="float32",
+                       append_batch_size=False)
+        v = fluid.data("av", [b, hds, t, d], dtype="float32",
+                       append_batch_size=False)
+        out = fluid.layers.fused_multihead_attention(q, k, v, causal=True)
+        return out
+
+    out = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"aq": qv, "ak": kv, "av": vv}
+    single = exe.run(feed=feed, fetch_list=[out])[0]
+
+    out2 = build()
+    mesh = build_mesh({"dp": 2, "sp": 4})
+    dist = DistributedProgram(
+        fluid.default_main_program(), mesh,
+        feed_specs={"aq": P("dp", None, "sp", None),
+                    "ak": P("dp", None, "sp", None),
+                    "av": P("dp", None, "sp", None)},
+    )
+    # prove the RING path engaged (the test would pass via plain GSPMD
+    # einsum too): count ring_attention trace-time invocations
+    from paddle_tpu.parallel import ring_attention as ra_mod
+
+    calls = []
+    orig = ra_mod.ring_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    ra_mod.ring_attention = spy
+    try:
+        ringed = exe.run(dist, feed=feed, fetch_list=[out2])[0]
+    finally:
+        ra_mod.ring_attention = orig
+    assert calls, "sp-sharded fused attention did not route to ring"
+    np.testing.assert_allclose(ringed, single, rtol=2e-4, atol=2e-5)
+
+
 def test_zero_merges_with_tp_layout():
     """Moments of tp-sharded params keep tp AND gain the dp axis."""
     from jax.sharding import PartitionSpec as P2
