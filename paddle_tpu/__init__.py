@@ -12,6 +12,8 @@ from .reader_utils import batch  # noqa: F401  paddle.batch
 from . import fluid  # noqa: F401
 from . import dataset  # noqa: F401
 from . import distributed  # noqa: F401
+from . import compat  # noqa: F401
+from . import sysconfig  # noqa: F401
 
 __version__ = "0.1.0"
 

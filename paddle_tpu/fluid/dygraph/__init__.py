@@ -22,11 +22,31 @@ from . import parallel
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import *  # noqa: F401,F403
+from . import container
+from .container import Sequential  # noqa: F401
+from . import backward_strategy
+from .backward_strategy import BackwardStrategy  # noqa: F401
+from .tracer import Tracer  # noqa: F401
+
+
+def start_gperf_profiler():
+    """ref dygraph/profiler.py; delegates to the jax-profiler wrapper."""
+    from ..profiler import start_profiler
+
+    start_profiler("All")
+
+
+def stop_gperf_profiler():
+    from ..profiler import stop_profiler
+
+    stop_profiler()
 
 __all__ = (
     ["enabled", "guard", "no_grad", "to_variable", "Layer", "VarBase",
      "save_dygraph", "load_dygraph", "TracedLayer", "DataParallel",
-     "ParallelEnv", "prepare_context"]
+     "ParallelEnv", "prepare_context", "Sequential",
+     "BackwardStrategy", "Tracer", "start_gperf_profiler",
+     "stop_gperf_profiler"]
     + nn.__all__
     + learning_rate_scheduler.__all__
 )

@@ -1,0 +1,12 @@
+"""Backward configuration (ref: python/paddle/fluid/dygraph/
+backward_strategy.py). The vjp-based tape always sums gradients
+deterministically in program order, so sort_sum_gradient is recorded but
+changes nothing (it existed to make the reference's accumulation order
+deterministic — already guaranteed here)."""
+
+__all__ = ["BackwardStrategy"]
+
+
+class BackwardStrategy:
+    def __init__(self):
+        self.sort_sum_gradient = False

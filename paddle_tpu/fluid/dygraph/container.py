@@ -1,0 +1,29 @@
+"""Layer containers (ref: python/paddle/fluid/dygraph/container.py)."""
+from .layers import Layer
+
+__all__ = ["Sequential"]
+
+
+class Sequential(Layer):
+    """Chain of sublayers applied in order (ref container.py Sequential).
+    Accepts layers positionally or as (name, layer) pairs; indexable."""
+
+    def __init__(self, *layers):
+        super().__init__("sequential")
+        for i, item in enumerate(layers):
+            if isinstance(item, (list, tuple)):
+                name, layer = item
+            else:
+                name, layer = str(i), item
+            self.add_sublayer(name, layer)
+
+    def __getitem__(self, i):
+        return list(self._sub_layers.values())[i]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
