@@ -339,7 +339,24 @@ def child_main(status_path):
         # reliable override in this environment, config.update is
         jax.config.update("jax_platforms", "cpu")
 
-    devs = jax.devices()
+    # the tunneled relay is intermittent and can fail fast with
+    # UNAVAILABLE; retry through half the supervisor's window (a hang is
+    # handled by the supervisor's deadline kill, not here)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            devs = jax.devices()
+            break
+        except RuntimeError as e:
+            st.error("init attempt %d: %s" % (attempt, str(e)[:160]))
+            if time.time() - t0 > DEADLINE_S * 0.5:
+                raise
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(60)
     backend = devs[0].platform
     device_kind = getattr(devs[0], "device_kind", "") or os.environ.get(
         "PALLAS_AXON_TPU_GEN", ""
