@@ -12,12 +12,23 @@ _lib = None
 _tried = False
 
 
-def _compile():
+def _compile(lib_path=None, extra_flags=()):
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", _LIB_PATH,
+        *extra_flags, _SRC, "-o", lib_path or _LIB_PATH,
     ]
     subprocess.run(cmd, check=True, capture_output=True)
+
+
+def build_tsan():
+    """Race-detection build of the native runtime (aux subsystem: the
+    reference's CI runs its C++ under sanitizers; here
+    -fsanitize=thread covers the slot ring + worker pool). Returns the
+    .so path; load it in a TSAN_OPTIONS-configured process to check for
+    data races in the pipe/queue/arena paths."""
+    path = _LIB_PATH.replace(".so", "_tsan.so")
+    _compile(path, ("-fsanitize=thread", "-O1", "-g"))
+    return path
 
 
 def load_native():

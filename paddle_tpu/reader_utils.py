@@ -96,7 +96,16 @@ def compose(*readers, **kwargs):
     def reader():
         rs = [r() for r in readers]
         if check_alignment:
-            for outputs in zip(*rs):
+            _end = object()
+            for outputs in itertools.zip_longest(*rs, fillvalue=_end):
+                if any(o is _end for o in outputs):
+                    if all(o is _end for o in outputs):
+                        return
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned (different "
+                        "lengths); pass check_alignment=False to zip the "
+                        "shorter length"
+                    )
                 yield sum(list(map(make_tuple, outputs)), ())
         else:
             for outputs in itertools.zip_longest(*rs):
@@ -142,3 +151,43 @@ def cache(reader):
             yield d
 
     return cache_reader
+
+
+class ComposeNotAligned(ValueError):
+    """Raised by compose(check_alignment=True) when component readers
+    yield different numbers of samples (ref reader/decorator.py)."""
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """ref reader/decorator.py multiprocess_reader. Thread-based here:
+    the payloads are numpy batches, and the producers' work (IO, numpy
+    prep, the C++ staging pipe) releases the GIL, so threads deliver the
+    overlap without fork()ing a jax-initialized process (unsafe: the TPU
+    client does not survive fork)."""
+    import queue as _q
+    import threading
+
+    def reader():
+        out = _q.Queue(maxsize=queue_size)
+        alive = [len(readers)]
+        lock = threading.Lock()
+
+        def pump(r):
+            try:
+                for item in r():
+                    out.put(item)
+            finally:
+                with lock:
+                    alive[0] -= 1
+                    if alive[0] == 0:
+                        out.put(None)
+
+        for r in readers:
+            threading.Thread(target=pump, args=(r,), daemon=True).start()
+        while True:
+            item = out.get()
+            if item is None:
+                return
+            yield item
+
+    return reader
