@@ -1,0 +1,200 @@
+"""The reference's `tests/book` chapters (ref python/paddle/fluid/tests/
+book/) as mini end-to-end programs: the canonical fluid usage patterns —
+regression, digits, word2vec n-gram, sentiment LSTM, recommender
+embeddings, seq2seq NMT — each built through the same layer calls as the
+reference chapter and trained until the loss drops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 3
+    yield
+
+
+def _exe():
+    e = fluid.Executor(fluid.CPUPlace())
+    return e
+
+
+def _train(loss, feeder, steps=12, lr=0.05, opt=None):
+    (opt or fluid.optimizer.Adam(learning_rate=lr)).minimize(loss)
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    losses = [float(exe.run(feed=feeder(i), fetch_list=[loss])[0])
+              for i in range(steps)]
+    assert all(np.isfinite(v) for v in losses), losses
+    assert min(losses[-3:]) < losses[0], losses
+    return exe, losses
+
+
+def test_book_fit_a_line():
+    """ch1: linear regression on uci_housing (ref test_fit_a_line.py)."""
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+
+    data = list(paddle.dataset.uci_housing.train()())[:64]
+    xs = np.asarray([d[0] for d in data], "float32")
+    ys = np.asarray([d[1] for d in data], "float32").reshape(-1, 1)
+
+    _train(avg_cost, lambda i: {"x": xs, "y": ys},
+           opt=fluid.optimizer.SGD(learning_rate=0.01))
+
+
+def test_book_recognize_digits_conv():
+    """ch2: LeNet-ish conv net on mnist (ref test_recognize_digits.py)."""
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=6, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=16, pool_size=2,
+        pool_stride=2, act="relu")
+    prediction = fluid.layers.fc(input=conv_pool_2, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+
+    data = list(paddle.dataset.mnist.train()())[:64]
+    xs = np.asarray([d[0] for d in data], "float32").reshape(-1, 1, 28, 28)
+    ys = np.asarray([d[1] for d in data], "int64").reshape(-1, 1)
+    exe, _ = _train(avg_cost, lambda i: {"img": xs, "label": ys}, lr=5e-3)
+    a = exe.run(feed={"img": xs, "label": ys}, fetch_list=[acc])[0]
+    assert 0.0 <= float(a) <= 1.0
+
+
+def test_book_word2vec_ngram():
+    """ch4: n-gram word embedding model (ref test_word2vec.py)."""
+    dict_size, emb = 200, 16
+    words = []
+    for nm in ["firstw", "secondw", "thirdw", "forthw", "nextw"]:
+        words.append(
+            fluid.layers.data(name=nm, shape=[1], dtype="int64"))
+    embeds = [
+        fluid.layers.embedding(
+            input=w, size=[dict_size, emb],
+            param_attr=fluid.ParamAttr(name="shared_w"),
+        )
+        for w in words[:4]
+    ]
+    concat = fluid.layers.concat(input=embeds, axis=-1)
+    concat = fluid.layers.reshape(concat, [-1, 4 * emb])
+    hidden1 = fluid.layers.fc(input=concat, size=64, act="sigmoid")
+    predict = fluid.layers.fc(input=hidden1, size=dict_size, act="softmax")
+    cost = fluid.layers.cross_entropy(input=predict, label=words[4])
+    avg_cost = fluid.layers.mean(cost)
+
+    rng = np.random.RandomState(0)
+    seq = rng.randint(0, dict_size, size=512)
+
+    def feeder(i):
+        starts = rng.randint(0, len(seq) - 5, size=32)
+        grams = np.stack([seq[s:s + 5] for s in starts])
+        return {
+            "firstw": grams[:, 0:1].astype("int64"),
+            "secondw": grams[:, 1:2].astype("int64"),
+            "thirdw": grams[:, 2:3].astype("int64"),
+            "forthw": grams[:, 3:4].astype("int64"),
+            "nextw": grams[:, 4:5].astype("int64"),
+        }
+
+    _train(avg_cost, feeder, steps=15, lr=0.02)
+
+
+def test_book_understand_sentiment_lstm():
+    """ch6: sentiment classification with an LSTM over padded sequences
+    (ref notest_understand_sentiment.py stacked-lstm net)."""
+    seq_len, dict_dim, emb_dim, hid = 24, 300, 24, 32
+    data = fluid.layers.data(name="words", shape=[seq_len], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(input=data, size=[dict_dim, emb_dim])
+    fc1 = fluid.layers.fc(input=emb, size=hid * 4, num_flatten_dims=2)
+    lstm1, _ = fluid.layers.dynamic_lstm(input=fc1, size=hid * 4)
+    last = fluid.layers.sequence_last_step(lstm1)
+    prediction = fluid.layers.fc(input=last, size=2, act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+
+    rng = np.random.RandomState(1)
+    n = 32
+    xs = rng.randint(1, dict_dim, size=(n, seq_len)).astype("int64")
+    lens = rng.randint(5, seq_len + 1, size=n).astype("int32")
+    # planted signal: positive samples use the low half of the vocab
+    ys = (xs[:, 0] > dict_dim // 2).astype("int64").reshape(-1, 1)
+    xs[ys[:, 0] == 1] = xs[ys[:, 0] == 1] % (dict_dim // 2) + 1
+
+    _train(avg_cost,
+           lambda i: {"words": xs, "words@SEQ_LEN": lens, "label": ys},
+           steps=15, lr=0.02)
+
+
+def test_book_recommender_system():
+    """ch5: wide&deep-style user/item embedding dot model (ref
+    test_recommender_system.py, simplified to its core pattern)."""
+    n_users, n_items, emb = 100, 80, 16
+    uid = fluid.layers.data(name="uid", shape=[1], dtype="int64")
+    iid = fluid.layers.data(name="iid", shape=[1], dtype="int64")
+    score = fluid.layers.data(name="score", shape=[1], dtype="float32")
+    u = fluid.layers.embedding(input=uid, size=[n_users, emb])
+    it = fluid.layers.embedding(input=iid, size=[n_items, emb])
+    u = fluid.layers.reshape(u, [-1, emb])
+    it = fluid.layers.reshape(it, [-1, emb])
+    uf = fluid.layers.fc(input=u, size=emb)
+    itf = fluid.layers.fc(input=it, size=emb)
+    sim = fluid.layers.cos_sim(X=uf, Y=itf)
+    pred = fluid.layers.scale(sim, scale=5.0)
+    cost = fluid.layers.square_error_cost(input=pred, label=score)
+    avg_cost = fluid.layers.mean(cost)
+
+    rng = np.random.RandomState(2)
+    n = 64
+    us = rng.randint(0, n_users, size=(n, 1)).astype("int64")
+    its = rng.randint(0, n_items, size=(n, 1)).astype("int64")
+    sc = ((us * 7 + its * 3) % 5 + 1).astype("float32")
+
+    _train(avg_cost,
+           lambda i: {"uid": us, "iid": its, "score": sc},
+           steps=15, lr=0.05)
+
+
+def test_book_machine_translation_seq2seq():
+    """ch7: encoder-decoder NMT with attention via the model zoo (ref
+    test_machine_translation.py); trains on wmt14's synthetic pairs."""
+    from paddle_tpu.models import transformer_nmt
+
+    cfg = transformer_nmt.NMTConfig(
+        src_vocab=120, tgt_vocab=120, hidden=32, heads=2, enc_layers=1,
+        dec_layers=1, ffn=64, max_len=16, dropout=0.0,
+    )
+    vs = transformer_nmt.build_transformer_nmt(cfg, 16, 16)
+    data = list(paddle.dataset.wmt14.train(120)())[:32]
+    src = np.full((32, 16), cfg.pad_id, "int64")
+    trg_in = np.full((32, 16), cfg.pad_id, "int64")
+    trg_out = np.full((32, 16), cfg.pad_id, "int64")
+    src_lens = np.zeros(32, "int32")
+    trg_lens = np.zeros(32, "int32")
+    for i, (s, t_in, t_out) in enumerate(data):
+        src[i, :min(16, len(s))] = s[:16]
+        trg_in[i, :min(16, len(t_in))] = t_in[:16]
+        trg_out[i, :min(16, len(t_out))] = t_out[:16]
+        src_lens[i] = min(16, len(s))
+        trg_lens[i] = min(16, len(t_in))
+
+    _train(vs["loss"],
+           lambda i: {"src_ids": src, "src_ids@SEQ_LEN": src_lens,
+                      "tgt_ids": trg_in, "tgt_ids@SEQ_LEN": trg_lens,
+                      "tgt_labels": trg_out},
+           steps=12, lr=3e-3)
