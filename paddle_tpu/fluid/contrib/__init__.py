@@ -1,5 +1,13 @@
 """fluid.contrib (ref: python/paddle/fluid/contrib)."""
 from . import mixed_precision
 from .mixed_precision import decorate as mixed_precision_decorate  # noqa: F401
+from . import quant  # noqa: F401
+from . import utils_stat
+from .utils_stat import memory_usage, op_freq_statistic, summary  # noqa: F401
+from . import extend_optimizer
+from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
 
-__all__ = ["mixed_precision"]
+__all__ = [
+    "mixed_precision", "quant", "memory_usage", "op_freq_statistic",
+    "summary", "extend_with_decoupled_weight_decay",
+]

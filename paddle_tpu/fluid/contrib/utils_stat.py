@@ -1,0 +1,66 @@
+"""Program introspection utilities (ref: fluid/contrib/
+memory_usage_calc.py, op_frequence.py, model_stat.py)."""
+import collections
+
+import numpy as np
+
+from .. import core
+
+__all__ = ["memory_usage", "op_freq_statistic", "summary"]
+
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "float32": 4, "int32": 4, "float16": 2,
+    "bfloat16": 2, "int16": 2, "uint8": 1, "int8": 1, "bool": 1,
+}
+
+
+def _var_bytes(var, batch_size):
+    if var.shape is None:
+        return 0
+    n = 1
+    for i, s in enumerate(var.shape):
+        if s in (None, -1):
+            s = batch_size if i == 0 else 1
+        n *= s
+    return n * _DTYPE_BYTES.get(core.convert_dtype(var.dtype), 4)
+
+
+def memory_usage(program, batch_size):
+    """Estimated activation+parameter bytes of one step (ref
+    memory_usage_calc.py:46). On TPU this approximates HBM residency of
+    the jitted step before XLA's buffer reuse — an upper bound."""
+    total = 0
+    for block in program.blocks:
+        for var in block.vars.values():
+            total += _var_bytes(var, batch_size)
+    return total
+
+
+def op_freq_statistic(program):
+    """Op-type histogram of the program (ref op_frequence.py)."""
+    freq = collections.Counter()
+    for block in program.blocks:
+        for op in block.ops:
+            freq[op.type] += 1
+    return collections.OrderedDict(freq.most_common())
+
+
+def summary(program):
+    """Parameter summary table (ref model_stat.py summary): returns and
+    prints total/trainable parameter counts with per-var shapes."""
+    rows = []
+    total = 0
+    for var in program.global_block().vars.values():
+        from ..framework import Parameter
+
+        if isinstance(var, Parameter) and var.shape is not None:
+            n = int(np.prod([max(s, 1) for s in var.shape]))
+            rows.append((var.name, tuple(var.shape), n))
+            total += n
+    lines = ["%-40s %-20s %12s" % ("param", "shape", "count")]
+    for name, shape, n in rows:
+        lines.append("%-40s %-20s %12d" % (name, shape, n))
+    lines.append("total params: %d" % total)
+    out = "\n".join(lines)
+    print(out)
+    return {"total_params": total, "params": rows}
