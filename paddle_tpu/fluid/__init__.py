@@ -59,6 +59,7 @@ from . import contrib
 from . import evaluator
 from . import inference
 from . import transpiler
+from . import incubate  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, memory_optimize, release_memory  # noqa: F401
 
 # top-level conveniences/aliases matching the reference fluid namespace

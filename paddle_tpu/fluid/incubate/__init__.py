@@ -1,0 +1,3 @@
+"""fluid.incubate (ref: python/paddle/fluid/incubate): the fleet API
+import paths user scripts rely on, re-exported from paddle_tpu.parallel."""
+from . import fleet  # noqa: F401

@@ -1,0 +1,3 @@
+"""incubate.fleet (ref: fluid/incubate/fleet)."""
+from . import base  # noqa: F401
+from . import collective  # noqa: F401

@@ -1,0 +1,9 @@
+"""Role makers (ref: incubate/fleet/base/role_maker.py) — re-exported
+from the mesh-based fleet implementation."""
+from paddle_tpu.parallel.fleet import (  # noqa: F401
+    PaddleCloudRoleMaker,
+    RoleMakerBase,
+    UserDefinedRoleMaker,
+)
+
+GeneralRoleMaker = PaddleCloudRoleMaker

@@ -1,0 +1,10 @@
+"""Collective-mode fleet (ref: incubate/fleet/collective/__init__.py):
+the canonical `from paddle.fluid.incubate.fleet.collective import fleet`
+entry point, backed by the GSPMD mesh implementation."""
+from paddle_tpu.parallel.fleet import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedStrategy,
+    distributed_optimizer,
+    fleet,
+    init,
+)
