@@ -176,3 +176,14 @@ class EOFException(Exception):
     """Raised by Executor.run when an attached py_reader is exhausted
     (ref: paddle/fluid/framework/reader.h EOFException) — catch it to end
     the epoch, then reader.reset()."""
+
+
+def __getattr__(name):
+    # deployment scripts reach AnalysisConfig / create_paddle_predictor
+    # through fluid.core (the reference exposes them via pybind); lazy to
+    # avoid a core <-> inference import cycle
+    if name in ("AnalysisConfig", "create_paddle_predictor"):
+        from . import inference
+
+        return getattr(inference, name)
+    raise AttributeError("module 'core' has no attribute %r" % name)

@@ -117,11 +117,68 @@ class Predictor:
         }
 
 
+class AnalysisConfig:
+    """Deployment config (ref: paddle/fluid/inference/api/
+    paddle_analysis_config.h via core.AnalysisConfig). The reference's
+    IR analysis passes / TensorRT / MKLDNN toggles are replaced by XLA's
+    own pass pipeline; device selection maps to the jit platform. Knobs
+    that can't apply on this stack are accepted and recorded so
+    deployment scripts run unchanged."""
+
+    def __init__(self, model_dir=None, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+        self._use_gpu = False
+        self._device_id = 0
+        self._switches = {}
+
+    # -- device ----------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # "gpu" in deployment scripts means "the accelerator": TPU here
+        self._use_gpu = True
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def use_gpu(self):
+        return self._use_gpu
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    # -- accepted no-op switches (XLA subsumes these passes) -------------
+    def switch_ir_optim(self, x=True):
+        self._switches["ir_optim"] = x
+
+    def enable_tensorrt_engine(self, **kw):
+        self._switches["tensorrt"] = kw
+
+    def enable_mkldnn(self):
+        self._switches["mkldnn"] = True
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        self._switches["feed_fetch_ops"] = x
+
+    def switch_specify_input_names(self, x=True):
+        self._switches["specify_input_names"] = x
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._switches["cpu_threads"] = n
+
+
 def create_paddle_predictor(config_or_dirname, **kw):
-    """ref inference api: create_paddle_predictor(AnalysisConfig)."""
+    """ref inference api: create_paddle_predictor(AnalysisConfig | dir)."""
     if isinstance(config_or_dirname, str):
         return Predictor.from_model(config_or_dirname, **kw)
+    if isinstance(config_or_dirname, AnalysisConfig):
+        cfg = config_or_dirname
+        if not cfg.model_dir:
+            raise ValueError("AnalysisConfig has no model_dir set")
+        from . import core
+
+        place = core.TPUPlace() if cfg.use_gpu() else core.CPUPlace()
+        return Predictor.from_model(cfg.model_dir, place=place, **kw)
     raise TypeError(
-        "pass a save_inference_model dirname (AnalysisConfig-style objects "
-        "are not modelled; the XLA pass pipeline replaces the analysis passes)"
+        "pass an AnalysisConfig or a save_inference_model dirname"
     )

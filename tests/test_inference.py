@@ -52,3 +52,29 @@ def test_engine_cache_per_shape(tmp_path):
     prof = pred.profile()
     assert prof["n_engines"] == 2
     assert prof["n_params"] >= 4              # 2 weights + 2 biases
+
+
+def test_analysis_config_predictor_path(tmp_path):
+    """Deployment-script path: AnalysisConfig -> create_paddle_predictor
+    (ref inference api), including the accepted no-op switches."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 11
+    x = fluid.data(name="acx", shape=[4], dtype="float32")
+    y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "m")
+    fluid.io.save_inference_model(d, ["acx"], [y], exe)
+
+    cfg = fluid.core.AnalysisConfig(d)
+    cfg.disable_gpu()
+    cfg.switch_ir_optim(True)
+    cfg.enable_mkldnn()
+    pred = fluid.core.create_paddle_predictor(cfg)
+    out = pred.run({"acx": np.ones((3, 4), "float32")})
+    assert out[0].shape == (3, 2)
