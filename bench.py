@@ -387,6 +387,18 @@ def child_main(status_path):
     st.stage("jax-init")
     import jax
 
+    try:
+        # persistent XLA compilation cache: reruns (and future rounds on
+        # the same code) skip the ~60-80s per-variant compiles
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
     if os.environ.get("PADDLE_TPU_BENCH_CPU"):
         # local validation path; the JAX_PLATFORMS env var is not a
         # reliable override in this environment, config.update is
