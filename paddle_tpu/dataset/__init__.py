@@ -1,6 +1,7 @@
 """Canned datasets (ref: python/paddle/dataset/). Zero-egress environment:
 each dataset synthesizes a deterministic stand-in with the real schema/shape
 unless local files are provided via env vars."""
+from . import common  # noqa: F401
 from . import mnist  # noqa: F401
 from . import uci_housing  # noqa: F401
 from . import imdb  # noqa: F401
