@@ -1,4 +1,13 @@
 """Train -> save_inference_model -> AnalysisConfig deployment round trip."""
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if os.environ.get("PADDLE_TPU_FORCE_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 import tempfile
 
 import numpy as np
@@ -25,13 +34,3 @@ def main():
 
 if __name__ == "__main__":
     main()
-
-import os
-import sys
-
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-if os.environ.get("PADDLE_TPU_FORCE_CPU"):
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
