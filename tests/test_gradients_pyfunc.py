@@ -141,3 +141,36 @@ def test_py_func_in_training_graph():
     losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
               for _ in range(5)]
     assert losses[-1] < losses[0]
+
+
+def test_recompute_with_intermediate_gradients():
+    """jax.checkpoint segments (RecomputeOptimizer) and intermediate-
+    target probes (fluid.gradients) compose: grads stay correct with
+    remat boundaries crossing the probed op."""
+    x = fluid.data(name="x", shape=[4, 8], dtype="float32",
+                   append_batch_size=False)
+    h1 = fluid.layers.fc(x, size=8, act="relu",
+                         param_attr=fluid.ParamAttr(name="rc_w1"))
+    h2 = fluid.layers.fc(h1, size=8, act="relu",
+                         param_attr=fluid.ParamAttr(name="rc_w2"))
+    pred = fluid.layers.fc(h2, size=1,
+                           param_attr=fluid.ParamAttr(name="rc_w3"))
+    loss = fluid.layers.reduce_mean(fluid.layers.square(pred))
+    (g_h1,) = fluid.gradients(loss, h1)
+    meta = fluid.layers.reduce_sum(fluid.layers.square(g_h1))
+
+    opt = fluid.optimizer.RecomputeOptimizer(
+        fluid.optimizer.SGD(learning_rate=0.01))
+    opt._set_checkpoints([h1, h2])
+    opt.minimize(loss)
+
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.random.RandomState(0).rand(4, 8).astype("float32")}
+    g, m, l = exe.run(feed=feed, fetch_list=[g_h1, meta, loss])
+    assert g.shape == (4, 8)
+    assert np.isfinite(m) and float(m) > 0
+    assert np.isfinite(l)
+    # training still progresses with both features active
+    l2 = float(exe.run(feed=feed, fetch_list=[loss])[0])
+    assert l2 < float(l)
