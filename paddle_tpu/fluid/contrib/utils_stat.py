@@ -8,11 +8,6 @@ from .. import core
 
 __all__ = ["memory_usage", "op_freq_statistic", "summary"]
 
-_DTYPE_BYTES = {
-    "float64": 8, "int64": 8, "float32": 4, "int32": 4, "float16": 2,
-    "bfloat16": 2, "int16": 2, "uint8": 1, "int8": 1, "bool": 1,
-}
-
 
 def _var_bytes(var, batch_size):
     if var.shape is None:
@@ -22,7 +17,12 @@ def _var_bytes(var, batch_size):
         if s in (None, -1):
             s = batch_size if i == 0 else 1
         n *= s
-    return n * _DTYPE_BYTES.get(core.convert_dtype(var.dtype), 4)
+    try:
+        itemsize = np.dtype(core.np_dtype(core.convert_dtype(var.dtype))
+                            ).itemsize
+    except TypeError:
+        itemsize = 4
+    return n * itemsize
 
 
 def memory_usage(program, batch_size):
@@ -48,11 +48,11 @@ def op_freq_statistic(program):
 def summary(program):
     """Parameter summary table (ref model_stat.py summary): returns and
     prints total/trainable parameter counts with per-var shapes."""
+    from ..framework import Parameter
+
     rows = []
     total = 0
     for var in program.global_block().vars.values():
-        from ..framework import Parameter
-
         if isinstance(var, Parameter) and var.shape is not None:
             n = int(np.prod([max(s, 1) for s in var.shape]))
             rows.append((var.name, tuple(var.shape), n))

@@ -4,7 +4,8 @@ import itertools
 import random
 
 __all__ = ["batch", "shuffle", "buffered", "map_readers", "chain", "compose",
-           "firstn", "xmap_readers", "cache"]
+           "firstn", "xmap_readers", "cache", "ComposeNotAligned",
+           "multiprocess_reader"]
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -87,6 +88,12 @@ def chain(*readers):
     return reader
 
 
+
+class ComposeNotAligned(ValueError):
+    """Raised by compose(check_alignment=True) when component readers
+    yield different numbers of samples (ref reader/decorator.py)."""
+
+
 def compose(*readers, **kwargs):
     check_alignment = kwargs.pop("check_alignment", True)
 
@@ -152,10 +159,6 @@ def cache(reader):
 
     return cache_reader
 
-
-class ComposeNotAligned(ValueError):
-    """Raised by compose(check_alignment=True) when component readers
-    yield different numbers of samples (ref reader/decorator.py)."""
 
 
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
