@@ -6,6 +6,8 @@ Executor.run lowers the Program once per (program version, feed signature)
 into a jitted step function with donated state, then replays it — so steady-
 state training is a single XLA executable launch per iteration.
 """
+import collections
+import os
 import warnings
 
 import numpy as np
@@ -147,7 +149,13 @@ class Executor:
 
     def __init__(self, place=None):
         self.place = place if place is not None else core.default_place()
-        self._cache = {}
+        # compiled-executable cache, LRU-bounded: every entry pins an
+        # XLA executable (and its host-side constants); long-running
+        # multi-program processes would otherwise grow without bound
+        self._cache = collections.OrderedDict()
+        self._cache_cap = int(
+            os.environ.get("PADDLE_TPU_EXECUTOR_CACHE_CAP", 32)
+        )
         self._run_counter = 0
         self._closed = False
 
@@ -213,6 +221,8 @@ class Executor:
         )
         rng = self._next_rng(program)
         entry = self._cache.get(sig) if use_program_cache else None
+        if entry is not None:
+            self._cache.move_to_end(sig)
         if entry is None:
             platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
             step = build_step_fn(
@@ -242,6 +252,8 @@ class Executor:
                 entry = jitted  # fall back to the tracing path
             if use_program_cache:
                 self._cache[sig] = entry
+                while len(self._cache) > self._cache_cap:
+                    self._cache.popitem(last=False)
 
         fetches, new_state = entry(state, feed_arrays, rng)
         for k, v in new_state.items():

@@ -131,3 +131,28 @@ def test_missing_feed_raises():
         assert "x" in str(e)
     else:
         raise AssertionError("expected error for missing feed")
+
+
+def test_executor_cache_lru_bound(monkeypatch):
+    """The compile cache is LRU-bounded (each entry pins an XLA
+    executable); distinct feed signatures beyond the cap evict oldest."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    monkeypatch.setenv("PADDLE_TPU_EXECUTOR_CACHE_CAP", "2")
+    x = fluid.data(name="cx", shape=[None, 4], dtype="float32",
+                   append_batch_size=False)
+    out = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    for batch in (1, 2, 3, 4):
+        o = exe.run(feed={"cx": np.ones((batch, 4), "float32")},
+                    fetch_list=[out])[0]
+        np.testing.assert_allclose(o, 2.0)
+    assert len(exe._cache) <= 2
+    # evicted signature still recompiles and runs correctly
+    o = exe.run(feed={"cx": np.ones((1, 4), "float32")},
+                fetch_list=[out])[0]
+    np.testing.assert_allclose(o, 2.0)
