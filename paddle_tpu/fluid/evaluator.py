@@ -7,7 +7,7 @@ import warnings
 from . import layers
 from .metrics import Accuracy as _AccuracyMetric
 
-__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance"]
+__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
 
 
 def _deprecation(name, new):
@@ -66,6 +66,17 @@ class EditDistance:
         from .metrics import EditDistance as M
 
         self._m = M()
+
+    def __getattr__(self, item):
+        return getattr(self._m, item)
+
+
+class DetectionMAP:
+    def __init__(self, *args, **kwargs):
+        _deprecation("DetectionMAP", "fluid.metrics.DetectionMAP")
+        from .metrics import DetectionMAP as M
+
+        self._m = M(*args, **kwargs) if args or kwargs else M()
 
     def __getattr__(self, item):
         return getattr(self._m, item)

@@ -215,10 +215,75 @@ class Auc(MetricBase):
 
 
 class DetectionMAP:
-    """mAP evaluator shell (ref metrics.py DetectionMAP); detection pipeline
-    lives in layers/detection.py."""
+    """Graph mAP evaluator (ref metrics.py DetectionMAP): builds the
+    detection_map op over the NMS output + padded gt and streams an
+    in-graph running MEAN of per-batch mAPs through persistable state
+    (the reference pools detection statistics across batches instead —
+    with similarly-sized batches the two converge; per-batch pooling is
+    what the static-shape op computes).
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "DetectionMAP graph evaluator: use layers.detection mAP utilities"
+    Usage mirrors the reference::
+
+        m = fluid.metrics.DetectionMAP(nms_out, gt_label, gt_box,
+                                       gt_difficult, class_num=21)
+        cur_map, accum_map = m.get_map_var()
+        ... exe.run(fetch_list=[cur_map, accum_map]) per batch ...
+        m.reset(exe)    # new evaluation pass
+    """
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        from . import unique_name
+        from .layers import detection, tensor
+        from .layers.nn import elementwise_add, elementwise_div
+
+        if class_num is None:
+            raise ValueError("DetectionMAP needs class_num")
+        parts = [tensor.cast(gt_label, "float32"), gt_box]
+        if gt_difficult is not None:
+            parts.append(tensor.cast(gt_difficult, "float32"))
+        label = tensor.concat(parts, axis=-1)
+        self._cur_map = detection.detection_map(
+            input, label, class_num, background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version,
         )
+        # streaming state rides the jitted step like optimizer state
+        self._accum_value = tensor.create_global_var(
+            shape=[1], value=0.0, dtype="float32", persistable=True,
+            name=unique_name.generate("map_accum_value"),
+        )
+        self._accum_count = tensor.create_global_var(
+            shape=[1], value=0.0, dtype="float32", persistable=True,
+            name=unique_name.generate("map_accum_count"),
+        )
+        block = self._cur_map.block
+        new_value = elementwise_add(
+            self._accum_value,
+            tensor.cast(self._cur_map, "float32"),
+        )
+        one = tensor.fill_constant([1], "float32", 1.0)
+        new_count = elementwise_add(self._accum_count, one)
+        self._accum_map = elementwise_div(new_value, new_count)
+        block.append_op(
+            type="assign", inputs={"X": [new_value]},
+            outputs={"Out": [self._accum_value]},
+        )
+        block.append_op(
+            type="assign", inputs={"X": [new_count]},
+            outputs={"Out": [self._accum_count]},
+        )
+
+    def get_map_var(self):
+        return self._cur_map, self._accum_map
+
+    def reset(self, executor, reset_program=None):
+        from .executor import global_scope
+
+        scope = global_scope()
+        scope.update(self._accum_value.name,
+                     np.zeros((1,), np.float32))
+        scope.update(self._accum_count.name,
+                     np.zeros((1,), np.float32))

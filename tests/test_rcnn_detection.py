@@ -620,3 +620,42 @@ def test_fpn_distribute_and_collect():
     # collect keeps the 2 highest-scoring rois
     np.testing.assert_allclose(col[0], rois_np[1])
     np.testing.assert_allclose(col[1], rois_np[3])
+
+
+def test_metrics_detection_map_streams():
+    """fluid.metrics.DetectionMAP: per-batch mAP + in-graph running mean,
+    reset() starts a fresh pass."""
+    det = fluid.data(name="mm_det", shape=[1, 3, 6], dtype="float32",
+                     append_batch_size=False)
+    gtl = fluid.data(name="mm_gtl", shape=[1, 2, 1], dtype="int64",
+                     append_batch_size=False)
+    gtb = fluid.data(name="mm_gtb", shape=[1, 2, 4], dtype="float32",
+                     append_batch_size=False)
+    m = fluid.metrics.DetectionMAP(det, gtl, gtb, class_num=3,
+                                   overlap_threshold=0.5)
+    cur, accum = m.get_map_var()
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    gt_feed = {
+        "mm_gtl": np.array([[[1], [2]]], "int64"),
+        "mm_gtb": np.array([[[10, 10, 20, 20], [40, 40, 60, 60]]],
+                           "float32"),
+    }
+    perfect = np.array([[[1, 0.9, 10, 10, 20, 20],
+                         [2, 0.8, 40, 40, 60, 60],
+                         [-1, 0, 0, 0, 0, 0]]], "float32")
+    half = np.array([[[1, 0.9, 10, 10, 20, 20],
+                      [2, 0.8, 100, 100, 110, 110],
+                      [-1, 0, 0, 0, 0, 0]]], "float32")
+    c1, a1 = exe.run(feed={"mm_det": perfect, **gt_feed},
+                     fetch_list=[cur, accum])
+    np.testing.assert_allclose(c1, 1.0, atol=1e-5)
+    np.testing.assert_allclose(a1, 1.0, atol=1e-5)
+    c2, a2 = exe.run(feed={"mm_det": half, **gt_feed},
+                     fetch_list=[cur, accum])
+    np.testing.assert_allclose(c2, 0.5, atol=1e-5)
+    np.testing.assert_allclose(a2, 0.75, atol=1e-5)  # mean(1.0, 0.5)
+    m.reset(exe)
+    c3, a3 = exe.run(feed={"mm_det": half, **gt_feed},
+                     fetch_list=[cur, accum])
+    np.testing.assert_allclose(a3, 0.5, atol=1e-5)
