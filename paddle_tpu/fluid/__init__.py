@@ -16,13 +16,15 @@ from .framework import (  # noqa: F401
     cuda_places,
     tpu_places,
     cuda_pinned_places,
+    is_compiled_with_cuda,
+    require_version,
+    load_op_library,
 )
 from .core import (  # noqa: F401
     CPUPlace,
     CUDAPlace,
     CUDAPinnedPlace,
     TPUPlace,
-    is_compiled_with_cuda,
     is_compiled_with_tpu,
 )
 from . import executor
@@ -46,6 +48,7 @@ from . import lod
 from .lod import LoDTensor, create_lod_tensor, create_random_int_lodtensor  # noqa: F401
 from . import io
 from . import nets
+from . import average
 from . import metrics
 from . import reader
 from .reader import DataLoader  # noqa: F401
@@ -94,7 +97,9 @@ __all__ = [
     "metrics", "DataLoader", "CompiledProgram", "ParallelExecutor",
     "dygraph", "profiler", "contrib", "evaluator", "inference",
     "VarBase", "Tensor", "LoDTensorArray", "save", "load", "embedding",
-    "one_hot", "learning_rate_decay", "dygraph_grad_clip",
+    "one_hot", "learning_rate_decay", "dygraph_grad_clip", "average",
+    "is_compiled_with_cuda", "is_compiled_with_tpu", "require_version",
+    "load_op_library",
 ]
 
 

@@ -12,6 +12,7 @@ import contextlib
 import copy
 import itertools
 import json
+import re
 import traceback
 
 import numpy as np
@@ -843,3 +844,74 @@ def program_guard(main_program, startup_program=None):
 
 def _get_paddle_place(place):
     return place
+
+
+def is_compiled_with_cuda():
+    """Always False: this build targets TPU via XLA, not CUDA
+    (ref framework.py:265). Scripts branching on it fall through to the
+    portable path, which compiles for whatever backend jax exposes."""
+    return core.is_compiled_with_cuda()
+
+
+_VERSION_PAT = re.compile(r"^\d+(\.\d+){0,3}([.-].*)?$")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version lies in
+    [min_version, max_version] (ref framework.py:66). Raises on syntax or
+    range violations, returns None when satisfied."""
+    for name, arg in (("min_version", min_version),
+                      ("max_version", max_version)):
+        if arg is None:
+            continue
+        if not isinstance(arg, str):
+            raise TypeError(
+                "%s must be str, but received %s." % (name, type(arg)))
+        if not _VERSION_PAT.match(arg):
+            raise ValueError(
+                "%s (%s) should have format like '1.5.2.0'." % (name, arg))
+
+    from .. import __version__
+
+    def _key(v):
+        # '0.2.0-rc1': numeric base, then the pre-release suffix; a
+        # suffixed build orders BEFORE its clean release, suffixes order
+        # lexically among themselves (rc1 < rc2)
+        base, sep, suffix = v.partition("-")
+        nums = [int(p) if p.isdigit() else 0 for p in base.split(".")[:4]]
+        while len(nums) < 4:
+            nums.append(0)
+        nums.append(0 if sep else 1)
+        nums.append(suffix)
+        return nums
+
+    if max_version is not None and _key(min_version) > _key(max_version):
+        raise ValueError(
+            "please make sure min_version (%s) <= max_version (%s)."
+            % (min_version, max_version))
+
+    installed = _key(__version__)
+    if installed < _key(min_version):
+        raise Exception(
+            "PaddleTPU version %s is installed, but version >= %s is "
+            "required." % (__version__, min_version))
+    if max_version is not None and installed > _key(max_version):
+        raise Exception(
+            "PaddleTPU version %s is installed, but version <= %s is "
+            "required." % (__version__, max_version))
+
+
+def load_op_library(lib_filename):
+    """Load a shared library of custom ops (ref framework.py:4938). The
+    TPU build's custom-op path is a Python registration API
+    (paddle_tpu.ops.register_lowering) — C++ op .so files target the CUDA
+    runtime and cannot carry XLA lowerings, so this raises with guidance
+    instead of silently accepting a no-op library."""
+    raise NotImplementedError(
+        "load_op_library loads CUDA/CPU op kernels; on the TPU build "
+        "register a jax lowering instead: "
+        "paddle_tpu.ops.register_lowering('%s', fn). The library file was "
+        "not loaded." % lib_filename)
+
+
+__all__ += ["is_compiled_with_cuda", "require_version", "load_op_library"]

@@ -24,6 +24,7 @@ from . import detection
 from .detection import *  # noqa: F401,F403
 from . import distributions
 from .distributions import *  # noqa: F401,F403
+from . import device  # noqa: F401
 from . import math_op_patch
 
 math_op_patch.monkey_patch_variable()

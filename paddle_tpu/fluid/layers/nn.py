@@ -2859,3 +2859,23 @@ def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
 
 __all__ += ["linear_chain_crf", "crf_decoding", "chunk_eval",
             "ctc_greedy_decoder"]
+
+
+# The reference's nn.py __all__ also exports these; here they are defined in
+# sibling modules (sequence_lod/rnn/ops) and re-exported for parity
+# (ref nn.py:84,85,184,185).
+from .sequence_lod import lod_reset, lod_append  # noqa: E402
+from .rnn import gather_tree  # noqa: E402
+
+__all__ += ["lod_reset", "lod_append", "gather_tree", "uniform_random"]
+
+
+def __getattr__(name):
+    # uniform_random lives in ops.py, which itself imports from this
+    # module at its top — resolve lazily so neither import order works
+    # only by accident (PEP 562)
+    if name == "uniform_random":
+        from .ops import uniform_random
+
+        return uniform_random
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

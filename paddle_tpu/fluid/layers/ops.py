@@ -83,3 +83,17 @@ def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
         },
     )
     return out
+
+
+# ref ops.py:243 re-exports gelu through the generated-layer path; the
+# implementation lives in nn.py here — resolved lazily (PEP 562) to keep
+# the nn<->ops import acyclic at module-exec time
+__all__ += ["gelu"]
+
+
+def __getattr__(name):
+    if name == "gelu":
+        from .nn import gelu
+
+        return gelu
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,79 @@
+"""Small parity surfaces: fluid.average, layers.device, framework version/
+compile-flag utils, and the cross-module re-exports the reference keeps in
+nn.py/ops.py (ref average.py, layers/device.py, framework.py:66,265,4938)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+
+
+def test_weighted_average():
+    a = fluid.average.WeightedAverage()
+    a.add(value=2.0, weight=1)
+    a.add(value=4.0, weight=2)
+    assert abs(a.eval() - 10.0 / 3.0) < 1e-12
+    a.reset()
+    with pytest.raises(ValueError):
+        a.eval()
+    with pytest.raises(ValueError):
+        a.add(value="x", weight=1)
+    with pytest.raises(ValueError):
+        a.add(value=1.0, weight="x")
+    a.add(value=np.ones((2, 2)), weight=2)
+    assert np.allclose(a.eval(), np.ones((2, 2)))
+
+
+def test_get_places():
+    from paddle_tpu.fluid.layers import device
+
+    places = device.get_places(device_count=2)
+    assert 1 <= len(places) <= 2
+    cpu = device.get_places(device_count=1, device_type="CPU")
+    assert len(cpu) == 1
+
+
+def test_is_compiled_with_cuda():
+    assert fluid.is_compiled_with_cuda() is False
+
+
+def test_require_version():
+    fluid.require_version("0.0.1")
+    fluid.require_version("0.0.1", "99.0")
+    with pytest.raises(Exception, match="required"):
+        fluid.require_version("99.0")
+    with pytest.raises(Exception, match="required"):
+        fluid.require_version("0.0.1", "0.0.2")
+    with pytest.raises(ValueError, match="min_version"):
+        fluid.require_version("2.0", "1.0")
+    with pytest.raises(TypeError):
+        fluid.require_version(1)
+    with pytest.raises(ValueError):
+        fluid.require_version("not-a-version!")
+    # pre-release orders before its clean release
+    orig = paddle_tpu.__version__
+    try:
+        paddle_tpu.__version__ = "0.2.0-rc1"
+        with pytest.raises(Exception, match="required"):
+            fluid.require_version("0.2.0")
+        fluid.require_version("0.1.0")
+    finally:
+        paddle_tpu.__version__ = orig
+
+
+def test_load_op_library_raises_with_guidance():
+    with pytest.raises(NotImplementedError, match="register_lowering"):
+        fluid.load_op_library("libcustom.so")
+
+
+def test_nn_ops_reexports():
+    from paddle_tpu.fluid.layers import nn, ops
+
+    for name in ("lod_reset", "lod_append", "gather_tree", "uniform_random"):
+        assert name in nn.__all__ and callable(getattr(nn, name))
+    assert "gelu" in ops.__all__ and callable(ops.gelu)
+    # the lazy __getattr__ paths still raise for unknown names
+    with pytest.raises(AttributeError):
+        nn.no_such_layer
+    with pytest.raises(AttributeError):
+        ops.no_such_op
