@@ -1,4 +1,5 @@
 """fluid.contrib (ref: python/paddle/fluid/contrib)."""
+from . import layers  # noqa: F401
 from . import mixed_precision
 from .mixed_precision import decorate as mixed_precision_decorate  # noqa: F401
 from . import quant  # noqa: F401
@@ -8,6 +9,6 @@ from . import extend_optimizer
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
 
 __all__ = [
-    "mixed_precision", "quant", "memory_usage", "op_freq_statistic",
+    "layers", "mixed_precision", "quant", "memory_usage", "op_freq_statistic",
     "summary", "extend_with_decoupled_weight_decay",
 ]

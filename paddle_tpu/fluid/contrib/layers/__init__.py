@@ -1,0 +1,6 @@
+"""fluid.contrib.layers namespace (ref: contrib/layers/__init__.py) —
+subset: the rnn_impl basic units backing layers.GRUCell/LSTMCell."""
+from . import rnn_impl
+from .rnn_impl import *  # noqa: F401,F403
+
+__all__ = list(rnn_impl.__all__)

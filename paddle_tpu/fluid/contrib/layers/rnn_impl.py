@@ -1,0 +1,137 @@
+"""Basic RNN units (ref: python/paddle/fluid/contrib/layers/rnn_impl.py
+BasicGRUUnit/BasicLSTMUnit, backing layers.GRUCell/LSTMCell).
+
+Graph-building step units: parameters are created lazily on the first
+call (when the input width is known) under the unit's name scope, then
+reused on every subsequent call — so one unit instance used inside a
+StaticRNN step traces the SAME weights at every time step and the whole
+recurrence lowers to one lax.scan.
+"""
+from ...initializer import Constant
+from ...layer_helper import LayerHelper
+from ... import unique_name
+
+__all__ = ["BasicGRUUnit", "BasicLSTMUnit"]
+
+
+class _LazyUnit:
+    """Shared lazy-parameter machinery."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        self._name = unique_name.generate(name_scope)
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act_name = gate_activation
+        self._act_name = activation
+        self._dtype = dtype
+        self._built = False
+
+    def _helper(self):
+        return LayerHelper(
+            self._name,
+            param_attr=self._param_attr,
+            bias_attr=self._bias_attr,
+        )
+
+    def _acts(self):
+        from ...layers import ops as activations
+
+        gate = self._gate_act_name or activations.sigmoid
+        act = self._act_name or activations.tanh
+        return gate, act
+
+
+class BasicGRUUnit(_LazyUnit):
+    """One GRU step (ref rnn_impl.py BasicGRUUnit):
+    u,r = act_g([x,h]·W_g + b_g); c = act_c([x, r⊙h]·W_c + b_c);
+    h' = u⊙h + (1-u)⊙c."""
+
+    def __call__(self, input, pre_hidden):
+        from ...layers import nn as L
+        from ...layers import tensor as T
+
+        gate_act, act = self._acts()
+        D = self._hidden_size
+        helper = self._helper()
+        in_width = input.shape[-1]
+        if not self._built:
+            self._gate_w = helper.create_parameter(
+                attr=helper.param_attr, shape=[in_width + D, 2 * D],
+                dtype=self._dtype)
+            self._gate_b = helper.create_parameter(
+                attr=helper.bias_attr, shape=[2 * D], dtype=self._dtype,
+                is_bias=True, default_initializer=Constant(0.0))
+            self._cand_w = helper.create_parameter(
+                attr=helper.param_attr, shape=[in_width + D, D],
+                dtype=self._dtype)
+            self._cand_b = helper.create_parameter(
+                attr=helper.bias_attr, shape=[D], dtype=self._dtype,
+                is_bias=True, default_initializer=Constant(0.0))
+            self._built = True
+
+        concat = T.concat([input, pre_hidden], axis=-1)
+        gates = L.elementwise_add(
+            L.matmul(concat, self._gate_w), self._gate_b)
+        gates = gate_act(gates)
+        # ref rnn_impl.py:125 splits (r, u): reset gate first
+        r = L.slice(gates, axes=[1], starts=[0], ends=[D])
+        u = L.slice(gates, axes=[1], starts=[D], ends=[2 * D])
+        r_hidden = L.elementwise_mul(r, pre_hidden)
+        cand = L.elementwise_add(
+            L.matmul(T.concat([input, r_hidden], axis=-1), self._cand_w),
+            self._cand_b)
+        c = act(cand)
+        new_hidden = L.elementwise_add(
+            L.elementwise_mul(u, pre_hidden),
+            L.elementwise_mul(
+                L.elementwise_sub(
+                    T.fill_constant([1], self._dtype, 1.0), u), c))
+        return new_hidden
+
+
+class BasicLSTMUnit(_LazyUnit):
+    """One LSTM step (ref rnn_impl.py BasicLSTMUnit), gate order i,j,f,o:
+    c' = c⊙act_g(f + forget_bias) + act_g(i)⊙act_c(j);
+    h' = act_c(c')⊙act_g(o)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        super().__init__(name_scope, hidden_size, param_attr, bias_attr,
+                         gate_activation, activation, dtype)
+        self._forget_bias = float(forget_bias)
+
+    def __call__(self, input, pre_hidden, pre_cell):
+        from ...layers import nn as L
+        from ...layers import tensor as T
+
+        gate_act, act = self._acts()
+        D = self._hidden_size
+        helper = self._helper()
+        in_width = input.shape[-1]
+        if not self._built:
+            self._w = helper.create_parameter(
+                attr=helper.param_attr, shape=[in_width + D, 4 * D],
+                dtype=self._dtype)
+            self._b = helper.create_parameter(
+                attr=helper.bias_attr, shape=[4 * D], dtype=self._dtype,
+                is_bias=True, default_initializer=Constant(0.0))
+            self._built = True
+
+        concat = T.concat([input, pre_hidden], axis=-1)
+        gates = L.elementwise_add(L.matmul(concat, self._w), self._b)
+        i = L.slice(gates, axes=[1], starts=[0], ends=[D])
+        j = L.slice(gates, axes=[1], starts=[D], ends=[2 * D])
+        f = L.slice(gates, axes=[1], starts=[2 * D], ends=[3 * D])
+        o = L.slice(gates, axes=[1], starts=[3 * D], ends=[4 * D])
+        forget = gate_act(
+            L.elementwise_add(
+                f, T.fill_constant([1], self._dtype, self._forget_bias)))
+        new_cell = L.elementwise_add(
+            L.elementwise_mul(pre_cell, forget),
+            L.elementwise_mul(gate_act(i), act(j)))
+        new_hidden = L.elementwise_mul(act(new_cell), gate_act(o))
+        return new_hidden, new_cell

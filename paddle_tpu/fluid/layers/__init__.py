@@ -18,7 +18,9 @@ from .control_flow import *  # noqa: F401,F403
 from . import sequence_lod
 from .sequence_lod import *  # noqa: F401,F403
 from . import rnn
-from .rnn import *  # noqa: F401,F403
+from . import rnn_cells  # noqa: F401
+_rnn_module = rnn
+from .rnn import *  # noqa: F401,F403  (rebinds `rnn` to the rnn() layer, like the reference)
 from . import collective  # noqa: F401
 from . import detection
 from .detection import *  # noqa: F401,F403
@@ -39,6 +41,6 @@ __all__ += metric_op.__all__
 __all__ += learning_rate_scheduler.__all__
 __all__ += control_flow.__all__
 __all__ += sequence_lod.__all__
-__all__ += rnn.__all__
+__all__ += _rnn_module.__all__
 __all__ += detection.__all__
 __all__ += distributions.__all__

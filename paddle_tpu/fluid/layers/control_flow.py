@@ -481,7 +481,7 @@ class StaticRNN:
                 shape, "float32", init_value
             )
         m = self._block.create_var(
-            name=init.name + "@MEM",
+            name="%s@MEM_%d" % (init.name, len(self._mem_in)),
             dtype=init.dtype,
             shape=init.shape,
         )
@@ -506,9 +506,9 @@ class StaticRNN:
             raise ValueError("every memory needs update_memory()")
         parent = self._parent_block
         outs = []
-        for o in self._step_outputs:
+        for idx, o in enumerate(self._step_outputs):
             ov = parent.create_var(
-                name=o.name + "@SCAN_OUT",
+                name="%s@SCAN_OUT_%d" % (o.name, idx),
                 dtype=o.dtype,
                 shape=((self._x_outer[0].shape[0],) + tuple(o.shape or ()))
                 if self._x_outer and self._x_outer[0].shape
@@ -639,7 +639,7 @@ class DynamicRNN:
             )
         # need_reorder is a no-op: sequences are never rank-sorted here
         m = self._block.create_var(
-            name=init.name + "@MEM",
+            name="%s@MEM_%d" % (init.name, len(self._mem_in)),
             dtype=init.dtype,
             shape=init.shape,
         )

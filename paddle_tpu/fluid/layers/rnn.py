@@ -345,3 +345,14 @@ def beam_search_decode(ids, scores, beam_size, end_id, name=None):
         attrs={"beam_size": beam_size, "end_id": end_id},
     )
     return out_ids, out_scores
+
+
+# Cell-based RNN API (ref rnn.py:48-1700) — implemented in rnn_cells.py,
+# re-exported here to mirror the reference module layout.
+from .rnn_cells import (  # noqa: E402,F401
+    RNNCell, GRUCell, LSTMCell, rnn, Decoder, BeamSearchDecoder,
+    dynamic_decode, dynamic_lstmp,
+)
+
+__all__ += ["RNNCell", "GRUCell", "LSTMCell", "rnn", "Decoder",
+            "BeamSearchDecoder", "dynamic_decode", "dynamic_lstmp"]

@@ -84,6 +84,82 @@ def _lstm(ctx, ins, attrs):
     }
 
 
+@register_op("lstmp")
+def _lstmp(ctx, ins, attrs):
+    """Projected LSTM (ref lstmp_op.cc / Sak et al. 2014): the recurrent
+    state is the projection r = h @ W_proj (P-dim), shrinking the
+    recurrent matmul from (D,4D) to (P,4D). Gate order i, c(g), f, o;
+    peephole weights live in bias cols 4D:7D (i, f, o)."""
+    xproj = ins["Input"][0]              # (B, T, 4D)
+    w = ins["Weight"][0]                 # (P, 4D)
+    w_proj = ins["ProjWeight"][0]        # (D, P)
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    lens = _lens(ins, xproj)
+    d = w_proj.shape[0]
+    p = w.shape[0]
+    B = xproj.shape[0]
+    r0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, p), xproj.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, d), xproj.dtype)
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    proj_act = _act(attrs.get("proj_activation", "tanh"))
+    is_reverse = attrs.get("is_reverse", False)
+    use_peepholes = attrs.get("use_peepholes", False)
+    cell_clip = attrs.get("cell_clip")
+    proj_clip = attrs.get("proj_clip")
+    peep = None
+    if b is not None:
+        bias = b.reshape((1, 1, -1))
+        xproj = xproj + bias[:, :, : 4 * d]
+        if use_peepholes and b.shape[-1] >= 7 * d:
+            peep = b.reshape(-1)[4 * d: 7 * d]   # w_ic, w_fc, w_oc
+
+    xs = jnp.moveaxis(xproj, 1, 0)       # (T, B, 4D)
+    tsteps = xs.shape[0]
+    if is_reverse:
+        xs = xs[::-1]
+
+    def step(carry, inp):
+        r, c = carry
+        xt, t = inp
+        gates = xt + r @ w
+        gi = gates[:, :d]
+        gg = gates[:, d: 2 * d]
+        gf = gates[:, 2 * d: 3 * d]
+        go = gates[:, 3 * d:]
+        if peep is not None:
+            gi = gi + c * peep[:d]
+            gf = gf + c * peep[d: 2 * d]
+        i = gate_act(gi)
+        g = cand_act(gg)
+        f = gate_act(gf)
+        c_new = f * c + i * g
+        if cell_clip is not None:
+            c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+        if peep is not None:
+            go = go + c_new * peep[2 * d:]
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(h_new @ w_proj)
+        if proj_clip is not None:
+            r_new = jnp.clip(r_new, -proj_clip, proj_clip)
+        tt = (tsteps - 1 - t) if is_reverse else t
+        live = (tt < lens)[:, None]
+        r_new = jnp.where(live, r_new, r)
+        c_new = jnp.where(live, c_new, c)
+        return (r_new, c_new), (r_new, c_new)
+
+    _, (rs, cs) = lax.scan(step, (r0, c0), (xs, jnp.arange(tsteps)))
+    if is_reverse:
+        rs = rs[::-1]
+        cs = cs[::-1]
+    return {
+        "Projection": [jnp.moveaxis(rs, 0, 1)],
+        "Cell": [jnp.moveaxis(cs, 0, 1)],
+    }
+
+
 @register_op("gru")
 def _gru(ctx, ins, attrs):
     """GRU over (B, T, 3D) pre-projected input (ref gru_op.cc)."""

@@ -1,0 +1,426 @@
+"""Cell-based RNN API (ref layers/rnn.py:48-1700): GRUCell/LSTMCell +
+rnn() vs numpy oracles, BeamSearchDecoder + dynamic_decode vs a
+reference beam-search implementation, dynamic_lstmp vs oracle."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _fresh():
+    from paddle_tpu.fluid import framework, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 11
+    fluid.default_main_program().random_seed = 11
+
+
+def _fetch_params(exe, names):
+    scope = fluid.global_scope()
+    return [np.asarray(scope[n]) for n in names]
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# ---------------------------------------------------------------------------
+# rnn() + GRUCell
+# ---------------------------------------------------------------------------
+def test_rnn_gru_cell_matches_numpy():
+    _fresh()
+    B, T, D_in, D = 3, 5, 4, 6
+    x = fluid.data("x", (T, D_in), "float32")
+    cell = layers.GRUCell(hidden_size=D)
+    outs, final = layers.rnn(cell, x)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    out_v, fin_v = exe.run(feed={"x": xv}, fetch_list=[outs, final])
+
+    # oracle using the traced parameters
+    prog = fluid.default_main_program()
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    gw, gb, cw, cb = _fetch_params(exe, pnames)
+    h = np.zeros((B, D), "float32")
+    ref = []
+    for t in range(T):
+        concat = np.concatenate([xv[:, t], h], axis=1)
+        gates = _sigmoid(concat @ gw + gb)
+        r, u = gates[:, :D], gates[:, D:]
+        cand = np.tanh(
+            np.concatenate([xv[:, t], r * h], axis=1) @ cw + cb)
+        h = u * h + (1 - u) * cand
+        ref.append(h)
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_allclose(np.asarray(out_v), ref, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(fin_v), ref[:, -1],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_rnn_lstm_cell_seq_len_and_reverse():
+    _fresh()
+    B, T, D_in, D = 2, 4, 3, 5
+    x = fluid.data("x", (T, D_in), "float32")
+    sl = fluid.data("sl", (), "int64")
+    cell = layers.LSTMCell(hidden_size=D)
+    outs, final = layers.rnn(cell, x, sequence_length=sl)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    slv = np.array([4, 2], "int64")
+    out_v, h_fin, c_fin = exe.run(
+        feed={"x": xv, "sl": slv},
+        fetch_list=[outs, final[0], final[1]])
+
+    prog = fluid.default_main_program()
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    w, b = _fetch_params(exe, pnames)
+    h = np.zeros((B, D), "float32")
+    c = np.zeros((B, D), "float32")
+    hs = []
+    for t in range(T):
+        gates = np.concatenate([xv[:, t], h], axis=1) @ w + b
+        i, j, f, o = np.split(gates, 4, axis=1)
+        c_new = c * _sigmoid(f + 1.0) + _sigmoid(i) * np.tanh(j)
+        h_new = np.tanh(c_new) * _sigmoid(o)
+        # ref rnn() masks only the carried STATE; step outputs stay the
+        # raw cell output (computed from the frozen state past the length)
+        hs.append(h_new)
+        live = (t < slv)[:, None]
+        h = np.where(live, h_new, h)
+        c = np.where(live, c_new, c)
+    ref = np.stack(hs, axis=1)
+    np.testing.assert_allclose(np.asarray(out_v), ref, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(h_fin), h, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(c_fin), c, rtol=2e-5, atol=2e-5)
+
+
+def test_rnn_is_reverse():
+    _fresh()
+    B, T, D_in, D = 2, 3, 3, 4
+    x = fluid.data("x", (T, D_in), "float32")
+    cell = layers.GRUCell(hidden_size=D, name="revgru")
+    outs, _ = layers.rnn(cell, x, is_reverse=True)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(3)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    out_v = np.asarray(exe.run(feed={"x": xv}, fetch_list=[outs])[0])
+
+    prog = fluid.default_main_program()
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    gw, gb, cw, cb = _fetch_params(exe, pnames)
+    h = np.zeros((B, D), "float32")
+    ref = [None] * T
+    for t in reversed(range(T)):
+        concat = np.concatenate([xv[:, t], h], axis=1)
+        gates = _sigmoid(concat @ gw + gb)
+        r, u = gates[:, :D], gates[:, D:]
+        cand = np.tanh(np.concatenate([xv[:, t], r * h], axis=1) @ cw + cb)
+        h = u * h + (1 - u) * cand
+        ref[t] = h
+    np.testing.assert_allclose(out_v, np.stack(ref, axis=1),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_rnn_trains():
+    _fresh()
+    B, T, D_in, D = 4, 6, 3, 8
+    x = fluid.data("x", (T, D_in), "float32")
+    y = fluid.data("y", (1,), "float32")
+    cell = layers.LSTMCell(hidden_size=D)
+    _, final = layers.rnn(cell, x)
+    pred = layers.fc(final[0], 1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(0.02).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(5)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    yv = xv.sum(axis=(1, 2), keepdims=False)[:, None].astype("float32")
+    first = last = None
+    for _ in range(40):
+        (lv,) = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        lv = float(lv)
+        first = lv if first is None else first
+        last = lv
+    assert last < first * 0.5, (first, last)
+
+
+# ---------------------------------------------------------------------------
+# dynamic_decode + BeamSearchDecoder
+# ---------------------------------------------------------------------------
+def _np_beam_search(gw, gb, cw, cb, ew, ow, B, V, D, beam, start, end,
+                    steps):
+    """Reference beam search over a GRU cell + embedding + output fc,
+    mirroring fluid's BeamSearchDecoder semantics."""
+    kinf = 1e9
+    h = np.zeros((B, beam, D), "float32")
+    log_probs = np.tile(
+        np.array([[0.0] + [-kinf] * (beam - 1)], "float32"), (B, 1))
+    finished = np.zeros((B, beam), bool)
+    lengths = np.zeros((B, beam), "int64")
+    ids = np.full((B, beam), start, "int64")
+    pred_hist, parent_hist = [], []
+    for _ in range(steps):
+        emb = ew[ids]                       # (B, beam, D)
+        xh = np.concatenate([emb, h], axis=-1)
+        gates = _sigmoid(xh @ gw + gb)
+        r, u = gates[..., :D], gates[..., D:]
+        cand = np.tanh(
+            np.concatenate([emb, r * h], axis=-1) @ cw + cb)
+        h_new = u * h + (1 - u) * cand
+        logits = h_new @ ow                 # (B, beam, V)
+        lp = np.log(
+            np.exp(logits - logits.max(-1, keepdims=True))
+            / np.exp(logits - logits.max(-1, keepdims=True)).sum(
+                -1, keepdims=True))
+        noend = np.full((V,), -kinf, "float32")
+        noend[end] = 0.0
+        fin = finished[..., None]
+        lp = np.where(fin, noend, lp)
+        total = lp + log_probs[..., None]
+        flat = total.reshape(B, beam * V)
+        top = np.argsort(-flat, axis=1, kind="stable")[:, :beam]
+        topk_scores = np.take_along_axis(flat, top, axis=1)
+        beam_idx = top // V
+        token_idx = top % V
+        log_probs = topk_scores
+        h = np.take_along_axis(h_new, beam_idx[..., None], axis=1)
+        finished = np.take_along_axis(finished, beam_idx, axis=1)
+        lengths = np.take_along_axis(lengths, beam_idx, axis=1)
+        lengths = lengths + (~finished).astype("int64")
+        finished = finished | (token_idx == end)
+        pred_hist.append(token_idx)
+        parent_hist.append(beam_idx)
+        ids = token_idx
+    # gather_tree backtrace
+    Tm = len(pred_hist)
+    preds = np.stack(pred_hist)            # (T, B, beam)
+    parents = np.stack(parent_hist)
+    out = np.zeros_like(preds)
+    for b in range(B):
+        for k in range(beam):
+            j = k
+            for t in reversed(range(Tm)):
+                out[t, b, k] = preds[t, b, j]
+                j = parents[t, b, j]
+    return out, lengths
+
+
+def test_beam_search_decoder_matches_numpy():
+    _fresh()
+    B, V, D, beam, steps = 2, 7, 5, 3, 5
+    enc = fluid.data("enc", (D,), "float32")  # (B, D) encoder final state
+
+    emb_w = fluid.ParamAttr(name="trg_emb")
+    out_w = fluid.ParamAttr(name="out_w")
+
+    def embedding_fn(ids):
+        return layers.embedding(ids, size=[V, D], param_attr=emb_w)
+
+    def output_fn(x):
+        return layers.fc(x, size=V, num_flatten_dims=len(x.shape) - 1,
+                         param_attr=out_w, bias_attr=False)
+
+    cell = layers.GRUCell(hidden_size=D, name="decgru")
+    decoder = layers.BeamSearchDecoder(
+        cell, start_token=0, end_token=1, beam_size=beam,
+        embedding_fn=embedding_fn, output_fn=output_fn)
+    outputs, final_states = layers.dynamic_decode(
+        decoder, inits=enc, max_step_num=steps - 1)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(7)
+    encv = rng.standard_normal((B, D)).astype("float32")
+    pred_v = np.asarray(
+        exe.run(feed={"enc": encv}, fetch_list=[outputs])[0])
+
+    prog = fluid.default_main_program()
+    name2p = {p.name: p for p in prog.global_block().all_parameters()}
+    gw, gb, cw, cb = _fetch_params(
+        exe, [n for n in name2p if n.startswith("decgru")])
+    (ew,) = _fetch_params(exe, ["trg_emb"])
+    (ow,) = _fetch_params(exe, ["out_w"])
+
+    # oracle starts from the tiled encoder state
+    kinf = 1e9
+    ref_pred, _ = _np_beam_search_with_h0(
+        gw, gb, cw, cb, ew, ow, B, V, D, beam, 0, 1, steps,
+        h0=np.tile(encv[:, None, :], (1, beam, 1)))
+    # fluid returns batch-major (B, T, beam)
+    np.testing.assert_array_equal(pred_v, ref_pred.transpose(1, 0, 2))
+
+
+def _np_beam_search_with_h0(gw, gb, cw, cb, ew, ow, B, V, D, beam, start,
+                            end, steps, h0):
+    kinf = 1e9
+    h = h0.astype("float32").copy()
+    log_probs = np.tile(
+        np.array([[0.0] + [-kinf] * (beam - 1)], "float32"), (B, 1))
+    finished = np.zeros((B, beam), bool)
+    lengths = np.zeros((B, beam), "int64")
+    ids = np.full((B, beam), start, "int64")
+    pred_hist, parent_hist = [], []
+    for _ in range(steps):
+        emb = ew[ids]
+        xh = np.concatenate([emb, h], axis=-1)
+        gates = _sigmoid(xh @ gw + gb)
+        r, u = gates[..., :D], gates[..., D:]
+        cand = np.tanh(np.concatenate([emb, r * h], axis=-1) @ cw + cb)
+        h_new = u * h + (1 - u) * cand
+        logits = h_new @ ow
+        mx = logits.max(-1, keepdims=True)
+        lp = np.log(np.exp(logits - mx)
+                    / np.exp(logits - mx).sum(-1, keepdims=True))
+        noend = np.full((V,), -kinf, "float32")
+        noend[end] = 0.0
+        lp = np.where(finished[..., None], noend, lp)
+        flat = (lp + log_probs[..., None]).reshape(B, beam * V)
+        top = np.argsort(-flat, axis=1, kind="stable")[:, :beam]
+        log_probs = np.take_along_axis(flat, top, axis=1)
+        beam_idx = top // V
+        token_idx = top % V
+        h = np.take_along_axis(h_new, beam_idx[..., None], axis=1)
+        finished = np.take_along_axis(finished, beam_idx, axis=1)
+        lengths = np.take_along_axis(lengths, beam_idx, axis=1)
+        lengths = lengths + (~finished).astype("int64")
+        finished = finished | (token_idx == end)
+        pred_hist.append(token_idx)
+        parent_hist.append(beam_idx)
+        ids = token_idx
+    Tm = len(pred_hist)
+    preds = np.stack(pred_hist)
+    parents = np.stack(parent_hist)
+    out = np.zeros_like(preds)
+    for b in range(B):
+        for k in range(beam):
+            j = k
+            for t in reversed(range(Tm)):
+                out[t, b, k] = preds[t, b, j]
+                j = parents[t, b, j]
+    return out, lengths
+
+
+# ---------------------------------------------------------------------------
+# dynamic_lstmp
+# ---------------------------------------------------------------------------
+def test_dynamic_lstmp_matches_numpy():
+    _fresh()
+    B, T, D, P = 2, 4, 6, 3
+    xp = fluid.data("xp", (T, 4 * D), "float32")
+    proj, cell = layers.dynamic_lstmp(
+        xp, size=4 * D, proj_size=P, use_peepholes=False)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(9)
+    xv = rng.standard_normal((B, T, 4 * D)).astype("float32")
+    proj_v, cell_v = exe.run(feed={"xp": xv}, fetch_list=[proj, cell])
+
+    prog = fluid.default_main_program()
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    w, w_proj, b = _fetch_params(exe, pnames)
+    r = np.zeros((B, P), "float32")
+    c = np.zeros((B, D), "float32")
+    rs, cs = [], []
+    for t in range(T):
+        gates = xv[:, t] + b.reshape(1, -1) + r @ w
+        i, g, f, o = (gates[:, :D], gates[:, D:2 * D],
+                      gates[:, 2 * D:3 * D], gates[:, 3 * D:])
+        c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+        h = _sigmoid(o) * np.tanh(c)
+        r = np.tanh(h @ w_proj)
+        rs.append(r)
+        cs.append(c)
+    np.testing.assert_allclose(
+        np.asarray(proj_v), np.stack(rs, 1), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(cell_v), np.stack(cs, 1), rtol=2e-5, atol=2e-5)
+
+
+def test_dynamic_lstmp_peephole_clip_runs():
+    _fresh()
+    B, T, D, P = 2, 3, 4, 2
+    xp = fluid.data("xp2", (T, 4 * D), "float32")
+    proj, cell = layers.dynamic_lstmp(
+        xp, size=4 * D, proj_size=P, use_peepholes=True,
+        cell_clip=1.0, proj_clip=0.5)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(13)
+    xv = rng.standard_normal((B, T, 4 * D)).astype("float32")
+    proj_v, cell_v = exe.run(feed={"xp2": xv}, fetch_list=[proj, cell])
+    assert np.abs(np.asarray(proj_v)).max() <= 0.5 + 1e-6
+    assert np.abs(np.asarray(cell_v)).max() <= 1.0 + 1e-6
+    assert np.isfinite(np.asarray(proj_v)).all()
+
+
+def test_get_initial_states_structure():
+    _fresh()
+    x = fluid.data("gis_x", (4,), "float32")
+    cell = layers.LSTMCell(hidden_size=6)
+    states = cell.get_initial_states(batch_ref=x)
+    assert isinstance(states, list) and len(states) == 2
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    a, b = exe.run(feed={"gis_x": np.zeros((3, 4), "float32")},
+                   fetch_list=list(states))
+    assert np.asarray(a).shape == (3, 6) and np.asarray(b).shape == (3, 6)
+
+
+def test_rnn_time_major():
+    _fresh()
+    B, T, D_in, D = 3, 7, 4, 6
+    # time-major layout: declare the full (T, B, D_in) shape with the
+    # batch placeholder in dim 1, not the auto-prepended dim 0
+    x = layers.data("xtm", (T, -1, D_in), append_batch_size=False,
+                    dtype="float32")
+    cell = layers.GRUCell(hidden_size=D, name="tmgru")
+    outs, final = layers.rnn(cell, x, time_major=True)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(17)
+    xv = rng.standard_normal((T, B, D_in)).astype("float32")
+    out_v, fin_v = exe.run(feed={"xtm": xv}, fetch_list=[outs, final])
+    assert np.asarray(out_v).shape == (T, B, D)
+    assert np.asarray(fin_v).shape == (B, D)
+    np.testing.assert_allclose(np.asarray(out_v)[-1], np.asarray(fin_v),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_dynamic_decode_final_states_are_final():
+    _fresh()
+    B, V, D, beam, steps = 2, 6, 4, 2, 4
+    enc = fluid.data("encf", (D,), "float32")
+    cell = layers.GRUCell(hidden_size=D, name="fsgru")
+    decoder = layers.BeamSearchDecoder(
+        cell, start_token=0, end_token=1, beam_size=beam,
+        embedding_fn=lambda ids: layers.embedding(
+            ids, size=[V, D], param_attr=fluid.ParamAttr(name="fsemb")),
+        output_fn=lambda x: layers.fc(
+            x, size=V, num_flatten_dims=len(x.shape) - 1, bias_attr=False))
+    outputs, final_states = layers.dynamic_decode(
+        decoder, inits=enc, max_step_num=steps - 1)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(19)
+    encv = rng.standard_normal((B, D)).astype("float32")
+    lens, fin, lp = exe.run(
+        feed={"encf": encv},
+        fetch_list=[final_states.lengths, final_states.finished,
+                    final_states.log_probs])
+    lens = np.asarray(lens)
+    # lengths must have advanced past t=0 (the round-1 bug returned all 0)
+    assert lens.max() >= 1, lens
+    assert lens.max() <= steps
+    assert np.asarray(lp).shape == (B, beam)
+    assert np.asarray(fin).dtype == bool
