@@ -4,6 +4,8 @@
 Creates parameters in both startup (initializer op) and main programs,
 appends ops, and applies activation/bias epilogues.
 """
+import copy
+
 from . import core
 from . import unique_name
 from .framework import (
@@ -112,6 +114,11 @@ class LayerHelper:
         if attr is False:
             return None
         attr = attr if isinstance(attr, ParamAttr) else ParamAttr._to_attr(attr)
+        # work on a copy (ref layer_helper_base.py does the same): one attr
+        # instance is commonly shared across a layer's weights, and setting
+        # a generated name / default initializer on the caller's object
+        # would alias every later parameter to the first one
+        attr = copy.deepcopy(attr)
         if default_initializer is None:
             if is_bias:
                 attr._set_default_bias_initializer()

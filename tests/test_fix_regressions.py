@@ -187,3 +187,36 @@ def test_prune_keeps_cond_branch_params():
         fetch_list=[out.name],
     )[0]
     assert res.shape == (2, 4)
+
+
+def test_dropout_rbg_mask_consistent_between_fwd_and_grad():
+    """The rbg dropout path (ops/nn_ops.py _dropout_keep_mask) must
+    reproduce the SAME mask in the vjp replay as in the forward pass:
+    grad(mean(dropout(x)*w)) w.r.t. x is nonzero exactly where the
+    forward output kept elements."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    prog.random_seed = 5
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("drx", (64,), "float32")
+        y = fluid.layers.dropout(
+            x, dropout_prob=0.5, dropout_implementation="upscale_in_train")
+        loss = fluid.layers.reduce_mean(y)
+        grads = fluid.backward.gradients([loss], [x])
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.default_rng(3).standard_normal((8, 64)).astype("float32")
+    xv[xv == 0] = 1.0
+    y_v, g_v = exe.run(prog, feed={"drx": xv}, fetch_list=[y, grads[0]])
+    y_v, g_v = np.asarray(y_v), np.asarray(g_v)
+    kept_fwd = y_v != 0
+    kept_bwd = g_v != 0
+    np.testing.assert_array_equal(kept_fwd, kept_bwd)
+    # masks advance with the step counter (fresh randomness each run)
+    y2 = np.asarray(exe.run(prog, feed={"drx": xv}, fetch_list=[y])[0])
+    assert (y_v != y2).any()
+    # keep rate plausible for p=0.5
+    assert 0.3 < kept_fwd.mean() < 0.7

@@ -158,64 +158,6 @@ def test_rnn_trains():
 # ---------------------------------------------------------------------------
 # dynamic_decode + BeamSearchDecoder
 # ---------------------------------------------------------------------------
-def _np_beam_search(gw, gb, cw, cb, ew, ow, B, V, D, beam, start, end,
-                    steps):
-    """Reference beam search over a GRU cell + embedding + output fc,
-    mirroring fluid's BeamSearchDecoder semantics."""
-    kinf = 1e9
-    h = np.zeros((B, beam, D), "float32")
-    log_probs = np.tile(
-        np.array([[0.0] + [-kinf] * (beam - 1)], "float32"), (B, 1))
-    finished = np.zeros((B, beam), bool)
-    lengths = np.zeros((B, beam), "int64")
-    ids = np.full((B, beam), start, "int64")
-    pred_hist, parent_hist = [], []
-    for _ in range(steps):
-        emb = ew[ids]                       # (B, beam, D)
-        xh = np.concatenate([emb, h], axis=-1)
-        gates = _sigmoid(xh @ gw + gb)
-        r, u = gates[..., :D], gates[..., D:]
-        cand = np.tanh(
-            np.concatenate([emb, r * h], axis=-1) @ cw + cb)
-        h_new = u * h + (1 - u) * cand
-        logits = h_new @ ow                 # (B, beam, V)
-        lp = np.log(
-            np.exp(logits - logits.max(-1, keepdims=True))
-            / np.exp(logits - logits.max(-1, keepdims=True)).sum(
-                -1, keepdims=True))
-        noend = np.full((V,), -kinf, "float32")
-        noend[end] = 0.0
-        fin = finished[..., None]
-        lp = np.where(fin, noend, lp)
-        total = lp + log_probs[..., None]
-        flat = total.reshape(B, beam * V)
-        top = np.argsort(-flat, axis=1, kind="stable")[:, :beam]
-        topk_scores = np.take_along_axis(flat, top, axis=1)
-        beam_idx = top // V
-        token_idx = top % V
-        log_probs = topk_scores
-        h = np.take_along_axis(h_new, beam_idx[..., None], axis=1)
-        finished = np.take_along_axis(finished, beam_idx, axis=1)
-        lengths = np.take_along_axis(lengths, beam_idx, axis=1)
-        lengths = lengths + (~finished).astype("int64")
-        finished = finished | (token_idx == end)
-        pred_hist.append(token_idx)
-        parent_hist.append(beam_idx)
-        ids = token_idx
-    # gather_tree backtrace
-    Tm = len(pred_hist)
-    preds = np.stack(pred_hist)            # (T, B, beam)
-    parents = np.stack(parent_hist)
-    out = np.zeros_like(preds)
-    for b in range(B):
-        for k in range(beam):
-            j = k
-            for t in reversed(range(Tm)):
-                out[t, b, k] = preds[t, b, j]
-                j = parents[t, b, j]
-    return out, lengths
-
-
 def test_beam_search_decoder_matches_numpy():
     _fresh()
     B, V, D, beam, steps = 2, 7, 5, 3, 5
@@ -424,3 +366,36 @@ def test_dynamic_decode_final_states_are_final():
     assert lens.max() <= steps
     assert np.asarray(lp).shape == (B, beam)
     assert np.asarray(fin).dtype == bool
+
+
+def test_shared_param_attr_not_aliased():
+    """A single ParamAttr instance passed to a multi-weight layer must
+    yield DISTINCT parameters (the helper deepcopies the attr, ref
+    layer_helper_base.py) — regression for gate/candidate weight
+    aliasing in GRUCell and Weight/ProjWeight in dynamic_lstmp."""
+    _fresh()
+    x = fluid.data("pax", (5, 4), "float32")
+    cell = layers.GRUCell(hidden_size=6, param_attr=fluid.ParamAttr())
+    outs, _ = layers.rnn(cell, x)
+    prog = fluid.default_main_program()
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    assert len(pnames) == len(set(pnames)) == 4, pnames
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    out = exe.run(feed={"pax": np.zeros((2, 5, 4), "float32")},
+                  fetch_list=[outs])[0]
+    assert np.asarray(out).shape == (2, 5, 6)
+
+    _fresh()
+    xp = fluid.data("paxp", (3, 24), "float32")
+    proj, _ = layers.dynamic_lstmp(
+        xp, size=24, proj_size=3, param_attr=fluid.ParamAttr(),
+        use_peepholes=False)
+    prog = fluid.default_main_program()
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    assert len(pnames) == len(set(pnames)) == 3, pnames
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    out = exe.run(feed={"paxp": np.zeros((2, 3, 24), "float32")},
+                  fetch_list=[proj])[0]
+    assert np.asarray(out).shape == (2, 3, 3)
