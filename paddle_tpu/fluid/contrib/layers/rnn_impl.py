@@ -7,11 +7,27 @@ reused on every subsequent call — so one unit instance used inside a
 StaticRNN step traces the SAME weights at every time step and the whole
 recurrence lowers to one lax.scan.
 """
+import copy
+
 from ...initializer import Constant
 from ...layer_helper import LayerHelper
+from ...param_attr import ParamAttr
 from ... import unique_name
 
 __all__ = ["BasicGRUUnit", "BasicLSTMUnit"]
+
+
+def _role_attr(attr, suffix):
+    """Per-role copy of a (possibly named) ParamAttr: a user-supplied
+    name gets the role suffix so a unit's multiple weights never alias
+    (ref rnn_impl.py renames per weight the same way)."""
+    if attr is None or attr is False:
+        return attr
+    a = attr if isinstance(attr, ParamAttr) else ParamAttr._to_attr(attr)
+    a = copy.deepcopy(a)
+    if a.name:
+        a.name = a.name + suffix
+    return a
 
 
 class _LazyUnit:
@@ -59,16 +75,18 @@ class BasicGRUUnit(_LazyUnit):
         in_width = input.shape[-1]
         if not self._built:
             self._gate_w = helper.create_parameter(
-                attr=helper.param_attr, shape=[in_width + D, 2 * D],
-                dtype=self._dtype)
+                attr=_role_attr(helper.param_attr, "_gate_weight"),
+                shape=[in_width + D, 2 * D], dtype=self._dtype)
             self._gate_b = helper.create_parameter(
-                attr=helper.bias_attr, shape=[2 * D], dtype=self._dtype,
+                attr=_role_attr(helper.bias_attr, "_gate_bias"),
+                shape=[2 * D], dtype=self._dtype,
                 is_bias=True, default_initializer=Constant(0.0))
             self._cand_w = helper.create_parameter(
-                attr=helper.param_attr, shape=[in_width + D, D],
-                dtype=self._dtype)
+                attr=_role_attr(helper.param_attr, "_candidate_weight"),
+                shape=[in_width + D, D], dtype=self._dtype)
             self._cand_b = helper.create_parameter(
-                attr=helper.bias_attr, shape=[D], dtype=self._dtype,
+                attr=_role_attr(helper.bias_attr, "_candidate_bias"),
+                shape=[D], dtype=self._dtype,
                 is_bias=True, default_initializer=Constant(0.0))
             self._built = True
 
@@ -114,10 +132,11 @@ class BasicLSTMUnit(_LazyUnit):
         in_width = input.shape[-1]
         if not self._built:
             self._w = helper.create_parameter(
-                attr=helper.param_attr, shape=[in_width + D, 4 * D],
-                dtype=self._dtype)
+                attr=_role_attr(helper.param_attr, "_weight"),
+                shape=[in_width + D, 4 * D], dtype=self._dtype)
             self._b = helper.create_parameter(
-                attr=helper.bias_attr, shape=[4 * D], dtype=self._dtype,
+                attr=_role_attr(helper.bias_attr, "_bias"),
+                shape=[4 * D], dtype=self._dtype,
                 is_bias=True, default_initializer=Constant(0.0))
             self._built = True
 
@@ -135,3 +154,106 @@ class BasicLSTMUnit(_LazyUnit):
             L.elementwise_mul(gate_act(i), act(j)))
         new_hidden = L.elementwise_mul(act(new_cell), gate_act(o))
         return new_hidden, new_cell
+
+
+def _stacked_rnn(input, init_states, make_cell, hidden_size, num_layers,
+                 sequence_length, dropout_prob, bidirectional, batch_first,
+                 name):
+    """Shared driver for basic_gru/basic_lstm (ref rnn_impl.py:139,358):
+    num_layers x (1 or 2 directions) of layers.rnn() stacked, inter-layer
+    dropout, outputs concatenated over directions. init_states is a list
+    of per-state stacked tensors shaped (L*ndir, B, D) or Nones; a None
+    entry zero-initialises that state independently of the others."""
+    from ...layers import nn as L
+    from ...layers import tensor as T
+    from ... import layers as lay
+
+    ndir = 2 if bidirectional else 1
+    time_major = not batch_first
+    batch_dim = 1 if time_major else 0
+
+    def _slice_init(stacked, idx):
+        if stacked is None:
+            # zero state batched like the input's batch dim
+            return T.fill_constant_batch_size_like(
+                input=input, shape=[-1, hidden_size], dtype="float32",
+                value=0.0, input_dim_idx=batch_dim)
+        s = L.slice(stacked, axes=[0], starts=[idx], ends=[idx + 1])
+        return L.squeeze(s, [0])
+
+    cur = input
+    last_per_state = None
+    for layer in range(num_layers):
+        dir_outs, dir_lasts = [], []
+        for d in range(ndir):
+            idx = layer * ndir + d
+            cell = make_cell("%s_l%d_%s" % (name, layer,
+                                            "fw" if d == 0 else "bw"))
+            init = [_slice_init(st, idx) for st in init_states]
+            init = init[0] if len(init) == 1 else init
+            out, last = lay.rnn(
+                cell, cur, initial_states=init,
+                sequence_length=sequence_length,
+                time_major=time_major, is_reverse=(d == 1))
+            dir_outs.append(out)
+            last = last if isinstance(last, (list, tuple)) else [last]
+            dir_lasts.append(list(last))
+        cur = (dir_outs[0] if ndir == 1
+               else lay.concat(dir_outs, axis=-1))
+        if last_per_state is None:
+            last_per_state = [[] for _ in dir_lasts[0]]
+        for dl in dir_lasts:
+            for si, sv in enumerate(dl):
+                last_per_state[si].append(sv)
+        if dropout_prob and layer != num_layers - 1:
+            cur = L.dropout(cur, dropout_prob,
+                            dropout_implementation="upscale_in_train")
+    lasts = [L.stack(vs, axis=0) for vs in last_per_state]
+    return cur, lasts
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """Multi-layer (bi)GRU over BasicGRUUnit (ref rnn_impl.py:139).
+    Returns (rnn_out, last_hidden) with last_hidden (L*ndir, B, D)."""
+    from ...layers.rnn_cells import GRUCell
+
+    def make_cell(nm):
+        suffix = nm[len(name):]            # "_l0_fw" etc.
+        return GRUCell(hidden_size, _role_attr(param_attr, suffix),
+                       _role_attr(bias_attr, suffix),
+                       gate_activation, activation, dtype, name=nm)
+
+    out, lasts = _stacked_rnn(
+        input, [init_hidden], make_cell, hidden_size, num_layers,
+        sequence_length, dropout_prob, bidirectional, batch_first, name)
+    return out, lasts[0]
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype="float32", name="basic_lstm"):
+    """Multi-layer (bi)LSTM over BasicLSTMUnit (ref rnn_impl.py:358).
+    Returns (rnn_out, last_hidden, last_cell), each last (L*ndir, B, D)."""
+    from ...layers.rnn_cells import LSTMCell
+
+    def make_cell(nm):
+        suffix = nm[len(name):]            # "_l0_fw" etc.
+        return LSTMCell(hidden_size, _role_attr(param_attr, suffix),
+                        _role_attr(bias_attr, suffix),
+                        gate_activation, activation, forget_bias, dtype,
+                        name=nm)
+
+    out, lasts = _stacked_rnn(
+        input, [init_hidden, init_cell], make_cell, hidden_size,
+        num_layers, sequence_length, dropout_prob, bidirectional,
+        batch_first, name)
+    return out, lasts[0], lasts[1]
+
+
+__all__ += ["basic_gru", "basic_lstm"]

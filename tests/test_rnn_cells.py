@@ -399,3 +399,117 @@ def test_shared_param_attr_not_aliased():
     out = exe.run(feed={"paxp": np.zeros((2, 3, 24), "float32")},
                   fetch_list=[proj])[0]
     assert np.asarray(out).shape == (2, 3, 3)
+
+
+def test_basic_gru_single_layer_matches_rnn_oracle():
+    from paddle_tpu.fluid.contrib.layers import basic_gru
+
+    _fresh()
+    B, T, D_in, D = 2, 4, 3, 5
+    x = fluid.data("bgx", (T, D_in), "float32")
+    out, last_h = basic_gru(x, None, D, num_layers=1, name="bg1")
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(21)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    out_v, lh_v = exe.run(feed={"bgx": xv}, fetch_list=[out, last_h])
+    out_v, lh_v = np.asarray(out_v), np.asarray(lh_v)
+    assert out_v.shape == (B, T, D)
+    assert lh_v.shape == (1, B, D)
+    prog = fluid.default_main_program()
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    gw, gb, cw, cb = _fetch_params(exe, pnames)
+    h = np.zeros((B, D), "float32")
+    for t in range(T):
+        gates = _sigmoid(np.concatenate([xv[:, t], h], 1) @ gw + gb)
+        r, u = gates[:, :D], gates[:, D:]
+        cand = np.tanh(np.concatenate([xv[:, t], r * h], 1) @ cw + cb)
+        h = u * h + (1 - u) * cand
+        np.testing.assert_allclose(out_v[:, t], h, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(lh_v[0], h, rtol=2e-5, atol=2e-5)
+
+
+def test_basic_lstm_bidirectional_stacked():
+    from paddle_tpu.fluid.contrib.layers import basic_lstm
+
+    _fresh()
+    B, T, D_in, D, L = 2, 5, 4, 6, 2
+    x = fluid.data("blx", (T, D_in), "float32")
+    out, last_h, last_c = basic_lstm(
+        x, None, None, D, num_layers=L, bidirectional=True,
+        dropout_prob=0.0, name="bl2")
+    y = fluid.data("bly", (1,), "float32")
+    pred = layers.fc(layers.reduce_mean(out, dim=1), 1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(0.02).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(23)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    yv = xv.sum((1, 2))[:, None].astype("float32")
+    o, lh, lc = exe.run(feed={"blx": xv, "bly": yv},
+                        fetch_list=[out, last_h, last_c])
+    assert np.asarray(o).shape == (B, T, 2 * D)
+    assert np.asarray(lh).shape == (2 * L, B, D)
+    assert np.asarray(lc).shape == (2 * L, B, D)
+    first = last = None
+    for _ in range(30):
+        (lv,) = exe.run(feed={"blx": xv, "bly": yv}, fetch_list=[loss])
+        first = float(lv) if first is None else first
+        last = float(lv)
+    assert last < first * 0.7, (first, last)
+
+
+def test_basic_gru_init_hidden_consumed():
+    from paddle_tpu.fluid.contrib.layers import basic_gru
+
+    _fresh()
+    B, T, D_in, D = 2, 3, 3, 4
+    x = fluid.data("bghx", (T, D_in), "float32")
+    h0 = layers.data("bgh0", (1, -1, D), append_batch_size=False,
+                     dtype="float32")
+    out, last_h = basic_gru(x, h0, D, num_layers=1, name="bgh")
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(29)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    h0a = rng.standard_normal((1, B, D)).astype("float32")
+    h0b = np.zeros((1, B, D), "float32")
+    oa = np.asarray(exe.run(feed={"bghx": xv, "bgh0": h0a},
+                            fetch_list=[out])[0])
+    ob = np.asarray(exe.run(feed={"bghx": xv, "bgh0": h0b},
+                            fetch_list=[out])[0])
+    assert not np.allclose(oa, ob)  # init hidden actually flows in
+
+
+def test_basic_lstm_partial_init_and_named_attr():
+    """init_hidden without init_cell must still flow in (not silently
+    zero both), and a NAMED param_attr must produce distinct per-layer
+    per-direction per-role parameters."""
+    from paddle_tpu.fluid.contrib.layers import basic_lstm
+
+    _fresh()
+    B, T, D_in, D = 2, 3, 3, 4
+    x = fluid.data("plx", (T, D_in), "float32")
+    h0 = layers.data("plh0", (1, -1, D), append_batch_size=False,
+                     dtype="float32")
+    out, lh, lc = basic_lstm(
+        x, h0, None, D, num_layers=2, bidirectional=False,
+        param_attr=fluid.ParamAttr(name="bl_named"), name="blpi")
+    prog = fluid.default_main_program()
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    assert len(pnames) == len(set(pnames)), pnames
+    named = [n for n in pnames if n.startswith("bl_named")]
+    assert len(named) == 2, named  # one weight per layer, role-suffixed
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(31)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    oa = np.asarray(exe.run(
+        feed={"plx": xv,
+              "plh0": rng.standard_normal((2, B, D)).astype("float32")},
+        fetch_list=[out])[0])
+    ob = np.asarray(exe.run(
+        feed={"plx": xv, "plh0": np.zeros((2, B, D), "float32")},
+        fetch_list=[out])[0])
+    assert not np.allclose(oa, ob)  # h0 flows in despite init_cell=None
