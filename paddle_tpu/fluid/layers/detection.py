@@ -113,7 +113,7 @@ def density_prior_box(input, image, densities=None, fixed_sizes=None,
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
-             downsample_ratio, name=None, clip_bbox=True):
+             downsample_ratio, clip_bbox=True, name=None):
     helper = LayerHelper("yolo_box", **locals())
     boxes = helper.create_variable_for_type_inference(x.dtype)
     scores = helper.create_variable_for_type_inference(x.dtype)
@@ -185,7 +185,14 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
 
 def detection_output(loc, scores, prior_box, prior_box_var,
                      background_label=0, nms_threshold=0.3, nms_top_k=400,
-                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    if return_index:
+        raise NotImplementedError(
+            "detection_output(return_index=True): the TPU static-shape "
+            "NMS emits fixed keep_top_k rows per image (padded with "
+            "label=-1), so there is no LoD row-index companion; consume "
+            "the padded rows directly or filter on label >= 0.")
     decoded = box_coder(
         prior_box, prior_box_var, loc, code_type="decode_center_size"
     )
@@ -723,7 +730,7 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
 
 def roi_perspective_transform(input, rois, transformed_height,
                               transformed_width, spatial_scale=1.0,
-                              rois_batch_idx=None):
+                              name=None, rois_batch_idx=None):
     """Perspective-warp quad rois (ref detection.py:2360). rois are
     (R, 8) quads; companion rois_batch_idx (R,) int32 maps each roi to
     its batch image (LoD → dense)."""

@@ -1040,7 +1040,14 @@ def data_norm(
     moving_mean_name=None,
     moving_variance_name=None,
     do_model_average_for_mean_and_var=False,
+    slot_dim=-1,
+    sync_stats=False,
+    summary_decay_rate=0.9999999,
 ):
+    # slot_dim / sync_stats / summary_decay_rate (ref nn.py data_norm) are
+    # CTR-pserver knobs: sync_stats maps to a psum under data parallelism
+    # (stats already consistent per-replica here); slot-aware init does
+    # not apply to the dense TPU path
     helper = LayerHelper("data_norm", **locals())
     dtype = helper.input_dtype()
     c = input.shape[1]
@@ -2342,8 +2349,9 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
     return out
 
 
-def prroi_pool(input, rois, output_channels=None, spatial_scale=1.0,
-               pooled_height=1, pooled_width=1, name=None):
+def prroi_pool(input, rois, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, name=None,
+               output_channels=None):
     """Precise ROI pooling (ref nn.py:12475): integral of the bilinear
     surface over each bin, differentiable in the roi coordinates."""
     helper = LayerHelper("prroi_pool", **locals())

@@ -207,7 +207,8 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None):
 
 
 def fill_constant_batch_size_like(
-    input, shape, dtype, value, input_dim_idx=0, output_dim_idx=0
+    input, shape, dtype, value, input_dim_idx=0, output_dim_idx=0,
+    force_cpu=False
 ):
     helper = LayerHelper("fill_constant_batch_size_like", **locals())
     out = helper.create_variable_for_type_inference(dtype=dtype)

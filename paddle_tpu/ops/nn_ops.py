@@ -256,6 +256,11 @@ def _conv2d(ctx, ins, attrs):
             padding = [(pads[0], pads[1]), (pads[2], pads[3])]
         else:
             padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+    # no preferred_element_type here: the TPU MXU accumulates bf16 convs
+    # in f32 internally already, and jax's conv transpose (grad) rule
+    # does not thread the widened output dtype — the f32 cotangent then
+    # meets the bf16 lhs and conv_general_dilated rejects the mix (the
+    # bf16 ResNet AMP path failed exactly there)
     out = lax.conv_general_dilated(
         x,
         w,
@@ -264,9 +269,8 @@ def _conv2d(ctx, ins, attrs):
         rhs_dilation=dilations,
         feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
-    return {"Output": [out.astype(x.dtype)]}
+    return {"Output": [out]}
 
 
 @register_op("depthwise_conv2d")

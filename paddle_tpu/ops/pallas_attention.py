@@ -610,11 +610,14 @@ def _fused_mha_lowering(ctx, ins, attrs):
     key = ctx.next_rng() if p > 0.0 else None
     import os
     platform = ctx.platform or jax.default_backend()
-    # measured on v5e (BERT-base, T=128): XLA's own fusion beats the flash
-    # kernel at short T (104k vs 80k tok/s) — the T x T tile is tiny and
-    # flash's lse/stats traffic dominates. The kernel pays off once the
-    # score tensor stops fitting cache-friendly sizes.
-    min_t = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", 512))
+    # measured on v5e (BERT-base): XLA's own attention fusion beats the
+    # pallas flash kernel at EVERY length tried — T=128: 104k vs 80k,
+    # T=512: 91k vs 69k, T=1024: 68k vs 51k, T=2048: 42k vs 34k tok/s —
+    # so auto-engage is off by default; set PADDLE_TPU_FLASH_MIN_SEQ to a
+    # threshold to opt in (the kernel is correctness-tested and remains
+    # the basis for the masked/dropout ring-attention block path).
+    _flash_env = os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ")
+    min_t = int(_flash_env) if _flash_env else (1 << 30)
     use_pallas = (
         platform == "tpu"
         and not ctx.mesh_axes
