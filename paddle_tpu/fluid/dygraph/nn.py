@@ -22,12 +22,23 @@ def _pair(v, n=2):
     return list(v) if isinstance(v, (list, tuple)) else [v] * n
 
 
+def _reject_name_scope(first, cls):
+    if isinstance(first, str):
+        raise TypeError(
+            "%s() no longer takes name_scope as its first argument (the "
+            "reference dropped it — dygraph/nn.py); pass the layer's "
+            "dimensions directly, e.g. Conv2D(num_channels, num_filters, "
+            "filter_size)" % cls)
+
+
+
 class Conv2D(Layer):
-    def __init__(self, name_scope, num_filters, filter_size, stride=1,
-                 padding=0, dilation=1, groups=None, param_attr=None,
-                 bias_attr=None, use_cudnn=True, act=None, dtype="float32",
-                 num_channels=None):
-        super().__init__(name_scope, dtype)
+    def __init__(self, num_channels, num_filters=None, filter_size=None,
+                 stride=1, padding=0, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        _reject_name_scope(num_channels, "Conv2D")
+        super().__init__(None, dtype)
         self._num_filters = num_filters
         self._filter_size = _pair(filter_size)
         self._stride = _pair(stride)
@@ -88,13 +99,20 @@ class Conv2D(Layer):
 
 
 class Conv3D(Conv2D):
-    def __init__(self, name_scope, num_filters, filter_size, **kw):
-        kw.setdefault("stride", 1)
-        super().__init__(name_scope, num_filters, filter_size, **kw)
+    def __init__(self, num_channels, num_filters=None, filter_size=None,
+                 stride=1, padding=0, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        _reject_name_scope(num_channels, "Conv3D")
+        super().__init__(num_channels, num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups, param_attr=param_attr,
+                         bias_attr=bias_attr, use_cudnn=use_cudnn, act=act,
+                         dtype=dtype)
         self._filter_size = _pair(filter_size, 3)
-        self._stride = _pair(kw.get("stride", 1), 3)
-        self._padding = _pair(kw.get("padding", 0), 3)
-        self._dilation = _pair(kw.get("dilation", 1), 3)
+        self._stride = _pair(stride, 3)
+        self._padding = _pair(padding, 3)
+        self._dilation = _pair(dilation, 3)
 
     def forward(self, input):
         if self.weight is None:
@@ -120,11 +138,13 @@ class Conv3D(Conv2D):
 
 
 class Conv2DTranspose(Layer):
-    def __init__(self, name_scope, num_filters, filter_size, output_size=None,
-                 padding=0, stride=1, dilation=1, groups=None,
-                 param_attr=None, bias_attr=None, use_cudnn=True, act=None,
-                 dtype="float32"):
-        super().__init__(name_scope, dtype)
+    def __init__(self, num_channels, num_filters=None, filter_size=None,
+                 output_size=None, padding=0, stride=1, dilation=1,
+                 groups=None, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        _reject_name_scope(num_channels, "Conv2DTranspose")
+        super().__init__(None, dtype)
+        self._num_channels = num_channels
         self._num_filters = num_filters
         self._filter_size = _pair(filter_size)
         self._padding = _pair(padding)
@@ -139,7 +159,7 @@ class Conv2DTranspose(Layer):
 
     def forward(self, input):
         if self.weight is None:
-            channels = input.shape[1]
+            channels = self._num_channels or input.shape[1]
             self.weight = self.create_parameter(
                 attr=self._param_attr,
                 shape=[channels, self._num_filters // self._groups]
@@ -176,11 +196,13 @@ class Conv2DTranspose(Layer):
 class Conv3DTranspose(Layer):
     """ref dygraph/nn.py:491 Conv3DTranspose → conv3d_transpose lowering."""
 
-    def __init__(self, name_scope, num_filters, filter_size, output_size=None,
+    def __init__(self, num_channels, num_filters=None, filter_size=None,
                  padding=0, stride=1, dilation=1, groups=None,
                  param_attr=None, bias_attr=None, use_cudnn=True, act=None,
-                 dtype="float32"):
-        super().__init__(name_scope, dtype)
+                 dtype="float32", output_size=None):
+        _reject_name_scope(num_channels, "Conv3DTranspose")
+        super().__init__(None, dtype)
+        self._num_channels = num_channels
         self._num_filters = num_filters
         self._filter_size = _pair(filter_size, 3)
         self._output_size = (
@@ -198,7 +220,7 @@ class Conv3DTranspose(Layer):
 
     def forward(self, input):
         if self.weight is None:
-            channels = input.shape[1]
+            channels = self._num_channels or input.shape[1]
             self.weight = self.create_parameter(
                 attr=self._param_attr,
                 shape=[channels, self._num_filters // self._groups]
@@ -327,14 +349,11 @@ class TreeConv(Layer):
     """ref dygraph/nn.py:2970 TreeConv (TBCNN continuous binary tree) →
     tree_conv lowering (reachability matmuls)."""
 
-    def __init__(self, name_scope, feature_size=None, output_size=None,
+    def __init__(self, feature_size, output_size=None,
                  num_filters=1, max_depth=2, act="tanh", param_attr=None,
                  bias_attr=None, name=None, dtype="float32"):
-        # also accept the 1.7+ signature TreeConv(feature_size, output_size)
-        if output_size is None and isinstance(name_scope, int):
-            feature_size, output_size = name_scope, feature_size
-            name_scope = "tree_conv"
-        super().__init__(name_scope, dtype)
+        _reject_name_scope(feature_size, "TreeConv")
+        super().__init__(None, dtype)
         self._feature_size = feature_size
         self._output_size = output_size
         self._num_filters = num_filters
@@ -377,11 +396,12 @@ class TreeConv(Layer):
 
 
 class Pool2D(Layer):
-    def __init__(self, name_scope, pool_size=-1, pool_type="max",
+    def __init__(self, pool_size=-1, pool_type="max",
                  pool_stride=1, pool_padding=0, global_pooling=False,
                  use_cudnn=True, ceil_mode=False, exclusive=True,
                  dtype="float32"):
-        super().__init__(name_scope, dtype)
+        _reject_name_scope(pool_size, "Pool2D")
+        super().__init__(None, dtype)
         self._attrs = {
             "pooling_type": pool_type,
             "ksize": _pair(pool_size),
@@ -478,13 +498,15 @@ class FC(Layer):
 
 
 class BatchNorm(Layer):
-    def __init__(self, name_scope, num_channels, act=None, is_test=False,
+    def __init__(self, num_channels, act=None, is_test=False,
                  momentum=0.9, epsilon=1e-05, param_attr=None,
                  bias_attr=None, dtype="float32", data_layout="NCHW",
                  in_place=False, moving_mean_name=None,
                  moving_variance_name=None, do_model_average_for_mean_and_var=False,
                  use_global_stats=False, trainable_statistics=False):
-        super().__init__(name_scope, dtype)
+        _reject_name_scope(num_channels, "BatchNorm")
+        super().__init__(None, dtype)
+        self._act = act
         self._momentum = momentum
         self._epsilon = epsilon
         self._data_layout = data_layout
@@ -533,14 +555,18 @@ class BatchNorm(Layer):
                 "use_global_stats": self._use_global_stats,
             },
         )
-        return outs["Y"][0]
+        y = outs["Y"][0]
+        if self._act:
+            y = call_op(self._act, {"X": [y]})
+        return y
 
 
 class Embedding(Layer):
-    def __init__(self, name_scope=None, size=None, is_sparse=False,
+    def __init__(self, size=None, is_sparse=False,
                  is_distributed=False, padding_idx=None, param_attr=None,
                  dtype="float32"):
-        super().__init__(name_scope or "embedding", dtype)
+        _reject_name_scope(size, "Embedding")
+        super().__init__(None, dtype)
         self._size = size
         self._padding_idx = (
             -1 if padding_idx is None else
@@ -559,13 +585,16 @@ class Embedding(Layer):
 
 
 class LayerNorm(Layer):
-    def __init__(self, name_scope, scale=True, shift=True,
-                 begin_norm_axis=1, epsilon=1e-05, param_attr=None,
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-05, param_attr=None,
                  bias_attr=None, act=None, dtype="float32"):
-        super().__init__(name_scope, dtype)
+        _reject_name_scope(normalized_shape, "LayerNorm")
+        super().__init__(None, dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
         self._scale = scale
         self._shift = shift
-        self._begin_norm_axis = begin_norm_axis
         self._epsilon = epsilon
         self._param_attr = param_attr
         self._bias_attr = bias_attr
@@ -574,8 +603,15 @@ class LayerNorm(Layer):
         self.bias = None
 
     def forward(self, input):
+        begin_norm_axis = len(input.shape) - len(self._normalized_shape)
+        if tuple(input.shape[begin_norm_axis:]) != tuple(
+                self._normalized_shape):
+            raise ValueError(
+                "LayerNorm normalized_shape %s does not match input tail "
+                "%s" % (self._normalized_shape,
+                        tuple(input.shape[begin_norm_axis:])))
         if self.weight is None and self._scale:
-            n = int(np.prod(input.shape[self._begin_norm_axis :]))
+            n = int(np.prod(self._normalized_shape))
             self.weight = self.create_parameter(
                 attr=self._param_attr, shape=[n], dtype=self._dtype,
                 default_initializer=Constant(1.0),
@@ -595,7 +631,7 @@ class LayerNorm(Layer):
             ins,
             {
                 "epsilon": self._epsilon,
-                "begin_norm_axis": self._begin_norm_axis,
+                "begin_norm_axis": begin_norm_axis,
             },
             out_slots=("Y", "Mean", "Variance"),
         )
@@ -606,10 +642,11 @@ class LayerNorm(Layer):
 
 
 class GRUUnit(Layer):
-    def __init__(self, name_scope, size, param_attr=None, bias_attr=None,
+    def __init__(self, size, param_attr=None, bias_attr=None,
                  activation="tanh", gate_activation="sigmoid",
                  origin_mode=False, dtype="float32"):
-        super().__init__(name_scope, dtype)
+        _reject_name_scope(size, "GRUUnit")
+        super().__init__(None, dtype)
         self._size = size  # 3*D
         d = size // 3
         self._d = d
@@ -648,11 +685,13 @@ class GRUUnit(Layer):
 
 
 class NCE(Layer):
-    def __init__(self, name_scope, num_total_classes, sample_weight=None,
+    def __init__(self, num_total_classes, dim=None, sample_weight=None,
                  param_attr=None, bias_attr=None, num_neg_samples=None,
                  sampler="uniform", custom_dist=None, seed=0,
                  is_sparse=False, dtype="float32"):
-        super().__init__(name_scope, dtype)
+        _reject_name_scope(num_total_classes, "NCE")
+        super().__init__(None, dtype)
+        self._dim = dim
         self._num_total_classes = num_total_classes
         self._num_neg_samples = num_neg_samples or 10
         self._param_attr = param_attr
@@ -662,7 +701,7 @@ class NCE(Layer):
 
     def forward(self, input, label, sample_weight=None):
         if self.weight is None:
-            dim = input.shape[1]
+            dim = self._dim or input.shape[1]
             self.weight = self.create_parameter(
                 attr=self._param_attr,
                 shape=[self._num_total_classes, dim],
@@ -691,9 +730,14 @@ class NCE(Layer):
 
 
 class PRelu(Layer):
-    def __init__(self, name_scope, mode, param_attr=None, dtype="float32",
-                 channel=None, input_shape=None):
-        super().__init__(name_scope, dtype)
+    def __init__(self, mode, input_shape=None, param_attr=None,
+                 dtype="float32", channel=None):
+        if mode not in ("all", "channel", "element"):
+            raise ValueError(
+                "PRelu mode must be 'all'/'channel'/'element', got %r "
+                "(the legacy (name_scope, mode) construction was removed "
+                "to match the reference)" % (mode,))
+        super().__init__(None, dtype)
         self._mode = mode
         self._param_attr = param_attr
         self._channel = channel
@@ -720,10 +764,14 @@ class PRelu(Layer):
 
 
 class BilinearTensorProduct(Layer):
-    def __init__(self, name_scope, size, name=None, act=None,
+    def __init__(self, input1_dim, input2_dim=None, output_dim=None,
+                 name=None, act=None,
                  param_attr=None, bias_attr=None, dtype="float32"):
-        super().__init__(name_scope, dtype)
-        self._size = size
+        _reject_name_scope(input1_dim, "BilinearTensorProduct")
+        super().__init__(None, dtype)
+        self._input1_dim = input1_dim
+        self._input2_dim = input2_dim
+        self._size = output_dim
         self._act = act
         self._param_attr = param_attr
         self._bias_attr = bias_attr
@@ -732,9 +780,11 @@ class BilinearTensorProduct(Layer):
 
     def forward(self, x, y):
         if self.weight is None:
+            d1 = self._input1_dim or x.shape[1]
+            d2 = self._input2_dim or y.shape[1]
             self.weight = self.create_parameter(
                 attr=self._param_attr,
-                shape=[self._size, x.shape[1], y.shape[1]],
+                shape=[self._size, d1, d2],
                 dtype=self._dtype,
             )
             if self._bias_attr is not False:
@@ -752,10 +802,17 @@ class BilinearTensorProduct(Layer):
 
 
 class GroupNorm(Layer):
-    def __init__(self, name_scope, groups, epsilon=1e-05, param_attr=None,
+    def __init__(self, channels, groups=None, epsilon=1e-05,
+                 param_attr=None,
                  bias_attr=None, act=None, data_layout="NCHW",
                  dtype="float32"):
-        super().__init__(name_scope, dtype)
+        _reject_name_scope(channels, "GroupNorm")
+        if groups is None:
+            raise ValueError(
+                "GroupNorm requires groups (ref signature: "
+                "GroupNorm(channels, groups, ...))")
+        super().__init__(None, dtype)
+        self._channels = channels
         self._groups = groups
         self._epsilon = epsilon
         self._param_attr = param_attr
@@ -766,7 +823,7 @@ class GroupNorm(Layer):
 
     def forward(self, input):
         if self.weight is None:
-            c = input.shape[1]
+            c = self._channels or input.shape[1]
             self.weight = self.create_parameter(
                 attr=self._param_attr, shape=[c], dtype=self._dtype,
                 default_initializer=Constant(1.0),
@@ -788,9 +845,11 @@ class GroupNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, name_scope, dim=0, power_iters=1, eps=1e-12,
+    def __init__(self, weight_shape=None, dim=0, power_iters=1, eps=1e-12,
                  dtype="float32"):
-        super().__init__(name_scope, dtype)
+        _reject_name_scope(weight_shape, "SpectralNorm")
+        super().__init__(None, dtype)
+        self._weight_shape = weight_shape
         self._dim = dim
         self._power_iters = power_iters
         self._eps = eps
@@ -798,6 +857,11 @@ class SpectralNorm(Layer):
         self._v = None
 
     def forward(self, weight):
+        if self._weight_shape is not None and tuple(weight.shape) != tuple(
+                self._weight_shape):
+            raise ValueError(
+                "SpectralNorm weight_shape %s does not match weight %s"
+                % (self._weight_shape, tuple(weight.shape)))
         if self._u is None:
             h = weight.shape[self._dim]
             w = int(np.prod(weight.shape)) // h

@@ -277,7 +277,11 @@ class Executor:
             if getattr(v, "seq_lens", None) is not None:
                 feed[seq_name] = np.asarray(v.seq_lens, dtype="int32")
             else:
-                shape = getattr(v, "_ndarray", v).shape  # no host copy
+                arr = getattr(v, "_ndarray", v)
+                # .shape avoids a host copy for device arrays; plain
+                # list/tuple feeds still go through np.asarray
+                shape = arr.shape if hasattr(arr, "shape") else \
+                    np.asarray(arr).shape
                 feed[seq_name] = np.full(
                     (shape[0],), shape[1], dtype="int32"
                 )

@@ -93,7 +93,7 @@ def test_traced_layer_matches_eager():
 
 def test_batchnorm_layer_updates_stats_and_eval_mode():
     with dygraph.guard():
-        bn = dygraph.BatchNorm("bn", num_channels=3)
+        bn = dygraph.BatchNorm(num_channels=3)
         x = dygraph.to_variable(
             (np.random.default_rng(0).standard_normal((4, 3, 5, 5)) * 2 + 1)
             .astype("float32")

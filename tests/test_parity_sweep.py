@@ -149,7 +149,7 @@ def test_dygraph_conv3dtranspose_seqconv_rowconv():
             np.random.RandomState(0).rand(1, 2, 3, 4, 4).astype("float32")
         )
         m = fluid.dygraph.nn.Conv3DTranspose(
-            "c3t", num_filters=3, filter_size=3, stride=2, padding=1,
+            2, num_filters=3, filter_size=3, stride=2, padding=1,
         )
         y = m(x3)
         assert y.shape[:2] == (1, 3)

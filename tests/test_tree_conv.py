@@ -141,7 +141,7 @@ def test_dygraph_tree_conv():
             np.array([[[1, 2], [1, 3], [3, 4]]], "int32")
         )
         m = fluid.dygraph.nn.TreeConv(
-            "tc", feature_size=3, output_size=5, num_filters=2, max_depth=2,
+            feature_size=3, output_size=5, num_filters=2, max_depth=2,
         )
         out = m(nv, es)
         assert out.shape == (1, 4, 5, 2)
