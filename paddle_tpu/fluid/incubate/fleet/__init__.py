@@ -1,3 +1,5 @@
 """incubate.fleet (ref: fluid/incubate/fleet)."""
 from . import base  # noqa: F401
 from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,8 @@
+"""fleet.parameter_server (ref: incubate/fleet/parameter_server).
+
+The reference's pserver training mode has no TPU counterpart — sparse
+updates flow over ICI collectives instead (see fluid/transpiler.py's
+documented re-mapping). The import path is kept so scripts can probe it;
+using the pserver fleet raises with that guidance.
+"""
+from . import distribute_transpiler  # noqa: F401

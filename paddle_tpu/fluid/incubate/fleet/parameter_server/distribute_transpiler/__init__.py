@@ -1,0 +1,27 @@
+"""Compat surface for fleet.parameter_server.distribute_transpiler
+(ref: incubate/fleet/parameter_server/distribute_transpiler/__init__.py:38).
+"""
+from ....fleet.collective import fleet as _collective_fleet  # noqa: F401
+
+_GUIDANCE = (
+    "fleet.parameter_server (pserver mode) does not exist on TPU: "
+    "parameters live sharded in HBM and gradients ride ICI "
+    "collectives. Use fluid.incubate.fleet.collective.fleet with "
+    "DistributedStrategy (dp/tp/sp/pp + sharding_degree for "
+    "ZeRO-1) instead."
+)
+
+
+class _PserverUnavailable(NotImplementedError, AttributeError):
+    """Raised on any pserver-fleet attribute: NotImplementedError for
+    parity with the other intentional raises, AttributeError so
+    hasattr()/getattr(..., default) feature probes degrade gracefully
+    instead of crashing."""
+
+
+class _PserverFleet:
+    def __getattr__(self, name):
+        raise _PserverUnavailable(_GUIDANCE)
+
+
+fleet = _PserverFleet()

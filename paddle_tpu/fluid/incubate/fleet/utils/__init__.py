@@ -1,0 +1,3 @@
+"""fleet.utils (ref: incubate/fleet/utils)."""
+from . import fleet_util  # noqa: F401
+from .fleet_util import FleetUtil  # noqa: F401

@@ -1,0 +1,56 @@
+"""FleetUtil (ref: incubate/fleet/utils/fleet_util.py:36) — rank-aware
+logging + small numeric helpers. Rank comes from jax.process_index()
+(multi-host) instead of the pserver role maker."""
+import logging
+
+import numpy as np
+
+__all__ = ["FleetUtil"]
+
+_logger = logging.getLogger("FleetUtil")
+_logger.setLevel(logging.INFO)
+if not _logger.handlers:
+    # the ref builds its logger with an attached StreamHandler
+    # (fleet_util.py get_logger); without one, INFO records are dropped
+    # by logging's WARNING-level lastResort handler
+    _handler = logging.StreamHandler()
+    _handler.setFormatter(logging.Formatter(
+        "%(levelname)s %(asctime)s %(message)s"))
+    _logger.addHandler(_handler)
+    _logger.propagate = False
+
+
+class FleetUtil:
+    def _rank(self):
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:  # noqa: BLE001 — uninitialised distributed
+            return 0
+
+    def rank0_print(self, s):
+        if self._rank() == 0:
+            print(s, flush=True)
+
+    def rank0_info(self, s):
+        if self._rank() == 0:
+            _logger.info(s)
+
+    def rank0_error(self, s):
+        if self._rank() == 0:
+            _logger.error(s)
+
+    def set_zero(self, var_name, scope=None, place=None, param_type="int64"):
+        """Reset a scope variable to zeros of `param_type`, keeping its
+        shape (ref fleet_util.py:107 re-types the stat var the same way;
+        `place` is accepted for signature parity — arrays are placed by
+        the executor on next use)."""
+        from ....executor import global_scope
+
+        scope = scope if scope is not None else global_scope()
+        cur = scope.find_value(var_name)
+        if cur is None:
+            raise KeyError("set_zero: no var named %r in scope" % var_name)
+        shape = np.asarray(cur).shape
+        scope.update(var_name, np.zeros(shape, dtype=param_type))

@@ -265,3 +265,65 @@ def test_elementwise_broadcast_fwd_grad():
     np.testing.assert_allclose(np.asarray(y_v), a_np * b_np, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(ga_v), ta.grad.numpy(), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gb_v), tb.grad.numpy(), rtol=1e-5)
+
+
+def test_conv2d_grads_vs_torch():
+    """conv2d input AND filter gradients vs torch autograd (the bf16 AMP
+    conv-backward bug showed conv grads were under-tested)."""
+    rng = np.random.default_rng(7)
+    x_np = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+    w_np = rng.standard_normal((4, 3, 3, 3)).astype("float32")
+
+    x = fluid.data(name="cx", shape=[2, 3, 8, 8], append_batch_size=False,
+                   dtype="float32", stop_gradient=False)
+    w_attr = fluid.ParamAttr(
+        name="cw", initializer=fluid.initializer.NumpyArrayInitializer(w_np))
+    y = fluid.layers.conv2d(x, 4, 3, stride=2, padding=1,
+                            param_attr=w_attr, bias_attr=False)
+    loss = fluid.layers.reduce_sum(y)
+    gx, gw = gradients(loss, [x, fluid.default_main_program()
+                              .global_block().var("cw")])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    y_v, gx_v, gw_v = exe.run(feed={"cx": x_np}, fetch_list=[y, gx, gw])
+
+    t_x = torch.tensor(x_np, requires_grad=True)
+    t_w = torch.tensor(w_np, requires_grad=True)
+    t_y = torch.nn.functional.conv2d(t_x, t_w, stride=2, padding=1)
+    t_y.sum().backward()
+    np.testing.assert_allclose(np.asarray(y_v), t_y.detach().numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gx_v), t_x.grad.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gw_v), t_w.grad.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_conv2d_bf16_amp_backward_runs():
+    """Regression: jax's conv transpose rule can't thread a widened
+    preferred_element_type — a bf16 AMP conv backward must compile and
+    run (it failed with a dtype mismatch before the fix)."""
+    from paddle_tpu.fluid.contrib.mixed_precision import decorate
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.data(name="ambx", shape=[3, 8, 8], dtype="float32")
+        lbl = fluid.data(name="amby", shape=[1], dtype="int64")
+        h = fluid.layers.conv2d(img, 4, 3, padding=1, act="relu")
+        h = fluid.layers.batch_norm(h)
+        logit = fluid.layers.fc(h, 5, act="softmax")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.cross_entropy(logit, lbl))
+        opt = decorate(fluid.optimizer.Momentum(0.05, 0.9), use_bf16=True)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(11)
+    feed = {"ambx": rng.standard_normal((4, 3, 8, 8)).astype("float32"),
+            "amby": rng.integers(0, 5, (4, 1)).astype("int64")}
+    first = float(np.asarray(exe.run(prog, feed=feed,
+                                     fetch_list=[loss])[0]))
+    for _ in range(10):
+        last = float(np.asarray(exe.run(prog, feed=feed,
+                                        fetch_list=[loss])[0]))
+    assert np.isfinite(last) and last < first, (first, last)
