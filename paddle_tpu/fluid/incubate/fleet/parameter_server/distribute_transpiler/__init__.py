@@ -1,8 +1,6 @@
 """Compat surface for fleet.parameter_server.distribute_transpiler
 (ref: incubate/fleet/parameter_server/distribute_transpiler/__init__.py:38).
 """
-from ....fleet.collective import fleet as _collective_fleet  # noqa: F401
-
 _GUIDANCE = (
     "fleet.parameter_server (pserver mode) does not exist on TPU: "
     "parameters live sharded in HBM and gradients ride ICI "

@@ -52,5 +52,5 @@ class FleetUtil:
         cur = scope.find_value(var_name)
         if cur is None:
             raise KeyError("set_zero: no var named %r in scope" % var_name)
-        shape = np.asarray(cur).shape
+        shape = np.shape(cur)  # no host copy for device arrays
         scope.update(var_name, np.zeros(shape, dtype=param_type))

@@ -77,3 +77,60 @@ def test_random_program_trains_finite(seed):
     # repeatability: the same seeded program re-runs identically
     lv2 = float(exe.run(feed=feed, fetch_list=[loss])[0])
     assert np.isfinite(lv2)
+
+
+def _rand_seq_stack(rng, x, width):
+    """Random sequence-model stack over a (B, T, D) tensor using the cell
+    API (GRU/LSTM rnn), fc, dropout, and layer_norm — ends with a
+    (B, D') tensor."""
+    L = fluid.layers
+    n = int(rng.integers(1, 4))
+    for _ in range(n):
+        choice = int(rng.integers(0, 5))
+        if choice == 0:
+            cell = L.GRUCell(hidden_size=width,
+                             name="fz_gru%d" % int(rng.integers(1e6)))
+            x, _ = L.rnn(cell, x)
+        elif choice == 1:
+            cell = L.LSTMCell(hidden_size=width,
+                              name="fz_lstm%d" % int(rng.integers(1e6)))
+            x, _ = L.rnn(cell, x, is_reverse=bool(rng.integers(0, 2)))
+        elif choice == 2:
+            x = L.fc(x, size=width, num_flatten_dims=2, act="relu")
+        elif choice == 3:
+            x = L.dropout(x, dropout_prob=0.1)
+        else:
+            x = L.layer_norm(x, begin_norm_axis=2)
+    pool = int(rng.integers(0, 3))
+    if pool == 0:
+        return L.reduce_mean(x, dim=1)
+    if pool == 1:
+        return L.reduce_max(x, dim=1)
+    return L.sequence_last_step(x)
+
+
+@pytest.mark.parametrize("seed", range(100, 106))
+def test_random_seq_program_trains_finite(seed):
+    rng = np.random.default_rng(seed)
+    batch = int(rng.integers(2, 5))
+    T = int(rng.integers(3, 7))
+    width = int(rng.integers(4, 17))
+    fluid.default_startup_program().random_seed = seed + 1
+    fluid.default_main_program().random_seed = seed + 1
+    x = fluid.data(name="x", shape=[batch, T, width], dtype="float32",
+                   append_batch_size=False)
+    y = fluid.data(name="y", shape=[batch, 1], dtype="float32",
+                   append_batch_size=False)
+    h = _rand_seq_stack(rng, x, width)
+    pred = fluid.layers.fc(h, size=1)
+    loss = fluid.layers.reduce_mean(
+        fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.default_rng(seed).standard_normal(
+        (batch, T, width)).astype("float32")
+    yv = xv.sum((1, 2))[:, None].astype("float32")
+    vals = [float(exe.run(feed={"x": xv, "y": yv},
+                          fetch_list=[loss])[0]) for _ in range(5)]
+    assert all(np.isfinite(v) for v in vals), vals
