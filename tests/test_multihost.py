@@ -71,24 +71,32 @@ def test_two_process_data_parallel_training(tmp_path):
         )
         # drop the parent test session's forced single-process settings
         env.pop("JAX_PLATFORMS", None)
-        procs.append(subprocess.Popen(
+        # log to files, not pipes: a worker blocking on a full pipe
+        # buffer would stall the other's collectives
+        out_f = open(tmp_path / ("out%d" % pid), "w+")
+        err_f = open(tmp_path / ("err%d" % pid), "w+")
+        procs.append((subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
              str(worker)],
-            env=env, cwd=REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
+            env=env, cwd=REPO, stdout=out_f, stderr=err_f, text=True,
+        ), out_f, err_f))
     outs = []
     try:
-        for pr in procs:
-            out, err = pr.communicate(timeout=240)
-            assert pr.returncode == 0, err[-2000:]
-            outs.append(out)
+        for pr, out_f, err_f in procs:
+            rc = pr.wait(timeout=240)
+            out_f.seek(0)
+            err_f.seek(0)
+            assert rc == 0, err_f.read()[-2000:]
+            outs.append(out_f.read())
     finally:
         # a failed/hung worker must not orphan its peer (it would block
         # in jax.distributed.initialize waiting for the dead coordinator)
-        for p2 in procs:
-            if p2.poll() is None:
-                p2.kill()
+        for pr, out_f, err_f in procs:
+            if pr.poll() is None:
+                pr.kill()
+                pr.wait()
+            out_f.close()
+            err_f.close()
     lines = [next(ln for ln in o.splitlines() if ln.startswith("MHOK"))
              for o in outs]
     vals = {tuple(ln.split()[2:]) for ln in lines}

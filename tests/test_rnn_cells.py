@@ -513,3 +513,30 @@ def test_basic_lstm_partial_init_and_named_attr():
         feed={"plx": xv, "plh0": np.zeros((2, B, D), "float32")},
         fetch_list=[out])[0])
     assert not np.allclose(oa, ob)  # h0 flows in despite init_cell=None
+
+
+def test_rnn_cell_under_data_parallel_mesh():
+    """rnn()'s lax.scan lowers under the dp-sharded CompiledProgram mesh
+    (GSPMD partitions the carried state over the batch axis)."""
+    _fresh()
+    B, T, D_in, D = 8, 4, 3, 6
+    x = fluid.data("dpx", (T, D_in), "float32")
+    y = fluid.data("dpy", (1,), "float32")
+    cell = layers.GRUCell(hidden_size=D, name="dpgru")
+    outs, final = layers.rnn(cell, x)
+    pred = layers.fc(final, 1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(0.02).minimize(loss)
+
+    prog = fluid.CompiledProgram(
+        fluid.default_main_program()).with_data_parallel(
+            loss_name=loss.name)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(41)
+    xv = rng.standard_normal((B, T, D_in)).astype("float32")
+    yv = xv.sum((1, 2))[:, None].astype("float32")
+    losses = [float(np.asarray(exe.run(prog, feed={"dpx": xv, "dpy": yv},
+                                       fetch_list=[loss])[0]))
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
