@@ -61,7 +61,23 @@ def save_params(executor, dirname, main_program=None, filename=None):
     )
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      use_orbax=False, step=0):
+    """Persist every persistable var (params + optimizer state + BN
+    stats). With use_orbax=True the write goes through the TPU-native
+    sharded orbax path (parallel_checkpoint.py): device-resident shards
+    stream to disk per-host, supporting multi-host meshes and step
+    retention."""
+    if use_orbax:
+        from ..parallel.checkpoint import save_checkpoint
+
+        main = main_program or default_main_program()
+        var_list = _collect(main, is_persistable, None)
+        scope = global_scope()
+        state = {v.name: scope.get(v.name) for v in var_list
+                 if scope.get(v.name) is not None}
+        save_checkpoint(dirname, state, step=step)
+        return
     save_vars(
         executor, dirname, main_program, predicate=is_persistable,
         filename=filename or "__persistables__.npz",
@@ -93,7 +109,17 @@ def load_params(executor, dirname, main_program=None, filename=None):
     )
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      use_orbax=False, step=None):
+    if use_orbax:
+        from ..parallel.checkpoint import load_checkpoint
+
+        main = main_program or default_main_program()
+        data = load_checkpoint(dirname, step=step)
+        # set_program_state shape-checks each restored array against the
+        # program's var metadata before writing the scope
+        set_program_state(main, data)
+        return
     load_vars(
         executor, dirname, main_program, predicate=is_persistable,
         filename=filename or "__persistables__.npz",

@@ -6,3 +6,4 @@ from . import sharding  # noqa: F401
 from . import fleet  # noqa: F401
 from . import ring_attention  # noqa: F401
 from . import pipeline  # noqa: F401
+from . import checkpoint  # noqa: F401

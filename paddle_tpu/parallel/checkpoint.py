@@ -1,0 +1,90 @@
+"""Step-managed checkpointing over orbax (no reference analogue — the
+reference's save_persistables writes one host-side npz per save; orbax
+adds step retention, atomic writes, and per-host parallel shard writes
+when the saved values are device-resident jax Arrays).
+
+Restore materializes host arrays (the executor re-places them on next
+run). Pod-scale sharded restore-in-place would need the target layouts
+from the compiled program; not wired yet — restores are host-replicated.
+
+Used directly, or through ``fluid.io.save_persistables(...,
+use_orbax=True)`` / ``load_persistables(..., use_orbax=True)``.
+"""
+import os
+
+import numpy as np
+
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step", "finalize"]
+
+# managers kept open across saves so async writes can complete in the
+# background; finalize()/process exit flushes them
+_managers = {}
+
+
+def _manager(dirname, max_to_keep=None):
+    import orbax.checkpoint as ocp
+
+    key = os.path.abspath(dirname)
+    mgr = _managers.get(key)
+    if mgr is None:
+        mgr = ocp.CheckpointManager(
+            key,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True,
+            ),
+        )
+        _managers[key] = mgr
+    return mgr
+
+
+def finalize(dirname=None):
+    """Flush and close the manager(s): pending async saves complete."""
+    keys = [os.path.abspath(dirname)] if dirname else list(_managers)
+    for k in keys:
+        mgr = _managers.pop(k, None)
+        if mgr is not None:
+            mgr.close()
+
+
+def save_checkpoint(dirname, state, step=0, max_to_keep=None, wait=True):
+    """Write `state` (a flat dict name -> array; jax Arrays may be
+    device-resident) as checkpoint `step` under `dirname`. Re-saving an
+    existing step REPLACES it (a trainer overwriting its own step means
+    newer state). With wait=False the write runs in the background —
+    call finalize()/a later save to join it."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(dirname, max_to_keep)
+    saved = mgr.save(int(step), args=ocp.args.StandardSave(dict(state)))
+    if not saved:
+        # orbax skips steps that already exist — delete and rewrite
+        mgr.delete(int(step))
+        saved = mgr.save(
+            int(step), args=ocp.args.StandardSave(dict(state)))
+        if not saved:
+            raise RuntimeError(
+                "orbax refused to save step %s under %r" % (step, dirname))
+    if wait:
+        mgr.wait_until_finished()
+
+
+def latest_step(dirname):
+    """The newest checkpoint step under `dirname`, or None."""
+    mgr = _manager(dirname)
+    mgr.wait_until_finished()
+    return mgr.latest_step()
+
+
+def load_checkpoint(dirname, step=None):
+    """Restore the state dict saved at `step` (newest when None)."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(dirname)
+    mgr.wait_until_finished()
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                "no orbax checkpoint under %r" % dirname)
+    restored = mgr.restore(int(step), args=ocp.args.StandardRestore())
+    return {k: np.asarray(v) for k, v in restored.items()}
