@@ -14,6 +14,7 @@ from . import dataset  # noqa: F401
 from . import distributed  # noqa: F401
 from . import compat  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import utils  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,8 @@
+"""paddle.utils (ref: python/paddle/utils) — the pieces the book
+chapters and detection pipelines actually use: Ploter (training-curve
+logging) and image_util (numpy image preprocessing)."""
+from . import plot  # noqa: F401
+from . import image_util  # noqa: F401
+from .plot import Ploter, PlotData  # noqa: F401
+
+__all__ = ["plot", "image_util", "Ploter", "PlotData"]

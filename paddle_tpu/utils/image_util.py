@@ -1,0 +1,148 @@
+"""Numpy image preprocessing helpers (ref: python/paddle/utils/
+image_util.py) — resize/flip/crop/oversample/mean-transform used by the
+classic image pipelines. Pure numpy (PIL only for file IO)."""
+import numpy as np
+
+__all__ = [
+    "resize_image", "flip", "crop_img", "preprocess_img", "load_image",
+    "oversample", "ImageTransformer",
+]
+
+
+def resize_image(img, target_size):
+    """Resize so the SHORT side equals target_size (ref image_util.py:20).
+    img is a PIL image."""
+    percent = target_size / float(min(img.size[0], img.size[1]))
+    resized = (int(round(img.size[0] * percent)),
+               int(round(img.size[1] * percent)))
+    return img.resize(resized)
+
+
+def flip(im):
+    """Horizontal flip of a (C, H, W) or (H, W) array."""
+    if im.ndim == 3:
+        return im[:, :, ::-1]
+    return im[:, ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """Center (test) or random crop to inner_size (ref image_util.py:45);
+    im is (C, H, W) when color else (H, W)."""
+    if color:
+        height, width = max(inner_size, im.shape[1]), max(
+            inner_size, im.shape[2])
+        padded_im = np.zeros((3, height, width), dtype=im.dtype)
+        startY = (height - im.shape[1]) // 2
+        startX = (width - im.shape[2]) // 2
+        endY, endX = startY + im.shape[1], startX + im.shape[2]
+        padded_im[:, startY:endY, startX:endX] = im
+    else:
+        im = im.astype("float32")
+        height, width = max(inner_size, im.shape[0]), max(
+            inner_size, im.shape[1])
+        padded_im = np.zeros((height, width), dtype=im.dtype)
+        startY = (height - im.shape[0]) // 2
+        startX = (width - im.shape[1]) // 2
+        endY, endX = startY + im.shape[0], startX + im.shape[1]
+        padded_im[startY:endY, startX:endX] = im
+    if test:
+        startY = (height - inner_size) // 2
+        startX = (width - inner_size) // 2
+    else:
+        startY = np.random.randint(0, height - inner_size + 1)
+        startX = np.random.randint(0, width - inner_size + 1)
+    endY, endX = startY + inner_size, startX + inner_size
+    if color:
+        pic = padded_im[:, startY:endY, startX:endX]
+        if not test and np.random.randint(2) == 0:
+            pic = flip(pic)
+    else:
+        pic = padded_im[startY:endY, startX:endX]
+        if not test and np.random.randint(2) == 0:
+            pic = flip(pic)
+    return pic
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True):
+    """Crop + mean-subtract (ref image_util.py:96)."""
+    im = im.astype("float32")
+    test = not is_train
+    pic = crop_img(im, crop_size, color, test)
+    return pic - img_mean
+
+
+def load_image(img_path, is_color=True):
+    """Load an image file as a PIL image (ref image_util.py:133)."""
+    from PIL import Image
+
+    img = Image.open(img_path)
+    img.load()
+    return img.convert("RGB") if is_color else img.convert("L")
+
+
+def oversample(img, crop_dims):
+    """10-crop oversampling: 4 corners + center, mirrored
+    (ref image_util.py:144). img: iterable of (H, W, C) arrays."""
+    im_shape = np.array(img[0].shape)
+    crop_dims = np.array(crop_dims)
+    im_center = im_shape[:2] / 2.0
+
+    h_indices = (0, im_shape[0] - crop_dims[0])
+    w_indices = (0, im_shape[1] - crop_dims[1])
+    crops_ix = np.empty((5, 4), dtype=int)
+    curr = 0
+    for i in h_indices:
+        for j in w_indices:
+            crops_ix[curr] = (i, j, i + crop_dims[0], j + crop_dims[1])
+            curr += 1
+    crops_ix[4] = np.tile(im_center, (1, 2)) + np.concatenate(
+        [-crop_dims / 2.0, crop_dims / 2.0])
+    crops_ix = np.tile(crops_ix, (2, 1))
+
+    crops = np.empty(
+        (10 * len(img), crop_dims[0], crop_dims[1], im_shape[-1]),
+        dtype=np.float32)
+    ix = 0
+    for im in img:
+        for crop in crops_ix:
+            crops[ix] = im[crop[0]:crop[2], crop[1]:crop[3], :]
+            ix += 1
+        crops[ix - 5:ix] = crops[ix - 5:ix, :, ::-1, :]  # mirror
+    return crops
+
+
+class ImageTransformer:
+    """Channel-order + mean transform (ref image_util.py:183)."""
+
+    def __init__(self, transpose=None, channel_swap=None, mean=None,
+                 is_color=True):
+        self.is_color = is_color
+        self.set_transpose(transpose)
+        self.set_channel_swap(channel_swap)
+        self.set_mean(mean)
+
+    def set_transpose(self, order):
+        if order is not None and self.is_color and len(order) != 3:
+            raise ValueError("transpose order needs 3 dims for color")
+        self.transpose = order
+
+    def set_channel_swap(self, order):
+        if order is not None and self.is_color and len(order) != 3:
+            raise ValueError("channel swap needs 3 channels for color")
+        self.channel_swap = order
+
+    def set_mean(self, mean):
+        if mean is not None:
+            mean = np.array(mean)
+            if mean.ndim == 1:
+                mean = mean[:, np.newaxis, np.newaxis]
+        self.mean = mean
+
+    def transformer(self, data):
+        if self.transpose is not None:
+            data = data.transpose(self.transpose)
+        if self.channel_swap is not None:
+            data = data[self.channel_swap, :, :]
+        if self.mean is not None:
+            data -= self.mean
+        return data

@@ -77,3 +77,43 @@ def test_nn_ops_reexports():
         nn.no_such_layer
     with pytest.raises(AttributeError):
         ops.no_such_op
+
+
+def test_paddle_utils_ploter(tmp_path, monkeypatch):
+    pytest.importorskip("matplotlib")
+    monkeypatch.delenv("DISABLE_PLOT", raising=False)
+    import paddle_tpu as paddle
+
+    pl = paddle.utils.Ploter("train_cost", "test_cost")
+    pl.append("train_cost", 0, 2.0)
+    pl.append("train_cost", 1, 1.0)
+    pl.append("test_cost", 0, 2.5)
+    with pytest.raises(ValueError):
+        pl.append("nope", 0, 1.0)
+    out = tmp_path / "curve.png"
+    pl.plot(str(out))
+    assert out.exists()
+    pl.reset()
+    assert pl.__plot_data__["train_cost"].step == []
+
+
+def test_paddle_utils_image_util():
+    import paddle_tpu as paddle
+
+    iu = paddle.utils.image_util
+    im = np.random.default_rng(0).random((3, 40, 48)).astype("float32")
+    c = iu.crop_img(im, 32, test=True)
+    assert c.shape == (3, 32, 32)
+    # center crop is deterministic
+    np.testing.assert_array_equal(c, iu.crop_img(im, 32, test=True))
+    assert iu.flip(im).shape == im.shape
+    np.testing.assert_array_equal(iu.flip(iu.flip(im)), im)
+    p = iu.preprocess_img(im, np.zeros((3, 32, 32), "float32"), 32,
+                          is_train=False)
+    np.testing.assert_array_equal(p, c)
+    imgs = [np.random.default_rng(1).random((40, 40, 3)).astype("f4")]
+    o = iu.oversample(imgs, (24, 24))
+    assert o.shape == (10, 24, 24, 3)
+    t = iu.ImageTransformer(transpose=(2, 0, 1), mean=[0.5, 0.5, 0.5])
+    out = t.transformer(imgs[0].copy())
+    assert out.shape == (3, 40, 40)
