@@ -1,0 +1,13 @@
+"""fluid.contrib.slim — model compression subset (ref: contrib/slim).
+
+Delivered the TPU way: magnitude/structure pruning operates on the
+device-resident scope params in numpy (ref slim/prune/pruner.py);
+distillers build the combined loss symbolically in ONE program so the
+whole distillation step still lowers to a single XLA module; QAT is the
+existing contrib.quant pass re-exported. The reference's yaml-driven
+Compressor/Strategy orchestration and NAS searcher are not ported — on
+TPU the training loop stays the user's (see MIGRATION.md).
+"""
+from . import prune  # noqa: F401
+from . import distillation  # noqa: F401
+from . import quantization  # noqa: F401

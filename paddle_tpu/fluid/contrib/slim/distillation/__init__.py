@@ -1,0 +1,3 @@
+from .distiller import L2Distiller, SoftLabelDistiller  # noqa: F401
+
+__all__ = ["L2Distiller", "SoftLabelDistiller"]

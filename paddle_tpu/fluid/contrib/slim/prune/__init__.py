@@ -1,0 +1,3 @@
+from .pruner import Pruner, StructurePruner, prune_program  # noqa: F401
+
+__all__ = ["Pruner", "StructurePruner", "prune_program"]

@@ -1,0 +1,93 @@
+"""Parameter pruning (ref: contrib/slim/prune/pruner.py:22-107).
+
+`StructurePruner.cal_pruned_idx`/`prune_tensor` follow the reference's
+group-pruning semantics (l1_norm criterion over the non-pruned axes);
+`prune_program` is the TPU-native applier: XLA needs static shapes, so
+pruning is LAZY (masked to zero in-place in the scope) rather than
+shrinking tensors — the sparsity is real, the shapes stay compile-stable.
+"""
+import fnmatch
+
+import numpy as np
+
+__all__ = ["Pruner", "StructurePruner", "prune_program"]
+
+
+class Pruner:
+    """Base pruner (ref pruner.py:22). Subclasses used with
+    prune_program must provide axis_for/cal_pruned_idx/prune_tensor
+    (StructurePruner is the stock implementation)."""
+
+    def prune(self, param, ratio=0.5):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Group pruning by axis + criterion (ref pruner.py:34).
+
+    pruning_axis/criterions are dicts keyed by param name ('*' default),
+    criterion 'l1_norm' supported.
+    """
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def axis_for(self, name, param):
+        """The pruning axis this pruner would use for `param`."""
+        return self.pruning_axis.get(name, self.pruning_axis.get("*"))
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        criterion = self.criterions.get(name, self.criterions.get("*"))
+        if axis is None:
+            axis = self.axis_for(name, param)
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion != "l1_norm":
+            raise ValueError("criterion %r not supported (l1_norm only)"
+                             % criterion)
+        scores = np.sum(np.abs(param), axis=reduce_dims)
+        return scores.argsort()[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        mask = np.zeros(tensor.shape[pruned_axis], dtype=bool)
+        mask[np.asarray(pruned_idx, dtype=int)] = True
+        if lazy:
+            out = np.array(tensor)
+            sl = [slice(None)] * out.ndim
+            sl[pruned_axis] = mask
+            out[tuple(sl)] = 0
+            return out
+        sl = [slice(None)] * tensor.ndim
+        sl[pruned_axis] = ~mask
+        return np.asarray(tensor)[tuple(sl)]
+
+
+def prune_program(program, ratio, patterns=("*",), pruner=None,
+                  scope=None):
+    """Mask-prune matching parameters of `program` in place in the scope
+    (lazy pruning: zeroed groups, static shapes). Returns
+    {param_name: n_pruned_groups}."""
+    from ....executor import global_scope
+
+    pruner = pruner or StructurePruner()
+    scope = scope if scope is not None else global_scope()
+    report = {}
+    for p in program.global_block().all_parameters():
+        if not any(fnmatch.fnmatch(p.name, pat) for pat in patterns):
+            continue
+        val = scope.get(p.name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        # the axis is resolved ONCE and passed to both calls so a custom
+        # per-param axis policy can't desynchronize index vs mask axis
+        axis = pruner.axis_for(p.name, arr)
+        if axis is None or arr.ndim <= axis:
+            continue  # e.g. 1-D biases under pruning_axis=1
+        idx = pruner.cal_pruned_idx(p.name, arr, ratio, axis=axis)
+        if len(idx) == 0:
+            continue
+        scope.set(p.name, pruner.prune_tensor(arr, idx, axis, lazy=True))
+        report[p.name] = int(len(idx))
+    return report

@@ -1,0 +1,8 @@
+"""slim.quantization — the QAT pass lives in contrib.quant (aqt-style
+int8 simulation); re-exported here to mirror the reference layout
+(ref contrib/slim/quantization)."""
+from ...quant import (  # noqa: F401
+    QuantizationTransformPass,
+    fake_quant_dequant_abs_max,
+    quantize_program,
+)

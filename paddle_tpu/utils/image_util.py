@@ -28,6 +28,7 @@ def flip(im):
 def crop_img(im, inner_size, color=True, test=True):
     """Center (test) or random crop to inner_size (ref image_util.py:45);
     im is (C, H, W) when color else (H, W)."""
+    im = im.astype("float32")
     if color:
         height, width = max(inner_size, im.shape[1]), max(
             inner_size, im.shape[2])
@@ -37,7 +38,6 @@ def crop_img(im, inner_size, color=True, test=True):
         endY, endX = startY + im.shape[1], startX + im.shape[2]
         padded_im[:, startY:endY, startX:endX] = im
     else:
-        im = im.astype("float32")
         height, width = max(inner_size, im.shape[0]), max(
             inner_size, im.shape[1])
         padded_im = np.zeros((height, width), dtype=im.dtype)
@@ -54,12 +54,10 @@ def crop_img(im, inner_size, color=True, test=True):
     endY, endX = startY + inner_size, startX + inner_size
     if color:
         pic = padded_im[:, startY:endY, startX:endX]
-        if not test and np.random.randint(2) == 0:
-            pic = flip(pic)
     else:
         pic = padded_im[startY:endY, startX:endX]
-        if not test and np.random.randint(2) == 0:
-            pic = flip(pic)
+    if not test and np.random.randint(2) == 0:
+        pic = flip(pic)
     return pic
 
 

@@ -1,0 +1,134 @@
+"""contrib.slim subset: structure pruning (ref slim/prune/pruner.py) and
+distillation losses (ref slim/distillation/distiller.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.contrib.slim.distillation import (
+    L2Distiller, SoftLabelDistiller,
+)
+from paddle_tpu.fluid.contrib.slim.prune import (
+    StructurePruner, prune_program,
+)
+
+
+def test_structure_pruner_l1_groups():
+    p = StructurePruner(pruning_axis={"*": 0}, criterions={"*": "l1_norm"})
+    w = np.array([[1.0, 1.0], [0.1, 0.1], [5.0, 5.0], [0.2, 0.2]],
+                 dtype="float32")
+    idx = p.cal_pruned_idx("w", w, ratio=0.5)
+    assert sorted(idx.tolist()) == [1, 3]  # two smallest l1 rows
+    lazy = p.prune_tensor(w, idx, pruned_axis=0, lazy=True)
+    assert lazy.shape == w.shape
+    np.testing.assert_array_equal(lazy[1], 0)
+    np.testing.assert_array_equal(lazy[3], 0)
+    np.testing.assert_array_equal(lazy[2], w[2])
+    hard = p.prune_tensor(w, idx, pruned_axis=0, lazy=False)
+    assert hard.shape == (2, 2)
+    # axis-1 pruning
+    p1 = StructurePruner(pruning_axis={"*": 1})
+    idx1 = p1.cal_pruned_idx("w", w, ratio=0.5)
+    assert len(idx1) == 1
+    assert p1.prune_tensor(w, idx1, 1, lazy=False).shape == (4, 1)
+
+
+def test_prune_program_masks_and_training_continues():
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 3
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("sx", (8,), "float32")
+        y = fluid.data("sy", (1,), "float32")
+        h = fluid.layers.fc(x, 16, act="relu",
+                            param_attr=fluid.ParamAttr(name="fc_w1"))
+        loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(h, 1), y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    feed = {"sx": rng.standard_normal((16, 8)).astype("float32"),
+            "sy": rng.standard_normal((16, 1)).astype("float32")}
+    exe.run(prog, feed=feed, fetch_list=[loss])
+
+    report = prune_program(prog, ratio=0.5, patterns=["fc_w1"])
+    assert report == {"fc_w1": 4}  # half of the 8 rows (axis 0)
+    w = np.asarray(fluid.global_scope()["fc_w1"])
+    zero_rows = int((np.abs(w).sum(axis=1) == 0).sum())
+    assert zero_rows == 4
+    # shapes unchanged -> program still runs
+    out = exe.run(prog, feed=feed, fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(out[0])))
+
+
+def _teacher_student_program():
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 5
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("dx", (6,), "float32")
+        student = fluid.layers.fc(x, 4, name="student_fc")
+        teacher = fluid.layers.fc(x, 4, name="teacher_fc")
+    return prog, startup, student, teacher
+
+
+def test_l2_distiller_loss_decreases():
+    prog, startup, student, teacher = _teacher_student_program()
+    d = L2Distiller(student.name, teacher.name,
+                    distillation_loss_weight=1.0)
+    with fluid.program_guard(prog, startup):
+        loss = d.distiller_loss(prog)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(1)
+    feed = {"dx": rng.standard_normal((8, 6)).astype("float32")}
+    t0 = np.asarray(fluid.global_scope()["teacher_fc.w_0"]).copy()
+    losses = [float(np.asarray(exe.run(prog, feed=feed,
+                                       fetch_list=[loss])[0]))
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+    # the teacher must stay frozen
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope()["teacher_fc.w_0"]), t0)
+
+
+def test_soft_label_distiller_loss_decreases():
+    prog, startup, student, teacher = _teacher_student_program()
+    d = SoftLabelDistiller(student.name, teacher.name,
+                           student_temperature=2.0,
+                           teacher_temperature=2.0)
+    with fluid.program_guard(prog, startup):
+        loss = d.distiller_loss(prog)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(2)
+    feed = {"dx": rng.standard_normal((8, 6)).astype("float32")}
+    losses = [float(np.asarray(exe.run(prog, feed=feed,
+                                       fetch_list=[loss])[0]))
+              for _ in range(40)]
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_slim_quantization_reexport():
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        QuantizationTransformPass, quantize_program,
+    )
+
+    assert callable(quantize_program)
+    assert QuantizationTransformPass is not None
+
+
+def test_prune_program_skips_low_rank_params_for_axis1():
+    """pruning_axis=1 with the default '*' pattern must skip 1-D biases
+    instead of crashing (regression)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("skx", (4,), "float32")
+        fluid.layers.fc(x, 6)  # creates a (4, 6) weight AND a (6,) bias
+    exe = fluid.Executor()
+    exe.run(startup)
+    rep = prune_program(
+        prog, ratio=0.5,
+        pruner=StructurePruner(pruning_axis={"*": 1}))
+    # only the 2-D weight was pruned (3 of 6 columns)
+    assert list(rep.values()) == [3], rep
