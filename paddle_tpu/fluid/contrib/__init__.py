@@ -4,12 +4,19 @@ from . import mixed_precision
 from .mixed_precision import decorate as mixed_precision_decorate  # noqa: F401
 from . import quant  # noqa: F401
 from . import slim  # noqa: F401
+from . import trainer  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent,
+    CheckpointConfig, Trainer,
+)
+from . import inferencer  # noqa: F401
+from .inferencer import Inferencer  # noqa: F401
 from . import utils_stat
 from .utils_stat import memory_usage, op_freq_statistic, summary  # noqa: F401
 from . import extend_optimizer
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
 
 __all__ = [
-    "layers", "mixed_precision", "quant", "slim", "memory_usage", "op_freq_statistic",
+    "layers", "mixed_precision", "quant", "slim", "Trainer", "Inferencer", "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent", "EndStepEvent", "CheckpointConfig", "memory_usage", "op_freq_statistic",
     "summary", "extend_with_decoupled_weight_decay",
 ]
