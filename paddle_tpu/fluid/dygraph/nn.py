@@ -502,7 +502,7 @@ class BatchNorm(Layer):
                  momentum=0.9, epsilon=1e-05, param_attr=None,
                  bias_attr=None, dtype="float32", data_layout="NCHW",
                  in_place=False, moving_mean_name=None,
-                 moving_variance_name=None, do_model_average_for_mean_and_var=False,
+                 moving_variance_name=None, do_model_average_for_mean_and_var=True,
                  use_global_stats=False, trainable_statistics=False):
         _reject_name_scope(num_channels, "BatchNorm")
         super().__init__(None, dtype)

@@ -819,7 +819,7 @@ def batch_norm(
     name=None,
     moving_mean_name=None,
     moving_variance_name=None,
-    do_model_average_for_mean_and_var=False,
+    do_model_average_for_mean_and_var=True,
     use_global_stats=False,
 ):
     """Batch normalization (ref nn.py:2372). Running stats are persistable
@@ -840,7 +840,9 @@ def batch_norm(
     )
     mean = helper.create_parameter(
         attr=ParamAttr(
-            name=moving_mean_name, initializer=Constant(0.0), trainable=False
+            name=moving_mean_name, initializer=Constant(0.0),
+            trainable=False,
+            do_model_average=do_model_average_for_mean_and_var,
         ),
         shape=param_shape,
         dtype=dtype,
@@ -851,6 +853,7 @@ def batch_norm(
             name=moving_variance_name,
             initializer=Constant(1.0),
             trainable=False,
+            do_model_average=do_model_average_for_mean_and_var,
         ),
         shape=param_shape,
         dtype=dtype,
@@ -1039,7 +1042,7 @@ def data_norm(
     name=None,
     moving_mean_name=None,
     moving_variance_name=None,
-    do_model_average_for_mean_and_var=False,
+    do_model_average_for_mean_and_var=True,
     slot_dim=-1,
     sync_stats=False,
     summary_decay_rate=0.9999999,

@@ -13,7 +13,7 @@ class ParamAttr:
         regularizer=None,
         trainable=True,
         gradient_clip=None,
-        do_model_average=False,
+        do_model_average=True,
     ):
         self.name = name
         self.initializer = initializer

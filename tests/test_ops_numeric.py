@@ -327,3 +327,36 @@ def test_conv2d_bf16_amp_backward_runs():
         last = float(np.asarray(exe.run(prog, feed=feed,
                                         fetch_list=[loss])[0]))
     assert np.isfinite(last) and last < first, (first, last)
+
+
+def test_conv2d_transpose_fwd_grad_vs_torch():
+    rng = np.random.default_rng(17)
+    x_np = rng.standard_normal((2, 4, 6, 6)).astype("float32")
+    w_np = rng.standard_normal((4, 3, 3, 3)).astype("float32")  # (Cin,Cout,kh,kw)
+
+    x = fluid.data(name="ctx", shape=[2, 4, 6, 6], append_batch_size=False,
+                   dtype="float32", stop_gradient=False)
+    y = fluid.layers.conv2d_transpose(
+        x, 3, filter_size=3, stride=2, padding=1,
+        param_attr=fluid.ParamAttr(
+            name="ctw",
+            initializer=fluid.initializer.NumpyArrayInitializer(w_np)),
+        bias_attr=False)
+    loss = fluid.layers.reduce_sum(y)
+    gx, gw = gradients(loss, [x, fluid.default_main_program()
+                              .global_block().var("ctw")])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    y_v, gx_v, gw_v = exe.run(feed={"ctx": x_np}, fetch_list=[y, gx, gw])
+
+    t_x = torch.tensor(x_np, requires_grad=True)
+    t_w = torch.tensor(w_np, requires_grad=True)
+    t_y = torch.nn.functional.conv_transpose2d(t_x, t_w, stride=2,
+                                               padding=1)
+    t_y.sum().backward()
+    np.testing.assert_allclose(np.asarray(y_v), t_y.detach().numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gx_v), t_x.grad.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gw_v), t_w.grad.numpy(),
+                               rtol=2e-4, atol=2e-4)

@@ -196,3 +196,46 @@ def test_recompute_optimizer_same_result_as_plain():
             return np.asarray(global_scope()["rw1"]).copy()
 
     np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+def test_model_average_apply_restore_numeric():
+    """ModelAverage must (a) capture params by default (ParamAttr's
+    do_model_average defaults True like the reference — regression: it
+    was False, silently averaging NOTHING), (b) swap in the accumulated
+    average under apply(), (c) restore originals exactly."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 11
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("max", (4,), "float32")
+        y = fluid.data("may", (1,), "float32")
+        pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="maw"),
+                               bias_attr=False)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(
+            0.5, min_average_window=1, max_average_window=4)
+    assert any(p.name == "maw" for p, _ in ma.params_grads)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    feed = {"max": rng.standard_normal((8, 4)).astype("float32"),
+            "may": rng.standard_normal((8, 1)).astype("float32")}
+    history = []
+    for _ in range(4):
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        history.append(np.asarray(fluid.global_scope()["maw"]).copy())
+    final = history[-1].copy()
+    with ma.apply(exe):
+        averaged = np.asarray(fluid.global_scope()["maw"]).copy()
+        # the swapped-in value is an average over the window: it differs
+        # from the final params and lies inside the visited range
+        assert not np.allclose(averaged, final)
+        lo = np.min(np.stack(history), axis=0) - 1e-6
+        hi = np.max(np.stack(history), axis=0) + 1e-6
+        assert ((averaged >= lo) & (averaged <= hi)).all()
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope()["maw"]), final)
