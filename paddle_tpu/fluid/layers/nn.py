@@ -1054,14 +1054,18 @@ def data_norm(
     helper = LayerHelper("data_norm", **locals())
     dtype = helper.input_dtype()
     c = input.shape[1]
+    _stat_avg = do_model_average_for_mean_and_var
     batch_size = helper.create_parameter(
-        attr=ParamAttr(initializer=Constant(1e4)), shape=[c], dtype=dtype
+        attr=ParamAttr(initializer=Constant(1e4),
+                       do_model_average=_stat_avg), shape=[c], dtype=dtype
     )
     batch_sum = helper.create_parameter(
-        attr=ParamAttr(initializer=Constant(0.0)), shape=[c], dtype=dtype
+        attr=ParamAttr(initializer=Constant(0.0),
+                       do_model_average=_stat_avg), shape=[c], dtype=dtype
     )
     batch_square = helper.create_parameter(
-        attr=ParamAttr(initializer=Constant(1e4)), shape=[c], dtype=dtype
+        attr=ParamAttr(initializer=Constant(1e4),
+                       do_model_average=_stat_avg), shape=[c], dtype=dtype
     )
     means = helper.create_variable_for_type_inference(dtype, True)
     scales = helper.create_variable_for_type_inference(dtype, True)

@@ -771,24 +771,69 @@ class ModelAverage(Optimizer):
                 self.params_grads.append((param, None))
         block = main.global_block()
         self.helper = LayerHelper("model_average")
+        self._shared = None  # per-program scalars built once below
         for param, _ in self.params_grads:
             self._append_average_accumulate_op(block, param)
 
     def _append_average_accumulate_op(self, block, param):
-        sum_ = self._add_accumulator("sum", param)
-        cnt = self._add_accumulator("cnt", param, dtype="float32", shape=[1])
-        block.append_op(
-            type="elementwise_add",
-            inputs={"X": [sum_], "Y": [param]},
-            outputs={"Out": [sum_]},
-            attrs={"axis": -1},
-        )
-        block.append_op(
-            type="increment",
-            inputs={"X": [cnt]},
-            outputs={"Out": [cnt]},
-            attrs={"step": 1.0},
-        )
+        # windowed accumulation (ref average_accumulates_op): sum_1
+        # gathers the live window; when num_acc reaches the threshold
+        # min(max_window, max(min_window, rate*num_updates)) the window
+        # SHIFTS into sum_2 (kept, not dropped) and restarts — apply()
+        # averages (sum_1 + sum_2) / (num_acc + old_num_acc), so a
+        # restart never collapses the average to one snapshot. (The ref
+        # keeps one further window in sum_3; two windows retained here.)
+        sum_1 = self._add_accumulator("sum", param)
+        sum_2 = self._add_accumulator("sum2", param)
+        num_acc = self._add_accumulator(
+            "cnt", param, dtype="float32", shape=[1])
+        old_acc = self._add_accumulator(
+            "old_cnt", param, dtype="float32", shape=[1])
+        num_upd = self._add_accumulator(
+            "nupd", param, dtype="float32", shape=[1])
+        from .layers import control_flow as cf
+        from .layers import nn as nn_l
+        from .layers import tensor as t
+
+        if self._shared is None:
+            # shared scalar constants, built ONCE per program (every
+            # param's accumulate ops reference the same three vars)
+            self._shared = (
+                t.fill_constant([1], "float32", 1.0),
+                t.fill_constant([1], "float32",
+                                float(self.max_average_window)),
+                t.fill_constant([1], "float32",
+                                float(self.min_average_window)),
+            )
+        one, max_w, min_w = self._shared
+        summed = nn_l.elementwise_add(sum_1, param)
+        bumped_acc = nn_l.elementwise_add(num_acc, one)
+        bumped_upd = nn_l.elementwise_add(num_upd, one)
+        # threshold = min(max_w, max(min_w, rate * num_updates))
+        thresh = nn_l.elementwise_min(
+            max_w,
+            nn_l.elementwise_max(
+                min_w,
+                nn_l.scale(bumped_upd, scale=float(self.average_window))))
+        shift = t.cast(cf.greater_equal(bumped_acc, thresh), "float32")
+        keep = nn_l.elementwise_sub(one, shift)
+        sp = t.cast(shift, param.dtype)
+        kp = t.cast(keep, param.dtype)
+        # on shift: sum_2 <- sum_1+param, sum_1 <- 0; else accumulate
+        new_sum2 = nn_l.elementwise_add(
+            nn_l.elementwise_mul(sp, summed),
+            nn_l.elementwise_mul(kp, sum_2))
+        new_sum1 = nn_l.elementwise_mul(kp, summed)
+        new_old = nn_l.elementwise_add(
+            nn_l.elementwise_mul(shift, bumped_acc),
+            nn_l.elementwise_mul(keep, old_acc))
+        new_acc = nn_l.elementwise_mul(keep, bumped_acc)
+        for var, val in ((sum_2, new_sum2), (sum_1, new_sum1),
+                         (old_acc, new_old), (num_acc, new_acc),
+                         (num_upd, bumped_upd)):
+            block.append_op(
+                type="assign", inputs={"X": [val]}, outputs={"Out": [var]}
+            )
 
     class _ApplyGuard:
         def __init__(self, outer, executor, scope):
@@ -801,20 +846,23 @@ class ModelAverage(Optimizer):
             import numpy as _np
 
             for param, _ in self.outer.params_grads:
-                s = self.scope.get(
-                    self.outer._accumulators["sum"][param.name].name
-                )
-                c = self.scope.get(
-                    self.outer._accumulators["cnt"][param.name].name
-                )
-                if s is None or c is None:
+                acc = self.outer._accumulators
+                s1 = self.scope.get(acc["sum"][param.name].name)
+                s2 = self.scope.get(acc["sum2"][param.name].name)
+                c = self.scope.get(acc["cnt"][param.name].name)
+                oc = self.scope.get(acc["old_cnt"][param.name].name)
+                if s1 is None or c is None:
                     continue
+                total = _np.asarray(s1)
+                count = float(_np.asarray(c)[0])
+                if s2 is not None:
+                    total = total + _np.asarray(s2)
+                if oc is not None:
+                    count += float(_np.asarray(oc)[0])
                 self.backup[param.name] = self.scope[param.name]
                 self.scope.set(
                     param.name,
-                    (_np.asarray(s) / max(float(_np.asarray(c)[0]), 1.0)).astype(
-                        _np.asarray(s).dtype
-                    ),
+                    (total / max(count, 1.0)).astype(total.dtype),
                 )
             return self
 

@@ -198,3 +198,113 @@ def test_book_machine_translation_seq2seq():
                       "tgt_ids": trg_in, "tgt_ids@SEQ_LEN": trg_lens,
                       "tgt_labels": trg_out},
            steps=12, lr=3e-3)
+
+
+def test_book_image_classification_vgg():
+    """ref book/test_image_classification.py vgg16_bn_drop, scaled down:
+    img_conv_group blocks (conv+bn+dropout+pool) -> bn fc head."""
+    def conv_block(ipt, num_filter, groups, dropouts):
+        return fluid.nets.img_conv_group(
+            input=ipt,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * groups,
+            conv_filter_size=3,
+            conv_act="relu",
+            conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts,
+            pool_type="max")
+
+    images = fluid.data(name="pixel", shape=[3, 16, 16], dtype="float32")
+    label = fluid.data(name="label", shape=[1], dtype="int64")
+    conv1 = conv_block(images, 8, 2, [0.3, 0.0])
+    conv2 = conv_block(conv1, 16, 2, [0.4, 0.0])
+    drop = fluid.layers.dropout(x=conv2, dropout_prob=0.5)
+    fc1 = fluid.layers.fc(input=drop, size=32, act=None)
+    bn = fluid.layers.batch_norm(input=fc1, act="relu")
+    drop2 = fluid.layers.dropout(x=bn, dropout_prob=0.5)
+    fc2 = fluid.layers.fc(input=drop2, size=32, act=None)
+    predict = fluid.layers.fc(input=fc2, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+
+    rng = np.random.default_rng(0)
+    imgs = rng.standard_normal((8, 3, 16, 16)).astype("float32")
+    lbls = rng.integers(0, 10, (8, 1)).astype("int64")
+    _train(avg_cost, lambda i: {"pixel": imgs, "label": lbls}, steps=15,
+           lr=0.02)
+
+
+def test_book_label_semantic_roles_crf():
+    """ref book/test_label_semantic_roles.py db_lstm + linear_chain_crf:
+    8 embedded features -> summed fc -> stacked bidirectional
+    dynamic_lstm -> CRF cost, decoded with crf_decoding."""
+    word_dict_len, pred_dict_len, mark_dict_len = 20, 10, 2
+    label_dict_len = 6
+    word_dim = mark_dim = 8
+    hidden_dim = 16     # dynamic_lstm convention: 4 * real hidden
+    depth = 4
+    B, T = 3, 5
+
+    feats = ["word_data", "verb_data", "ctx_n2", "ctx_n1", "ctx_0",
+             "ctx_p1", "ctx_p2", "mark_data"]
+    ins = {n: fluid.data(name=n, shape=[T], dtype="int64", lod_level=1)
+           for n in feats}
+    target = fluid.data(name="target", shape=[T], dtype="int64",
+                        lod_level=1)
+
+    pred_emb = fluid.layers.embedding(
+        input=ins["verb_data"], size=[pred_dict_len, word_dim],
+        dtype="float32", param_attr="vemb")
+    mark_emb = fluid.layers.embedding(
+        input=ins["mark_data"], size=[mark_dict_len, mark_dim])
+    word_inputs = [ins[n] for n in
+                   ["word_data", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1",
+                    "ctx_p2"]]
+    emb_layers = [fluid.layers.embedding(
+        input=x, size=[word_dict_len, word_dim],
+        param_attr=fluid.ParamAttr(name="emb", trainable=False))
+        for x in word_inputs]
+    emb_layers += [pred_emb, mark_emb]
+
+    hidden_0 = fluid.layers.sums(input=[
+        fluid.layers.fc(input=emb, size=hidden_dim, num_flatten_dims=2)
+        for emb in emb_layers])
+    lstm_0, _ = fluid.layers.dynamic_lstm(
+        input=hidden_0, size=hidden_dim, candidate_activation="relu",
+        gate_activation="sigmoid", cell_activation="sigmoid")
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, depth):
+        mix_hidden = fluid.layers.sums(input=[
+            fluid.layers.fc(input=input_tmp[0], size=hidden_dim,
+                            num_flatten_dims=2),
+            fluid.layers.fc(input=input_tmp[1], size=hidden_dim,
+                            num_flatten_dims=2)])
+        lstm, _ = fluid.layers.dynamic_lstm(
+            input=mix_hidden, size=hidden_dim,
+            candidate_activation="relu", gate_activation="sigmoid",
+            cell_activation="sigmoid", is_reverse=((i % 2) == 1))
+        input_tmp = [mix_hidden, lstm]
+    feature_out = fluid.layers.sums(input=[
+        fluid.layers.fc(input=input_tmp[0], size=label_dict_len,
+                        act="tanh", num_flatten_dims=2),
+        fluid.layers.fc(input=input_tmp[1], size=label_dict_len,
+                        act="tanh", num_flatten_dims=2)])
+
+    crf_cost = fluid.layers.linear_chain_crf(
+        input=feature_out, label=target,
+        param_attr=fluid.ParamAttr(name="crfw"))
+    avg_cost = fluid.layers.mean(crf_cost)
+    crf_decode = fluid.layers.crf_decoding(
+        input=feature_out, param_attr=fluid.ParamAttr(name="crfw"))
+
+    rng = np.random.default_rng(1)
+    feed = {n: rng.integers(
+        0, {"verb_data": pred_dict_len, "mark_data": mark_dict_len}.get(
+            n, word_dict_len), (B, T)).astype("int64") for n in feats}
+    feed["target"] = rng.integers(0, label_dict_len, (B, T)).astype("int64")
+    exe, losses = _train(avg_cost, lambda i: feed, steps=15, lr=0.02)
+    (decoded,) = exe.run(feed=feed, fetch_list=[crf_decode])
+    decoded = np.asarray(decoded)
+    assert decoded.shape[0] == B
+    assert decoded.min() >= 0 and decoded.max() < label_dict_len

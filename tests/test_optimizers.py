@@ -203,9 +203,6 @@ def test_model_average_apply_restore_numeric():
     do_model_average defaults True like the reference — regression: it
     was False, silently averaging NOTHING), (b) swap in the accumulated
     average under apply(), (c) restore originals exactly."""
-    import numpy as np
-    import paddle_tpu.fluid as fluid
-
     prog, startup = fluid.Program(), fluid.Program()
     prog.random_seed = startup.random_seed = 11
     with fluid.program_guard(prog, startup):
@@ -225,17 +222,27 @@ def test_model_average_apply_restore_numeric():
     feed = {"max": rng.standard_normal((8, 4)).astype("float32"),
             "may": rng.standard_normal((8, 1)).astype("float32")}
     history = []
-    for _ in range(4):
+    for _ in range(10):
         exe.run(prog, feed=feed, fetch_list=[loss])
         history.append(np.asarray(fluid.global_scope()["maw"]).copy())
     final = history[-1].copy()
+    # two-window oracle mirroring the accumulate rule: sum_1 shifts into
+    # sum_2 when num_acc reaches min(max_w, max(min_w, rate*num_updates))
+    rate, min_w, max_w = 0.5, 1, 4
+    s1 = s2 = np.zeros_like(history[0])
+    n_acc = old = nupd = 0.0
+    for h in history:
+        s1 = s1 + h
+        n_acc += 1
+        nupd += 1
+        thresh = min(max_w, max(min_w, rate * nupd))
+        if n_acc >= thresh:
+            s2, old = s1, n_acc
+            s1, n_acc = np.zeros_like(s1), 0.0
+    want = (s1 + s2) / (n_acc + old)
     with ma.apply(exe):
         averaged = np.asarray(fluid.global_scope()["maw"]).copy()
-        # the swapped-in value is an average over the window: it differs
-        # from the final params and lies inside the visited range
         assert not np.allclose(averaged, final)
-        lo = np.min(np.stack(history), axis=0) - 1e-6
-        hi = np.max(np.stack(history), axis=0) + 1e-6
-        assert ((averaged >= lo) & (averaged <= hi)).all()
+        np.testing.assert_allclose(averaged, want, rtol=1e-5, atol=1e-6)
     np.testing.assert_array_equal(
         np.asarray(fluid.global_scope()["maw"]), final)
