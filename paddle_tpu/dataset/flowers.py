@@ -19,13 +19,23 @@ def _samples(split, n):
         yield np.clip(img, 0.0, 1.0), label
 
 
+def _make(split, n, mapper, buffered_size, use_xmap):
+    base = lambda: _samples(split, n)  # noqa: E731
+    if mapper is None:
+        return base
+    if use_xmap:
+        from ..reader_utils import xmap_readers
+        return xmap_readers(mapper, base, 4, buffered_size, order=True)
+    return lambda: (mapper(s) for s in base())
+
+
 def train(mapper=None, buffered_size=1024, use_xmap=False):
-    return lambda: _samples("train", 300)
+    return _make("train", 300, mapper, buffered_size, use_xmap)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=False):
-    return lambda: _samples("test", 60)
+    return _make("test", 60, mapper, buffered_size, use_xmap)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):
-    return lambda: _samples("valid", 60)
+    return _make("valid", 60, mapper, buffered_size, use_xmap)

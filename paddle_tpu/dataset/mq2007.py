@@ -68,7 +68,11 @@ def load_from_text(filepath, shuffle=False, fill_missing=-1):
                 if 0 <= idx < FEATURE_DIM:
                     feat[idx] = float(v)
             lists.setdefault(qid, QueryList()).add(Query(qid, rel, feat))
-    return list(lists.values())
+    out = list(lists.values())
+    if shuffle:
+        import random
+        random.shuffle(out)
+    return out
 
 
 def gen_point(querylist):
@@ -77,6 +81,11 @@ def gen_point(querylist):
 
 
 def gen_pair(querylist, partial_order="full"):
+    if partial_order != "full":
+        raise NotImplementedError(
+            "mq2007.gen_pair: only partial_order='full' is supported "
+            "(every (higher, lower) relevance pair)"
+        )
     qs = sorted(querylist, key=lambda q: -q.relevance_score)
     for i, hi in enumerate(qs):
         for lo in qs[i + 1:]:

@@ -36,21 +36,38 @@ def _pairs(split, src_dict_size, trg_dict_size):
         )
 
 
-def _reader_creator(split, src_dict_size, trg_dict_size):
+def _reader_creator(split, src_dict_size, trg_dict_size, src_lang):
+    # src_lang selects translation direction (ref wmt16.py): "en" reads
+    # en->de; "de" swaps the pair so the German side is the source.
+    if src_lang not in ("en", "de"):
+        raise ValueError("wmt16: src_lang must be 'en' or 'de'")
+    # generate each side under the vocab that will consume it: for "de"
+    # the German (generated-target) side becomes the source, so it must
+    # be drawn from src_dict_size
+    gen_src, gen_trg = (
+        (trg_dict_size, src_dict_size) if src_lang == "de"
+        else (src_dict_size, trg_dict_size)
+    )
+
     def reader():
-        for sample in _pairs(split, src_dict_size, trg_dict_size):
-            yield sample
+        for src, trg_in, trg_next in _pairs(split, gen_src, gen_trg):
+            if src_lang == "de":
+                de = trg_in[1:]  # strip <s> to recover the raw target side
+                yield de, [0] + src, src + [1]
+            else:
+                yield src, trg_in, trg_next
 
     return reader
 
 
 def train(src_dict_size=_VOCAB, trg_dict_size=_VOCAB, src_lang="en"):
-    return _reader_creator("train", src_dict_size, trg_dict_size)
+    return _reader_creator("train", src_dict_size, trg_dict_size, src_lang)
 
 
 def test(src_dict_size=_VOCAB, trg_dict_size=_VOCAB, src_lang="en"):
-    return _reader_creator("test", src_dict_size, trg_dict_size)
+    return _reader_creator("test", src_dict_size, trg_dict_size, src_lang)
 
 
 def validation(src_dict_size=_VOCAB, trg_dict_size=_VOCAB, src_lang="en"):
-    return _reader_creator("validation", src_dict_size, trg_dict_size)
+    return _reader_creator(
+        "validation", src_dict_size, trg_dict_size, src_lang)

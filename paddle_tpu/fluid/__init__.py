@@ -37,6 +37,12 @@ from .backward import append_backward, gradients  # noqa: F401
 from . import optimizer
 from . import regularizer
 from . import clip
+from . import dygraph_grad_clip
+from .dygraph_grad_clip import (  # noqa: F401
+    GradClipByValue,
+    GradClipByNorm,
+    GradClipByGlobalNorm,
+)
 from . import unique_name
 from . import param_attr
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

@@ -90,22 +90,46 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """Compute gradients of ``targets`` w.r.t. arbitrary ``inputs`` —
     params, feeds, or INTERMEDIATE vars (a zero probe is injected after
     the intermediate's producing op in the vjp replay; see lowering
-    run_ops). Ref backward.py gradients()."""
+    run_ops). Ref backward.py gradients().
+
+    ``target_gradients`` seeds the vjp cotangent (default: ones, the
+    reference's fill-1 seed); ``no_grad_set`` vars are treated as
+    constants — a stop_gradient probe is placed at their producing op in
+    the replay, so no gradient flows through them.
+    """
     if isinstance(targets, Variable):
         targets = [targets]
     if isinstance(inputs, Variable):
         inputs = [inputs]
+    if target_gradients is not None:
+        if isinstance(target_gradients, Variable):
+            target_gradients = [target_gradients]
+        assert len(target_gradients) == len(targets), (
+            "target_gradients must pair 1:1 with targets"
+        )
     assert len(targets) == 1, (
         "paddle_tpu gradients() currently supports a single scalar target; "
         "combine targets with layers.sum first"
     )
     loss = targets[0]
     block = loss.block
+    no_grad = sorted(
+        {v.name if isinstance(v, Variable) else v for v in (no_grad_set or ())}
+    )
     grad_vars = [_create_grad_var(block, v) for v in inputs]
+    ins = {"Loss": [loss.name]}
+    attrs = {
+        "targets": [v.name for v in inputs],
+        "checkpoints": [],
+        "no_grad": no_grad,
+    }
+    if target_gradients is not None and target_gradients[0] is not None:
+        # a None entry means "seed with ones" (the default), per reference
+        ins["InitGrad"] = [target_gradients[0].name]
     block.append_op(
         type="backward",
-        inputs={"Loss": [loss.name]},
+        inputs=ins,
         outputs={"Grads": [g.name for g in grad_vars]},
-        attrs={"targets": [v.name for v in inputs], "checkpoints": []},
+        attrs=attrs,
     )
     return grad_vars

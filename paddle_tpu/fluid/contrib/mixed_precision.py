@@ -81,39 +81,169 @@ def _rewrite_program_bf16(program, amp_lists):
 
 class OptimizerWithMixedPrecision:
     def __init__(self, optimizer, amp_lists, init_loss_scaling,
-                 use_dynamic_loss_scaling, use_bf16=True):
+                 use_dynamic_loss_scaling, use_bf16=True,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.8):
         self._optimizer = optimizer
         self._amp_lists = amp_lists
+        self._init_loss_scaling = float(init_loss_scaling)
         self._loss_scaling = init_loss_scaling
         self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
         self._use_bf16 = use_bf16
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._scale_var = None
         self._scaled_loss = None
 
     def get_loss_scaling(self):
-        return self._loss_scaling
+        """The current loss-scaling: a graph Variable when dynamic scaling
+        is active (fp16 path), else the static float."""
+        return self._scale_var if self._scale_var is not None \
+            else self._loss_scaling
 
     def get_scaled_loss(self):
         return self._scaled_loss
 
+    def _ensure_scale_state(self):
+        from ..layers import tensor
+
+        if self._scale_var is not None:
+            return
+        from .. import unique_name
+
+        # unique names: two decorated optimizers in one process must not
+        # share loss-scaling state in the (name-keyed) global scope
+        self._scale_var = tensor.create_global_var(
+            shape=[1], value=self._init_loss_scaling, dtype="float32",
+            persistable=True, name=unique_name.generate("amp_loss_scaling"),
+        )
+        self._good_steps = tensor.create_global_var(
+            shape=[1], value=0.0, dtype="float32",
+            persistable=True, name=unique_name.generate("amp_good_steps"),
+        )
+        self._bad_steps = tensor.create_global_var(
+            shape=[1], value=0.0, dtype="float32",
+            persistable=True, name=unique_name.generate("amp_bad_steps"),
+        )
+
+    def _append_dynamic_update(self, finite):
+        """In-graph dynamic loss-scaling update (ref mixed_precision
+        update_loss_scaling op): after ``incr_every_n_steps`` consecutive
+        finite steps scale *= incr_ratio; after ``decr_every_n_nan_or_inf``
+        consecutive non-finite steps scale *= decr_ratio. All branch-free
+        arithmetic selects — XLA fuses it into the step."""
+        from ..layers import nn, tensor
+
+        block = self._scale_var.block
+
+        def assign(var, val):
+            block.append_op(
+                type="assign", inputs={"X": [val]}, outputs={"Out": [var]}
+            )
+
+        not_finite = nn.scale(finite, scale=-1.0, bias=1.0)
+        good = nn.elementwise_mul(
+            nn.scale(self._good_steps, bias=1.0), finite
+        )
+        bad = nn.elementwise_mul(
+            nn.scale(self._bad_steps, bias=1.0), not_finite
+        )
+        bump = nn._layer(
+            "greater_equal",
+            {"X": good,
+             "Y": tensor.fill_constant(
+                 [1], "float32", float(self._incr_every_n_steps))},
+            out_dtype="bool", out_shape=(1,),
+        )
+        bump = tensor.cast(bump, "float32")
+        decay = nn._layer(
+            "greater_equal",
+            {"X": bad,
+             "Y": tensor.fill_constant(
+                 [1], "float32", float(self._decr_every_n_nan_or_inf))},
+            out_dtype="bool", out_shape=(1,),
+        )
+        decay = tensor.cast(decay, "float32")
+        factor = nn.elementwise_mul(
+            nn.scale(bump, scale=self._incr_ratio - 1.0, bias=1.0),
+            nn.scale(decay, scale=self._decr_ratio - 1.0, bias=1.0),
+        )
+        new_scale = nn.elementwise_mul(self._scale_var, factor)
+        # never scale below 1.0 (ref keeps the scale usable)
+        new_scale = nn.elementwise_max(
+            new_scale, tensor.fill_constant([1], "float32", 1.0)
+        )
+        assign(self._scale_var, new_scale)
+        assign(self._good_steps, nn.elementwise_mul(
+            good, nn.scale(bump, scale=-1.0, bias=1.0)))
+        assign(self._bad_steps, nn.elementwise_mul(
+            bad, nn.scale(decay, scale=-1.0, bias=1.0)))
+
     def backward(self, loss, **kwargs):
-        from ..layers import nn
+        from ..layers import nn, tensor
 
         if self._use_bf16:
-            # bf16 path: no loss scaling needed
+            # bf16 path: no loss scaling needed (same exponent range as
+            # fp32) — this is the TPU-native default
             self._scaled_loss = loss
+            return self._optimizer.backward(self._scaled_loss, **kwargs)
+        if self._use_dynamic_loss_scaling:
+            self._ensure_scale_state()
+            self._scaled_loss = nn.elementwise_mul(
+                loss, nn.reduce_sum(self._scale_var)
+            )
         else:
-            self._scaled_loss = nn.scale(loss, scale=float(self._loss_scaling))
+            self._scaled_loss = nn.scale(
+                loss, scale=float(self._loss_scaling))
         params_grads = self._optimizer.backward(self._scaled_loss, **kwargs)
-        if not self._use_bf16 and self._loss_scaling != 1.0:
-            inv = 1.0 / float(self._loss_scaling)
-            unscaled = []
-            for p, g in params_grads:
+        if self._use_dynamic_loss_scaling:
+            # check_finite_and_unscale: one scalar flag per grad (the
+            # isfinite lowering reduces to a scalar itself), combined into
+            # a global flag; each grad is unscaled AND — because NaN * 0
+            # is NaN — zeroed via a select on overflow, so the optimizer
+            # update becomes a no-op on bad steps.
+            per_grad_flag = {}
+            finite = None
+            for _, g in params_grads:
                 if g is None:
-                    unscaled.append((p, g))
                     continue
-                ng = nn.scale(g, scale=inv)
-                unscaled.append((p, ng))
-            params_grads = unscaled
+                fb = nn._layer(
+                    "isfinite", {"X": g}, out_dtype="bool", out_shape=()
+                )
+                per_grad_flag[g.name] = fb
+                f = nn.reshape(tensor.cast(fb, "float32"), [1])
+                finite = f if finite is None else nn.elementwise_mul(
+                    finite, f)
+            inv_s = nn.reduce_sum(nn.elementwise_div(
+                tensor.fill_constant([1], "float32", 1.0), self._scale_var
+            ))
+            gate = nn.elementwise_mul(inv_s, nn.reduce_sum(finite))
+
+            def _unscale_or_zero(g):
+                zeros = nn._layer(
+                    "fill_zeros_like", {"X": g}, out_shape=g.shape,
+                    out_dtype=g.dtype,
+                )
+                cleaned = nn._layer(
+                    "where",
+                    {"Condition": per_grad_flag[g.name], "X": g, "Y": zeros},
+                    out_shape=g.shape,
+                )
+                return nn.elementwise_mul(cleaned, gate)
+
+            params_grads = [
+                (p, g if g is None else _unscale_or_zero(g))
+                for p, g in params_grads
+            ]
+            self._append_dynamic_update(finite)
+        elif self._loss_scaling != 1.0:
+            inv = 1.0 / float(self._loss_scaling)
+            params_grads = [
+                (p, g if g is None else nn.scale(g, scale=inv))
+                for p, g in params_grads
+            ]
         return params_grads
 
     def apply_gradients(self, params_grads):
@@ -154,6 +284,9 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2**15,
     return OptimizerWithMixedPrecision(
         optimizer, amp_lists, init_loss_scaling,
         use_dynamic_loss_scaling, use_bf16,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
     )
 
 

@@ -76,8 +76,18 @@ class DataFeeder:
 
     def decorate_reader(self, reader, multi_devices=False, num_places=None,
                         drop_last=True):
+        # drop_last (ref data_feeder.py): a trailing batch smaller than the
+        # established batch size is dropped — essential on TPU, where a
+        # ragged final batch would trigger a fresh XLA compilation. The
+        # batch size is established from the FIRST batch; a stream whose
+        # only batch is ragged has no size reference and passes through.
         def __reader_creator__():
+            full = None
             for item in reader():
+                if full is None:
+                    full = len(item)
+                if drop_last and len(item) < full:
+                    continue
                 yield self.feed(item)
 
         return __reader_creator__

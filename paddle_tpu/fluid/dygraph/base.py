@@ -231,6 +231,11 @@ def dygraph_minimize(opt, loss, parameter_list=None, no_grad_set=None,
     params = parameter_list
     if params is None:
         params = _default_param_registry()
+    if no_grad_set:
+        skip = {
+            getattr(v, "name", v) for v in no_grad_set
+        }
+        params = [p for p in params if p.name not in skip]
     if not params:
         raise ValueError(
             "dygraph minimize: pass parameter_list=model.parameters()"
@@ -249,6 +254,19 @@ def dygraph_minimize(opt, loss, parameter_list=None, no_grad_set=None,
             "optimizer %s not supported in dygraph mode" % opt.type
         )
     attrs = _opt_attrs(opt)
+    if grad_clip is not None:
+        from ..dygraph_grad_clip import GradClipBase
+
+        if not isinstance(grad_clip, GradClipBase):
+            raise TypeError(
+                "grad_clip must be a dygraph_grad_clip.GradClipBase "
+                "(GradClipByValue/GradClipByNorm/GradClipByGlobalNorm), "
+                "got %r" % (grad_clip,)
+            )
+        live = [p for p in params if p.grad is not None and p.trainable]
+        clipped = grad_clip([(p, p.grad) for p in live])
+        for p, g in clipped:
+            p.grad = g
     for p in params:
         if p.grad is None or not p.trainable:
             continue

@@ -57,6 +57,16 @@ class TracedLayer:
         return outs, traced
 
     def save_inference_model(self, dirname, feed=None, fetch=None):
+        """Persist the traced layer's weights. feed/fetch subset selection
+        (ref jit.py save_inference_model) is not supported on the eager
+        trace path — re-trace a wrapper layer exposing only the wanted
+        inputs/outputs instead."""
+        if feed is not None or fetch is not None:
+            raise NotImplementedError(
+                "TracedLayer.save_inference_model: feed/fetch subset "
+                "selection is not supported; trace a wrapper Layer that "
+                "takes/returns exactly the tensors you want saved"
+            )
         from ..dygraph.checkpoint import save_dygraph
 
         save_dygraph(self._layer.state_dict(), dirname + "/model")

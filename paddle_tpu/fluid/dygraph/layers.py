@@ -62,11 +62,13 @@ class Layer:
         return p
 
     def create_variable(self, name=None, persistable=None, dtype=None):
-        return VarBase(
+        v = VarBase(
             None,
             name=name or unique_name.generate(self._full_name + ".var"),
             persistable=bool(persistable),
         )
+        v._dtype_hint = dtype or "float32"
+        return v
 
     def parameters(self, include_sublayers=True):
         ret = list(self._parameters.values())
@@ -118,13 +120,15 @@ class Layer:
     def state_dict(self, destination=None, include_sublayers=True,
                    structured_name_prefix=""):
         dest = destination if destination is not None else collections.OrderedDict()
-        for name, p in self.named_parameters():
+        for name, p in self.named_parameters(
+                include_sublayers=include_sublayers):
             if p is not None:
                 dest[structured_name_prefix + name] = p
         return dest
 
     def set_dict(self, stat_dict, include_sublayers=True):
-        named = dict(self.named_parameters())
+        named = dict(
+            self.named_parameters(include_sublayers=include_sublayers))
         by_varname = {p.name: p for _, p in named.items()}
         for k, v in stat_dict.items():
             target = named.get(k) or by_varname.get(k)

@@ -700,6 +700,11 @@ class NCE(Layer):
         self.bias = None
 
     def forward(self, input, label, sample_weight=None):
+        if sample_weight is not None:
+            raise NotImplementedError(
+                "NCE: per-sample weights are not supported; weight the "
+                "loss externally instead"
+            )
         if self.weight is None:
             dim = self._dim or input.shape[1]
             self.weight = self.create_parameter(

@@ -199,11 +199,17 @@ def save(program, model_path):
 def load(program, model_path, executor=None, var_list=None):
     data = np.load(model_path + ".pdparams.npz")
     scope = global_scope()
-    names = (
-        [v.name if isinstance(v, Variable) else v for v in var_list]
-        if var_list
-        else list(data.files)
-    )
+    if var_list:
+        names = [v.name if isinstance(v, Variable) else v for v in var_list]
+    elif program is not None:
+        # only touch the program's persistables, like the reference
+        names = [
+            v.name
+            for v in program.list_vars()
+            if getattr(v, "persistable", False)
+        ]
+    else:
+        names = list(data.files)
     for name in names:
         if name in data:
             scope.set(name, np.asarray(data[name]))

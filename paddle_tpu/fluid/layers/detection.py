@@ -193,6 +193,11 @@ def detection_output(loc, scores, prior_box, prior_box_var,
             "NMS emits fixed keep_top_k rows per image (padded with "
             "label=-1), so there is no LoD row-index companion; consume "
             "the padded rows directly or filter on label >= 0.")
+    if nms_eta != 1.0:
+        raise NotImplementedError(
+            "detection_output(nms_eta != 1): adaptive NMS decays the "
+            "threshold per kept box, which is inherently sequential; the "
+            "vectorized TPU NMS supports only the standard nms_eta=1.0")
     decoded = box_coder(
         prior_box, prior_box_var, loc, code_type="decode_center_size"
     )
@@ -800,7 +805,17 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     iou = iou_similarity(gt_box, prior_box)          # (n_gt, n_prior)
     best_iou = nn.reduce_max(iou, dim=[0])           # (n_prior,)
     best_gt = tensor.argmax(iou, axis=0)             # (n_prior,) gt index
-    pos_mask = tensor.cast(
+    # bipartite step (ref bipartite_match): every gt claims its best prior
+    # even below the threshold, expressed as a dense one-hot claim matrix
+    best_prior = tensor.argmax(iou, axis=1)          # (n_gt,)
+    claims = nn.one_hot(
+        nn.unsqueeze(tensor.cast(best_prior, "int64"), [1]), iou.shape[1]
+    )                                                # (n_gt, n_prior)
+    bi_mask = nn.reduce_max(claims, dim=[0])         # (n_prior,)
+    best_gt_bi = tensor.argmax(
+        nn.elementwise_mul(iou, claims), axis=0
+    )
+    thr_mask = tensor.cast(
         nn._layer(
             "greater_equal",
             {"X": best_iou,
@@ -809,6 +824,24 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         ),
         "float32",
     )
+    if match_type == "bipartite":
+        pos_mask = bi_mask
+        best_gt = best_gt_bi
+    elif match_type == "per_prediction":
+        pos_mask = nn.elementwise_max(thr_mask, bi_mask)
+        bi_i = tensor.cast(bi_mask, "int64")
+        not_bi = tensor.cast(
+            nn.scale(bi_mask, scale=-1.0, bias=1.0), "int64"
+        )
+        best_gt = nn.elementwise_add(
+            nn.elementwise_mul(bi_i, best_gt_bi),
+            nn.elementwise_mul(not_bi, best_gt),
+        )
+    else:
+        raise ValueError(
+            "ssd_loss: match_type must be 'per_prediction' or 'bipartite', "
+            "got %r" % (match_type,)
+        )
     # localization: smooth-L1 of predicted offsets vs the MATCHED gt's
     # encoded offsets (gather the per-prior matched row of the encode
     # matrix: encoded[gt, prior] -> take diag of gathered rows)
@@ -861,12 +894,60 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         ),
     )
     ce = loss_layers.softmax_with_cross_entropy(confidence, target_label)
-    weights = nn.unsqueeze(
-        nn.scale(pos_mask, scale=1.0 - 1.0 / neg_pos_ratio,
-                 bias=1.0 / neg_pos_ratio),
-        [1],
+    ce_flat = nn.squeeze(ce, [1])                    # (n_prior,)
+    if mining_type != "max_negative":
+        raise NotImplementedError(
+            "ssd_loss: mining_type='%s' unsupported; the reference default "
+            "'max_negative' (per-image hard-negative mining) is implemented"
+            % mining_type
+        )
+    # hard-negative mining (ref mine_hard_examples, max_negative mode):
+    # candidates are non-positive priors whose best IoU < neg_overlap;
+    # keep the neg_pos_ratio * num_pos highest-loss candidates (capped by
+    # sample_size), all with static shapes — the count is a traced scalar
+    # compared against each candidate's rank.
+    neg_cand = nn.elementwise_mul(
+        nn.scale(pos_mask, scale=-1.0, bias=1.0),
+        tensor.cast(
+            nn._layer(
+                "less_than",
+                {"X": best_iou,
+                 "Y": tensor.fill_constant([1], "float32", neg_overlap)},
+                out_dtype="bool", out_shape=best_iou.shape,
+            ),
+            "float32",
+        ),
     )
-    conf_l = nn.reduce_sum(nn.elementwise_mul(ce, weights))
+    masked = nn.elementwise_sub(
+        nn.elementwise_mul(ce_flat, neg_cand),
+        nn.scale(nn.scale(neg_cand, scale=-1.0, bias=1.0), scale=1e9),
+    )
+    # rank of each prior among candidates by loss desc = double argsort
+    _, order = tensor.argsort(masked, descending=True)
+    _, rank = tensor.argsort(tensor.cast(order, "float32"))
+    num_pos = nn.reduce_sum(pos_mask)
+    neg_count = nn.elementwise_min(
+        nn.scale(num_pos, scale=float(neg_pos_ratio)),
+        nn.reduce_sum(neg_cand),
+    )
+    if sample_size is not None:
+        neg_count = nn.elementwise_min(
+            neg_count, tensor.fill_constant([], "float32", float(sample_size))
+        )
+    neg_mask = nn.elementwise_mul(
+        tensor.cast(
+            nn._layer(
+                "less_than",
+                {"X": tensor.cast(rank, "float32"), "Y": neg_count},
+                out_dtype="bool", out_shape=best_iou.shape,
+            ),
+            "float32",
+        ),
+        neg_cand,
+    )
+    conf_l = nn.reduce_sum(
+        nn.elementwise_mul(ce_flat, nn.elementwise_add(pos_mask, neg_mask))
+    )
     total = nn.elementwise_add(
         nn.scale(loc_l, scale=loc_loss_weight),
         nn.scale(conf_l, scale=conf_loss_weight),

@@ -121,7 +121,23 @@ class Categorical(Distribution):
         return nn.softmax(self.logits)
 
     def sample(self, shape=None, seed=0):
-        return nn.sampling_id(self._probs(), seed=seed)
+        # (the reference raises NotImplementedError here; we sample via
+        # sampling_id, tiling the batch for a leading sample shape)
+        if not shape:
+            return nn.sampling_id(self._probs(), seed=seed)
+        import numpy as _np
+
+        probs = self._probs()
+        if len(probs.shape) != 2:
+            raise ValueError(
+                "Categorical.sample with a sample shape needs 2-D logits "
+                "(batch, n_categories)"
+            )
+        n = int(_np.prod(shape))
+        tiled = nn.expand(nn.unsqueeze(probs, [0]), [n, 1, 1])
+        flat = nn.reshape(tiled, [-1, probs.shape[-1]])
+        draws = nn.sampling_id(flat, seed=seed)
+        return nn.reshape(draws, list(shape) + [probs.shape[0]])
 
     def entropy(self):
         p = self._probs()
@@ -163,7 +179,7 @@ class MultivariateNormalDiag(Distribution):
 
     def sample(self, shape=None, seed=0):
         d = self.loc.shape[-1]
-        z = nn.gaussian_random([d], seed=seed)
+        z = nn.gaussian_random(list(shape or []) + [d], seed=seed)
         std = ops.sqrt(self._cov_diag())
         return nn.elementwise_add(nn.elementwise_mul(z, std), self.loc)
 

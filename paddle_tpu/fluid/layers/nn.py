@@ -1362,7 +1362,21 @@ def flatten(x, axis=1, name=None):
 
 
 def flatten_contiguous(x, start_axis=0, stop_axis=-1):
-    return flatten(x, axis=start_axis or 1)
+    """Collapse dims [start_axis, stop_axis] into one (reshape, not the
+    2-D flatten op)."""
+    ndim = len(x.shape)
+    lo = start_axis + ndim if start_axis < 0 else start_axis
+    hi = stop_axis + ndim if stop_axis < 0 else stop_axis
+    if not (0 <= lo <= hi < ndim):
+        raise ValueError(
+            "flatten_contiguous: invalid axes (%d, %d) for rank %d"
+            % (start_axis, stop_axis, ndim)
+        )
+    mid = 1
+    for s in x.shape[lo:hi + 1]:
+        mid = -1 if (s in (None, -1) or mid == -1) else mid * int(s)
+    new_shape = list(x.shape[:lo]) + [mid] + list(x.shape[hi + 1:])
+    return reshape(x, new_shape)
 
 
 def stack(x, axis=0):
@@ -1822,8 +1836,12 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
     inputs = {"X": label}
     if prior_dist is not None:
         inputs["PriorDist"] = prior_dist
-    return _layer("label_smooth", inputs, {"epsilon": float(epsilon)},
-                  out_shape=label.shape)
+    out = _layer("label_smooth", inputs, {"epsilon": float(epsilon)},
+                 out_shape=label.shape)
+    if dtype not in (None, out.dtype):
+        from . import tensor as _tensor
+        out = _tensor.cast(out, dtype)
+    return out
 
 
 def image_resize(
@@ -1847,6 +1865,19 @@ def image_resize(
         "align_corners": align_corners,
         "align_mode": align_mode,
     }
+    channel_last = data_format in ("NHWC", "NDHWC")
+    if not channel_last and data_format not in ("NCHW", "NCDHW"):
+        raise ValueError(
+            "image_resize: data_format must be NCHW/NHWC (or NCDHW/NDHWC "
+            "for trilinear), got %r" % (data_format,)
+        )
+    if channel_last:
+        # the interp lowerings are channel-first; wrap with transposes
+        # (XLA folds them into the gather/resize layout)
+        nd = len(input.shape)
+        to_cf = [0, nd - 1] + list(range(1, nd - 1))
+        to_cl = [0] + list(range(2, nd)) + [1]
+        input = transpose(input, to_cf)
     oshape = None
     if out_shape is not None:
         if op_type == "trilinear_interp":
@@ -1862,7 +1893,10 @@ def image_resize(
                 list(input.shape[:2])
                 + [int(s * scale) if s not in (None, -1) else -1 for s in input.shape[2:]]
             )
-    return _layer(op_type, {"X": input}, attrs, out_shape=oshape)
+    out = _layer(op_type, {"X": input}, attrs, out_shape=oshape)
+    if channel_last:
+        out = transpose(out, to_cl)
+    return out
 
 
 def resize_bilinear(input, out_shape=None, scale=None, name=None,
@@ -1875,14 +1909,16 @@ def resize_bilinear(input, out_shape=None, scale=None, name=None,
 def resize_nearest(input, out_shape=None, scale=None, name=None,
                    actual_shape=None, align_corners=True, data_format="NCHW"):
     return image_resize(input, out_shape, scale, name, "NEAREST",
-                        actual_shape, align_corners)
+                        actual_shape, align_corners,
+                        data_format=data_format)
 
 
 def resize_trilinear(input, out_shape=None, scale=None, name=None,
                      actual_shape=None, align_corners=True, align_mode=1,
                      data_format="NCDHW"):
     return image_resize(input, out_shape, scale, name, "TRILINEAR",
-                        actual_shape, align_corners, align_mode)
+                        actual_shape, align_corners, align_mode,
+                        data_format=data_format)
 
 
 # ---------------------------------------------------------------------------

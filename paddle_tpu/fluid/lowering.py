@@ -137,6 +137,14 @@ def run_ops(block, op_list, env, ctx):
         for n in inter_targets:
             probe_at.setdefault(producer[n], []).append(n)
 
+        # no_grad_set vars become constants: a stop_gradient probe at the
+        # producing op blocks any gradient flowing through them (vars bound
+        # at program start are already vjp constants unless targeted).
+        stop_at = {}
+        for n in bw_op.attrs.get("no_grad", ()) or ():
+            if n in producer and n not in env0:
+                stop_at.setdefault(producer[n], []).append(n)
+
         probe_shapes = {}
         if inter_targets:
             def _shapes_probe():
@@ -202,6 +210,8 @@ def run_ops(block, op_list, env, ctx):
                         # to Grads outputs of earlier backward ops so
                         # grad-of-grad targets work.
                         e_in[n] = e_in[n] + by_name[n]
+                    for n in stop_at.get(j, ()):
+                        e_in[n] = lax.stop_gradient(e_in[n])
                 return e_in
 
             prev = 0
@@ -218,7 +228,17 @@ def run_ops(block, op_list, env, ctx):
             return e[_ln], e
 
         (loss_val, vjp_fn, env) = jax.vjp(fwd, primals, has_aux=True)
-        (grads,) = vjp_fn(jnp.ones_like(loss_val))
+        init_grad = bw_op.input("InitGrad")
+        if init_grad:
+            # gradients(target_gradients=...): user-supplied vjp seed; the
+            # seed var is produced by the region, so the aux env holds it.
+            seed = jnp.broadcast_to(
+                jnp.asarray(env[init_grad[0]], loss_val.dtype),
+                loss_val.shape,
+            )
+        else:
+            seed = jnp.ones_like(loss_val)
+        (grads,) = vjp_fn(seed)
         grad_names = bw_op.output("Grads")
         for n, g in zip(grad_names, grads):
             env[n] = g

@@ -178,8 +178,18 @@ class Optimizer:
             no_grad_set=no_grad_set,
         )
         if grad_clip is not None:
+            # The reference only honors grad_clip in dygraph mode (TODO at
+            # ref optimizer.py:3774 for static) — we apply it in both modes
+            # by emitting clip ops over the freshly appended grad vars.
             from .dygraph_grad_clip import GradClipBase
 
+            if not isinstance(grad_clip, GradClipBase):
+                raise TypeError(
+                    "grad_clip must be a dygraph_grad_clip.GradClipBase "
+                    "instance, got %r" % (grad_clip,)
+                )
+            with program_guard(loss.block.program, startup_program):
+                params_grads = grad_clip(params_grads)
         optimize_ops = self.apply_optimize(
             loss, startup_program, params_grads
         )
@@ -836,10 +846,11 @@ class ModelAverage(Optimizer):
             )
 
     class _ApplyGuard:
-        def __init__(self, outer, executor, scope):
+        def __init__(self, outer, executor, scope, need_restore=True):
             self.outer = outer
             self.executor = executor
             self.scope = scope
+            self.need_restore = need_restore
             self.backup = {}
 
         def __enter__(self):
@@ -867,16 +878,36 @@ class ModelAverage(Optimizer):
             return self
 
         def __exit__(self, *exc):
+            # ref semantics: need_restore=False keeps the averaged weights
+            # applied; ModelAverage.restore(exe) restores them later.
+            if self.need_restore:
+                self._do_restore()
+            else:
+                self.outer._pending_restore = dict(self.backup)
+
+        def _do_restore(self):
             for name, val in self.backup.items():
                 self.scope.set(name, val)
 
     def apply(self, executor, need_restore=True):
         from .executor import global_scope
 
-        return ModelAverage._ApplyGuard(self, executor, global_scope())
+        return ModelAverage._ApplyGuard(
+            self, executor, global_scope(), need_restore
+        )
 
     def restore(self, executor):
-        pass
+        """Restore the pre-average weights saved by an
+        ``apply(need_restore=False)`` (ref optimizer.py ModelAverage)."""
+        from .executor import global_scope
+
+        pending = getattr(self, "_pending_restore", None)
+        if not pending:
+            return
+        scope = global_scope()
+        for name, val in pending.items():
+            scope.set(name, val)
+        self._pending_restore = {}
 
 
 class ExponentialMovingAverage:
@@ -1007,6 +1038,16 @@ class RecomputeOptimizer(Optimizer):
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
+        if grad_clip is not None:
+            from .dygraph_grad_clip import GradClipBase
+
+            if not isinstance(grad_clip, GradClipBase):
+                raise TypeError(
+                    "grad_clip must be a dygraph_grad_clip.GradClipBase "
+                    "instance, got %r" % (grad_clip,)
+                )
+            with program_guard(loss.block.program, startup_program):
+                params_grads = grad_clip(params_grads)
         optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
         return optimize_ops, params_grads
 

@@ -135,13 +135,22 @@ def firstn(reader, n):
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    # thread pool map (host-side preprocessing off the main thread)
+    # Thread-pool map with a bounded in-flight window of ``buffer_size``
+    # futures (pool.map would eagerly consume the whole source reader).
+    # Results always come back in input order, which satisfies order=True;
+    # order=False merely permits reordering we don't need to exploit.
+    import collections
     import concurrent.futures
 
     def data_reader():
         with concurrent.futures.ThreadPoolExecutor(process_num) as pool:
-            for out in pool.map(mapper, reader()):
-                yield out
+            pending = collections.deque()
+            for sample in reader():
+                pending.append(pool.submit(mapper, sample))
+                if len(pending) >= max(int(buffer_size), 1):
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
 
     return data_reader
 

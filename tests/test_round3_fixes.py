@@ -1,0 +1,304 @@
+"""Round-3 regression tests: dygraph grad clipping, ADVICE fixes,
+accepted-kwarg audit."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.dygraph_grad_clip import (
+    GradClipByValue,
+    GradClipByNorm,
+    GradClipByGlobalNorm,
+)
+
+
+def _global_norm(arrs):
+    return float(np.sqrt(sum(float(np.sum(np.square(a))) for a in arrs)))
+
+
+def test_grad_clip_by_value_eager():
+    g = np.array([[-3.0, 0.5], [2.0, -0.1]], "float32")
+    clip = GradClipByValue(-1.0, 1.0)
+    (_, out), = clip([(None, g)])
+    np.testing.assert_allclose(np.asarray(out), np.clip(g, -1.0, 1.0))
+    # min defaults to -max
+    clip2 = GradClipByValue(None, 0.25)
+    (_, out2), = clip2([(None, g)])
+    np.testing.assert_allclose(np.asarray(out2), np.clip(g, -0.25, 0.25))
+
+
+def test_grad_clip_by_norm_eager():
+    g = np.full((4, 4), 2.0, "float32")  # norm = 8
+    clip = GradClipByNorm(2.0)
+    (_, out), = clip([(None, g)])
+    assert abs(_global_norm([np.asarray(out)]) - 2.0) < 1e-4
+    # under the limit: unchanged
+    small = np.full((2,), 0.1, "float32")
+    (_, out2), = clip([(None, small)])
+    np.testing.assert_allclose(np.asarray(out2), small, rtol=1e-6)
+
+
+def test_grad_clip_by_global_norm_eager():
+    g1 = np.full((3, 3), 1.0, "float32")
+    g2 = np.full((4,), 2.0, "float32")
+    orig = _global_norm([g1, g2])
+    clip = GradClipByGlobalNorm(1.0)
+    out = clip([(None, g1), (None, None), (None, g2)])
+    assert out[1][1] is None
+    got = _global_norm([np.asarray(out[0][1]), np.asarray(out[2][1])])
+    assert abs(got - 1.0) < 1e-4
+    # ratio preserved across tensors
+    np.testing.assert_allclose(
+        np.asarray(out[0][1]) / g1, np.asarray(out[2][1])[0] / 2.0, rtol=1e-5
+    )
+    assert orig > 1.0
+
+
+def test_dygraph_minimize_applies_global_norm_clip():
+    max_norm = 0.01
+    with dygraph.guard():
+        m = dygraph.Linear(6, 3)
+        x = dygraph.to_variable(
+            np.random.default_rng(0).standard_normal((8, 6)).astype("float32")
+        )
+        from paddle_tpu.fluid.dygraph.tracer import call_op
+
+        before = {p.name: np.asarray(p.value).copy() for p in m.parameters()}
+        loss = call_op("mean", {"X": [call_op(
+            "elementwise_mul", {"X": [m(x)], "Y": [m(x)]}, {"axis": -1})]})
+        loss.backward()
+        grads = [np.asarray(p.grad) for p in m.parameters()
+                 if p.grad is not None]
+        assert _global_norm(grads) > max_norm  # clip must actually bite
+        opt = fluid.optimizer.SGD(learning_rate=1.0)
+        opt.minimize(loss, parameter_list=m.parameters(),
+                     grad_clip=GradClipByGlobalNorm(max_norm))
+        # with lr=1.0 sgd, total param delta norm == clipped global norm
+        deltas = [
+            np.asarray(p.value) - before[p.name] for p in m.parameters()
+        ]
+        assert abs(_global_norm(deltas) - max_norm) < 1e-4
+
+
+def test_dygraph_minimize_rejects_bad_grad_clip():
+    with dygraph.guard():
+        m = dygraph.Linear(2, 2)
+        x = dygraph.to_variable(np.ones((1, 2), "float32"))
+        from paddle_tpu.fluid.dygraph.tracer import call_op
+
+        loss = call_op("mean", {"X": [m(x)]})
+        loss.backward()
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        with pytest.raises(TypeError):
+            opt.minimize(loss, parameter_list=m.parameters(),
+                         grad_clip=5.0)  # not a GradClipBase
+
+
+def test_static_minimize_applies_grad_clip():
+    max_norm = 0.01
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[6], dtype="float32")
+        y = fluid.layers.fc(x, size=3)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(y))
+        opt = fluid.optimizer.SGD(learning_rate=1.0)
+        opt.minimize(loss, grad_clip=GradClipByGlobalNorm(max_norm))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    params = [p.name for p in main.global_block().all_parameters()]
+    scope = fluid.global_scope()
+    xs = np.random.default_rng(1).standard_normal((8, 6)).astype("float32")
+    before = {n: np.asarray(scope.find_var(n).get_tensor()).copy()
+              for n in params}
+    exe.run(main, feed={"x": xs}, fetch_list=[loss])
+    deltas = [
+        np.asarray(scope.find_var(n).get_tensor()) - before[n] for n in params
+    ]
+    assert abs(_global_norm(deltas) - max_norm) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# silent-kwarg audit fixes
+# ---------------------------------------------------------------------------
+def test_gradients_target_gradients_scales_seed():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", shape=[3], dtype="float32")
+        y = fluid.layers.reduce_sum(fluid.layers.square(x))  # dy/dx = 2x
+        seed = fluid.layers.fill_constant([], "float32", 5.0)
+        (gx,) = fluid.gradients(y, x, target_gradients=seed)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.array([[1.0, 2.0, 3.0]], "float32")
+    (out,) = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+    np.testing.assert_allclose(out, 5.0 * 2.0 * xs, rtol=1e-5)
+
+
+def test_gradients_no_grad_set_blocks_flow():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", shape=[3], dtype="float32")
+        h = fluid.layers.square(x)          # dh/dx = 2x
+        z = fluid.layers.scale(h, scale=3.0)
+        y = fluid.layers.reduce_sum(fluid.layers.elementwise_add(z, x))
+        # block flow through h: only the direct +x path contributes
+        (gx,) = fluid.gradients(y, x, no_grad_set={h.name})
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.array([[1.0, 2.0, 3.0]], "float32")
+    (out,) = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+    np.testing.assert_allclose(out, np.ones_like(xs), rtol=1e-5)
+
+
+def test_amp_dynamic_loss_scaling_decreases_on_overflow():
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.reduce_mean(y)
+        opt = mp.decorate(
+            fluid.optimizer.SGD(learning_rate=0.1),
+            init_loss_scaling=1024.0,
+            use_dynamic_loss_scaling=True,
+            use_bf16=False,
+            incr_every_n_steps=2,
+            decr_every_n_nan_or_inf=1,
+            incr_ratio=2.0,
+            decr_ratio=0.5,
+        )
+        opt.minimize(loss)
+        scale_var = opt.get_loss_scaling()
+    assert hasattr(scale_var, "name"), "dynamic scaling must be a graph var"
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    params = [p.name for p in main.global_block().all_parameters()]
+
+    ok = np.ones((2, 4), "float32")
+    bad = np.full((2, 4), np.nan, "float32")
+    # finite step: params move, scale unchanged (good=1 < incr_every_n=2)
+    exe.run(main, feed={"x": ok}, fetch_list=[loss])
+    s1 = float(np.asarray(scope.find_var(scale_var.name).get_tensor())[0])
+    assert s1 == 1024.0
+    before = {n: np.asarray(scope.find_var(n).get_tensor()).copy()
+              for n in params}
+    # nan step: params must NOT move, scale halves (decr_every_n=1)
+    exe.run(main, feed={"x": bad}, fetch_list=[loss])
+    s2 = float(np.asarray(scope.find_var(scale_var.name).get_tensor())[0])
+    assert s2 == 512.0, s2
+    for n in params:
+        got = np.asarray(scope.find_var(n).get_tensor())
+        np.testing.assert_allclose(got, before[n], atol=0,
+                                   err_msg="params moved on overflow step")
+    # second finite step reaches good=2 -> scale doubles
+    exe.run(main, feed={"x": ok}, fetch_list=[loss])
+    exe.run(main, feed={"x": ok}, fetch_list=[loss])
+    s3 = float(np.asarray(scope.find_var(scale_var.name).get_tensor())[0])
+    assert s3 == 1024.0, s3
+
+
+def test_model_average_need_restore_false_then_restore():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.reduce_mean(y)
+        opt = fluid.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+        ma = fluid.optimizer.ModelAverage(average_window_rate=0.5,
+                                          min_average_window=1,
+                                          max_average_window=100)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for _ in range(4):
+        exe.run(main, feed={"x": np.ones((2, 2), "float32")},
+                fetch_list=[loss])
+    scope = fluid.global_scope()
+    pname = main.global_block().all_parameters()[0].name
+    trained = np.asarray(scope.find_var(pname).get_tensor()).copy()
+    with ma.apply(exe, need_restore=False):
+        averaged = np.asarray(scope.find_var(pname).get_tensor()).copy()
+    # still averaged after the guard exits
+    now = np.asarray(scope.find_var(pname).get_tensor())
+    np.testing.assert_allclose(now, averaged)
+    assert not np.allclose(trained, averaged)
+    ma.restore(exe)
+    np.testing.assert_allclose(
+        np.asarray(scope.find_var(pname).get_tensor()), trained)
+
+
+def test_flatten_contiguous_axes():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.fill_constant([2, 3, 4, 5], "float32", 1.0)
+        a = fluid.layers.flatten_contiguous(x, 1, 2)
+        b = fluid.layers.flatten_contiguous(x, 0, -1)
+    assert tuple(a.shape) == (2, 12, 5), a.shape
+    assert tuple(b.shape) == (120,), b.shape
+    exe = fluid.Executor(fluid.CPUPlace())
+    av, bv = exe.run(main, feed={}, fetch_list=[a, b])
+    assert av.shape == (2, 12, 5) and bv.shape == (120,)
+
+
+def test_resize_nearest_nhwc_matches_nchw():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", shape=[3, 4, 4], dtype="float32")
+        up_cf = fluid.layers.resize_nearest(x, out_shape=[8, 8])
+        xt = fluid.layers.transpose(x, [0, 2, 3, 1])
+        up_cl = fluid.layers.resize_nearest(
+            xt, out_shape=[8, 8], data_format="NHWC")
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.random.default_rng(3).random((2, 3, 4, 4)).astype("float32")
+    cf, cl = exe.run(main, feed={"x": xs}, fetch_list=[up_cf, up_cl])
+    np.testing.assert_allclose(cf, np.transpose(cl, (0, 3, 1, 2)), rtol=1e-6)
+
+
+def test_categorical_sample_shape():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        logits = fluid.layers.fill_constant([2, 5], "float32", 0.0)
+        dist = fluid.layers.Categorical(logits)
+        s = dist.sample([7])
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(main, feed={}, fetch_list=[s])
+    assert out.shape == (7, 2)
+    assert out.min() >= 0 and out.max() < 5
+
+
+def test_decorate_reader_drop_last():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", shape=[2], dtype="float32")
+    feeder = fluid.DataFeeder(feed_list=[x], place=fluid.CPUPlace())
+
+    def batches():
+        yield [(np.zeros(2, "float32"),)] * 4
+        yield [(np.zeros(2, "float32"),)] * 2  # ragged tail
+
+    kept = list(feeder.decorate_reader(batches, drop_last=True)())
+    assert len(kept) == 1
+    both = list(feeder.decorate_reader(batches, drop_last=False)())
+    assert len(both) == 2
+
+
+def test_imdb_word_idx_caps_vocab():
+    from paddle_tpu.dataset import imdb
+
+    small = {("w%d" % i).encode(): i for i in range(50)}
+    seqs = [s for s, _ in list(imdb.train(small)())[:64]]
+    assert max(max(s) for s in seqs) < 50
+
+
+def test_wmt16_src_lang_swaps_direction():
+    from paddle_tpu.dataset import wmt16
+
+    en = list(wmt16.test()())[:5]
+    de = list(wmt16.test(src_lang="de")())[:5]
+    for (s_en, t_in_en, _), (s_de, t_in_de, t_next_de) in zip(en, de):
+        assert s_de == t_in_en[1:]          # German side becomes source
+        assert t_in_de == [0] + s_en        # English becomes target
+        assert t_next_de == s_en + [1]
