@@ -159,11 +159,22 @@ class BasicLSTMUnit(_LazyUnit):
 def _stacked_rnn(input, init_states, make_cell, hidden_size, num_layers,
                  sequence_length, dropout_prob, bidirectional, batch_first,
                  name):
-    """Shared driver for basic_gru/basic_lstm (ref rnn_impl.py:139,358):
-    num_layers x (1 or 2 directions) of layers.rnn() stacked, inter-layer
-    dropout, outputs concatenated over directions. init_states is a list
-    of per-state stacked tensors shaped (L*ndir, B, D) or Nones; a None
-    entry zero-initialises that state independently of the others."""
+    """Shared driver for basic_gru/basic_lstm (ref rnn_impl.py:139,358).
+
+    Mirrors the reference topology exactly: each direction is an
+    INDEPENDENT num_layers-deep stack over the (reversed) input, and the
+    two directions' final outputs are concatenated once at the end — so
+    layer>0 weights have input width D, not 2D, and reference-shaped
+    checkpoints port directly. Dropout follows the reference too: the
+    default 'downgrade_in_infer' implementation, applied after every
+    layer of a stack INCLUDING the last (the final rnn output is dropped
+    out; recorded last-states are not — ref rnn_impl.py:305).
+
+    init_states is a list of per-state stacked tensors shaped
+    (L*ndir, B, D) (layer-major, direction-minor, like the reference's
+    [num_layers, direc_num, -1, D] reshape) or Nones; a None entry
+    zero-initialises that state.
+    """
     from ...layers import nn as L
     from ...layers import tensor as T
     from ... import layers as lay
@@ -181,35 +192,37 @@ def _stacked_rnn(input, init_states, make_cell, hidden_size, num_layers,
         s = L.slice(stacked, axes=[0], starts=[idx], ends=[idx + 1])
         return L.squeeze(s, [0])
 
-    cur = input
-    last_per_state = None
-    for layer in range(num_layers):
-        dir_outs, dir_lasts = [], []
-        for d in range(ndir):
-            idx = layer * ndir + d
+    dir_outs = []
+    # dir_layer_lasts[d][layer] = list of that cell's final states
+    dir_layer_lasts = []
+    for d in range(ndir):
+        cur = input
+        layer_lasts = []
+        for layer in range(num_layers):
             cell = make_cell("%s_l%d_%s" % (name, layer,
                                             "fw" if d == 0 else "bw"))
-            init = [_slice_init(st, idx) for st in init_states]
+            init = [_slice_init(st, layer * ndir + d) for st in init_states]
             init = init[0] if len(init) == 1 else init
             out, last = lay.rnn(
                 cell, cur, initial_states=init,
                 sequence_length=sequence_length,
                 time_major=time_major, is_reverse=(d == 1))
-            dir_outs.append(out)
             last = last if isinstance(last, (list, tuple)) else [last]
-            dir_lasts.append(list(last))
-        cur = (dir_outs[0] if ndir == 1
-               else lay.concat(dir_outs, axis=-1))
-        if last_per_state is None:
-            last_per_state = [[] for _ in dir_lasts[0]]
-        for dl in dir_lasts:
-            for si, sv in enumerate(dl):
+            layer_lasts.append(list(last))
+            cur = out
+            if dropout_prob:
+                cur = L.dropout(cur, dropout_prob)
+        dir_outs.append(cur)
+        dir_layer_lasts.append(layer_lasts)
+    out = dir_outs[0] if ndir == 1 else lay.concat(dir_outs, axis=-1)
+    # stack last states layer-major, direction-minor (ref layout)
+    last_per_state = [[] for _ in dir_layer_lasts[0][0]]
+    for layer in range(num_layers):
+        for d in range(ndir):
+            for si, sv in enumerate(dir_layer_lasts[d][layer]):
                 last_per_state[si].append(sv)
-        if dropout_prob and layer != num_layers - 1:
-            cur = L.dropout(cur, dropout_prob,
-                            dropout_implementation="upscale_in_train")
     lasts = [L.stack(vs, axis=0) for vs in last_per_state]
-    return cur, lasts
+    return out, lasts
 
 
 def basic_gru(input, init_hidden, hidden_size, num_layers=1,

@@ -122,7 +122,8 @@ class Trainer:
         # retention window (ref CheckpointConfig.max_num_checkpoints)
         import shutil
 
-        drop = serial - cfg.max_num_checkpoints + 1
+        # keep exactly max_num_checkpoints (ref _scroll_delete)
+        drop = serial - cfg.max_num_checkpoints
         if drop >= 0:
             old = os.path.join(cfg.checkpoint_dir, "checkpoint_%d" % drop)
             shutil.rmtree(old, ignore_errors=True)

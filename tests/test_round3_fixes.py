@@ -302,3 +302,101 @@ def test_wmt16_src_lang_swaps_direction():
         assert s_de == t_in_en[1:]          # German side becomes source
         assert t_in_de == [0] + s_en        # English becomes target
         assert t_next_de == s_en + [1]
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r2 fixes
+# ---------------------------------------------------------------------------
+def test_basic_gru_bidirectional_independent_stacks():
+    """Layer>0 weights must have input width D (independent per-direction
+    stacks, ref topology), not 2D (concat-after-every-layer)."""
+    from paddle_tpu.fluid.contrib.layers import basic_gru
+
+    D = 8
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("x", shape=[5, 12], dtype="float32")
+        out, last_h = basic_gru(x, None, D, num_layers=2,
+                                bidirectional=True, name="bgadv")
+        params = {p.name: p for p in main.global_block().all_parameters()}
+    l1_gate = [p for n, p in params.items()
+               if "l1" in n and len(p.shape) == 2 and p.shape[1] == 2 * D]
+    assert l1_gate, list(params)
+    for p in l1_gate:
+        assert p.shape[0] == D + D, (
+            "layer-1 cell consumes its own direction's D-wide output, "
+            "got input width %d" % (p.shape[0] - D)
+        )
+    assert tuple(out.shape[-1:]) == (2 * D,)
+    assert tuple(last_h.shape) == (4, -1, D) or last_h.shape[0] == 4
+
+
+def test_basic_gru_bidirectional_matches_numpy_two_stacks():
+    """Numeric parity vs a numpy oracle implementing the REFERENCE
+    topology: two independent 2-layer direction stacks, concat once."""
+    from paddle_tpu.fluid.contrib.layers import basic_gru
+
+    D, T, B, W = 4, 6, 3, 5
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[T, W], dtype="float32")
+        out, _ = basic_gru(x, None, D, num_layers=2, bidirectional=True,
+                           name="bgpar")
+        params = {p.name: p for p in main.global_block().all_parameters()}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+
+    def weights(layer, direc):
+        tag = "bgpar_l%d_%s" % (layer, direc)
+        ps = sorted(n for n in params if n.startswith(tag))
+        vals = [np.asarray(scope.find_var(n).get_tensor()) for n in ps]
+        gw = next(v for v in vals if v.ndim == 2 and v.shape[1] == 2 * D)
+        gb = next(v for v in vals if v.ndim == 1 and v.shape[0] == 2 * D)
+        cw = next(v for v in vals if v.ndim == 2 and v.shape[1] == D)
+        cb = next(v for v in vals if v.ndim == 1 and v.shape[0] == D)
+        return gw, gb, cw, cb
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    def gru_pass(xs_tbw, gw, gb, cw, cb, reverse):
+        T_ = xs_tbw.shape[0]
+        h = np.zeros((xs_tbw.shape[1], D), "float32")
+        outs = [None] * T_
+        order = range(T_ - 1, -1, -1) if reverse else range(T_)
+        for t in order:
+            xt = xs_tbw[t]
+            g = sigmoid(np.concatenate([xt, h], 1) @ gw + gb)
+            r, u = g[:, :D], g[:, D:]
+            c = np.tanh(np.concatenate([xt, r * h], 1) @ cw + cb)
+            h = u * h + (1 - u) * c
+            outs[t] = h
+        return np.stack(outs)
+
+    xs = np.random.default_rng(7).standard_normal((B, T, W)).astype("float32")
+    (o,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    xt = xs.transpose(1, 0, 2)  # (T, B, W)
+    fw = gru_pass(gru_pass(xt, *weights(0, "fw"), False),
+                  *weights(1, "fw"), False)
+    bw = gru_pass(gru_pass(xt, *weights(0, "bw"), True),
+                  *weights(1, "bw"), True)
+    want = np.concatenate([fw, bw], -1).transpose(1, 0, 2)
+    np.testing.assert_allclose(o, want, rtol=2e-4, atol=2e-5)
+
+
+def test_trainer_checkpoint_retention_keeps_max():
+    import os
+    from paddle_tpu.fluid.contrib.trainer import CheckpointConfig
+
+    cfg = CheckpointConfig.__new__(CheckpointConfig)
+    # emulate the retention arithmetic without a full Trainer
+    kept = set()
+    cfg.max_num_checkpoints = 3
+    for serial in range(6):
+        kept.add(serial)
+        drop = serial - cfg.max_num_checkpoints
+        if drop >= 0:
+            kept.discard(drop)
+    assert len(kept) == 3, kept
