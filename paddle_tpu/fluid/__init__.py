@@ -58,6 +58,13 @@ from . import average
 from . import metrics
 from . import reader
 from .reader import DataLoader  # noqa: F401
+from . import dataset
+from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
+from . import data_feed_desc
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+from . import device_worker
+from . import trainer_factory
+from .trainer_factory import FetchHandler  # noqa: F401
 from . import compiler
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
 from . import parallel_executor

@@ -329,13 +329,101 @@ class Executor:
         self._cache.clear()
         self._closed = True
 
-    # compat no-ops ----------------------------------------------------
-    def infer_from_dataset(self, *a, **k):
-        raise NotImplementedError(
-            "dataset trainer path not supported; use DataLoader + run()"
-        )
+    # -- dataset trainer path (ref executor.py:1033,1103) --------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Consume every batch of ``dataset`` through the jitted program
+        step (ref executor.py train_from_dataset). The reference fans the
+        work across C++ Hogwild threads; here `thread` tunes host-side
+        parsing parallelism and batches stage through the native C++
+        slot ring, while ONE XLA stream runs the step with donated
+        params (see fluid/dataset.py module docstring)."""
+        return self._run_from_dataset(
+            program, dataset, scope, thread, False, debug, fetch_list,
+            fetch_info, print_period, fetch_handler)
 
-    def train_from_dataset(self, *a, **k):
-        raise NotImplementedError(
-            "dataset trainer path not supported; use DataLoader + run()"
-        )
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Like train_from_dataset but runs a test-pruned clone: ops from
+        the first `backward` op onward (grads + optimizer updates) are
+        dropped, mirroring the reference's infer-mode skip_ops."""
+        return self._run_from_dataset(
+            program, dataset, scope, thread, True, debug, fetch_list,
+            fetch_info, print_period, fetch_handler)
+
+    def _run_from_dataset(self, program, dataset, scope, thread, is_infer,
+                          debug, fetch_list, fetch_info, print_period,
+                          fetch_handler):
+        from .data_feeder import DataFeeder  # noqa: F401 (via loader)
+        from .reader import _GeneratorLoader
+        from .trainer_factory import FetchHandlerMonitor, TrainerFactory
+
+        if dataset is None:
+            raise ValueError(
+                "train/infer_from_dataset requires a dataset (build one "
+                "with fluid.DatasetFactory().create_dataset())"
+            )
+        program = program if program is not None else default_main_program()
+        program = getattr(program, "_program", program)  # CompiledProgram
+        run_prog = self._strip_training_ops(program) if is_infer else program
+        # trainer desc for parity/introspection (Hogwild contract)
+        trainer = TrainerFactory()._create_trainer(
+            getattr(program, "_fleet_opt", None))
+        trainer.device_worker._set_infer(is_infer)
+        trainer._set_thread(thread or dataset.thread_num)
+
+        dataset._prepare_to_run()
+        dataset._dynamic_adjust_before_train(thread or dataset.thread_num)
+        monitor = None
+        if fetch_handler is not None:
+            monitor = FetchHandlerMonitor(
+                scope or global_scope(), fetch_handler)
+            monitor.start()
+        fetch_vars = list(fetch_list or [])
+        infos = list(fetch_info or [
+            getattr(v, "name", str(v)) for v in fetch_vars])
+        loader = _GeneratorLoader(
+            feed_list=dataset.use_vars, capacity=8,
+        ).set_sample_list_generator(
+            lambda: dataset._batch_iterator(thread), places=self.place)
+        step = 0
+        try:
+            for feed in loader():
+                step += 1
+                want_fetch = fetch_vars and (
+                    debug or step % print_period == 0)
+                out = self.run(
+                    run_prog, feed=feed,
+                    fetch_list=fetch_vars if want_fetch else None,
+                    scope=scope,
+                )
+                if want_fetch:
+                    msg = ", ".join(
+                        "%s=%s" % (i, np.asarray(v).reshape(-1)[:8])
+                        for i, v in zip(infos, out)
+                    )
+                    print("[dataset step %d] %s" % (step, msg))
+        finally:
+            if monitor is not None:
+                monitor.stop()
+            dataset._dynamic_adjust_after_train()
+            dataset._finish_to_run()
+        return None
+
+    @staticmethod
+    def _strip_training_ops(program):
+        """Clone with ops from the first `backward` op onward removed —
+        the single-HloModule analogue of the reference infer-mode
+        skip-ops list (grad + update ops never enter the traced step)."""
+        pruned = program.clone()
+        block = pruned.global_block()
+        for i, op in enumerate(block.ops):
+            if op.type == "backward":
+                block.ops = block.ops[:i]
+                pruned._bump_version()
+                break
+        return pruned

@@ -1,3 +1,4 @@
 """fluid.incubate (ref: python/paddle/fluid/incubate): the fleet API
 import paths user scripts rely on, re-exported from paddle_tpu.parallel."""
 from . import fleet  # noqa: F401
+from . import data_generator  # noqa: F401

@@ -185,10 +185,26 @@ class DataLoader:
 
     @staticmethod
     def from_dataset(dataset, places, drop_last=True):
-        raise NotImplementedError(
-            "dataset ingestion path: use from_generator with the dataset's "
-            "reader"
+        """Iterate a fluid.dataset (Queue/InMemory) as a DataLoader
+        (ref reader.py from_dataset): batches flow through the same
+        native staging ring as from_generator loaders."""
+        dataset._prepare_to_run()
+        place = places[0] if isinstance(places, (list, tuple)) else places
+        loader = _GeneratorLoader(
+            feed_list=dataset.use_vars, capacity=8
         )
+
+        def batches():
+            full = None
+            for b in dataset._batch_iterator():
+                if drop_last:
+                    if full is None:
+                        full = len(b)
+                    if len(b) < full:
+                        continue
+                yield b
+
+        return loader.set_sample_list_generator(batches, places=place)
 
 
 class PyReader(_GeneratorLoader):

@@ -1,0 +1,339 @@
+"""Dataset trainer path: DatasetFactory / QueueDataset / InMemoryDataset,
+DataFeedDesc, data_generator, Executor.train_from_dataset /
+infer_from_dataset, DataLoader.from_dataset. Mirrors ref
+fluid/tests/unittests/test_dataset.py coverage the TPU way."""
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _write_multislot(path, rows):
+    """rows: list of samples; sample = list of slot value-lists."""
+    with open(path, "w") as f:
+        for sample in rows:
+            toks = []
+            for vals in sample:
+                toks.append(str(len(vals)))
+                toks.extend(str(v) for v in vals)
+            f.write(" ".join(toks) + "\n")
+
+
+def _ctr_rows(n, seed, vocab=50, ndense=4, nsparse=3):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        sparse = rng.integers(0, vocab, size=nsparse).tolist()
+        label = [int(sparse[0] % 2)]  # learnable: label from first id
+        dense = [round(float(x), 4) for x in rng.random(ndense)]
+        rows.append([sparse, dense, label])
+    return rows
+
+
+def _ctr_program(vocab=50, ndense=4, nsparse=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        sparse = fluid.data("sparse", shape=[nsparse], dtype="int64")
+        dense = fluid.data("dense", shape=[ndense], dtype="float32")
+        label = fluid.data("label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(sparse, size=[vocab, 8])
+        feat = fluid.layers.concat(
+            [fluid.layers.reshape(emb, [0, nsparse * 8]), dense], axis=1)
+        h = fluid.layers.fc(feat, 32, act="relu")
+        logit = fluid.layers.fc(h, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logit, label))
+        opt = fluid.optimizer.Adam(5e-3)
+        opt.minimize(loss)
+    return main, startup, [sparse, dense, label], loss
+
+
+def test_datafeed_desc_roundtrip(tmp_path):
+    proto = """
+name: "MultiSlotDataFeed"
+batch_size: 16
+multi_slot_desc {
+  slots { name: "words" type: "uint64" is_dense: false is_used: false }
+  slots { name: "dense_f" type: "float" is_dense: false is_used: false }
+  slots { name: "label" type: "uint64" is_dense: false is_used: false }
+}
+"""
+    p = tmp_path / "feed.proto"
+    p.write_text(proto)
+    desc = fluid.DataFeedDesc(str(p))
+    desc.set_batch_size(64)
+    desc.set_dense_slots(["dense_f"])
+    desc.set_use_slots(["words", "label"])
+    text = desc.desc()
+    again = fluid.DataFeedDesc(text)
+    assert again._batch_size == 64
+    by_name = {s.name: s for s in again.slots}
+    assert by_name["dense_f"].is_dense
+    assert by_name["words"].is_used and by_name["label"].is_used
+    assert not by_name["dense_f"].is_used
+    with pytest.raises(ValueError):
+        desc.set_use_slots(["nope"])
+
+
+def test_queue_dataset_batches(tmp_path):
+    rows = _ctr_rows(25, 0)
+    f1, f2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    _write_multislot(f1, rows[:13])
+    _write_multislot(f2, rows[13:])
+    main, startup, use_vars, loss = _ctr_program()
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_thread(2)
+    ds.set_filelist([f1, f2])
+    ds.set_use_var(use_vars)
+    ds._prepare_to_run()
+    batches = list(ds._batch_iterator())
+    total = sum(len(b) for b in batches)
+    assert total == 25
+    # sample fields parse to the right widths/types
+    s0 = batches[0][0]
+    assert len(s0) == 3 and len(s0[0]) == 3 and len(s0[1]) == 4
+    assert isinstance(s0[0][0], int) and isinstance(s0[1][0], float)
+    # multiset of samples is preserved across threading
+    seen = sorted(tuple(tuple(sl) for sl in s) for b in batches for s in b)
+    want = sorted(
+        tuple(tuple(sl) for sl in s)
+        for s in ((r[0], [float(x) for x in r[1]], r[2]) for r in rows)
+    )
+    assert seen == want
+
+
+def test_queue_dataset_refuses_shuffle(tmp_path):
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    with pytest.raises(NotImplementedError):
+        ds.local_shuffle()
+    with pytest.raises(NotImplementedError):
+        ds.global_shuffle()
+
+
+def test_in_memory_dataset_shuffle_and_sizes(tmp_path):
+    rows = _ctr_rows(30, 1)
+    fn = str(tmp_path / "mem.txt")
+    _write_multislot(fn, rows)
+    main, startup, use_vars, loss = _ctr_program()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_thread(3)
+    ds.set_filelist([fn])
+    ds.set_use_var(use_vars)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 30
+    before = [tuple(tuple(sl) for sl in s) for s in ds._memory]
+    ds.local_shuffle()
+    after = [tuple(tuple(sl) for sl in s) for s in ds._memory]
+    assert sorted(before) == sorted(after)
+    assert before != after  # 30 samples: astronomically unlikely to match
+    assert ds.get_shuffle_data_size() == 30
+    ds.release_memory()
+    with pytest.raises(RuntimeError):
+        ds.get_memory_data_size()
+
+
+def test_in_memory_preload_and_merge_by_lineid(tmp_path):
+    # lines with instance ids: two lines share id "u1" and merge
+    fn = str(tmp_path / "ins.txt")
+    with open(fn, "w") as f:
+        f.write("u1 2 5 6 1 1\n")
+        f.write("u2 1 7 1 0\n")
+        f.write("u1 1 9 1 1\n")
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data("mlabel", shape=[1], dtype="int64",
+                                  lod_level=1)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_filelist([fn])
+    ds.set_use_var([words, label])
+    ds.set_parse_ins_id(True)
+    ds.set_merge_by_lineid(2)
+    ds.preload_into_memory(thread_num=2)
+    ds.wait_preload_done()
+    assert ds.get_memory_data_size() == 2
+    by_id = {s[0]: s[1:] for s in ds._memory}
+    assert by_id["u1"][0] == [5, 6, 9]       # merged word ids
+    assert by_id["u1"][1] == [1, 1]          # merged labels
+    assert by_id["u2"][0] == [7]
+    # batches strip the ins id
+    b = list(ds._batch_iterator())[0]
+    assert len(b[0]) == 2
+
+
+def test_pipe_command_preprocessing(tmp_path):
+    # raw file is NOT multislot; the pipe command converts it
+    fn = str(tmp_path / "raw.txt")
+    with open(fn, "w") as f:
+        for i in range(6):
+            f.write("%d %d\n" % (i, i % 2))
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("px", shape=[1], dtype="int64")
+        y = fluid.data("py", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(3)
+    ds.set_filelist([fn])
+    ds.set_use_var([x, y])
+    ds.set_pipe_command("awk '{print 1, $1, 1, $2}'")
+    ds._prepare_to_run()
+    batches = list(ds._batch_iterator())
+    flat = [s for b in batches for s in b]
+    assert sorted(s[0][0] for s in flat) == [0, 1, 2, 3, 4, 5]
+
+
+def test_data_generator_to_dataset(tmp_path):
+    from paddle_tpu.fluid.incubate.data_generator import (
+        MultiSlotDataGenerator,
+        MultiSlotStringDataGenerator,
+    )
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                for i in range(10):
+                    yield [("ids", [i, i + 1]), ("lab", [i % 2])]
+            return it
+
+    buf = io.StringIO()
+    g = Gen()
+    g.run_from_memory(out=buf)
+    fn = str(tmp_path / "gen.txt")
+    with open(fn, "w") as f:
+        f.write(buf.getvalue())
+    assert g._proto_info == [("ids", "uint64"), ("lab", "uint64")]
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        ids = fluid.data("gids", shape=[2], dtype="int64")
+        lab = fluid.data("glab", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_filelist([fn])
+    ds.set_use_var([ids, lab])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 10
+    assert ds._memory[3][0] == [3, 4]
+
+    # string generator run_from_stdin path via pipe_command text
+    class SGen(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                if line is None:
+                    return
+                a, b = line.split()
+                yield [("ids", [a, a]), ("lab", [b])]
+            return it
+
+    sbuf = io.StringIO()
+    import sys
+    old = sys.stdin
+    sys.stdin = io.StringIO("4 1\n5 0\n")
+    try:
+        SGen().run_from_stdin(out=sbuf)
+    finally:
+        sys.stdin = old
+    assert sbuf.getvalue() == "2 4 4 1 1\n2 5 5 1 0\n"
+
+
+def test_train_from_dataset_wide_deep_loss_drops(tmp_path):
+    rows = _ctr_rows(256, 2)
+    files = []
+    for k in range(2):
+        fn = str(tmp_path / ("train%d.txt" % k))
+        _write_multislot(fn, rows[k::2])
+        files.append(fn)
+    main, startup, use_vars, loss = _ctr_program()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(32)
+    ds.set_thread(2)
+    ds.set_filelist(files)
+    ds.set_use_var(use_vars)
+    ds.load_into_memory()
+    ds.local_shuffle()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+
+    def epoch_loss():
+        tot, n = 0.0, 0
+        for b in ds._batch_iterator():
+            from paddle_tpu.fluid.data_feeder import DataFeeder
+            feed = DataFeeder(use_vars, exe.place, program=main).feed(b)
+            # fetch loss WITHOUT training: use the pruned infer clone
+            (lv,) = exe.run(exe._strip_training_ops(main), feed=feed,
+                            fetch_list=[loss])
+            tot += float(lv) * len(b)
+            n += len(b)
+        return tot / n
+
+    l0 = epoch_loss()
+    for _ in range(6):
+        exe.train_from_dataset(program=main, dataset=ds,
+                               fetch_list=[loss], print_period=10**9)
+    l1 = epoch_loss()
+    assert l1 < l0 * 0.8, (l0, l1)
+
+
+def test_infer_from_dataset_does_not_touch_params(tmp_path):
+    rows = _ctr_rows(32, 3)
+    fn = str(tmp_path / "inf.txt")
+    _write_multislot(fn, rows)
+    main, startup, use_vars, loss = _ctr_program()
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist([fn])
+    ds.set_use_var(use_vars)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    pnames = [p.name for p in main.global_block().all_parameters()]
+    before = {n: np.asarray(scope.find_var(n).get_tensor()).copy()
+              for n in pnames}
+    exe.infer_from_dataset(program=main, dataset=ds, fetch_list=[loss],
+                           print_period=10**9)
+    for n in pnames:
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(n).get_tensor()), before[n])
+
+
+def test_dataloader_from_dataset(tmp_path):
+    rows = _ctr_rows(20, 4)
+    fn = str(tmp_path / "dl.txt")
+    _write_multislot(fn, rows)
+    main, startup, use_vars, loss = _ctr_program()
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist([fn])
+    ds.set_use_var(use_vars)
+    loader = fluid.DataLoader.from_dataset(ds, [fluid.CPUPlace()])
+    feeds = list(loader())
+    assert len(feeds) == 2  # 20 samples, bs=8, ragged tail dropped
+    assert set(feeds[0].keys()) >= {"sparse", "dense", "label"}
+    assert feeds[0]["dense"].shape == (8, 4)
+
+
+def test_fetch_handler_monitor():
+    import time
+    from paddle_tpu.fluid.trainer_factory import (
+        FetchHandler, FetchHandlerMonitor,
+    )
+
+    scope = fluid.global_scope()
+    scope.set("fh_var", np.array([3.25], "float32"))
+    got = []
+
+    class H(FetchHandler):
+        def handler(self, res):
+            got.append(res)
+
+    mon = FetchHandlerMonitor(scope, H({"v": "fh_var"}, period_secs=0.05))
+    mon.start()
+    time.sleep(0.3)
+    mon.stop()
+    assert got and float(got[0]["v"][0]) == 3.25
