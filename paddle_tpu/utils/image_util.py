@@ -1,6 +1,11 @@
-"""Numpy image preprocessing helpers (ref: python/paddle/utils/
-image_util.py) — resize/flip/crop/oversample/mean-transform used by the
-classic image pipelines. Pure numpy (PIL only for file IO)."""
+"""Numpy image preprocessing helpers (behavioral parity target:
+python/paddle/utils/image_util.py) — short-side resize, flips, padded
+center/random crops, 10-crop oversampling, and the channel/mean
+transformer used by the classic image pipelines.
+
+Written as vectorized numpy over an (N, H, W, C) batch axis where the
+operation allows it; single images are the N=1 case.
+"""
 import numpy as np
 
 __all__ = [
@@ -10,107 +15,88 @@ __all__ = [
 
 
 def resize_image(img, target_size):
-    """Resize so the SHORT side equals target_size (ref image_util.py:20).
-    img is a PIL image."""
-    percent = target_size / float(min(img.size[0], img.size[1]))
-    resized = (int(round(img.size[0] * percent)),
-               int(round(img.size[1] * percent)))
-    return img.resize(resized)
+    """Scale a PIL image so its SHORT side equals target_size, keeping
+    aspect ratio."""
+    w, h = img.size
+    scale = target_size / min(w, h)
+    return img.resize((round(w * scale), round(h * scale)))
 
 
 def flip(im):
-    """Horizontal flip of a (C, H, W) or (H, W) array."""
-    if im.ndim == 3:
-        return im[:, :, ::-1]
-    return im[:, ::-1]
+    """Mirror the width axis of a (C, H, W) or (H, W) array."""
+    return np.flip(im, axis=-1)
+
+
+def _pad_to_square_min(im, size, spatial_axes):
+    """Zero-pad so every spatial axis is at least `size`."""
+    pads = [(0, 0)] * im.ndim
+    for ax in spatial_axes:
+        short = max(size - im.shape[ax], 0)
+        pads[ax] = (short // 2, short - short // 2)
+    if any(p != (0, 0) for p in pads):
+        im = np.pad(im, pads)
+    return im
 
 
 def crop_img(im, inner_size, color=True, test=True):
-    """Center (test) or random crop to inner_size (ref image_util.py:45);
-    im is (C, H, W) when color else (H, W)."""
-    im = im.astype("float32")
-    if color:
-        height, width = max(inner_size, im.shape[1]), max(
-            inner_size, im.shape[2])
-        padded_im = np.zeros((3, height, width), dtype=im.dtype)
-        startY = (height - im.shape[1]) // 2
-        startX = (width - im.shape[2]) // 2
-        endY, endX = startY + im.shape[1], startX + im.shape[2]
-        padded_im[:, startY:endY, startX:endX] = im
-    else:
-        height, width = max(inner_size, im.shape[0]), max(
-            inner_size, im.shape[1])
-        padded_im = np.zeros((height, width), dtype=im.dtype)
-        startY = (height - im.shape[0]) // 2
-        startX = (width - im.shape[1]) // 2
-        endY, endX = startY + im.shape[0], startX + im.shape[1]
-        padded_im[startY:endY, startX:endX] = im
+    """Crop to inner_size x inner_size: centered when `test`, else a
+    uniformly random window plus a coin-flip mirror. Images smaller than
+    the crop are zero-padded to fit first. Layout: (C, H, W) when color,
+    (H, W) otherwise."""
+    im = np.asarray(im, dtype="float32")
+    spatial = (-2, -1) if color else (0, 1)
+    im = _pad_to_square_min(im, inner_size, spatial)
+    room_y = im.shape[spatial[0]] - inner_size
+    room_x = im.shape[spatial[1]] - inner_size
     if test:
-        startY = (height - inner_size) // 2
-        startX = (width - inner_size) // 2
+        y0, x0 = room_y // 2, room_x // 2
     else:
-        startY = np.random.randint(0, height - inner_size + 1)
-        startX = np.random.randint(0, width - inner_size + 1)
-    endY, endX = startY + inner_size, startX + inner_size
-    if color:
-        pic = padded_im[:, startY:endY, startX:endX]
-    else:
-        pic = padded_im[startY:endY, startX:endX]
+        y0 = np.random.randint(room_y + 1)
+        x0 = np.random.randint(room_x + 1)
+    window = im[..., y0:y0 + inner_size, x0:x0 + inner_size]
     if not test and np.random.randint(2) == 0:
-        pic = flip(pic)
-    return pic
+        window = flip(window)
+    return window
 
 
 def preprocess_img(im, img_mean, crop_size, is_train, color=True):
-    """Crop + mean-subtract (ref image_util.py:96)."""
-    im = im.astype("float32")
-    test = not is_train
-    pic = crop_img(im, crop_size, color, test)
-    return pic - img_mean
+    """Crop (random when training, center otherwise) then subtract the
+    pixel mean."""
+    return crop_img(im, crop_size, color, test=not is_train) - img_mean
 
 
 def load_image(img_path, is_color=True):
-    """Load an image file as a PIL image (ref image_util.py:133)."""
+    """Read an image file into a PIL image (RGB or grayscale)."""
     from PIL import Image
 
-    img = Image.open(img_path)
-    img.load()
-    return img.convert("RGB") if is_color else img.convert("L")
+    with Image.open(img_path) as f:
+        f.load()
+        return f.convert("RGB" if is_color else "L")
 
 
 def oversample(img, crop_dims):
-    """10-crop oversampling: 4 corners + center, mirrored
-    (ref image_util.py:144). img: iterable of (H, W, C) arrays."""
-    im_shape = np.array(img[0].shape)
-    crop_dims = np.array(crop_dims)
-    im_center = im_shape[:2] / 2.0
+    """Classic 10-crop TTA: four corners + center, each mirrored.
 
-    h_indices = (0, im_shape[0] - crop_dims[0])
-    w_indices = (0, im_shape[1] - crop_dims[1])
-    crops_ix = np.empty((5, 4), dtype=int)
-    curr = 0
-    for i in h_indices:
-        for j in w_indices:
-            crops_ix[curr] = (i, j, i + crop_dims[0], j + crop_dims[1])
-            curr += 1
-    crops_ix[4] = np.tile(im_center, (1, 2)) + np.concatenate(
-        [-crop_dims / 2.0, crop_dims / 2.0])
-    crops_ix = np.tile(crops_ix, (2, 1))
-
-    crops = np.empty(
-        (10 * len(img), crop_dims[0], crop_dims[1], im_shape[-1]),
-        dtype=np.float32)
-    ix = 0
-    for im in img:
-        for crop in crops_ix:
-            crops[ix] = im[crop[0]:crop[2], crop[1]:crop[3], :]
-            ix += 1
-        crops[ix - 5:ix] = crops[ix - 5:ix, :, ::-1, :]  # mirror
-    return crops
+    img: sequence of (H, W, C) arrays sharing one shape.
+    Returns (10 * len(img), ch, cw, C), ordered per image as the five
+    crops followed by their mirrors.
+    """
+    batch = np.stack([np.asarray(i, dtype="float32") for i in img])
+    _, H, W, _ = batch.shape
+    ch, cw = int(crop_dims[0]), int(crop_dims[1])
+    # window origins: corners then center (int floor of the centered box)
+    ys = [0, 0, H - ch, H - ch, int(H / 2.0 - ch / 2.0)]
+    xs = [0, W - cw, 0, W - cw, int(W / 2.0 - cw / 2.0)]
+    views = np.stack(
+        [batch[:, y:y + ch, x:x + cw, :] for y, x in zip(ys, xs)], axis=1
+    )                                        # (N, 5, ch, cw, C)
+    both = np.concatenate([views, views[:, :, :, ::-1, :]], axis=1)
+    return both.reshape(-1, ch, cw, batch.shape[-1])
 
 
 class ImageTransformer:
-    """Channel-order + mean transform (ref image_util.py:183)."""
+    """Axis-order / channel-order / mean normalization applied in that
+    sequence; mean given per channel is broadcast over H, W."""
 
     def __init__(self, transpose=None, channel_swap=None, mean=None,
                  is_color=True):
@@ -119,28 +105,30 @@ class ImageTransformer:
         self.set_channel_swap(channel_swap)
         self.set_mean(mean)
 
-    def set_transpose(self, order):
+    def _check3(self, order, what):
         if order is not None and self.is_color and len(order) != 3:
-            raise ValueError("transpose order needs 3 dims for color")
+            raise ValueError("%s needs 3 entries for color images" % what)
+
+    def set_transpose(self, order):
+        self._check3(order, "transpose order")
         self.transpose = order
 
     def set_channel_swap(self, order):
-        if order is not None and self.is_color and len(order) != 3:
-            raise ValueError("channel swap needs 3 channels for color")
+        self._check3(order, "channel swap")
         self.channel_swap = order
 
     def set_mean(self, mean):
         if mean is not None:
-            mean = np.array(mean)
-            if mean.ndim == 1:
-                mean = mean[:, np.newaxis, np.newaxis]
+            mean = np.asarray(mean)
+            if mean.ndim == 1:  # per-channel -> broadcastable (C, 1, 1)
+                mean = mean.reshape(-1, 1, 1)
         self.mean = mean
 
     def transformer(self, data):
         if self.transpose is not None:
-            data = data.transpose(self.transpose)
+            data = np.transpose(data, self.transpose)
         if self.channel_swap is not None:
-            data = data[self.channel_swap, :, :]
+            data = np.take(data, self.channel_swap, axis=0)
         if self.mean is not None:
-            data -= self.mean
+            data = data - self.mean
         return data
