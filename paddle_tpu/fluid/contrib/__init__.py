@@ -1,5 +1,8 @@
 """fluid.contrib (ref: python/paddle/fluid/contrib)."""
 from . import layers  # noqa: F401
+from . import decoder  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import distributed_batch_reader  # noqa: F401
 from . import mixed_precision
 from .mixed_precision import decorate as mixed_precision_decorate  # noqa: F401
 from . import quant  # noqa: F401

@@ -1,0 +1,202 @@
+"""contrib.decoder (StateCell / TrainingDecoder / BeamSearchDecoder) and
+contrib.reader.distributed_batch_reader."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.contrib.decoder import (
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)
+from paddle_tpu.fluid.param_attr import ParamAttr
+
+D, V, EMB = 6, 9, 5
+
+
+def _make_state_cell():
+    state_cell = StateCell(
+        inputs={"x": None}, states={"h": None}, out_state="h"
+    ) if False else None
+    return state_cell
+
+
+def _cell_updater(state_cell):
+    """One step: h' = tanh([x, h] W + b) with FIXED param names so the
+    same weights drive training, beam search, and the numpy oracle."""
+    x = state_cell.get_input("x")
+    h = state_cell.get_state("h")
+    new_h = layers.fc(
+        layers.concat([x, h], axis=-1), D, act="tanh",
+        num_flatten_dims=len(x.shape) - 1,
+        param_attr=ParamAttr(name="dec_step.w"),
+        bias_attr=ParamAttr(name="dec_step.b"),
+    )
+    state_cell.set_state("h", new_h)
+
+
+def test_training_decoder_teacher_forcing_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.data("src_ids", shape=[4], dtype="int64")
+        trg = fluid.data("trg_ids", shape=[5], dtype="int64")
+        lab = fluid.data("lab_ids", shape=[5], dtype="int64")
+        src_emb = layers.embedding(
+            src, size=[V, EMB], param_attr=ParamAttr("src_emb"))
+        h0 = layers.fc(layers.reduce_mean(src_emb, dim=[1]), D, act="tanh")
+        trg_emb = layers.embedding(
+            trg, size=[V, EMB], param_attr=ParamAttr("trg_emb"))
+
+        state_cell = StateCell(
+            inputs={"x": None}, states={"h": InitState(init=h0)},
+            out_state="h")
+        state_cell.state_updater(_cell_updater)
+
+        decoder = TrainingDecoder(state_cell)
+        with decoder.block():
+            cur = decoder.step_input(trg_emb)
+            state_cell.compute_state(inputs={"x": cur})
+            score = layers.fc(
+                state_cell.get_state("h"), V,
+                param_attr=ParamAttr("dec_out.w"), bias_attr=False)
+            state_cell.update_states()
+            decoder.output(score)
+        logits = decoder()
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(
+                logits, layers.unsqueeze(lab, [2])))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    feed = {
+        "src_ids": rng.integers(0, V, (8, 4)).astype("int64"),
+        "trg_ids": rng.integers(0, V, (8, 5)).astype("int64"),
+    }
+    # label is a deterministic function of the teacher-forced input token,
+    # so the step cell can drive the loss toward zero
+    feed["lab_ids"] = (feed["trg_ids"] * 2 + 1) % V
+    losses = [
+        float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        for _ in range(120)
+    ]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_contrib_beam_decoder_matches_layers_decoder():
+    """The canonical contrib decode flow must equal the layers-level
+    BeamSearchDecoder driven by an equivalent RNNCell with the SAME
+    weights (shared by param name)."""
+    beam, max_len = 3, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        enc = fluid.data("enc_h", shape=[D], dtype="float32")
+        init_ids = fluid.data("bs_init_ids", shape=[1], dtype="int64")
+        init_scores = fluid.data("bs_init_scores", shape=[1],
+                                 dtype="float32")
+
+        state_cell = StateCell(
+            inputs={"x": None}, states={"h": InitState(init=enc)},
+            out_state="h")
+        state_cell.state_updater(_cell_updater)
+        decoder = BeamSearchDecoder(
+            state_cell, init_ids=init_ids, init_scores=init_scores,
+            target_dict_dim=V, word_dim=EMB, beam_size=beam,
+            max_len=max_len, end_id=1)
+        decoder.decode()
+        ids, scores = decoder()
+
+        # layers-level equivalent with the same weights
+        class StepCell(layers.RNNCell):
+            def call(self, inputs, states):
+                h = states
+                nh = layers.fc(
+                    layers.concat([inputs, h], axis=-1), D, act="tanh",
+                    num_flatten_dims=len(inputs.shape) - 1,
+                    param_attr=ParamAttr(name="dec_step.w"),
+                    bias_attr=ParamAttr(name="dec_step.b"))
+                return nh, nh
+
+        def embedding_fn(x):
+            return layers.embedding(
+                x, size=[V, EMB],
+                param_attr=ParamAttr(decoder._emb_param_name))
+
+        def output_fn(x):
+            return layers.fc(
+                x, size=V, num_flatten_dims=len(x.shape) - 1,
+                param_attr=ParamAttr(decoder._proj_param_name),
+                bias_attr=False)
+
+        ref_dec = layers.BeamSearchDecoder(
+            StepCell(), start_token=0, end_token=1, beam_size=beam,
+            embedding_fn=embedding_fn, output_fn=output_fn)
+        ref_out, ref_final = layers.dynamic_decode(
+            ref_dec, inits=enc, max_step_num=max_len - 1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    B = 2
+    rng = np.random.default_rng(5)
+    feed = {
+        "enc_h": rng.standard_normal((B, D)).astype("float32"),
+        "bs_init_ids": np.zeros((B, 1), "int64"),
+        "bs_init_scores": np.zeros((B, 1), "float32"),
+    }
+    got_ids, got_sc, want_ids = exe.run(
+        main, feed=feed, fetch_list=[ids, scores, ref_out])
+    np.testing.assert_array_equal(got_ids, want_ids)
+    assert got_sc.shape[:2] == (B, beam)
+
+
+def test_state_cell_validation():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("scv", shape=[D], dtype="float32")
+        with pytest.raises(ValueError):
+            StateCell(inputs={}, states={"h": InitState(init=x)},
+                      out_state="nope")
+        with pytest.raises(ValueError):
+            StateCell(inputs={}, states={"h": "not-an-initstate"},
+                      out_state="h")
+        sc = StateCell(inputs={"x": None},
+                       states={"h": InitState(init=x)}, out_state="h")
+        with pytest.raises(ValueError):
+            sc.get_input("x")  # still a placeholder
+        with pytest.raises(ValueError):
+            sc.compute_state(inputs={"y": x})  # undeclared input
+
+
+def test_contrib_beam_block_raises_with_guidance():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("bsx", shape=[D], dtype="float32")
+        ii = fluid.data("bsi", shape=[1], dtype="int64")
+        sc0 = fluid.data("bss", shape=[1], dtype="float32")
+        sc = StateCell(inputs={"x": None},
+                       states={"h": InitState(init=x)}, out_state="h")
+        dec = BeamSearchDecoder(sc, ii, sc0, V, EMB)
+        with pytest.raises(NotImplementedError, match="dynamic_decode"):
+            dec.block()
+
+
+def test_distributed_batch_reader_shards_round_robin(monkeypatch):
+    from paddle_tpu.fluid.contrib.reader import distributed_batch_reader
+
+    def batches():
+        for i in range(7):  # 7 batches, 3 trainers -> 2 full rounds
+            yield [i]
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+    shards = {}
+    for tid in range(3):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", str(tid))
+        shards[tid] = [b[0] for b in distributed_batch_reader(batches)()]
+    assert shards == {0: [0, 3], 1: [1, 4], 2: [2, 5]}
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    assert [b[0] for b in distributed_batch_reader(batches)()] == list(
+        range(7))
