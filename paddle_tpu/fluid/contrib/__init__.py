@@ -6,6 +6,7 @@ from .reader import distributed_batch_reader  # noqa: F401
 from . import mixed_precision
 from .mixed_precision import decorate as mixed_precision_decorate  # noqa: F401
 from . import quant  # noqa: F401
+from . import quantize  # noqa: F401
 from . import slim  # noqa: F401
 from . import trainer  # noqa: F401
 from .trainer import (  # noqa: F401

@@ -1,13 +1,24 @@
-"""fluid.contrib.slim — model compression subset (ref: contrib/slim).
+"""fluid.contrib.slim — model compression framework (ref: contrib/slim).
 
-Delivered the TPU way: magnitude/structure pruning operates on the
-device-resident scope params in numpy (ref slim/prune/pruner.py);
-distillers build the combined loss symbolically in ONE program so the
-whole distillation step still lowers to a single XLA module; QAT is the
-existing contrib.quant pass re-exported. The reference's yaml-driven
-Compressor/Strategy orchestration and NAS searcher are not ported — on
-TPU the training loop stays the user's (see MIGRATION.md).
+TPU-native shape of the reference's pieces:
+- prune: masked (lazy) structure pruning on scope params — real sparsity,
+  static shapes; strategies re-assert masks after every batch.
+- distillation: teacher+student in ONE program (teacher stop-gradient),
+  so the combined distill step is still one XLA module.
+- quantization: QAT fake-quant with straight-through gradients; freeze
+  produces a REAL int8 program (int8 MXU dot/conv, int32 accumulation);
+  PostTrainingQuantization calibrates without retraining (abs-max / KL).
+- core: yaml-configured Compressor scheduling strategies per epoch.
+- graph: GraphWrapper views over the symbolic Program.
+- searcher: SAController (simulated annealing); nas.LightNasStrategy is
+  a loud stub (controller-server machinery not rebuilt).
 """
+from . import core  # noqa: F401
+from .core import Compressor, ConfigFactory, Context, Strategy  # noqa: F401
+from . import graph  # noqa: F401
+from .graph import GraphWrapper  # noqa: F401
 from . import prune  # noqa: F401
 from . import distillation  # noqa: F401
 from . import quantization  # noqa: F401
+from . import searcher  # noqa: F401
+from . import nas  # noqa: F401

@@ -1,0 +1,199 @@
+"""Post-training quantization (ref: python/paddle/fluid/contrib/slim/
+quantization/post_training_quantization.py).
+
+Load a saved fp32 inference model, run calibration batches, compute
+activation scales (abs-max or KL-divergence threshold search), then
+rewrite the program onto the real-int8 ops from quantization_pass and
+save. No retraining involved.
+"""
+import numpy as np
+
+from ..... import reader_utils
+from ... import quant as _quant
+from .quantization_pass import (
+    ConvertToInt8Pass,
+    QuantizationFreezePass,
+    _channel_scales,
+    _weight_quant_axis,
+)
+
+__all__ = ["PostTrainingQuantization"]
+
+
+def _kl_threshold(samples, bins=2048, quant_levels=128):
+    """TensorRT-style KL calibration: pick the clip threshold whose
+    quantized distribution diverges least from the observed one."""
+    amax = float(np.max(np.abs(samples)))
+    if amax <= 0:
+        return 1e-9
+    hist, edges = np.histogram(np.abs(samples), bins=bins, range=(0, amax))
+    hist = hist.astype(np.float64)
+    best_kl, best_t = None, amax
+    for i in range(quant_levels, bins + 1, 8):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()          # clip outliers into last bin
+        if p.sum() == 0:
+            continue
+        # quantize the first i bins down to quant_levels then expand back
+        chunks = np.array_split(p, quant_levels)
+        q = np.concatenate([
+            np.full(len(c), c.sum() / max((c > 0).sum(), 1)) * (c > 0)
+            for c in chunks
+        ])
+        pn, qn = p / p.sum(), q / max(q.sum(), 1e-12)
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(pn[mask] /
+                                            np.maximum(qn[mask], 1e-12))))
+        if best_kl is None or kl < best_kl:
+            best_kl, best_t = kl, float(edges[i])
+    # guard against over-clipping when the histogram is dominated by the
+    # post-ReLU zero mass (small nets / few channels): never clip below
+    # the 99.9th percentile of observed magnitudes
+    floor = float(np.percentile(samples, 99.9))
+    return max(best_t, floor, 1e-9)
+
+
+class PostTrainingQuantization:
+    """ref post_training_quantization.py:36 — same constructor surface.
+
+    algo: 'KL' (divergence threshold search) or 'direct'/'abs_max'
+    (plain abs-max over calibration activations).
+    """
+
+    def __init__(self, executor, sample_generator, model_dir,
+                 model_filename=None, params_filename=None, batch_size=10,
+                 batch_nums=None, scope=None, algo="KL",
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul"),
+                 is_full_quantize=False, is_use_cache_file=False,
+                 cache_dir="./temp_post_training"):
+        from ....executor import global_scope
+        from .... import io as _io
+
+        self._executor = executor
+        self._sample_generator = sample_generator
+        self._batch_size = int(batch_size)
+        self._batch_nums = batch_nums
+        self._scope = scope or global_scope()
+        if algo not in ("KL", "direct", "abs_max"):
+            raise ValueError("algo must be 'KL' or 'direct'/'abs_max'")
+        self._algo = algo
+        self._op_types = (
+            ("conv2d", "depthwise_conv2d", "mul", "matmul")
+            if is_full_quantize else tuple(quantizable_op_type)
+        )
+        # is_use_cache_file/cache_dir: calibration activations fit in host
+        # memory here (samples are reduced to histograms immediately)
+        self._program, self._feed_list, self._fetch_list = (
+            _io.load_inference_model(
+                model_dir, executor, model_filename=model_filename,
+                params_filename=params_filename)
+        )
+        self._quantized_program = None
+
+    # ------------------------------------------------------------------
+    def quantize(self):
+        program = self._program
+        # 1. find quantizable ops and the activations they consume
+        targets = []  # (op, act_input_name, weight_name)
+        gb = program.global_block()
+        for op in gb.ops:
+            if op.type not in self._op_types:
+                continue
+            if op.type in ("mul", "matmul"):
+                act, wt = op.input("X")[0], op.input("Y")[0]
+            else:
+                act, wt = op.input("Input")[0], op.input("Filter")[0]
+            if self._scope.find_var(wt) is None:
+                continue  # second operand is not a parameter
+            targets.append((op, act, wt))
+        if not targets:
+            raise ValueError(
+                "no quantizable ops (%s) found in the loaded program"
+                % (self._op_types,)
+            )
+        act_names = sorted({a for _, a, _ in targets})
+
+        # 2. run calibration batches, collecting activation samples
+        samples = {n: [] for n in act_names}
+        batches = reader_utils.batch(
+            self._sample_generator, self._batch_size, drop_last=False)
+        from ....data_feeder import DataFeeder
+
+        feeder = DataFeeder(list(self._feed_list), self._executor.place,
+                            program=program)
+        n_batches = 0
+        for batch in batches():
+            feed = feeder.feed(batch)
+            vals = self._executor.run(
+                program, feed=feed,
+                fetch_list=[gb.var(n) for n in act_names])
+            for n, v in zip(act_names, vals):
+                a = np.abs(np.asarray(v, dtype=np.float32)).reshape(-1)
+                # subsample big activations: the histogram needs the
+                # distribution, not every element
+                if a.size > 1 << 16:
+                    a = a[:: max(a.size >> 16, 1)]
+                samples[n].append(a)
+            n_batches += 1
+            if self._batch_nums and n_batches >= self._batch_nums:
+                break
+        if n_batches == 0:
+            raise ValueError("sample_generator yielded no data")
+
+        # 3. activation scales
+        act_scales = {}
+        for n in act_names:
+            flat = np.concatenate(samples[n])
+            if self._algo == "KL":
+                act_scales[n] = _kl_threshold(flat)
+            else:
+                act_scales[n] = max(float(np.max(flat)), 1e-9)
+
+        # 4. rewrite: weights to int8 grid + quantized ops
+        qmax = 127.0
+        for op, act, wt in targets:
+            w = np.asarray(self._scope.find_var(wt).get_tensor())
+            axis = _weight_quant_axis(op.type, w.shape)
+            wscales = _channel_scales(w, axis)
+            shape = [1] * w.ndim
+            shape[axis] = -1
+            wq = np.clip(np.round(w / wscales.reshape(shape) * qmax),
+                         -qmax, qmax)
+            self._scope.set(wt, wq.astype(w.dtype))
+            if op.type in ("mul", "matmul"):
+                op.type = "quantized_mul"
+                op.inputs = {"X": [act], "Y": [wt]}
+                op.attrs = {
+                    "act_scale": act_scales[act],
+                    "weight_scale": [float(s) for s in wscales],
+                    "quant_bits": 8,
+                }
+            else:
+                op.attrs = dict(
+                    op.attrs, act_scale=act_scales[act],
+                    weight_scale=[float(s) for s in wscales], quant_bits=8)
+                op.inputs = {"Input": [act], "Filter": [wt]}
+                op.type = "quantized_conv2d"
+        ConvertToInt8Pass(self._scope, self._executor.place).apply(program)
+        program._bump_version()
+        self._quantized_program = program
+        return program
+
+    def save_quantized_model(self, save_model_path):
+        from .... import io as _io
+
+        if self._quantized_program is None:
+            raise RuntimeError("call quantize() first")
+        _io.save_inference_model(
+            dirname=save_model_path,
+            feeded_var_names=list(self._feed_list),
+            target_vars=self._fetch_list,
+            executor=self._executor,
+            main_program=self._quantized_program,
+        )
+
+
+# re-export for freeze-path callers that import from this module (ref
+# exposes both through the quantization package)
+QuantizationFreezePass = QuantizationFreezePass
+_ = _quant  # anchor: the fake-quant op lowerings must be registered

@@ -1,0 +1,281 @@
+"""Freeze / int8-convert passes for quantization
+(ref: python/paddle/fluid/contrib/slim/quantization/quantization_pass.py:
+QuantizationFreezePass, ConvertToInt8Pass, AddQuantDequantPass).
+
+TPU-native design: the frozen inference program runs REAL int8 compute —
+``quantized_mul`` / ``quantized_conv2d`` ops quantize the activation
+inline, do an int8xint8 -> int32 ``dot_general`` / conv (the MXU has a
+native int8 path with int32 accumulation), and rescale by
+act_scale * weight_scale. The reference instead emits fake-dequant
+patterns for a separate C++ int8 runtime; here the one XLA module IS the
+runtime.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .....ops.registry import register_op
+
+__all__ = [
+    "QuantizationFreezePass", "ConvertToInt8Pass", "AddQuantDequantPass",
+    "OutScaleForTrainingPass", "OutScaleForInferencePass",
+    "TransformForMobilePass",
+]
+
+_QMAX = {8: 127.0, 16: 32767.0}
+
+
+def _quant_act(x, scale, bits):
+    qmax = _QMAX[bits]
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-9)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s * qmax), -qmax, qmax)
+    return q.astype(jnp.int8 if bits == 8 else jnp.int32)
+
+
+@register_op("quantized_mul")
+def _quantized_mul(ctx, ins, attrs):
+    """x (f32) @ w (int8-valued): inline activation quant, int8 MXU dot,
+    int32 accum, per-column rescale."""
+    x, w = ins["X"][0], ins["Y"][0]
+    bits = attrs.get("quant_bits", 8)
+    qmax = _QMAX[bits]
+    xq = _quant_act(x, attrs["act_scale"], bits)
+    wq = w if w.dtype == jnp.int8 else jnp.round(w).astype(jnp.int8)
+    x2 = xq.reshape(-1, xq.shape[-1]) if xq.ndim > 2 else xq
+    acc = jax.lax.dot_general(
+        x2, wq, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    w_scale = jnp.asarray(attrs["weight_scale"], jnp.float32)
+    out = acc.astype(jnp.float32) * (
+        float(attrs["act_scale"]) * w_scale / (qmax * qmax))
+    if xq.ndim > 2:
+        out = out.reshape(xq.shape[:-1] + (w.shape[-1],))
+    return {"Out": [out]}
+
+
+@register_op("quantized_conv2d")
+def _quantized_conv2d(ctx, ins, attrs):
+    """NCHW conv with int8 inputs and int32 accumulation; weight scale is
+    per output channel."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    bits = attrs.get("quant_bits", 8)
+    qmax = _QMAX[bits]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    xq = _quant_act(x, attrs["act_scale"], bits)
+    wq = w if w.dtype == jnp.int8 else jnp.round(w).astype(jnp.int8)
+    pad_seq = ((pads[0], pads[0]), (pads[1], pads[1])) \
+        if len(pads) == 2 else ((pads[0], pads[2]), (pads[1], pads[3]))
+    acc = jax.lax.conv_general_dilated(
+        xq, wq, strides, pad_seq, rhs_dilation=dil,
+        feature_group_count=groups, preferred_element_type=jnp.int32)
+    w_scale = jnp.asarray(attrs["weight_scale"], jnp.float32)
+    out = acc.astype(jnp.float32) * (
+        float(attrs["act_scale"]) * w_scale.reshape(1, -1, 1, 1)
+        / (qmax * qmax))
+    return {"Output": [out]}
+
+
+def _weight_quant_axis(op_type, shape):
+    # conv filters per output channel (axis 0); matmul weights per column
+    return 0 if "conv" in op_type else max(0, len(shape) - 1)
+
+
+def _channel_scales(w, axis):
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    return np.maximum(np.max(np.abs(w), axis=red), 1e-9)
+
+
+class QuantizationFreezePass:
+    """Rewrite a QAT (or calibrated) program for int8 inference
+    (ref quantization_pass.py:634).
+
+    - weight fake-qdq ops are removed; the scope weight becomes its
+      rounded int8 grid value (storage dtype unchanged until
+      ConvertToInt8Pass)
+    - activation fake-qdq ops are removed; the trained moving-average
+      scale (read from the scope) becomes the consumer's ``act_scale``
+    - consumer mul/conv2d ops become quantized_mul / quantized_conv2d
+    """
+
+    def __init__(self, scope, place, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max"):
+        self._scope = scope
+        self._place = place
+        self._weight_bits = int(weight_bits)
+        self._activation_bits = int(activation_bits)
+        self._weight_quantize_type = weight_quantize_type
+
+    def apply(self, program):
+        qmax = _QMAX[self._weight_bits]
+        for block in program.blocks:
+            act_scale = {}     # dequantized-name -> (orig_name, scale)
+            weight_scale = {}  # dequantized-name -> (orig_name, scales)
+            new_ops = []
+            for op in block.ops:
+                if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                    src = op.input("X")[0]
+                    state = op.input("InScale")[0]
+                    sval = self._scope.find_var(state)
+                    if sval is None:
+                        raise RuntimeError(
+                            "freeze: activation scale state %r not in "
+                            "scope — run startup + some training/"
+                            "calibration steps first" % state
+                        )
+                    scale = float(np.asarray(sval.get_tensor()).reshape(-1)[0])
+                    act_scale[op.output("Out")[0]] = (src, scale)
+                    continue
+                if op.type == "fake_channel_wise_quantize_dequantize_abs_max":
+                    src = op.input("X")[0]
+                    wvar = self._scope.find_var(src)
+                    if wvar is None:
+                        raise RuntimeError(
+                            "freeze: weight %r not in scope" % src)
+                    w = np.asarray(wvar.get_tensor())
+                    axis = int(op.attrs.get("quant_axis", 0))
+                    scales = _channel_scales(w, axis)
+                    shape = [1] * w.ndim
+                    shape[axis] = -1
+                    wq = np.clip(
+                        np.round(w / scales.reshape(shape) * qmax),
+                        -qmax, qmax)
+                    self._scope.set(src, wq.astype(w.dtype))
+                    weight_scale[op.output("Out")[0]] = (src, scales)
+                    continue
+                if op.type in ("mul", "matmul") and (
+                        op.input("Y") and op.input("Y")[0] in weight_scale):
+                    xname = op.input("X")[0]
+                    if xname not in act_scale:
+                        raise RuntimeError(
+                            "freeze: %s consumes unquantized activation %r"
+                            % (op.type, xname)
+                        )
+                    xsrc, ascale = act_scale[xname]
+                    wsrc, wscales = weight_scale[op.input("Y")[0]]
+                    op.type = "quantized_mul"
+                    op.inputs = {"X": [xsrc], "Y": [wsrc]}
+                    op.attrs = {
+                        "act_scale": ascale,
+                        "weight_scale": [float(s) for s in wscales],
+                        "quant_bits": self._weight_bits,
+                    }
+                elif op.type in ("conv2d", "depthwise_conv2d") and (
+                        op.input("Filter")
+                        and op.input("Filter")[0] in weight_scale):
+                    xname = op.input("Input")[0]
+                    if xname not in act_scale:
+                        raise RuntimeError(
+                            "freeze: conv consumes unquantized "
+                            "activation %r" % xname
+                        )
+                    xsrc, ascale = act_scale[xname]
+                    wsrc, wscales = weight_scale[op.input("Filter")[0]]
+                    op.attrs = dict(
+                        op.attrs,
+                        act_scale=ascale,
+                        weight_scale=[float(s) for s in wscales],
+                        quant_bits=self._weight_bits,
+                    )
+                    op.inputs = {"Input": [xsrc], "Filter": [wsrc]}
+                    op.type = "quantized_conv2d"
+                else:
+                    # rewire any other reader of a dequantized name
+                    for slot, names in op.inputs.items():
+                        op.inputs[slot] = [
+                            act_scale.get(n, weight_scale.get(n, (n,)))[0]
+                            for n in names
+                        ]
+                new_ops.append(op)
+            block.ops = new_ops
+        program._bump_version()
+        return program
+
+
+class ConvertToInt8Pass:
+    """Cast frozen int8-grid weights to real int8 storage
+    (ref quantization_pass.py:944)."""
+
+    def __init__(self, scope, place):
+        self._scope = scope
+        self._place = place
+
+    def apply(self, program):
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type == "quantized_mul":
+                    names = op.input("Y")
+                elif op.type == "quantized_conv2d":
+                    names = op.input("Filter")
+                else:
+                    continue
+                for n in names:
+                    v = self._scope.find_var(n)
+                    if v is None:
+                        continue
+                    w = np.asarray(v.get_tensor())
+                    if w.dtype != np.int8:
+                        self._scope.set(n, w.astype(np.int8))
+                    var = block.vars.get(n) or \
+                        program.global_block().vars.get(n)
+                    if var is not None:
+                        var.dtype = "int8"
+        program._bump_version()
+        return program
+
+
+class AddQuantDequantPass:
+    """Insert per-tensor fake quant-dequant on inputs of extra op types
+    (elementwise_add, pool2d, ...) so their int8 error is modeled during
+    QAT (ref quantization_pass.py:1237)."""
+
+    _DEFAULT_TYPES = ("elementwise_add", "pool2d", "concat", "softmax",
+                      "relu")
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9,
+                 quant_bits=8, skip_pattern="skip_quant",
+                 quantizable_op_type=_DEFAULT_TYPES):
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self._skip_pattern = skip_pattern
+        self._op_types = tuple(quantizable_op_type)
+
+    def apply(self, program, startup_program=None):
+        from ...quant import QuantizationTransformPass
+
+        pass_ = QuantizationTransformPass(
+            weight_bits=self._quant_bits,
+            activation_bits=self._quant_bits,
+            moving_rate=self._moving_rate,
+            quantizable_op_type=self._op_types,
+            skip_pattern=self._skip_pattern,
+        )
+        return pass_.apply(program, startup_program)
+
+
+class OutScaleForTrainingPass:
+    """The reference collects per-output scales for TensorRT export; the
+    XLA inference path computes with the op-attr scales directly, so this
+    is a documented no-op kept for pipeline compatibility."""
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9):
+        self._moving_rate = moving_rate
+
+    def apply(self, program):
+        return program
+
+
+OutScaleForInferencePass = OutScaleForTrainingPass
+
+
+class TransformForMobilePass:
+    """Paddle-Lite mobile op renaming has no TPU analogue."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "TransformForMobilePass targets Paddle-Lite mobile kernels; "
+            "the XLA int8 program needs no mobile transform"
+        )

@@ -1,0 +1,305 @@
+"""Slim framework round 3: int8 freeze/convert, PTQ, GraphWrapper,
+Compressor yaml orchestration, SAController, quantize_transpiler."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+V_IN, HID, NCLS = 12, 24, 4
+
+
+def _mlp_programs(seed=0):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("qx", shape=[V_IN], dtype="float32")
+        y = fluid.data("qy", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, HID, act="relu")
+        logits = fluid.layers.fc(h, NCLS)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        acc = fluid.layers.accuracy(
+            fluid.layers.softmax(logits), y)
+    return main, startup, x, y, logits, loss, acc
+
+
+def _data(n, seed):
+    rng = np.random.default_rng(seed)
+    xs = rng.standard_normal((n, V_IN)).astype("float32")
+    ys = (np.argmax(xs[:, :NCLS], axis=1)).astype("int64")[:, None]
+    return xs, ys
+
+
+def _accuracy(exe, prog, logits, xs, ys):
+    (lv,) = exe.run(prog, feed={"qx": xs, "qy": ys}, fetch_list=[logits])
+    return float((np.argmax(lv, 1) == ys[:, 0]).mean())
+
+
+def _train_fp32(main, startup, loss, exe, xs, ys, steps=80):
+    test_prog = main.clone(for_test=True)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe.run(startup)
+    for i in range(steps):
+        exe.run(main, feed={"qx": xs, "qy": ys}, fetch_list=[loss])
+    return test_prog
+
+
+def test_qat_freeze_convert_int8_accuracy():
+    from paddle_tpu.fluid.contrib.quant import quantize_program
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        ConvertToInt8Pass,
+        QuantizationFreezePass,
+    )
+
+    main, startup, x, y, logits, loss, acc = _mlp_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs, ys = _data(512, 0)
+    test_prog = _train_fp32(main, startup, loss, exe, xs, ys)
+    fp32_acc = _accuracy(exe, test_prog, logits, xs, ys)
+    assert fp32_acc > 0.9, fp32_acc
+
+    # QAT transform on a fresh test clone + brief finetune of the scales
+    qat_prog = test_prog.clone()
+    qat_startup = fluid.Program()
+    quantize_program(qat_prog, qat_startup)
+    exe.run(qat_startup)
+    for _ in range(10):  # populate moving-average activation scales
+        exe.run(qat_prog, feed={"qx": xs[:64], "qy": ys[:64]},
+                fetch_list=[logits])
+    qat_acc = _accuracy(exe, qat_prog, logits, xs, ys)
+    assert qat_acc > fp32_acc - 0.02, (fp32_acc, qat_acc)
+
+    # freeze -> real int8 ops
+    scope = fluid.global_scope()
+    frozen = qat_prog
+    QuantizationFreezePass(scope, exe.place).apply(frozen)
+    types = [op.type for op in frozen.global_block().ops]
+    assert "quantized_mul" in types, types
+    assert not any(t.startswith("fake_quantize") for t in types), types
+    int8_acc = _accuracy(exe, frozen, logits, xs, ys)
+    assert int8_acc > fp32_acc - 0.01, (fp32_acc, int8_acc)
+
+    # convert weight storage to int8 and keep predicting
+    ConvertToInt8Pass(scope, exe.place).apply(frozen)
+    wname = frozen.global_block().ops[
+        types.index("quantized_mul")].input("Y")[0]
+    assert np.asarray(scope.find_var(wname).get_tensor()).dtype == np.int8
+    int8s_acc = _accuracy(exe, frozen, logits, xs, ys)
+    assert int8s_acc == int8_acc, (int8_acc, int8s_acc)
+
+
+def test_post_training_quantization(tmp_path):
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        PostTrainingQuantization,
+    )
+
+    main, startup, x, y, logits, loss, acc = _mlp_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs, ys = _data(512, 1)
+    test_prog = _train_fp32(main, startup, loss, exe, xs, ys)
+    fp32_acc = _accuracy(exe, test_prog, logits, xs, ys)
+    model_dir = str(tmp_path / "fp32")
+    fluid.io.save_inference_model(
+        model_dir, ["qx"], [logits], exe, main_program=test_prog)
+
+    def sample_gen():
+        for i in range(128):
+            yield (xs[i],)
+
+    for algo in ("abs_max", "KL"):
+        ptq = PostTrainingQuantization(
+            executor=exe, sample_generator=sample_gen,
+            model_dir=model_dir, batch_size=16, batch_nums=8, algo=algo)
+        qprog = ptq.quantize()
+        types = [op.type for op in qprog.global_block().ops]
+        assert "quantized_mul" in types, types
+        (lv,) = exe.run(qprog, feed={"qx": xs}, fetch_list=ptq._fetch_list)
+        ptq_acc = float((np.argmax(lv, 1) == ys[:, 0]).mean())
+        assert ptq_acc > fp32_acc - 0.01, (algo, fp32_acc, ptq_acc)
+        out_dir = str(tmp_path / ("int8_" + algo))
+        ptq.save_quantized_model(out_dir)
+        prog2, feeds, fetches = fluid.io.load_inference_model(out_dir, exe)
+        (lv2,) = exe.run(prog2, feed={"qx": xs[:8]}, fetch_list=fetches)
+        assert lv2.shape == (8, NCLS)
+
+
+def test_graph_wrapper_queries():
+    from paddle_tpu.fluid.contrib.slim import GraphWrapper
+
+    main, startup, x, y, logits, loss, acc = _mlp_programs()
+    g = GraphWrapper(main, in_nodes=[("image", "qx")],
+                     out_nodes=[("loss", loss.name)])
+    params = g.all_parameters()
+    assert len(params) == 4  # 2 fc weights + 2 biases
+    assert g.numel_params() == V_IN * HID + HID + HID * NCLS + NCLS
+    assert g.flops() == V_IN * HID + HID * NCLS
+    mul_ops = [op for op in g.ops() if op.type() == "mul"]
+    assert len(mul_ops) == 2
+    w = g.get_param_by_op(mul_ops[0])
+    assert len(w) == 1 and w[0].shape() == (V_IN, HID)
+    nxt = g.next_ops(mul_ops[0])
+    assert any(o.type() == "elementwise_add" for o in nxt)
+    assert g.var(loss.name).name() == loss.name
+    c = g.clone()
+    assert c.program is not main and len(c.ops()) == len(g.ops())
+
+
+def test_compressor_yaml_prune_plus_quant(tmp_path):
+    from paddle_tpu.fluid.contrib.slim import Compressor
+
+    cfg = tmp_path / "compress.yaml"
+    int8_dir = str(tmp_path / "int8_out")
+    cfg.write_text("""
+version: 1.0
+pruners:
+  pruner_1:
+    class: StructurePruner
+    pruning_axis:
+      '*': 0
+    criterions:
+      '*': l1_norm
+strategies:
+  prune_strategy:
+    class: UniformPruneStrategy
+    pruner: pruner_1
+    start_epoch: 0
+    end_epoch: 2
+    target_ratio: 0.25
+    pruned_params: 'fc_*.w*'
+  quant_strategy:
+    class: QuantizationStrategy
+    start_epoch: 1
+    end_epoch: 2
+    weight_bits: 8
+    activation_bits: 8
+    int8_model_save_path: %s
+compressor:
+  epoch: 3
+  eval_epoch: 1
+  strategies:
+    - prune_strategy
+    - quant_strategy
+""" % int8_dir)
+    main, startup, x, y, logits, loss, acc = _mlp_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs, ys = _data(256, 2)
+    exe.run(startup)
+
+    def reader():
+        for i in range(0, 256, 32):
+            yield [(xs[j], ys[j]) for j in range(i, i + 32)]
+
+    comp = Compressor(
+        place=exe.place, scope=fluid.global_scope(),
+        train_program=main,
+        train_reader=reader,
+        train_feed_list=[("image", "qx"), ("label", "qy")],
+        train_fetch_list=[("loss", loss.name)],
+        eval_program=main.clone(for_test=True),
+        eval_reader=reader,
+        eval_feed_list=[("image", "qx"), ("label", "qy")],
+        eval_fetch_list=[("acc", acc.name)],
+        train_optimizer=fluid.optimizer.Adam(5e-3),
+        log_period=4)
+    comp.config(str(cfg))
+    assert comp.epoch == 3 and len(comp.strategies) == 2
+    ctx = comp.run()
+    # pruning really masked 25% of fc weight rows
+    w0 = np.asarray(fluid.global_scope().get("fc_0.w_0"))
+    zero_rows = int((np.abs(w0).sum(axis=1) == 0).sum())
+    assert zero_rows == round(V_IN * 0.25), zero_rows
+    # quant strategy exported a loadable int8 model
+    assert os.path.isdir(int8_dir)
+    prog2, feeds, fetches = fluid.io.load_inference_model(int8_dir, exe)
+    types = [op.type for op in prog2.global_block().ops]
+    assert "quantized_mul" in types
+    # training made progress and eval ran
+    assert "acc" in ctx.eval_results and len(ctx.eval_results["acc"]) == 3
+
+
+def test_distillation_strategy_runs():
+    from paddle_tpu.fluid.contrib.slim import Compressor
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("dx", shape=[V_IN], dtype="float32")
+        y = fluid.data("dy", shape=[1], dtype="int64")
+        student = fluid.layers.fc(x, NCLS, name="student_fc")
+        teacher = fluid.layers.fc(x, NCLS, name="teacher_fc")
+        teacher.stop_gradient = True
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(student, y))
+    from paddle_tpu.fluid.contrib.slim.distillation import (
+        DistillationStrategy, L2Distiller,
+    )
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs, ys = _data(64, 3)
+
+    def reader():
+        yield [(xs[j], ys[j]) for j in range(32)]
+
+    strat = DistillationStrategy(
+        distillers=[L2Distiller(student.name, teacher.name,
+                                distillation_loss_weight=1.0)],
+        start_epoch=0, end_epoch=1)
+    comp = Compressor(
+        place=exe.place, scope=fluid.global_scope(),
+        train_program=main, train_reader=reader,
+        train_feed_list=[("image", "dx"), ("label", "dy")],
+        train_fetch_list=[("loss", loss.name)],
+        train_optimizer=fluid.optimizer.SGD(learning_rate=0.1),
+        log_period=1)
+    comp._add_strategy(strat)
+    comp.epoch = 2
+    before = np.asarray(
+        fluid.global_scope().get("student_fc.w_0")).copy()
+    comp.run()
+    after = np.asarray(fluid.global_scope().get("student_fc.w_0"))
+    assert not np.allclose(before, after)  # distill loss trained student
+
+
+def test_sa_controller_improves():
+    from paddle_tpu.fluid.contrib.slim.searcher import SAController
+
+    import random
+    random.seed(0)
+    # reward: negative distance to the target token vector
+    target = [3, 1, 4, 1, 5]
+    table = [8] * 5
+
+    def reward(tokens):
+        return -sum(abs(a - b) for a, b in zip(tokens, target))
+
+    ctl = SAController(reduce_rate=0.9, init_temperature=10.0)
+    ctl.reset(table, [0, 0, 0, 0, 0])
+    first = reward([0, 0, 0, 0, 0])
+    for _ in range(300):
+        cand = ctl.next_tokens()
+        ctl.update(cand, reward(cand))
+    assert ctl.max_reward > first
+    assert ctl.max_reward >= -3  # close to the target
+
+
+def test_quantize_transpiler_facade():
+    from paddle_tpu.fluid.contrib.quantize import QuantizeTranspiler
+
+    main, startup, x, y, logits, loss, acc = _mlp_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs, ys = _data(128, 4)
+    test_prog = _train_fp32(main, startup, loss, exe, xs, ys, steps=30)
+    t = QuantizeTranspiler(activation_quantize_type="range_abs_max")
+    qp = test_prog.clone()
+    st = fluid.Program()
+    t.training_transpile(qp, st)
+    exe.run(st)
+    exe.run(qp, feed={"qx": xs[:32], "qy": ys[:32]}, fetch_list=[logits])
+    t.freeze_program(qp, exe.place)
+    t.convert_to_int8(qp, exe.place)
+    types = [op.type for op in qp.global_block().ops]
+    assert "quantized_mul" in types
+    (lv,) = exe.run(qp, feed={"qx": xs[:8], "qy": ys[:8]}, fetch_list=[logits])
+    assert lv.shape == (8, NCLS)
