@@ -75,6 +75,11 @@ from . import contrib
 from . import evaluator
 from . import inference
 from . import transpiler
+from . import debugger
+from . import graphviz
+from . import net_drawer
+from . import communicator
+from .communicator import Communicator  # noqa: F401
 from . import incubate  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, memory_optimize, release_memory  # noqa: F401
 

@@ -1,0 +1,38 @@
+"""Communicator (ref: python/paddle/fluid/communicator.py).
+
+The reference's Communicator is a C++ background thread pool pushing
+gradients to / pulling parameters from parameter servers during ASYNC
+training. On TPU there is no async pserver channel to service: gradients
+ride synchronous ICI collectives inserted by XLA inside the jitted step,
+so there is nothing for a background communicator to do. The class keeps
+the reference lifecycle (start/stop/is_running) as state so fleet
+scripts that manage one run unchanged, and warns once that the work
+happens in-graph.
+"""
+import warnings
+
+__all__ = ["Communicator"]
+
+
+class Communicator:
+    def __init__(self, program, mode=None, kwargs=None, envs=None):
+        self._program = program
+        self._mode = mode
+        self._running = False
+        self._warned = False
+
+    def start(self):
+        if not self._warned:
+            warnings.warn(
+                "Communicator.start(): async pserver push/pull is "
+                "replaced by synchronous ICI collectives compiled into "
+                "the training step on TPU; the communicator is "
+                "lifecycle-only here", stacklevel=2)
+            self._warned = True
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self):
+        return self._running

@@ -1,0 +1,152 @@
+"""Minimal graphviz dot-source builder
+(ref: python/paddle/fluid/graphviz.py).
+
+Pure text generation: ``Graph`` accumulates nodes/edges/rank groups and
+emits dot source; ``show`` additionally runs the ``dot`` binary when it
+is installed (and silently keeps just the .dot file otherwise — CI boxes
+rarely have graphviz)."""
+import os
+import shutil
+import subprocess
+
+__all__ = ["Graph", "Node", "Edge", "GraphPreviewGenerator"]
+
+
+def crepr(v):
+    return '"%s"' % v if isinstance(v, str) else str(v)
+
+
+class Rank:
+    def __init__(self, kind, name, priority):
+        if kind not in ("source", "sink", "same", "min", "max"):
+            raise ValueError("unsupported rank kind %r" % kind)
+        self.kind = kind
+        self.name = name
+        self.priority = priority
+        self.nodes = []
+
+    def __str__(self):
+        if not self.nodes:
+            return ""
+        return "{rank=%s; %s}" % (
+            self.kind, ",".join(n.name for n in self.nodes))
+
+
+class Node:
+    counter = 1
+
+    def __init__(self, label, prefix, description="", **attrs):
+        self.label = label
+        self.name = "%s_%d" % (prefix, Node.counter)
+        Node.counter += 1
+        self.description = description
+        self.attrs = attrs
+
+    def __str__(self):
+        attrs = dict(self.attrs)
+        attrs.setdefault("label", self.label)
+        body = ",".join(
+            "%s=%s" % (k, crepr(v)) for k, v in sorted(attrs.items()))
+        return "%s [%s];" % (self.name, body)
+
+
+class Edge:
+    def __init__(self, source, target, **attrs):
+        self.source = source
+        self.target = target
+        self.attrs = attrs
+
+    def __str__(self):
+        body = ",".join(
+            "%s=%s" % (k, crepr(v)) for k, v in sorted(self.attrs.items()))
+        return "%s -> %s [%s];" % (self.source.name, self.target.name, body)
+
+
+class Graph:
+    def __init__(self, title, **attrs):
+        self.title = title
+        self.attrs = attrs
+        self.nodes = []
+        self.edges = []
+        self.rank_groups = {}
+
+    def add_node(self, label, prefix, description="", **attrs):
+        node = Node(label, prefix, description, **attrs)
+        self.nodes.append(node)
+        return node
+
+    def add_edge(self, source, target, **attrs):
+        edge = Edge(source, target, **attrs)
+        self.edges.append(edge)
+        return edge
+
+    def rank_group(self, kind, priority):
+        name = "rankgroup-%d" % len(self.rank_groups)
+        self.rank_groups[name] = Rank(kind, name, priority)
+        return name
+
+    def node(self, label, prefix, description="", **attrs):
+        node = self.add_node(label, prefix, description, **attrs)
+        group = attrs.get("rank_group")
+        if group in self.rank_groups:
+            self.rank_groups[group].nodes.append(node)
+        return node
+
+    def code(self):
+        head = 'digraph G {\nlabel=%s;\n' % crepr(self.title)
+        head += "".join(
+            "%s=%s;\n" % (k, crepr(v)) for k, v in sorted(self.attrs.items())
+        )
+        parts = [str(n) for n in self.nodes]
+        parts += [str(e) for e in self.edges]
+        parts += [
+            str(r) for r in sorted(
+                self.rank_groups.values(), key=lambda r: r.priority)
+            if str(r)
+        ]
+        return head + "\n".join(parts) + "\n}\n"
+
+    def compile(self, dot_path):
+        """Write dot source; render a PDF next to it if `dot` exists."""
+        with open(dot_path, "w") as f:
+            f.write(self.code())
+        if shutil.which("dot"):
+            out = os.path.splitext(dot_path)[0] + ".pdf"
+            subprocess.run(
+                ["dot", "-Tpdf", dot_path, "-o", out], check=False)
+            return out
+        return dot_path
+
+    # ref naming
+    def show(self, dot_path):
+        return self.compile(dot_path)
+
+    def __str__(self):
+        return self.code()
+
+
+class GraphPreviewGenerator:
+    """Typed helpers over Graph (ref graphviz.py:184): params as
+    octagons, ops as rectangles, vars as ellipses."""
+
+    def __init__(self, title):
+        self.graph = Graph(title, layout="dot")
+
+    def add_param(self, name, data_type, highlight=False):
+        return self.graph.add_node(
+            "%s\\n%s" % (name, data_type), prefix="param", shape="octagon",
+            style="filled",
+            fillcolor="green" if highlight else "lightgrey")
+
+    def add_op(self, opType, **kwargs):
+        return self.graph.add_node(
+            opType, prefix="op", shape="box", style="rounded", **kwargs)
+
+    def add_arg(self, name, highlight=False):
+        return self.graph.add_node(
+            name, prefix="arg", shape="ellipse",
+            style="filled" if highlight else "solid",
+            fillcolor="yellow" if highlight else "white")
+
+    def add_edge(self, source, target, **kwargs):
+        return self.graph.add_edge(source, target, **kwargs)

@@ -164,7 +164,8 @@ def embedding(
         type="lookup_table_v2",
         inputs={"Ids": [input], "W": [w]},
         outputs={"Out": [out]},
-        attrs={"padding_idx": padding_idx, "is_sparse": is_sparse},
+        attrs={"padding_idx": padding_idx, "is_sparse": is_sparse,
+               "is_distributed": is_distributed},
     )
     return out
 

@@ -1,0 +1,53 @@
+"""Draw main/startup programs as one graphviz graph
+(ref: python/paddle/fluid/net_drawer.py)."""
+import json
+
+from .graphviz import Graph
+
+__all__ = ["draw_graph"]
+
+OP_STYLE = {"shape": "box", "color": "#0F9D58", "style": "rounded"}
+VAR_STYLE = {"shape": "ellipse"}
+
+
+def unique_id():
+    counter = [0]
+
+    def gen():
+        counter[0] += 1
+        return counter[0]
+
+    return gen
+
+
+def draw_node(op):
+    return "%s" % op.type
+
+
+def parse_graph(program, graph, var_dict, **kwargs):
+    for block in program.blocks:
+        for op in block.ops:
+            op_node = graph.add_node(draw_node(op), prefix="op", **OP_STYLE)
+            for ns in op.inputs.values():
+                for n in ns:
+                    if n not in var_dict:
+                        var_dict[n] = graph.add_node(
+                            n, prefix="var", **VAR_STYLE)
+                    graph.add_edge(var_dict[n], op_node)
+            for ns in op.outputs.values():
+                for n in ns:
+                    if n not in var_dict:
+                        var_dict[n] = graph.add_node(
+                            n, prefix="var", **VAR_STYLE)
+                    graph.add_edge(op_node, var_dict[n])
+
+
+def draw_graph(startup_program, main_program, **kwargs):
+    filename = kwargs.pop("path", None) or (
+        kwargs.pop("graph_attr", {}) or {}).get("path") or "network.dot"
+    graph = Graph("network", layout="dot")
+    var_dict = {}
+    parse_graph(startup_program, graph, var_dict)
+    parse_graph(main_program, graph, var_dict)
+    graph.compile(filename)
+    return graph

@@ -16,9 +16,14 @@ API-compatible surface with TPU-native semantics:
   script compatibility (they print a note once).
 """
 import warnings
-import zlib
 
-from . import framework
+from .. import framework
+from . import ps_dispatcher
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
+from . import distribute_lookup_table
+from .distribute_lookup_table import (  # noqa: F401
+    find_distributed_lookup_table,
+)
 
 __all__ = [
     "DistributeTranspiler",
@@ -40,32 +45,6 @@ class DistributeTranspilerConfig:
     print_log = False
     wait_port = True
     sync_mode = True
-
-
-class HashName:
-    def __init__(self, pserver_endpoints):
-        self.eps = pserver_endpoints
-
-    def dispatch(self, varlist):
-        # stable digest, NOT builtin hash(): every process (trainer/restart)
-        # must agree on the same var -> endpoint placement
-        return [
-            self.eps[zlib.crc32(v.name.encode()) % len(self.eps)]
-            for v in varlist
-        ]
-
-
-class RoundRobin:
-    def __init__(self, pserver_endpoints):
-        self.eps = pserver_endpoints
-        self._i = 0
-
-    def dispatch(self, varlist):
-        out = []
-        for v in varlist:
-            out.append(self.eps[self._i % len(self.eps)])
-            self._i += 1
-        return out
 
 
 class DistributeTranspiler:

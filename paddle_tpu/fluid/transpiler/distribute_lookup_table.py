@@ -1,0 +1,32 @@
+"""Distributed lookup-table discovery
+(ref: python/paddle/fluid/transpiler/details/distribute_lookup_table.py).
+
+Finds the embedding table marked ``is_distributed`` in a program — the
+table the pserver runtime would shard. The TPU pipeline uses the same
+discovery to pick which table gets vocab-dim sharding over the mesh
+(see parallel/sharding.py rules).
+"""
+
+__all__ = ["find_distributed_lookup_table"]
+
+LOOKUP_TABLE_TYPES = ("lookup_table", "lookup_table_v2")
+
+
+def find_distributed_lookup_table(program):
+    """Return the single distributed lookup table's param name, or None.
+    Multiple distinct distributed tables raise, like the reference."""
+    table_name = None
+    for op in program.global_block().ops:
+        if op.type not in LOOKUP_TABLE_TYPES:
+            continue
+        if not op.attrs.get("is_distributed", False):
+            continue
+        name = op.input("W")[0]
+        if table_name is None:
+            table_name = name
+        elif table_name != name:
+            raise RuntimeError(
+                "all distributed lookup_table ops must share one table; "
+                "found %r and %r" % (table_name, name)
+            )
+    return table_name

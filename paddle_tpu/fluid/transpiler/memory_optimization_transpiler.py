@@ -1,0 +1,6 @@
+"""ref transpiler/memory_optimization_transpiler.py import path; the
+implementations live in the package __init__ (XLA buffer assignment
+subsumes the pass — see memory_optimize's docstring)."""
+from . import memory_optimize, release_memory  # noqa: F401
+
+__all__ = ["memory_optimize", "release_memory"]

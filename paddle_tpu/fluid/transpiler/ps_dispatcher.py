@@ -1,0 +1,55 @@
+"""Parameter-server variable dispatchers
+(ref: python/paddle/fluid/transpiler/ps_dispatcher.py).
+
+On TPU the pserver role maps to mesh-sharded parameters (see the package
+docstring), but the dispatch POLICY objects stay useful: the transpiler
+uses them to assign vars to logical shards, and reference scripts
+construct them directly. Semantics match the reference: HashName is a
+stable content hash (every process must agree), RoundRobin cycles.
+"""
+import zlib
+
+__all__ = ["PSDispatcher", "HashName", "RoundRobin"]
+
+
+class PSDispatcher:
+    """ref ps_dispatcher.py:18."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError("use HashName or RoundRobin")
+
+
+class HashName(PSDispatcher):
+    """Stable digest placement — NOT builtin hash(): trainers and
+    restarts must agree on var -> endpoint (ref ps_dispatcher.py:49)."""
+
+    def _hash_block(self, block_str, total):
+        return zlib.crc32(str(block_str).encode()) % total
+
+    def dispatch(self, varlist):
+        return [
+            self._eps[self._hash_block(v.name, len(self._eps))]
+            for v in varlist
+        ]
+
+
+class RoundRobin(PSDispatcher):
+    """Cycle endpoints in order (ref ps_dispatcher.py:89)."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out

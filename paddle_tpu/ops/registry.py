@@ -30,12 +30,60 @@ def register_op(name):
     return deco
 
 
+# Known-unsupported op manifest: reference op types with NO TPU lowering
+# BY DESIGN, each with the alternative a porting user should reach for.
+# Anything not here and not registered is an accidental gap — the error
+# text distinguishes the two cases.
+KNOWN_UNSUPPORTED = {
+    # pserver / async-distributed machinery -> sharding + collectives
+    "send": "pserver RPC: gradients ride ICI collectives inside the "
+            "jitted step (fleet collective mode)",
+    "recv": "pserver RPC: see 'send'",
+    "fetch_barrier": "pserver sync barrier: XLA steps are synchronous",
+    "send_barrier": "pserver sync barrier: XLA steps are synchronous",
+    "listen_and_serv": "pserver main loop: no parameter servers on TPU; "
+                       "use fleet collective mode",
+    "ref_by_trainer_id": "pserver sharding detail; use mesh sharding",
+    "distributed_lookup_table": "vocab-sharded embedding over the mesh "
+                                "(parallel/sharding.py) replaces the "
+                                "pserver-sharded table",
+    "nccl_init": "NCCL context: XLA manages ICI/DCN collectives",
+    "gen_nccl_id": "NCCL context: XLA manages ICI/DCN collectives",
+    # GPU-runtime specifics
+    "cudnn_lstm": "use layers.lstm / the cell API (lax.scan fusion)",
+    "fused_embedding_fc_lstm": "compose embedding + fc + lstm; XLA fuses",
+    "tensorrt_engine": "TensorRT subgraph: the AOT Predictor compiles "
+                       "the whole program with XLA instead",
+    "anakin_engine": "Anakin subgraph: see 'tensorrt_engine'",
+    # mkldnn / x86 quantization runtime
+    "dequantize_mkldnn": "int8 runs via quantized_mul/quantized_conv2d",
+    "quantize_mkldnn": "int8 runs via quantized_mul/quantized_conv2d",
+    # reader ops: the data path is DataLoader/dataset + the native ring
+    "create_py_reader": "use fluid.DataLoader.from_generator",
+    "read": "use fluid.DataLoader / dataset trainer path",
+    "open_files": "use fluid.dataset (QueueDataset/InMemoryDataset)",
+}
+
+
 def get_lowering(op_type):
     fn = LOWERINGS.get(op_type)
     if fn is None:
+        if op_type in KNOWN_UNSUPPORTED:
+            raise NotImplementedError(
+                "op '%s' is intentionally unsupported on TPU: %s"
+                % (op_type, KNOWN_UNSUPPORTED[op_type])
+            )
+        import difflib
+
+        close = difflib.get_close_matches(
+            op_type, list(LOWERINGS), n=3, cutoff=0.6)
+        hint = ("; nearest supported: %s" % ", ".join(close)) if close \
+            else ""
         raise NotImplementedError(
-            "no TPU lowering registered for op '%s' (registered: %d ops)"
-            % (op_type, len(LOWERINGS))
+            "no TPU lowering registered for op '%s' (registered: %d "
+            "ops%s). If the reference supports this op, this is a "
+            "coverage gap — please report it."
+            % (op_type, len(LOWERINGS), hint)
         )
     return fn
 

@@ -78,13 +78,16 @@ ALLOW = {
     ("fluid/profiler.py", "cuda_profiler"): {"output_mode", "config"},  # cuda-era
     ("fluid/profiler.py", "start_profiler"): {"state", "tracer_option"},  # jax.profiler traces everything
     ("fluid/profiler.py", "stop_profiler"): {"sorted_key", "profile_path"},  # xplane dump is fixed-format
-    ("fluid/transpiler.py", "DistributeTranspiler.transpile"): {"pservers", "sync_mode", "startup_program", "current_endpoint"},  # pserver->ICI mapping documented in module docstring
-    ("fluid/transpiler.py", "DistributeTranspiler.get_trainer_program"): {"wait_port"},  # pserver-era
-    ("fluid/transpiler.py", "DistributeTranspiler.get_startup_program"): {"endpoint", "pserver_program", "startup_program"},  # pserver-era
-    ("fluid/transpiler.py", "memory_optimize"): {"skip_opt_set", "print_log", "level", "skip_grads"},  # XLA buffer assignment subsumes
-    ("fluid/transpiler.py", "release_memory"): {"skip_opt_set"},  # XLA buffer assignment subsumes
+    ("fluid/transpiler/__init__.py", "DistributeTranspiler.transpile"): {"pservers", "sync_mode", "startup_program", "current_endpoint"},  # pserver->ICI mapping documented in module docstring
+    ("fluid/transpiler/__init__.py", "DistributeTranspiler.get_trainer_program"): {"wait_port"},  # pserver-era
+    ("fluid/transpiler/__init__.py", "DistributeTranspiler.get_startup_program"): {"endpoint", "pserver_program", "startup_program"},  # pserver-era
+    ("fluid/transpiler/__init__.py", "memory_optimize"): {"skip_opt_set", "print_log", "level", "skip_grads"},  # XLA buffer assignment subsumes
+    ("fluid/transpiler/__init__.py", "release_memory"): {"skip_opt_set"},  # XLA buffer assignment subsumes
     ("parallel/fleet.py", "Fleet.init"): {"is_collective"},  # collective is the only TPU mode
     ("parallel/fleet.py", "Fleet.save_inference_model"): {"export_for_deployment"},  # single format
+    ("fluid/contrib/slim/core/compressor.py", "Context.run_eval_graph"): {"sampled_rate", "cached_id"},  # iface-compat: full-eval only (no cached_reader subsampling)
+    ("fluid/dataset.py", "InMemoryDataset.global_shuffle"): {"fleet", "thread_num"},  # documented: per-worker shard shuffle (docstring)
+    ("fluid/debugger.py", "run_fast_nan_inf_debug"): {"use_program_cache", "dump_core"},  # iface-compat: executor caches by program version; no core dumps
     ("reader_utils.py", "xmap_readers"): {"order"},  # results always ordered (stronger than order=True)
     ("reader_utils.py", "multiprocess_reader"): {"use_pipe"},  # thread-based by documented design
 }
