@@ -349,17 +349,35 @@ def _measure_resnet(batch=128, image_size=224, n_steps=20):
                       return_numpy=False)
     last = float(np.asarray(out[0]))
     dt = time.time() - t0
-    return {
-        "imgs_per_sec": round(n_steps * batch / dt, 1),
+    imgs_per_sec = n_steps * batch / dt
+    # ResNet-50 fwd ~= 3.86 GFLOPs/img at 224; train ~= 3x fwd. MFU here
+    # is the CHIP ceiling for this workload, not framework overhead: a
+    # minimal pure-jax ResNet-50 (bf16, NCHW and NHWC) measures the same
+    # ~0.14 on v5e (bench_experiments/resnet_ablate.py, BENCHMARKS.md) —
+    # ResNet's conv stack is HBM-bandwidth-bound at batch 128-256.
+    train_flops_per_img = 3 * 3.86e9
+    out = {
+        "imgs_per_sec": round(imgs_per_sec, 1),
         "batch": batch,
         "image_size": image_size,
         "step_ms": round(1000 * dt / n_steps, 2),
         "compile_s": round(compile_s, 1),
         "loss_last": round(last, 4),
+        "train_flops_per_img": train_flops_per_img,
     }
+    dk = getattr(_jax.devices()[0], "device_kind", "")
+    peak = _peak_flops(dk)
+    if peak:
+        out["mfu"] = round(imgs_per_sec * train_flops_per_img / peak, 4)
+    return out
 
 
 def _bank(st, variant, cfg, on_accel, backend, device_kind):
+    peak_v = _peak_flops(device_kind)
+    if peak_v:
+        variant["mfu"] = round(
+            variant["tokens_per_sec"]
+            * _flops_per_token_train(cfg, variant["seq_len"]) / peak_v, 4)
     st.data["variants"].append(variant)
     tps = variant["tokens_per_sec"]
     best = st.data["best"]
@@ -454,6 +472,11 @@ def child_main(status_path):
             ("b48", False, 48, 128, 30, None),
             ("b64", False, 64, 128, 30, None),
             ("b128", False, 128, 128, 30, None),
+            # phase-2 pretrain shape; MFU 0.34 here vs 0.485 at s128
+            # (attention's T^2 term). XLA attention beats pallas flash
+            # at s512/1024/2048 too (BENCHMARKS.md crossover table), so
+            # flash stays opt-in.
+            ("s512", False, 16, 512, 12, None),
         ]
     else:
         plan = [("cpu-tiny", False, 8, 64, 5, None)]

@@ -1,0 +1,55 @@
+"""BERT-base long-sequence ablation: XLA attention vs pallas flash at
+T=512/1024/2048 on the real chip (VERDICT r2 #3). Self-exiting; writes
+bench_experiments/bert_longseq.json.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(__file__), "bert_longseq.json")
+RESULTS = {"variants": [], "errors": []}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+
+
+def main():
+    import bench
+
+    plan = [
+        # tag, flash, batch, seq, steps  (batch scaled down as T grows
+        # to keep activation memory and wall clock in range)
+        ("s512_xla_b16", False, 16, 512, 12),
+        ("s512_flash_b16", True, 16, 512, 12),
+        ("s1024_xla_b8", False, 8, 1024, 10),
+        ("s1024_flash_b8", True, 8, 1024, 10),
+        ("s2048_xla_b4", False, 4, 2048, 8),
+        ("s2048_flash_b4", True, 4, 2048, 8),
+    ]
+    for tag, use_flash, batch, seq, n_steps in plan:
+        try:
+            t0 = time.time()
+            variant, cfg = bench._measure(
+                tag, True, use_flash, batch, seq, n_steps)
+            flops = bench._flops_per_token_train(cfg, seq)
+            peak = 197e12
+            variant["mfu"] = round(
+                variant["tokens_per_sec"] * flops / peak, 4)
+            variant["wall_s"] = round(time.time() - t0, 1)
+            RESULTS["variants"].append(variant)
+            print("[longseq]", variant, flush=True)
+        except Exception as e:
+            RESULTS["errors"].append("%s: %r" % (tag, e))
+            print("[longseq] FAIL", tag, repr(e), flush=True)
+        flush()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,241 @@
+"""ResNet-50 conv-path ablation on the real chip.
+
+Measures (a) the framework's ResNet-50 train step at several configs and
+(b) a minimal pure-JAX ResNet-50 train step (the achievable ceiling for
+this chip) in NCHW and NHWC, bf16 compute. Writes JSON to
+bench_experiments/resnet_ablate.json and exits.
+
+Run: python bench_experiments/resnet_ablate.py [--quick]
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(__file__), "resnet_ablate.json")
+RESULTS = {"variants": [], "errors": []}
+
+
+def flush():
+    with open(OUT, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+
+
+def record(tag, batch, dt_per_step, compile_s, extra=None):
+    imgs = batch / dt_per_step
+    flops = 3 * 3.86e9  # fwd 3.86 GFLOPs/img @224, train ~3x
+    peak = 197e12
+    v = {
+        "tag": tag, "batch": batch,
+        "imgs_per_sec": round(imgs, 1),
+        "step_ms": round(1000 * dt_per_step, 2),
+        "compile_s": round(compile_s, 1),
+        "mfu": round(imgs * flops / peak, 4),
+    }
+    if extra:
+        v.update(extra)
+    RESULTS["variants"].append(v)
+    flush()
+    print("[ablate]", v, flush=True)
+
+
+def time_steps(fn, n=20, sync=None):
+    """sync(out) must force completion — np.asarray for the framework's
+    TensorView fetches, block_until_ready for jax arrays. Called once
+    after the timed loop (steady-state async dispatch, like bench.py)."""
+    if sync is None:
+        import jax
+
+        sync = jax.block_until_ready
+    t0 = time.time()
+    sync(fn())
+    compile_s = time.time() - t0
+    sync(fn())
+    t0 = time.time()
+    for _ in range(n):
+        out = fn()
+    sync(out)
+    return (time.time() - t0) / n, compile_s
+
+
+# ---------------------------------------------------------------------------
+# (a) framework step
+# ---------------------------------------------------------------------------
+def bench_framework(batch):
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.contrib.mixed_precision import decorate
+    from paddle_tpu.models import resnet
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 7
+    vs = resnet.build_resnet_train(depth=50, class_num=1000,
+                                   image_size=224)
+    opt = decorate(fluid.optimizer.Momentum(0.1, 0.9), use_bf16=True)
+    opt.minimize(vs["loss"])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(0)
+    feed = {
+        "image": jax.device_put(rng.standard_normal(
+            (batch, 3, 224, 224), dtype=np.float32)),
+        "label": jax.device_put(rng.integers(
+            0, 1000, size=(batch, 1), dtype=np.int64)),
+    }
+
+    def step():
+        return exe.run(feed=feed, fetch_list=[vs["loss"]],
+                       return_numpy=False)[0]
+
+    dt, comp = time_steps(step, sync=lambda o: np.asarray(o))
+    record("framework_b%d" % batch, batch, dt, comp)
+
+
+# ---------------------------------------------------------------------------
+# (b) pure-jax ceiling: minimal ResNet-50, bf16 compute, momentum update
+# ---------------------------------------------------------------------------
+BLOCKS = [3, 4, 6, 3]
+WIDTHS = [64, 128, 256, 512]
+
+
+def init_resnet(key, nhwc):
+    import jax
+
+    params = []
+
+    def conv_p(key, cin, cout, k):
+        w = jax.random.normal(key, (k, k, cin, cout), np.float32) * (
+            1.0 / np.sqrt(k * k * cin))
+        return w
+
+    keys = iter(jax.random.split(key, 200))
+    params.append(conv_p(next(keys), 3, 64, 7))
+    for stage, (n, w) in enumerate(zip(BLOCKS, WIDTHS)):
+        cin = 64 if stage == 0 else WIDTHS[stage - 1] * 4
+        for b in range(n):
+            c_in = cin if b == 0 else w * 4
+            params.append(conv_p(next(keys), c_in, w, 1))
+            params.append(conv_p(next(keys), w, w, 3))
+            params.append(conv_p(next(keys), w, w * 4, 1))
+            if b == 0:
+                params.append(conv_p(next(keys), c_in, w * 4, 1))
+    params.append(jax.random.normal(next(keys), (2048, 1000),
+                                    np.float32) * 0.02)
+    return params
+
+
+def resnet_fwd(params, x, nhwc):
+    """bf16 conv stack with per-conv 'bn' as mean-var normalize (train
+    mode batch stats) — matmul-free BN keeps the comparison about conv
+    throughput."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dn = ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "HWIO", "NCHW")
+    caxis = 3 if nhwc else 1
+    red = (0, 1, 2) if nhwc else (0, 2, 3)
+
+    def conv(x, w, stride=1):
+        # no preferred_element_type: its transpose rule feeds the f32
+        # cotangent back into a bf16 conv and fails; TPU accumulates
+        # bf16 convs in f32 internally regardless. Output stays bf16 —
+        # activations in bf16 end-to-end halves HBM traffic.
+        return lax.conv_general_dilated(
+            x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+            (stride, stride), "SAME", dimension_numbers=dn)
+
+    def bn_relu(x, relu=True):
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, red, keepdims=True)
+        v = jnp.var(xf, red, keepdims=True)
+        y = ((xf - m) * jax.lax.rsqrt(v + 1e-5)).astype(jnp.bfloat16)
+        return jnp.maximum(y, 0) if relu else y
+
+    it = iter(params[:-1])
+    x = bn_relu(conv(x, next(it), 2))
+    x = lax.reduce_window(x, -jnp.inf, lax.max,
+                          (1, 1, 3, 3) if not nhwc else (1, 3, 3, 1),
+                          (1, 1, 2, 2) if not nhwc else (1, 2, 2, 1),
+                          "SAME")
+    for stage, (n, w) in enumerate(zip(BLOCKS, WIDTHS)):
+        for b in range(n):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            identity = x
+            y = bn_relu(conv(x, next(it), stride))
+            y = bn_relu(conv(y, next(it)))
+            y = bn_relu(conv(y, next(it)), relu=False)
+            if b == 0:
+                identity = bn_relu(conv(x, next(it), stride), relu=False)
+            x = jnp.maximum(y + identity, 0.0)
+    x = jnp.mean(x, axis=red[1:])  # global average pool over H, W
+    logits = x.astype(jnp.bfloat16) @ params[-1].astype(jnp.bfloat16)
+    return logits.astype(jnp.float32)
+
+
+def bench_pure(batch, nhwc):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    params = init_resnet(key, nhwc)
+    params = [jax.device_put(p) for p in params]
+    vel = [jnp.zeros_like(p) for p in params]
+    shape = (batch, 224, 224, 3) if nhwc else (batch, 3, 224, 224)
+    x = jax.device_put(np.random.default_rng(0).standard_normal(
+        shape, dtype=np.float32))
+    labels = jax.device_put(np.random.default_rng(1).integers(
+        0, 1000, size=(batch,)))
+
+    def loss_fn(params, x, labels):
+        logits = resnet_fwd(params, x, nhwc)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, labels[:, None], 1)[:, 0]
+        return jnp.mean(lse - ll)
+
+    @jax.jit
+    def step(params, vel, x, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, labels)
+        vel = [0.9 * v + g for v, g in zip(vel, grads)]
+        params = [p - 0.1 * v for p, v in zip(params, vel)]
+        return params, vel, loss
+
+    state = [params, vel]
+
+    def run():
+        state[0], state[1], loss = step(state[0], state[1], x, labels)
+        return loss
+
+    dt, comp = time_steps(run)
+    record("purejax_%s_b%d" % ("nhwc" if nhwc else "nchw", batch),
+           batch, dt, comp)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    try:
+        bench_framework(128)
+        if not quick:
+            bench_framework(256)
+    except Exception as e:
+        RESULTS["errors"].append("framework: %r" % (e,))
+        flush()
+    for nhwc in (False, True):
+        try:
+            bench_pure(128, nhwc)
+        except Exception as e:
+            RESULTS["errors"].append("pure nhwc=%s: %r" % (nhwc, e))
+            flush()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
