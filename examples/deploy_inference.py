@@ -16,7 +16,7 @@ import paddle_tpu.fluid as fluid
 
 
 def main():
-    x = fluid.data(name="x", shape=[16], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 16], dtype="float32")
     h = fluid.layers.fc(x, 32, act="relu")
     out = fluid.layers.fc(h, 4, act="softmax")
     exe = fluid.Executor()

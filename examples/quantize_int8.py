@@ -28,8 +28,8 @@ def main():
     xs = rng.standard_normal((2048, D)).astype("float32")
     ys = np.argmax(xs[:, :C], axis=1).astype("int64")[:, None]
 
-    x = fluid.data("x", shape=[D], dtype="float32")
-    y = fluid.data("y", shape=[1], dtype="int64")
+    x = fluid.data("x", shape=[None, D], dtype="float32")
+    y = fluid.data("y", shape=[None, 1], dtype="int64")
     h = fluid.layers.fc(x, H, act="relu")
     logits = fluid.layers.fc(h, C)
     loss = fluid.layers.mean(

@@ -62,9 +62,9 @@ def write_shards(tmpdir, n_shards=4, rows_per_shard=512):
 
 
 def build_model():
-    sparse = fluid.data("sparse", shape=[N_SPARSE], dtype="int64")
-    dense = fluid.data("dense", shape=[N_DENSE], dtype="float32")
-    label = fluid.data("click", shape=[1], dtype="int64")
+    sparse = fluid.data("sparse", shape=[None, N_SPARSE], dtype="int64")
+    dense = fluid.data("dense", shape=[None, N_DENSE], dtype="float32")
+    label = fluid.data("click", shape=[None, 1], dtype="int64")
     emb = fluid.layers.embedding(sparse, size=[VOCAB, 16])
     deep = fluid.layers.concat(
         [fluid.layers.reshape(emb, [0, N_SPARSE * 16]), dense], axis=1)

@@ -31,7 +31,7 @@ from . import executor
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from . import initializer
 from . import layers
-from .layers.io import data  # noqa: F401
+from .data import data  # noqa: F401
 from . import backward
 from .backward import append_backward, gradients  # noqa: F401
 from . import optimizer
@@ -80,15 +80,33 @@ from . import graphviz
 from . import net_drawer
 from . import communicator
 from .communicator import Communicator  # noqa: F401
+from . import annotations
+from . import wrapped_decorator
+from . import default_scope_funcs
+from . import input
+from .input import one_hot, embedding  # noqa: F401
+from . import lod_tensor
+from . import log_helper
+from . import install_check
+from . import trainer_desc
+from .trainer_desc import (  # noqa: F401
+    DistMultiTrainer,
+    MultiTrainer,
+    PipelineTrainer,
+    TrainerDesc,
+)
+from . import distribute_lookup_table
+from . import inferencer
+from . import layer_helper_base
 from . import incubate  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, memory_optimize, release_memory  # noqa: F401
 
 # top-level conveniences/aliases matching the reference fluid namespace
 from .dygraph.tracer import VarBase  # noqa: F401
 from .io import save, load  # noqa: F401
-from .layers import embedding, one_hot  # noqa: F401
+# fluid.embedding / fluid.one_hot are the v2 variants from .input
+# (imported above); fluid.layers.* keep the v1 trailing-1 squeeze.
 from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401
-from . import clip as dygraph_grad_clip  # noqa: F401  (same classes serve both modes)
 
 import numpy as _np
 
@@ -121,22 +139,5 @@ __all__ = [
 ]
 
 
-def install_check():
-    """Quick self-test (ref fluid/install_check.py)."""
-    import numpy as np
-
-    prog = Program()
-    startup = Program()
-    with program_guard(prog, startup):
-        x = data(name="check_x", shape=[2], dtype="float32")
-        y = layers.fc(x, size=2)
-        loss = layers.mean(y)
-    place = core.default_place()
-    exe = Executor(place)
-    exe.run(startup)
-    out = exe.run(
-        prog,
-        feed={"check_x": np.ones((4, 2), dtype="float32")},
-        fetch_list=[loss],
-    )
-    print("paddle_tpu install check passed. loss=", out[0])
+# fluid.install_check is the module (import above); run
+# fluid.install_check.run_check() for the self-test (ref layout).

@@ -1,0 +1,33 @@
+"""ref import path python/paddle/fluid/distribute_lookup_table.py; the
+discovery lives in transpiler/distribute_lookup_table.py, plus the
+inputs/outputs helpers the reference exposes here."""
+from .transpiler.distribute_lookup_table import (  # noqa: F401
+    LOOKUP_TABLE_TYPES,
+    find_distributed_lookup_table,
+)
+
+__all__ = [
+    "find_distributed_lookup_table",
+    "find_distributed_lookup_table_inputs",
+    "find_distributed_lookup_table_outputs",
+]
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    local_vars = program.current_block().vars
+    inputs = []
+    for op in program.global_block().ops:
+        if op.type in LOOKUP_TABLE_TYPES and \
+                table_name == op.input("W")[0]:
+            inputs.extend(local_vars[name] for name in op.input("Ids"))
+    return inputs
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    local_vars = program.current_block().vars
+    outputs = []
+    for op in program.global_block().ops:
+        if op.type in LOOKUP_TABLE_TYPES and \
+                table_name == op.input("W")[0]:
+            outputs.extend(local_vars[name] for name in op.output("Out"))
+    return outputs

@@ -1,0 +1,4 @@
+"""ref import path python/paddle/fluid/inferencer.py (the reference file
+is a tombstone pointing at contrib); the working Inferencer lives in
+fluid.contrib.inferencer."""
+__all__ = []

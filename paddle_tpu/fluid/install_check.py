@@ -1,0 +1,47 @@
+"""Installation smoke check (ref: python/paddle/fluid/install_check.py).
+
+``fluid.install_check.run_check()`` trains a tiny linear model one step
+in dygraph mode and, when more than one device is visible, also jits a
+data-parallel step over the mesh — the TPU analogue of the reference's
+single-card + ParallelExecutor checks.
+"""
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import jax
+
+    from . import dygraph, optimizer
+    from .dygraph import Linear, to_variable
+
+    with dygraph.guard():
+        m = Linear(2, 4)
+        x = to_variable(np.random.uniform(-1, 1, (2, 2)).astype("float32"))
+        from .dygraph.tracer import call_op
+
+        loss = call_op("mean", {"X": [m(x)]})
+        loss.backward()
+        optimizer.SGD(learning_rate=0.01).minimize(
+            loss, parameter_list=m.parameters())
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        xs = jax.device_put(
+            np.ones((n_dev * 2, 2), np.float32),
+            NamedSharding(mesh, P("dp", None)))
+        w = jax.device_put(np.ones((2, 4), np.float32),
+                           NamedSharding(mesh, P(None, None)))
+
+        @jax.jit
+        def step(x, w):
+            return (x @ w).mean()
+
+        float(step(xs, w))
+        print("Your paddle_tpu works well on MULTIPLE devices (%d)."
+              % n_dev)
+    print("Your paddle_tpu is installed successfully! Device count: %d"
+          % n_dev)

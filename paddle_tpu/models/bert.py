@@ -134,8 +134,8 @@ def _encoder_layer(x, cfg, i, attn_mask, is_test):
 def build_bert_pretrain(cfg, seq_len, is_test=False):
     """Build the MLM pretraining graph in the current default programs.
     Returns dict of the interface variables."""
-    ids = fluid.data(name="input_ids", shape=[seq_len], dtype="int64")
-    mlm_labels = fluid.data(name="mlm_labels", shape=[seq_len], dtype="int64")
+    ids = fluid.data(name="input_ids", shape=[None, seq_len], dtype="int64")
+    mlm_labels = fluid.data(name="mlm_labels", shape=[None, seq_len], dtype="int64")
     emb = layers.embedding(
         ids,
         size=[cfg.vocab_size, cfg.hidden],

@@ -94,9 +94,9 @@ def resnet50(img, class_num=1000):
 
 
 def build_resnet_train(depth=50, class_num=1000, image_size=224):
-    img = fluid.data(name="image", shape=[3, image_size, image_size],
+    img = fluid.data(name="image", shape=[None, 3, image_size, image_size],
                      dtype="float32")
-    label = fluid.data(name="label", shape=[1], dtype="int64")
+    label = fluid.data(name="label", shape=[None, 1], dtype="int64")
     logits = resnet(img, class_num, depth)
     loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
     acc = layers.accuracy(layers.softmax(logits), label)

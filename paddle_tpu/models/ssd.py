@@ -41,11 +41,10 @@ def build_ssd_train(num_classes=4, image_size=64, max_gt=8):
     gt-matching path; the reference's LoD gt batching maps to fixed
     max_gt padding)."""
     img = fluid.data(name="image", shape=[1, 3, image_size, image_size],
-                     dtype="float32", append_batch_size=False)
-    gt_box = fluid.data(name="gt_box", shape=[max_gt, 4], dtype="float32",
-                        append_batch_size=False)
+                     dtype="float32")
+    gt_box = fluid.data(name="gt_box", shape=[max_gt, 4], dtype="float32")
     gt_label = fluid.data(name="gt_label", shape=[max_gt, 1],
-                          dtype="int64", append_batch_size=False)
+                          dtype="int64")
     locs, confs, boxes, variances = _head(img, num_classes, image_size)
     loc0 = layers.reshape(layers.slice(locs, [0], [0], [1]), [-1, 4])
     conf0 = layers.reshape(
@@ -62,7 +61,7 @@ def build_ssd_infer(num_classes=4, image_size=64, keep_top_k=20):
     """Inference graph: decode + NMS to a static (N, keep_top_k, 6)
     detection tensor [label, score, x1, y1, x2, y2]."""
     img = fluid.data(name="image", shape=[1, 3, image_size, image_size],
-                     dtype="float32", append_batch_size=False)
+                     dtype="float32")
     locs, confs, boxes, variances = _head(img, num_classes, image_size)
     scores = layers.transpose(layers.softmax(confs), [0, 2, 1])
     decoded = layers.detection.box_coder(

@@ -106,11 +106,11 @@ def _causal_mask(t):
 
 def build_transformer_nmt(cfg, src_len, tgt_len):
     src = fluid.data(name="src_ids", shape=[None, src_len], dtype="int64",
-                     lod_level=1, append_batch_size=False)
+                     lod_level=1)
     tgt = fluid.data(name="tgt_ids", shape=[None, tgt_len], dtype="int64",
-                     lod_level=1, append_batch_size=False)
+                     lod_level=1)
     labels = fluid.data(name="tgt_labels", shape=[None, tgt_len],
-                        dtype="int64", append_batch_size=False)
+                        dtype="int64")
 
     enc = _embed(src, cfg.src_vocab, cfg, "src_emb", src_len)
     for i in range(cfg.enc_layers):

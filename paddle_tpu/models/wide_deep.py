@@ -21,11 +21,11 @@ def build_wide_deep(
     num_dense=13,
     hidden=[400, 400, 400],
 ):
-    dense = fluid.data(name="dense", shape=[num_dense], dtype="float32")
+    dense = fluid.data(name="dense", shape=[None, num_dense], dtype="float32")
     sparse = fluid.data(
-        name="sparse", shape=[num_sparse_fields], dtype="int64"
+        name="sparse", shape=[None, num_sparse_fields], dtype="int64"
     )
-    label = fluid.data(name="ctr_label", shape=[1], dtype="int64")
+    label = fluid.data(name="ctr_label", shape=[None, 1], dtype="int64")
 
     # deep part: shared big embedding, one gather per field
     emb = layers.embedding(

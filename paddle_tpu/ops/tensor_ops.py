@@ -211,7 +211,9 @@ def _scatter_nd_add(ctx, ins, attrs):
 def _one_hot(ctx, ins, attrs):
     x = ins["X"][0]
     depth = attrs["depth"]
-    if x.ndim >= 2 and x.shape[-1] == 1:
+    # v1 convention collapses a trailing (n, 1) ids dim; the v2 API
+    # (fluid.input.one_hot) appends depth to the shape as-is
+    if x.ndim >= 2 and x.shape[-1] == 1 and attrs.get("_squeeze", True):
         x = x[..., 0]
     out = jax.nn.one_hot(x, depth, dtype=jnp.float32)
     return single(out)

@@ -215,8 +215,8 @@ def test_book_image_classification_vgg():
             conv_batchnorm_drop_rate=dropouts,
             pool_type="max")
 
-    images = fluid.data(name="pixel", shape=[3, 16, 16], dtype="float32")
-    label = fluid.data(name="label", shape=[1], dtype="int64")
+    images = fluid.data(name="pixel", shape=[None, 3, 16, 16], dtype="float32")
+    label = fluid.data(name="label", shape=[None, 1], dtype="int64")
     conv1 = conv_block(images, 8, 2, [0.3, 0.0])
     conv2 = conv_block(conv1, 16, 2, [0.4, 0.0])
     drop = fluid.layers.dropout(x=conv2, dropout_prob=0.5)
@@ -248,9 +248,9 @@ def test_book_label_semantic_roles_crf():
 
     feats = ["word_data", "verb_data", "ctx_n2", "ctx_n1", "ctx_0",
              "ctx_p1", "ctx_p2", "mark_data"]
-    ins = {n: fluid.data(name=n, shape=[T], dtype="int64", lod_level=1)
+    ins = {n: fluid.data(name=n, shape=[None, T], dtype="int64", lod_level=1)
            for n in feats}
-    target = fluid.data(name="target", shape=[T], dtype="int64",
+    target = fluid.data(name="target", shape=[None, T], dtype="int64",
                         lod_level=1)
 
     pred_emb = fluid.layers.embedding(

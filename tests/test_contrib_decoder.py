@@ -42,9 +42,9 @@ def _cell_updater(state_cell):
 def test_training_decoder_teacher_forcing_trains():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        src = fluid.data("src_ids", shape=[4], dtype="int64")
-        trg = fluid.data("trg_ids", shape=[5], dtype="int64")
-        lab = fluid.data("lab_ids", shape=[5], dtype="int64")
+        src = fluid.data("src_ids", shape=[None, 4], dtype="int64")
+        trg = fluid.data("trg_ids", shape=[None, 5], dtype="int64")
+        lab = fluid.data("lab_ids", shape=[None, 5], dtype="int64")
         src_emb = layers.embedding(
             src, size=[V, EMB], param_attr=ParamAttr("src_emb"))
         h0 = layers.fc(layers.reduce_mean(src_emb, dim=[1]), D, act="tanh")
@@ -94,9 +94,9 @@ def test_contrib_beam_decoder_matches_layers_decoder():
     beam, max_len = 3, 6
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        enc = fluid.data("enc_h", shape=[D], dtype="float32")
-        init_ids = fluid.data("bs_init_ids", shape=[1], dtype="int64")
-        init_scores = fluid.data("bs_init_scores", shape=[1],
+        enc = fluid.data("enc_h", shape=[None, D], dtype="float32")
+        init_ids = fluid.data("bs_init_ids", shape=[None, 1], dtype="int64")
+        init_scores = fluid.data("bs_init_scores", shape=[None, 1],
                                  dtype="float32")
 
         state_cell = StateCell(
@@ -155,7 +155,7 @@ def test_contrib_beam_decoder_matches_layers_decoder():
 def test_state_cell_validation():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("scv", shape=[D], dtype="float32")
+        x = fluid.data("scv", shape=[None, D], dtype="float32")
         with pytest.raises(ValueError):
             StateCell(inputs={}, states={"h": InitState(init=x)},
                       out_state="nope")
@@ -173,9 +173,9 @@ def test_state_cell_validation():
 def test_contrib_beam_block_raises_with_guidance():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("bsx", shape=[D], dtype="float32")
-        ii = fluid.data("bsi", shape=[1], dtype="int64")
-        sc0 = fluid.data("bss", shape=[1], dtype="float32")
+        x = fluid.data("bsx", shape=[None, D], dtype="float32")
+        ii = fluid.data("bsi", shape=[None, 1], dtype="int64")
+        sc0 = fluid.data("bss", shape=[None, 1], dtype="float32")
         sc = StateCell(inputs={"x": None},
                        states={"h": InitState(init=x)}, out_state="h")
         dec = BeamSearchDecoder(sc, ii, sc0, V, EMB)

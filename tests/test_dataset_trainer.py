@@ -36,9 +36,9 @@ def _ctr_rows(n, seed, vocab=50, ndense=4, nsparse=3):
 def _ctr_program(vocab=50, ndense=4, nsparse=3):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        sparse = fluid.data("sparse", shape=[nsparse], dtype="int64")
-        dense = fluid.data("dense", shape=[ndense], dtype="float32")
-        label = fluid.data("label", shape=[1], dtype="int64")
+        sparse = fluid.data("sparse", shape=[None, nsparse], dtype="int64")
+        dense = fluid.data("dense", shape=[None, ndense], dtype="float32")
+        label = fluid.data("label", shape=[None, 1], dtype="int64")
         emb = fluid.layers.embedding(sparse, size=[vocab, 8])
         feat = fluid.layers.concat(
             [fluid.layers.reshape(emb, [0, nsparse * 8]), dense], axis=1)
@@ -175,8 +175,8 @@ def test_pipe_command_preprocessing(tmp_path):
             f.write("%d %d\n" % (i, i % 2))
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("px", shape=[1], dtype="int64")
-        y = fluid.data("py", shape=[1], dtype="int64")
+        x = fluid.data("px", shape=[None, 1], dtype="int64")
+        y = fluid.data("py", shape=[None, 1], dtype="int64")
     ds = fluid.DatasetFactory().create_dataset("QueueDataset")
     ds.set_batch_size(3)
     ds.set_filelist([fn])
@@ -211,8 +211,8 @@ def test_data_generator_to_dataset(tmp_path):
 
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        ids = fluid.data("gids", shape=[2], dtype="int64")
-        lab = fluid.data("glab", shape=[1], dtype="int64")
+        ids = fluid.data("gids", shape=[None, 2], dtype="int64")
+        lab = fluid.data("glab", shape=[None, 1], dtype="int64")
     ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
     ds.set_filelist([fn])
     ds.set_use_var([ids, lab])

@@ -27,7 +27,7 @@ def test_draw_block_graphviz_bert_renders(tmp_path):
     if not block.ops:  # model builder signature differs: use an MLP
         main = fluid.Program()
         with fluid.program_guard(main, fluid.Program()):
-            x = fluid.data("gx", shape=[8], dtype="float32")
+            x = fluid.data("gx", shape=[None, 8], dtype="float32")
             h = fluid.layers.fc(x, 16, act="relu")
             fluid.layers.fc(h, 4)
         block = main.global_block()
@@ -44,7 +44,7 @@ def test_draw_block_graphviz_bert_renders(tmp_path):
 def test_pprint_program_codes():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("px2", shape=[4], dtype="float32")
+        x = fluid.data("px2", shape=[None, 4], dtype="float32")
         y = fluid.layers.fc(x, 2)
         loss = fluid.layers.reduce_mean(y)
         fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
@@ -58,7 +58,7 @@ def test_pprint_program_codes():
 def test_net_drawer(tmp_path):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.data("nd_x", shape=[4], dtype="float32")
+        x = fluid.data("nd_x", shape=[None, 4], dtype="float32")
         fluid.layers.fc(x, 3)
     path = str(tmp_path / "net.dot")
     g = fluid.net_drawer.draw_graph(startup, main, path=path)
@@ -69,7 +69,7 @@ def test_net_drawer(tmp_path):
 def test_nan_inf_debug_names_offending_op():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("nanx", shape=[3], dtype="float32")
+        x = fluid.data("nanx", shape=[None, 3], dtype="float32")
         h = fluid.layers.log(x)          # negative input -> nan
         out = fluid.layers.reduce_sum(h)
     exe = fluid.Executor(fluid.CPUPlace())

@@ -22,12 +22,11 @@ def test_deformable_conv_zero_offset_matches_conv2d():
     """With zero offsets and unit mask, deformable conv == plain conv."""
     torch = pytest.importorskip("torch")
     n, c, h, w, co, k = 1, 2, 6, 6, 3, 3
-    x = fluid.data(name="x", shape=[n, c, h, w], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[n, c, h, w], dtype="float32")
     off = fluid.data(name="off", shape=[n, 2 * k * k, h, w],
-                     dtype="float32", append_batch_size=False)
+                     dtype="float32")
     mask = fluid.data(name="mask", shape=[n, k * k, h, w],
-                      dtype="float32", append_batch_size=False)
+                      dtype="float32")
     out = fluid.layers.deformable_conv(
         x, off, mask, num_filters=co, filter_size=k, padding=1,
         bias_attr=False,
@@ -57,12 +56,9 @@ def test_deformable_conv_zero_offset_matches_conv2d():
 def test_deformable_conv_integer_offset_shifts():
     """An integer offset of (0, +1) samples one pixel to the right."""
     n, c, h, w, k = 1, 1, 5, 5, 1
-    x = fluid.data(name="x", shape=[n, c, h, w], dtype="float32",
-                   append_batch_size=False)
-    off = fluid.data(name="off", shape=[n, 2, h, w], dtype="float32",
-                     append_batch_size=False)
-    mask = fluid.data(name="mask", shape=[n, 1, h, w], dtype="float32",
-                      append_batch_size=False)
+    x = fluid.data(name="x", shape=[n, c, h, w], dtype="float32")
+    off = fluid.data(name="off", shape=[n, 2, h, w], dtype="float32")
+    mask = fluid.data(name="mask", shape=[n, 1, h, w], dtype="float32")
     out = fluid.layers.deformable_conv(
         x, off, mask, num_filters=1, filter_size=1, padding=0,
         bias_attr=False,
@@ -87,10 +83,8 @@ def test_deformable_conv_integer_offset_shifts():
 def test_psroi_pool_position_sensitive_channels():
     out_c, ph, pw = 2, 2, 2
     c_in = out_c * ph * pw
-    x = fluid.data(name="x", shape=[1, c_in, 8, 8], dtype="float32",
-                   append_batch_size=False)
-    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32",
-                      append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, c_in, 8, 8], dtype="float32")
+    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32")
     out = fluid.layers.psroi_pool(x, rois, out_c, 1.0, ph, pw)
     # each input channel is constant = its channel index
     xv = np.broadcast_to(
@@ -109,10 +103,8 @@ def test_psroi_pool_position_sensitive_channels():
 
 
 def test_prroi_pool_constant_region():
-    x = fluid.data(name="x", shape=[1, 1, 8, 8], dtype="float32",
-                   append_batch_size=False)
-    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32",
-                      append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, 1, 8, 8], dtype="float32")
+    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32")
     out = fluid.layers.prroi_pool(x, rois, pooled_height=2, pooled_width=2)
     xv = np.full((1, 1, 8, 8), 3.0, "float32")
     o = _exe().run(
@@ -128,8 +120,8 @@ class TestDGCMomentum:
         framework.switch_startup_program(framework.Program())
         unique_name.switch()
         fluid.default_startup_program().random_seed = 5
-        x = fluid.data(name="x", shape=[8], dtype="float32")
-        y = fluid.data(name="y", shape=[1], dtype="float32")
+        x = fluid.data(name="x", shape=[None, 8], dtype="float32")
+        y = fluid.data(name="y", shape=[None, 1], dtype="float32")
         pred = fluid.layers.fc(x, size=1)
         loss = fluid.layers.reduce_mean(
             fluid.layers.square_error_cost(pred, y)
@@ -154,8 +146,8 @@ class TestDGCMomentum:
         framework.switch_startup_program(framework.Program())
         unique_name.switch()
         fluid.default_startup_program().random_seed = 5
-        x = fluid.data(name="x", shape=[8], dtype="float32")
-        y = fluid.data(name="y", shape=[1], dtype="float32")
+        x = fluid.data(name="x", shape=[None, 8], dtype="float32")
+        y = fluid.data(name="y", shape=[None, 1], dtype="float32")
         pred = fluid.layers.fc(x, size=1)
         loss = fluid.layers.reduce_mean(
             fluid.layers.square_error_cost(pred, y)

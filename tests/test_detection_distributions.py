@@ -10,10 +10,8 @@ def _exe():
 
 
 def test_iou_similarity_and_box_coder():
-    x = fluid.data(name="x", shape=[None, 4], dtype="float32",
-                   append_batch_size=False)
-    y = fluid.data(name="y", shape=[None, 4], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+    y = fluid.data(name="y", shape=[None, 4], dtype="float32")
     iou = fluid.layers.detection.iou_similarity(x, y)
     exe = _exe()
     bx = np.array([[0, 0, 2, 2]], "float32")
@@ -23,10 +21,8 @@ def test_iou_similarity_and_box_coder():
 
 
 def test_multiclass_nms_static_shape():
-    bboxes = fluid.data(name="bb", shape=[1, 4, 4], dtype="float32",
-                        append_batch_size=False)
-    scores = fluid.data(name="sc", shape=[1, 2, 4], dtype="float32",
-                        append_batch_size=False)
+    bboxes = fluid.data(name="bb", shape=[1, 4, 4], dtype="float32")
+    scores = fluid.data(name="sc", shape=[1, 2, 4], dtype="float32")
     out = fluid.layers.detection.multiclass_nms(
         bboxes, scores, score_threshold=0.1, nms_top_k=4, keep_top_k=3,
         nms_threshold=0.5, background_label=0,
@@ -78,7 +74,7 @@ def test_categorical_log_prob():
 
 
 def test_transpiler_api_compat():
-    x = fluid.data(name="x", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
     y = fluid.layers.fc(x, 3)
     loss = fluid.layers.mean(y)
     fluid.optimizer.SGD(0.1).minimize(loss)
@@ -109,16 +105,11 @@ def test_mvn_diag_entropy_matches_reference_formula():
 
 
 def test_ssd_loss_uses_labels():
-    loc = fluid.data(name="loc", shape=[4, 4], dtype="float32",
-                     append_batch_size=False)
-    conf = fluid.data(name="conf", shape=[4, 3], dtype="float32",
-                      append_batch_size=False)
-    gtb = fluid.data(name="gtb", shape=[1, 4], dtype="float32",
-                     append_batch_size=False)
-    gtl = fluid.data(name="gtl", shape=[1, 1], dtype="int64",
-                     append_batch_size=False)
-    pb = fluid.data(name="pb", shape=[4, 4], dtype="float32",
-                    append_batch_size=False)
+    loc = fluid.data(name="loc", shape=[4, 4], dtype="float32")
+    conf = fluid.data(name="conf", shape=[4, 3], dtype="float32")
+    gtb = fluid.data(name="gtb", shape=[1, 4], dtype="float32")
+    gtl = fluid.data(name="gtl", shape=[1, 1], dtype="int64")
+    pb = fluid.data(name="pb", shape=[4, 4], dtype="float32")
     loss = fluid.layers.ssd_loss(loc, conf, gtb, gtl, pb)
     exe = _exe()
     feed = {
@@ -138,12 +129,9 @@ def test_ssd_loss_uses_labels():
 
 
 def test_yolov3_loss_runs():
-    x = fluid.data(name="yx", shape=[1, 3 * 7, 4, 4], dtype="float32",
-                   append_batch_size=False)
-    gtb = fluid.data(name="ygb", shape=[1, 2, 4], dtype="float32",
-                     append_batch_size=False)
-    gtl = fluid.data(name="ygl", shape=[1, 2], dtype="int64",
-                     append_batch_size=False)
+    x = fluid.data(name="yx", shape=[1, 3 * 7, 4, 4], dtype="float32")
+    gtb = fluid.data(name="ygb", shape=[1, 2, 4], dtype="float32")
+    gtl = fluid.data(name="ygl", shape=[1, 2], dtype="int64")
     loss = fluid.layers.yolov3_loss(
         x, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
         anchor_mask=[0, 1, 2], class_num=2, ignore_thresh=0.7,
@@ -186,11 +174,9 @@ def test_multiclass_nms_adaptive_eta():
         framework.switch_main_program(framework.Program())
         framework.switch_startup_program(framework.Program())
         unique_name.switch()
-        b = fluid.data(name="b", shape=[3, 4], dtype="float32",
-                       append_batch_size=False)
+        b = fluid.data(name="b", shape=[3, 4], dtype="float32")
         b.shape = (1, 3, 4)
-        s = fluid.data(name="s", shape=[2, 3], dtype="float32",
-                       append_batch_size=False)
+        s = fluid.data(name="s", shape=[2, 3], dtype="float32")
         s.shape = (1, 2, 3)
         out = fluid.layers.detection.multiclass_nms(
             b, s, score_threshold=0.1, nms_top_k=3, keep_top_k=3,

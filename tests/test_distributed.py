@@ -19,8 +19,8 @@ def _train_once(dist=None, batch=8, seed=3):
     """Tiny MLP classifier one SGD step; returns (loss0, w_after)."""
     fluid.default_main_program().random_seed = 11
     fluid.default_startup_program().random_seed = 11
-    x = fluid.data("x", [16], dtype="float32")
-    y = fluid.data("y", [1], dtype="int64")
+    x = fluid.data("x", [None, 16], dtype="float32")
+    y = fluid.data("y", [None, 1], dtype="int64")
     h = fluid.layers.fc(
         x, size=32, act="relu",
         param_attr=fluid.ParamAttr(
@@ -98,7 +98,7 @@ def test_tp_sharded_matmul_matches_replicated():
     rng = np.random.default_rng(0)
     x_np = rng.standard_normal((4, 16)).astype("float32")
 
-    x = fluid.data("x", [16], dtype="float32")
+    x = fluid.data("x", [None, 16], dtype="float32")
     y = fluid.layers.fc(
         x, size=32,
         param_attr=fluid.ParamAttr(
@@ -133,7 +133,7 @@ def test_collective_layer_ops_single_rank_identity():
     """World-size-1 execution: collective layers behave as identity."""
     from paddle_tpu.fluid.layers import collective as coll
 
-    x = fluid.data("x", [4], append_batch_size=False, dtype="float32")
+    x = fluid.data("x", [4], dtype="float32")
     y = coll._c_allreduce(x, reduce_type="sum")
     z = coll._c_broadcast(x, root=0)
     exe = fluid.Executor()
@@ -164,7 +164,7 @@ def test_ring_attention_matches_full(causal):
 
 
 def test_compiled_program_with_data_parallel():
-    x = fluid.data("x", [16], dtype="float32")
+    x = fluid.data("x", [None, 16], dtype="float32")
     y = fluid.layers.fc(
         x, size=2,
         param_attr=fluid.ParamAttr(
@@ -185,7 +185,7 @@ def test_fleet_distributed_optimizer_runs():
     from paddle_tpu.parallel import fleet
 
     fleet.init(is_collective=True)
-    x = fluid.data("x", [8], dtype="float32")
+    x = fluid.data("x", [None, 8], dtype="float32")
     y = fluid.layers.fc(x, size=2)
     loss = fluid.layers.reduce_mean(y)
     opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1))
@@ -204,7 +204,7 @@ def test_fleet_zero_shards_optimizer_state():
     from paddle_tpu.parallel import fleet
 
     fleet.init(is_collective=True)
-    x = fluid.data("zx", [16], dtype="float32")
+    x = fluid.data("zx", [None, 16], dtype="float32")
     y = fluid.layers.fc(x, size=8)
     loss = fluid.layers.reduce_mean(y)
     strategy = fleet.DistributedStrategy()
@@ -279,12 +279,9 @@ def test_fused_attention_rides_ring_under_sp_mesh():
         fw.switch_main_program(fw.Program())
         fw.switch_startup_program(fw.Program())
         unique_name.switch()
-        q = fluid.data("aq", [b, hds, t, d], dtype="float32",
-                       append_batch_size=False)
-        k = fluid.data("ak", [b, hds, t, d], dtype="float32",
-                       append_batch_size=False)
-        v = fluid.data("av", [b, hds, t, d], dtype="float32",
-                       append_batch_size=False)
+        q = fluid.data("aq", [b, hds, t, d], dtype="float32")
+        k = fluid.data("ak", [b, hds, t, d], dtype="float32")
+        v = fluid.data("av", [b, hds, t, d], dtype="float32")
         out = fluid.layers.fused_multihead_attention(q, k, v, causal=True)
         return out
 

@@ -115,7 +115,7 @@ def test_static_vs_dygraph_same_numbers():
     x = np.random.default_rng(2).standard_normal((4, 6)).astype("float32")
 
     # static
-    xin = fluid.data(name="x", shape=[6], dtype="float32")
+    xin = fluid.data(name="x", shape=[None, 6], dtype="float32")
     from paddle_tpu.fluid.initializer import NumpyArrayInitializer
     from paddle_tpu.fluid.param_attr import ParamAttr
 

@@ -21,8 +21,7 @@ def _exe():
 def test_dynamic_rnn_masked_accumulator():
     """A DynamicRNN summing its inputs must freeze finished sequences."""
     b, t, d = 3, 4, 2
-    x = fluid.data(name="x", shape=[b, t, d], dtype="float32",
-                   append_batch_size=False, lod_level=1)
+    x = fluid.data(name="x", shape=[b, t, d], dtype="float32", lod_level=1)
     drnn = fluid.layers.DynamicRNN()
     with drnn.block():
         xt = drnn.step_input(x)
@@ -50,10 +49,8 @@ def test_dynamic_rnn_masked_accumulator():
 def test_dynamic_rnn_with_fc_and_training():
     """DynamicRNN with parameters trains end-to-end (seq2seq-style use)."""
     b, t, d, h = 4, 5, 3, 6
-    x = fluid.data(name="x", shape=[b, t, d], dtype="float32",
-                   append_batch_size=False, lod_level=1)
-    y = fluid.data(name="y", shape=[b, h], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[b, t, d], dtype="float32", lod_level=1)
+    y = fluid.data(name="y", shape=[b, h], dtype="float32")
     drnn = fluid.layers.DynamicRNN()
     with drnn.block():
         xt = drnn.step_input(x)
@@ -83,7 +80,7 @@ def test_dynamic_rnn_with_fc_and_training():
 def test_dynamic_rnn_dynamic_batch_memory():
     """shape-only memory must work when the batch dim is dynamic (-1)."""
     t, d = 3, 2
-    x = fluid.data(name="x", shape=[t, d], dtype="float32", lod_level=1)
+    x = fluid.data(name="x", shape=[None, t, d], dtype="float32", lod_level=1)
     # append_batch_size=True -> shape (-1, t, d)
     drnn = fluid.layers.DynamicRNN()
     with drnn.block():
@@ -102,10 +99,8 @@ def test_dynamic_rnn_dynamic_batch_memory():
 
 
 def test_gather_tree_oracle():
-    ids = fluid.data(name="ids", shape=[3, 1, 2], dtype="int64",
-                     append_batch_size=False)
-    par = fluid.data(name="par", shape=[3, 1, 2], dtype="int64",
-                     append_batch_size=False)
+    ids = fluid.data(name="ids", shape=[3, 1, 2], dtype="int64")
+    par = fluid.data(name="par", shape=[3, 1, 2], dtype="int64")
     out = fluid.layers.gather_tree(ids, par)
     ids_np = np.array(
         [[[2, 5]], [[3, 1]], [[7, 4]]], "int64"
@@ -129,8 +124,7 @@ def test_gather_tree_oracle():
 
 
 def test_lod_reset_and_append_swap_lengths():
-    x = fluid.data(name="x", shape=[3, 4, 2], dtype="float32",
-                   append_batch_size=False, lod_level=1)
+    x = fluid.data(name="x", shape=[3, 4, 2], dtype="float32", lod_level=1)
     out = fluid.layers.lod_reset(x, target_lod=[1, 2, 3])
     pooled = fluid.layers.sequence_pool(out, "sum")
     out2 = fluid.layers.lod_append(x, [4, 4, 4])
@@ -180,8 +174,7 @@ def test_py_reader_epoch_loop():
 
 
 def test_create_py_reader_by_data_and_double_buffer():
-    x = fluid.data(name="px", shape=[2, 2], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="px", shape=[2, 2], dtype="float32")
     reader = fluid.layers.create_py_reader_by_data(
         capacity=2, feed_list=[x], name="r2",
     )
@@ -202,8 +195,7 @@ def test_create_py_reader_by_data_and_double_buffer():
 def test_py_reader_reset_mid_epoch_no_stale_batches():
     """reset() mid-epoch + start() must begin a clean epoch (no leftover
     batches or sentinels from the abandoned producer thread)."""
-    x = fluid.data(name="mx", shape=[1], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="mx", shape=[1], dtype="float32")
     reader = fluid.layers.create_py_reader_by_data(
         capacity=1, feed_list=[x], name="r3",
     )
@@ -227,8 +219,7 @@ def test_py_reader_reset_mid_epoch_no_stale_batches():
 
 
 def test_py_reader_producer_error_surfaces():
-    x = fluid.data(name="ex", shape=[1], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="ex", shape=[1], dtype="float32")
     reader = fluid.layers.create_py_reader_by_data(
         capacity=2, feed_list=[x], name="r4",
     )
@@ -249,8 +240,7 @@ def test_py_reader_producer_error_surfaces():
 
 
 def test_py_reader_survives_program_clone():
-    x = fluid.data(name="cx", shape=[1], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="cx", shape=[1], dtype="float32")
     reader = fluid.layers.create_py_reader_by_data(
         capacity=2, feed_list=[x], name="r5",
     )
@@ -272,8 +262,7 @@ def test_layers_load_round_trip(tmp_path):
 
     p = str(tmp_path / "w.npy")
     np.save(p, np.full((2, 2), 3.0, "float32"))
-    x = fluid.data(name="lx", shape=[2, 2], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="lx", shape=[2, 2], dtype="float32")
     w = fluid.layers.create_parameter([2, 2], "float32", name="loaded_w")
     out = fluid.layers.elementwise_add(x, w)
     exe = _exe()

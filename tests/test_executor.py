@@ -7,7 +7,7 @@ from paddle_tpu.fluid.executor import Scope, global_scope, scope_guard
 
 
 def _simple_net():
-    x = fluid.data("x", [4], dtype="float32")
+    x = fluid.data("x", [None, 4], dtype="float32")
     y = fluid.layers.fc(
         x, size=2,
         param_attr=fluid.ParamAttr(
@@ -48,7 +48,7 @@ def test_startup_initializes_scope_params():
 
 
 def test_param_updates_persist_across_runs():
-    x = fluid.data("x", [4], dtype="float32")
+    x = fluid.data("x", [None, 4], dtype="float32")
     y = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w2"))
     loss = fluid.layers.reduce_mean(y)
     fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
@@ -103,7 +103,7 @@ def test_run_specific_program():
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.data("x", [2], dtype="float32")
+        x = fluid.data("x", [None, 2], dtype="float32")
         y = fluid.layers.scale(x, scale=10.0)
     exe = fluid.Executor()
     exe.run(startup)
@@ -143,8 +143,7 @@ def test_executor_cache_lru_bound(monkeypatch):
     framework.switch_startup_program(framework.Program())
     unique_name.switch()
     monkeypatch.setenv("PADDLE_TPU_EXECUTOR_CACHE_CAP", "2")
-    x = fluid.data(name="cx", shape=[None, 4], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="cx", shape=[None, 4], dtype="float32")
     out = fluid.layers.scale(x, scale=2.0)
     exe = fluid.Executor(fluid.CPUPlace())
     for batch in (1, 2, 3, 4):

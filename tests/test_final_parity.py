@@ -22,8 +22,7 @@ def _exe():
 
 def test_similarity_focus_reference_example():
     """The documented example from the reference docstring."""
-    x = fluid.data(name="x", shape=[2, 3, 2, 2], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[2, 3, 2, 2], dtype="float32")
     out = fluid.layers.similarity_focus(x, axis=1, indexes=[0])
     xv = np.array(
         [[[[0.8, 0.1], [0.4, 0.5]],
@@ -43,8 +42,7 @@ def test_similarity_focus_reference_example():
 
 
 def test_selected_rows_compat_identity():
-    x = fluid.data(name="x", shape=[4, 3], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[4, 3], dtype="float32")
     m = fluid.layers.merge_selected_rows(x)
     t = fluid.layers.get_tensor_from_selected_rows(m)
     xv = np.random.RandomState(0).rand(4, 3).astype("float32")
@@ -55,12 +53,9 @@ def test_selected_rows_compat_identity():
 def test_deformable_roi_pooling_zero_trans_matches_avg():
     """Zero offsets + non-position-sensitive == plain average pooling of
     the roi bins."""
-    x = fluid.data(name="x", shape=[1, 2, 8, 8], dtype="float32",
-                   append_batch_size=False)
-    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32",
-                      append_batch_size=False)
-    trans = fluid.data(name="trans", shape=[1, 2, 2, 2], dtype="float32",
-                       append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, 2, 8, 8], dtype="float32")
+    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32")
+    trans = fluid.data(name="trans", shape=[1, 2, 2, 2], dtype="float32")
     out = fluid.layers.deformable_roi_pooling(
         x, rois, trans, pooled_height=2, pooled_width=2,
         sample_per_part=4, position_sensitive=False,
@@ -78,12 +73,9 @@ def test_deformable_roi_pooling_zero_trans_matches_avg():
 def test_deformable_roi_pooling_position_sensitive():
     out_c, gh, gw = 2, 2, 2
     c_in = out_c * gh * gw
-    x = fluid.data(name="x", shape=[1, c_in, 8, 8], dtype="float32",
-                   append_batch_size=False)
-    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32",
-                      append_batch_size=False)
-    trans = fluid.data(name="trans", shape=[1, 2, 2, 2], dtype="float32",
-                       append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, c_in, 8, 8], dtype="float32")
+    rois = fluid.data(name="rois", shape=[1, 4], dtype="float32")
+    trans = fluid.data(name="trans", shape=[1, 2, 2, 2], dtype="float32")
     out = fluid.layers.deformable_roi_pooling(
         x, rois, trans, pooled_height=2, pooled_width=2,
         group_size=[gh, gw], sample_per_part=2, position_sensitive=True,
@@ -105,8 +97,7 @@ def test_deformable_roi_pooling_position_sensitive():
 
 
 def test_image_resize_short():
-    x = fluid.data(name="x", shape=[1, 3, 32, 48], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, 3, 32, 48], dtype="float32")
     out = fluid.layers.image_resize_short(x, 16)
     xv = np.random.RandomState(1).rand(1, 3, 32, 48).astype("float32")
     o = _exe().run(feed={"x": xv}, fetch_list=[out])[0]
@@ -114,10 +105,8 @@ def test_image_resize_short():
 
 
 def test_tensor_array_to_tensor():
-    x = fluid.data(name="x", shape=[2, 3], dtype="float32",
-                   append_batch_size=False)
-    y = fluid.data(name="y", shape=[2, 5], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[2, 3], dtype="float32")
+    y = fluid.data(name="y", shape=[2, 5], dtype="float32")
     arr = fluid.layers.create_array("float32")
     fluid.layers.array_write(x, 0, arr)
     fluid.layers.array_write(y, 1, arr)
@@ -144,8 +133,8 @@ def test_tensor_array_to_tensor():
 def test_contrib_stats_and_adamw():
     """contrib: memory_usage / op_freq / summary introspection, and
     decoupled weight decay (AdamW) shrinking weights vs plain Adam."""
-    x = fluid.data(name="x", shape=[8], dtype="float32")
-    y = fluid.data(name="y", shape=[1], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 8], dtype="float32")
+    y = fluid.data(name="y", shape=[None, 1], dtype="float32")
     pred = fluid.layers.fc(x, 1, bias_attr=False,
                            param_attr=fluid.ParamAttr(name="aw_w"))
     loss = fluid.layers.reduce_mean(

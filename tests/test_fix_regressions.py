@@ -10,7 +10,7 @@ from paddle_tpu.fluid import layers
 def test_prune_keeps_params_used_inside_while_body():
     i = layers.fill_constant([1], "float32", 0.0)
     n = layers.fill_constant([1], "float32", 3.0)
-    x = fluid.data("x", [4], dtype="float32")
+    x = fluid.data("x", [None, 4], dtype="float32")
     acc = layers.fill_constant_batch_size_like(x, [-1, 4], "float32", 0.0)
 
     def body(it, a):
@@ -35,7 +35,7 @@ def test_prune_keeps_params_used_inside_while_body():
 def test_prune_keeps_producer_of_var_read_only_in_sub_block():
     """A var produced OUTSIDE the loop but read only INSIDE the body must
     keep its producing op through _prune."""
-    x = fluid.data("x", [4], dtype="float32")
+    x = fluid.data("x", [None, 4], dtype="float32")
     bias = layers.scale(x, scale=3.0)  # producer outside the loop
     i = layers.fill_constant([1], "float32", 0.0)
     n = layers.fill_constant([1], "float32", 2.0)
@@ -74,8 +74,8 @@ def test_sharding_rule_annotation_is_exact_match():
 
 
 def test_density_prior_box_subgrid_offsets():
-    feat = fluid.data("feat", [1, 8, 2, 2], append_batch_size=False)
-    img = fluid.data("img", [1, 3, 64, 64], append_batch_size=False)
+    feat = fluid.data("feat", [1, 8, 2, 2])
+    img = fluid.data("img", [1, 3, 64, 64])
     box, var = layers.density_prior_box(
         feat, img, densities=[2], fixed_sizes=[16.0], fixed_ratios=[1.0])
     exe = fluid.Executor()
@@ -99,8 +99,8 @@ def test_multiclass_nms_respects_nms_top_k():
     # two far-apart boxes, same class, both above threshold
     boxes = np.array([[[0, 0, 10, 10], [50, 50, 60, 60]]], "float32")
     scores = np.array([[[0.0, 0.0], [0.9, 0.8]]], "float32")  # class1 scores
-    b = fluid.data("b", [1, 2, 4], append_batch_size=False)
-    s = fluid.data("s", [1, 2, 2], append_batch_size=False)
+    b = fluid.data("b", [1, 2, 4])
+    s = fluid.data("s", [1, 2, 2])
     out = layers.multiclass_nms(b, s, score_threshold=0.1, nms_top_k=1,
                                 keep_top_k=5, background_label=0)
     exe = fluid.Executor()
@@ -112,8 +112,8 @@ def test_multiclass_nms_respects_nms_top_k():
 
 
 def test_box_clip_preserves_2d_rank():
-    b = fluid.data("b", [5, 4], append_batch_size=False)
-    info = fluid.data("im", [1, 3], append_batch_size=False)
+    b = fluid.data("b", [5, 4])
+    info = fluid.data("im", [1, 3])
     out = layers.box_clip(b, info)
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())
@@ -166,7 +166,7 @@ def test_prune_keeps_cond_branch_params():
     unique_name.switch()
     fluid.default_startup_program().random_seed = 2
 
-    x = fluid.data(name="x", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
     pred = layers.greater_than(
         layers.reduce_sum(x), layers.fill_constant([1], "float32", 0.0)
     )
@@ -201,7 +201,7 @@ def test_dropout_rbg_mask_consistent_between_fwd_and_grad():
     startup = fluid.Program()
     prog.random_seed = 5
     with fluid.program_guard(prog, startup):
-        x = fluid.data("drx", (64,), "float32")
+        x = fluid.data("drx", (None, 64,), "float32")
         y = fluid.layers.dropout(
             x, dropout_prob=0.5, dropout_implementation="upscale_in_train")
         loss = fluid.layers.reduce_mean(y)

@@ -43,7 +43,7 @@ def test_operator_io_and_attrs():
 
 
 def test_layer_records_ops_in_default_program():
-    x = fluid.data("x", [4], dtype="float32")
+    x = fluid.data("x", [None, 4], dtype="float32")
     y = fluid.layers.fc(x, size=3)
     prog = fluid.default_main_program()
     op_types = [op.type for op in prog.global_block().ops]
@@ -55,7 +55,7 @@ def test_layer_records_ops_in_default_program():
 
 
 def test_program_clone_for_test_disables_dropout_randomness():
-    x = fluid.data("x", [8], dtype="float32")
+    x = fluid.data("x", [None, 8], dtype="float32")
     h = fluid.layers.fc(x, size=8)
     h = fluid.layers.dropout(h, dropout_prob=0.5)
     loss = fluid.layers.reduce_mean(h)
@@ -77,7 +77,7 @@ def test_program_clone_for_test_disables_dropout_randomness():
 
 
 def test_prune_keeps_only_needed_ops():
-    x = fluid.data("x", [4], dtype="float32")
+    x = fluid.data("x", [None, 4], dtype="float32")
     h = fluid.layers.fc(x, size=4, name="keepme")
     unused = fluid.layers.fc(x, size=9, name="dropme")
     pruned = fluid.default_main_program()._prune([h])
@@ -87,7 +87,7 @@ def test_prune_keeps_only_needed_ops():
 
 
 def test_program_json_roundtrip():
-    x = fluid.data("x", [4], dtype="float32")
+    x = fluid.data("x", [None, 4], dtype="float32")
     h = fluid.layers.fc(x, size=3)
     fluid.layers.softmax(h)
     prog = fluid.default_main_program()
@@ -127,8 +127,8 @@ def test_grad_var_name():
 
 
 def test_variable_stop_gradient_blocks_grad():
-    x = fluid.data("x", [3], append_batch_size=False, dtype="float32",
-                   stop_gradient=False)
+    x = fluid.layers.data("x", [3], append_batch_size=False,
+                          dtype="float32", stop_gradient=False)
     frozen = fluid.layers.fc(x, size=3,
                              param_attr=fluid.ParamAttr(trainable=False),
                              bias_attr=fluid.ParamAttr(trainable=False))

@@ -21,8 +21,7 @@ def _exe():
 def test_gradients_wrt_intermediate_matches_manual():
     """d loss/d h for h = x*w (intermediate), loss = sum(h^2):
     grad must be 2h, evaluated at the actual forward value."""
-    x = fluid.data(name="x", shape=[3], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[3], dtype="float32")
     w = fluid.layers.create_parameter([3], "float32", name="gw")
     h = fluid.layers.elementwise_mul(x, w)          # intermediate
     loss = fluid.layers.reduce_sum(fluid.layers.square(h))
@@ -37,8 +36,7 @@ def test_gradients_wrt_intermediate_matches_manual():
 def test_gradients_gan_style_training():
     """Classic GAN pattern: generator grads flow through d(D(fake))/d fake
     computed w.r.t. the intermediate fake tensor."""
-    z = fluid.data(name="z", shape=[4, 8], dtype="float32",
-                   append_batch_size=False)
+    z = fluid.data(name="z", shape=[4, 8], dtype="float32")
     fake = fluid.layers.fc(z, size=16, act="tanh",
                            param_attr=fluid.ParamAttr(name="gen_w"))
     d_out = fluid.layers.fc(fake, size=1,
@@ -64,8 +62,7 @@ def test_gradients_gan_style_training():
 def test_gradients_of_gradients():
     """Second-order: d/dg sum(g^2) where g = d loss/d h (regression for
     the probe skipping backward-op outputs)."""
-    x = fluid.data(name="x", shape=[3], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[3], dtype="float32")
     w = fluid.layers.create_parameter([3], "float32", name="ggw")
     h = fluid.layers.elementwise_mul(x, w)
     loss = fluid.layers.reduce_sum(fluid.layers.square(h))
@@ -87,8 +84,7 @@ def test_py_func_forward_and_custom_backward():
     def backward(a, out, dout):
         return dout * (1.0 - out * out)     # d tanh
 
-    x = fluid.data(name="x", shape=[2, 3], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[2, 3], dtype="float32")
     out_var = fluid.default_main_program().current_block().create_var(
         name="pyf_out", dtype="float32", shape=(2, 3),
     )
@@ -106,10 +102,8 @@ def test_py_func_multi_io_no_backward():
     def forward(a, b):
         return a + b, a * b
 
-    x = fluid.data(name="x", shape=[4], dtype="float32",
-                   append_batch_size=False)
-    y = fluid.data(name="y", shape=[4], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[4], dtype="float32")
+    y = fluid.data(name="y", shape=[4], dtype="float32")
     blk = fluid.default_main_program().current_block()
     o1 = blk.create_var(name="s_out", dtype="float32", shape=(4,))
     o2 = blk.create_var(name="p_out", dtype="float32", shape=(4,))
@@ -124,8 +118,7 @@ def test_py_func_multi_io_no_backward():
 
 def test_py_func_in_training_graph():
     """py_func with a custom grad participates in a real optimizer step."""
-    x = fluid.data(name="x", shape=[4, 2], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[4, 2], dtype="float32")
     h = fluid.layers.fc(x, size=2)
     blk = fluid.default_main_program().current_block()
     sq = blk.create_var(name="sq_out", dtype="float32", shape=(4, 2))
@@ -147,8 +140,7 @@ def test_recompute_with_intermediate_gradients():
     """jax.checkpoint segments (RecomputeOptimizer) and intermediate-
     target probes (fluid.gradients) compose: grads stay correct with
     remat boundaries crossing the probed op."""
-    x = fluid.data(name="x", shape=[4, 8], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[4, 8], dtype="float32")
     h1 = fluid.layers.fc(x, size=8, act="relu",
                          param_attr=fluid.ParamAttr(name="rc_w1"))
     h2 = fluid.layers.fc(h1, size=8, act="relu",

@@ -20,7 +20,7 @@ def fresh_programs():
 
 
 def _build_and_save(tmpdir):
-    x = fluid.data(name="x", shape=[6], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 6], dtype="float32")
     h = layers.fc(x, size=12, act="relu")
     out = layers.fc(h, size=3, act="softmax")
     exe = fluid.Executor(fluid.CPUPlace())
@@ -64,7 +64,7 @@ def test_analysis_config_predictor_path(tmp_path):
     framework.switch_startup_program(framework.Program())
     unique_name.switch()
     fluid.default_startup_program().random_seed = 11
-    x = fluid.data(name="acx", shape=[4], dtype="float32")
+    x = fluid.data(name="acx", shape=[None, 4], dtype="float32")
     y = fluid.layers.fc(x, 2)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
@@ -91,8 +91,8 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
     prog, startup = fluid.Program(), fluid.Program()
     prog.random_seed = startup.random_seed = 3
     with fluid.program_guard(prog, startup):
-        x = fluid.data("ox", (4,), "float32")
-        y = fluid.data("oy", (1,), "float32")
+        x = fluid.data("ox", (None, 4,), "float32")
+        y = fluid.data("oy", (None, 1,), "float32")
         p = fluid.layers.fc(x, 8, act="relu")
         loss = fluid.layers.reduce_mean(
             fluid.layers.square_error_cost(fluid.layers.fc(p, 1), y))

@@ -7,8 +7,8 @@ import paddle_tpu.fluid as fluid
 
 
 def _build_mlp():
-    img = fluid.data(name="img", shape=[784], dtype="float32")
-    label = fluid.data(name="label", shape=[1], dtype="int64")
+    img = fluid.data(name="img", shape=[None, 784], dtype="float32")
+    label = fluid.data(name="label", shape=[None, 1], dtype="int64")
     h1 = fluid.layers.fc(input=img, size=64, act="relu")
     h2 = fluid.layers.fc(input=h1, size=64, act="relu")
     logits = fluid.layers.fc(input=h2, size=10)
@@ -60,7 +60,7 @@ def test_mnist_mlp_trains():
 def test_executor_cache_and_state_persistence():
     startup = fluid.default_startup_program()
     startup.random_seed = 1
-    x = fluid.data(name="x", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
     y = fluid.layers.fc(input=x, size=3)
     loss = fluid.layers.mean(y)
     opt = fluid.optimizer.SGD(learning_rate=0.5)

@@ -27,8 +27,8 @@ WORKER = textwrap.dedent("""
     # every process must hold identical initial params (global dp mesh)
     fluid.default_startup_program().random_seed = 7
     fluid.default_main_program().random_seed = 7
-    x = fluid.data("x", (4,), "float32")
-    y = fluid.data("y", (1,), "float32")
+    x = fluid.data("x", (None, 4,), "float32")
+    y = fluid.data("y", (None, 1,), "float32")
     p = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 1)
     loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
     fluid.optimizer.SGD(0.1).minimize(loss)

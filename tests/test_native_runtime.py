@@ -75,8 +75,8 @@ def test_dataloader_uses_native_pipe_and_trains():
     unique_name.switch()
     fluid.default_startup_program().random_seed = 4
 
-    x = fluid.data(name="dl_x", shape=[4], dtype="float32")
-    y = fluid.data(name="dl_y", shape=[1], dtype="float32")
+    x = fluid.data(name="dl_x", shape=[None, 4], dtype="float32")
+    y = fluid.data(name="dl_y", shape=[None, 1], dtype="float32")
     loss = layers.mean(
         layers.square_error_cost(layers.fc(x, 1), y)
     )
@@ -114,8 +114,8 @@ def test_evaluator_shim_legacy_flow():
     unique_name.switch()
     fluid.default_startup_program().random_seed = 4
 
-    x = fluid.data(name="ev_x", shape=[4], dtype="float32")
-    y = fluid.data(name="ev_y", shape=[1], dtype="int64")
+    x = fluid.data(name="ev_x", shape=[None, 4], dtype="float32")
+    y = fluid.data(name="ev_y", shape=[None, 1], dtype="int64")
     pred = layers.fc(x, 3, act="softmax")
     with warnings.catch_warnings():
         warnings.simplefilter("ignore", DeprecationWarning)

@@ -14,8 +14,8 @@ from paddle_tpu.fluid.backward import gradients
 
 def run_fwd_grad(build, x_np):
     """Build y = build(x) on a fed var, return (y, dsum(y)/dx)."""
-    x = fluid.data(name="x", shape=list(x_np.shape), append_batch_size=False,
-                   dtype=str(x_np.dtype), stop_gradient=False)
+    x = fluid.layers.data(name="x", shape=list(x_np.shape),
+                   dtype=str(x_np.dtype), stop_gradient=False, append_batch_size=False)
     y = build(x)
     loss = fluid.layers.reduce_sum(y)
     (gx,) = gradients(loss, [x])
@@ -98,10 +98,10 @@ def test_unary_fwd_grad(name, build, oracle, x):
 def test_matmul_fwd_grad():
     a_np = RNG.standard_normal((3, 4)).astype("float32")
     b_np = RNG.standard_normal((4, 5)).astype("float32")
-    a = fluid.data("a", [3, 4], append_batch_size=False,
-                   stop_gradient=False)
-    b = fluid.data("b", [4, 5], append_batch_size=False,
-                   stop_gradient=False)
+    a = fluid.layers.data("a", [3, 4],
+                   stop_gradient=False, append_batch_size=False)
+    b = fluid.layers.data("b", [4, 5],
+                   stop_gradient=False, append_batch_size=False)
     y = fluid.layers.matmul(a, b)
     loss = fluid.layers.reduce_sum(y)
     ga, gb = gradients(loss, [a, b])
@@ -123,8 +123,8 @@ def test_matmul_fwd_grad():
 
 def test_conv2d_fwd_vs_torch():
     x_np = RNG.standard_normal((2, 3, 8, 8)).astype("float32")
-    x = fluid.data("x", [2, 3, 8, 8], append_batch_size=False,
-                   stop_gradient=False)
+    x = fluid.layers.data("x", [2, 3, 8, 8],
+                   stop_gradient=False, append_batch_size=False)
     y = fluid.layers.conv2d(
         x, num_filters=5, filter_size=3, padding=1, stride=1,
         param_attr=fluid.ParamAttr(
@@ -205,10 +205,10 @@ def test_batch_norm_train_vs_torch():
 def test_softmax_with_cross_entropy_vs_torch():
     logits_np = RNG.standard_normal((6, 10)).astype("float32")
     labels_np = RNG.integers(0, 10, size=(6, 1)).astype("int64")
-    logits = fluid.data("logits", [6, 10], append_batch_size=False,
-                        stop_gradient=False)
-    labels = fluid.data("labels", [6, 1], append_batch_size=False,
-                        dtype="int64")
+    logits = fluid.layers.data("logits", [6, 10],
+                        stop_gradient=False, append_batch_size=False)
+    labels = fluid.layers.data("labels", [6, 1],
+                        dtype="int64", append_batch_size=False)
     loss = fluid.layers.softmax_with_cross_entropy(logits, labels)
     total = fluid.layers.reduce_sum(loss)
     (g,) = gradients(total, [logits])
@@ -228,7 +228,7 @@ def test_softmax_with_cross_entropy_vs_torch():
 
 def test_embedding_grad_is_scatter():
     ids_np = np.array([[0], [2], [0]], dtype="int64")
-    ids = fluid.data("ids", [3, 1], append_batch_size=False, dtype="int64")
+    ids = fluid.layers.data("ids", [3, 1], dtype="int64", append_batch_size=False)
     emb = fluid.layers.embedding(
         ids, size=(4, 3),
         param_attr=fluid.ParamAttr(
@@ -248,10 +248,10 @@ def test_embedding_grad_is_scatter():
 def test_elementwise_broadcast_fwd_grad():
     a_np = RNG.standard_normal((2, 3, 4)).astype("float32")
     b_np = RNG.standard_normal((3, 4)).astype("float32")
-    a = fluid.data("a", [2, 3, 4], append_batch_size=False,
-                   stop_gradient=False)
-    b = fluid.data("b", [3, 4], append_batch_size=False,
-                   stop_gradient=False)
+    a = fluid.layers.data("a", [2, 3, 4],
+                   stop_gradient=False, append_batch_size=False)
+    b = fluid.layers.data("b", [3, 4],
+                   stop_gradient=False, append_batch_size=False)
     y = fluid.layers.elementwise_mul(a, b)
     loss = fluid.layers.reduce_sum(y)
     ga, gb = gradients(loss, [a, b])
@@ -274,8 +274,8 @@ def test_conv2d_grads_vs_torch():
     x_np = rng.standard_normal((2, 3, 8, 8)).astype("float32")
     w_np = rng.standard_normal((4, 3, 3, 3)).astype("float32")
 
-    x = fluid.data(name="cx", shape=[2, 3, 8, 8], append_batch_size=False,
-                   dtype="float32", stop_gradient=False)
+    x = fluid.layers.data(name="cx", shape=[2, 3, 8, 8],
+                   dtype="float32", stop_gradient=False, append_batch_size=False)
     w_attr = fluid.ParamAttr(
         name="cw", initializer=fluid.initializer.NumpyArrayInitializer(w_np))
     y = fluid.layers.conv2d(x, 4, 3, stride=2, padding=1,
@@ -307,8 +307,8 @@ def test_conv2d_bf16_amp_backward_runs():
 
     prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(prog, startup):
-        img = fluid.data(name="ambx", shape=[3, 8, 8], dtype="float32")
-        lbl = fluid.data(name="amby", shape=[1], dtype="int64")
+        img = fluid.layers.data(name="ambx", shape=[None, 3, 8, 8], dtype="float32", append_batch_size=False)
+        lbl = fluid.layers.data(name="amby", shape=[None, 1], dtype="int64", append_batch_size=False)
         h = fluid.layers.conv2d(img, 4, 3, padding=1, act="relu")
         h = fluid.layers.batch_norm(h)
         logit = fluid.layers.fc(h, 5, act="softmax")
@@ -334,8 +334,8 @@ def test_conv2d_transpose_fwd_grad_vs_torch():
     x_np = rng.standard_normal((2, 4, 6, 6)).astype("float32")
     w_np = rng.standard_normal((4, 3, 3, 3)).astype("float32")  # (Cin,Cout,kh,kw)
 
-    x = fluid.data(name="ctx", shape=[2, 4, 6, 6], append_batch_size=False,
-                   dtype="float32", stop_gradient=False)
+    x = fluid.layers.data(name="ctx", shape=[2, 4, 6, 6],
+                   dtype="float32", stop_gradient=False, append_batch_size=False)
     y = fluid.layers.conv2d_transpose(
         x, 3, filter_size=3, stride=2, padding=1,
         param_attr=fluid.ParamAttr(

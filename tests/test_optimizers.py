@@ -171,7 +171,7 @@ def test_recompute_optimizer_same_result_as_plain():
     def run(use_recompute):
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup):
-            x = fluid.data("x", [8], dtype="float32")
+            x = fluid.data("x", [None, 8], dtype="float32")
             h1 = fl.layers.fc(x, size=8, act="relu",
                               param_attr=fluid.ParamAttr(
                                   name="rw1",
@@ -206,8 +206,8 @@ def test_model_average_apply_restore_numeric():
     prog, startup = fluid.Program(), fluid.Program()
     prog.random_seed = startup.random_seed = 11
     with fluid.program_guard(prog, startup):
-        x = fluid.data("max", (4,), "float32")
-        y = fluid.data("may", (1,), "float32")
+        x = fluid.data("max", (None, 4,), "float32")
+        y = fluid.data("may", (None, 1,), "float32")
         pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="maw"),
                                bias_attr=False)
         loss = fluid.layers.reduce_mean(

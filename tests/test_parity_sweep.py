@@ -21,8 +21,7 @@ def _exe():
 
 
 def test_hard_swish_numeric():
-    x = fluid.data(name="x", shape=[5], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[5], dtype="float32")
     out = fluid.layers.hard_swish(x)
     xv = np.array([-4.0, -1.0, 0.0, 2.0, 7.0], "float32")
     o = _exe().run(feed={"x": xv}, fetch_list=[out])[0]
@@ -33,8 +32,7 @@ def test_hard_swish_numeric():
 def test_conv3d_transpose_vs_torch():
     torch = pytest.importorskip("torch")
     n, c, d, h, w = 1, 2, 3, 4, 4
-    x = fluid.data(name="x", shape=[n, c, d, h, w], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[n, c, d, h, w], dtype="float32")
     out = fluid.layers.conv3d_transpose(
         x, num_filters=3, filter_size=3, stride=2, padding=1,
         bias_attr=False,
@@ -65,8 +63,7 @@ def test_conv2d_transpose_vs_torch():
     C_in != C_out (masked before because no numeric test existed)."""
     torch = pytest.importorskip("torch")
     n, c, h, w = 1, 2, 5, 5
-    x = fluid.data(name="x2", shape=[n, c, h, w], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x2", shape=[n, c, h, w], dtype="float32")
     out = fluid.layers.conv2d_transpose(
         x, num_filters=3, filter_size=3, stride=2, padding=1,
         bias_attr=False,
@@ -90,8 +87,7 @@ def test_conv2d_transpose_vs_torch():
 
 
 def test_adaptive_pool3d():
-    x = fluid.data(name="x", shape=[1, 2, 4, 4, 4], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, 2, 4, 4, 4], dtype="float32")
     out = fluid.layers.adaptive_pool3d(x, pool_size=2, pool_type="avg")
     xv = np.arange(128, dtype="float32").reshape(1, 2, 4, 4, 4)
     o = _exe().run(feed={"x": xv}, fetch_list=[out])[0]
@@ -102,10 +98,8 @@ def test_adaptive_pool3d():
 
 
 def test_cross_entropy2_matches_manual():
-    x = fluid.data(name="x", shape=[3, 4], dtype="float32",
-                   append_batch_size=False)
-    lab = fluid.data(name="lab", shape=[3, 1], dtype="int64",
-                     append_batch_size=False)
+    x = fluid.data(name="x", shape=[3, 4], dtype="float32")
+    lab = fluid.data(name="lab", shape=[3, 1], dtype="int64")
     out = fluid.layers.cross_entropy2(x, lab)
     probs = np.array(
         [[0.1, 0.7, 0.1, 0.1], [0.25, 0.25, 0.25, 0.25],
@@ -119,14 +113,10 @@ def test_cross_entropy2_matches_manual():
 
 
 def test_edit_distance_layer():
-    hyp = fluid.data(name="hyp", shape=[2, 5], dtype="int64",
-                     append_batch_size=False)
-    ref = fluid.data(name="ref", shape=[2, 6], dtype="int64",
-                     append_batch_size=False)
-    hl = fluid.data(name="hl", shape=[2], dtype="int64",
-                    append_batch_size=False)
-    rl = fluid.data(name="rl", shape=[2], dtype="int64",
-                    append_batch_size=False)
+    hyp = fluid.data(name="hyp", shape=[2, 5], dtype="int64")
+    ref = fluid.data(name="ref", shape=[2, 6], dtype="int64")
+    hl = fluid.data(name="hl", shape=[2], dtype="int64")
+    rl = fluid.data(name="rl", shape=[2], dtype="int64")
     dist, seq_num = fluid.layers.edit_distance(
         hyp, ref, normalized=False, input_length=hl, label_length=rl,
     )

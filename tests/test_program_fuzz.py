@@ -50,10 +50,8 @@ def test_random_program_trains_finite(seed):
     width = int(rng.integers(4, 33))
     fluid.default_startup_program().random_seed = seed + 1
     fluid.default_main_program().random_seed = seed + 1
-    x = fluid.data(name="x", shape=[batch, width], dtype="float32",
-                   append_batch_size=False)
-    y = fluid.data(name="y", shape=[batch, 1], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[batch, width], dtype="float32")
+    y = fluid.data(name="y", shape=[batch, 1], dtype="float32")
     h = _rand_stack(rng, x, width)
     pred = fluid.layers.fc(h, size=1)
     loss = fluid.layers.reduce_mean(
@@ -117,10 +115,8 @@ def test_random_seq_program_trains_finite(seed):
     width = int(rng.integers(4, 17))
     fluid.default_startup_program().random_seed = seed + 1
     fluid.default_main_program().random_seed = seed + 1
-    x = fluid.data(name="x", shape=[batch, T, width], dtype="float32",
-                   append_batch_size=False)
-    y = fluid.data(name="y", shape=[batch, 1], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[batch, T, width], dtype="float32")
+    y = fluid.data(name="y", shape=[batch, 1], dtype="float32")
     h = _rand_seq_stack(rng, x, width)
     pred = fluid.layers.fc(h, size=1)
     loss = fluid.layers.reduce_mean(

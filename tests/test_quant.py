@@ -20,7 +20,7 @@ def fresh_programs():
 
 
 def test_fake_qdq_abs_max_numeric():
-    x = fluid.data(name="x", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
     y = quant.fake_quant_dequant_abs_max(x, bit_length=8)
     exe = fluid.Executor(fluid.CPUPlace())
     xv = np.array([[0.5, -1.0, 0.25, 0.124], [1.27, -0.3, 0.0, 2.0]],
@@ -35,7 +35,7 @@ def test_fake_qdq_abs_max_numeric():
 
 def test_fake_qdq_ste_gradient():
     """STE: d(qdq(x))/dx == 1 -> grad of sum(qdq(w*x)) wrt w equals x."""
-    x = fluid.data(name="x", shape=[3], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 3], dtype="float32")
     w = layers.create_parameter(shape=[3], dtype="float32", name="w_q",
                                 default_initializer=fluid.initializer.Constant(2.0))
     y = quant.fake_quant_dequant_abs_max(x * w)
@@ -49,7 +49,7 @@ def test_fake_qdq_ste_gradient():
 
 
 def test_transform_pass_inserts_fake_quant():
-    x = fluid.data(name="x", shape=[8], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 8], dtype="float32")
     h = layers.fc(x, size=16, act="relu")
     out = layers.fc(h, size=4)
     loss = layers.mean(out)
@@ -68,8 +68,8 @@ def test_transform_pass_inserts_fake_quant():
 
 
 def test_qat_training_converges_and_updates_scale():
-    x = fluid.data(name="x", shape=[4], dtype="float32")
-    label = fluid.data(name="y", shape=[1], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+    label = fluid.data(name="y", shape=[None, 1], dtype="float32")
     h = layers.fc(x, size=8, act="relu")
     pred = layers.fc(h, size=1)
     loss = layers.mean(layers.square_error_cost(pred, label))
@@ -103,7 +103,7 @@ def test_qat_training_converges_and_updates_scale():
 def test_transform_quantizes_sub_blocks():
     """Quantizable ops inside cond branches get fake-quant too (the pass
     walks every block, like the reference QuantizationTransformPass)."""
-    x = fluid.data(name="x", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
     pred = layers.greater_than(
         layers.reduce_sum(x), layers.fill_constant([1], "float32", 0.0)
     )

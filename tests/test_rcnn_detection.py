@@ -44,8 +44,7 @@ def _anchor_oracle(h, w, sizes, ratios, stride, offset=0.5):
 
 
 def test_anchor_generator_matches_oracle():
-    feat = fluid.data(name="feat", shape=[1, 8, 3, 4], dtype="float32",
-                     append_batch_size=False)
+    feat = fluid.data(name="feat", shape=[1, 8, 3, 4], dtype="float32")
     anchors, var = fluid.layers.detection.anchor_generator(
         feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0],
         stride=[16.0, 16.0],
@@ -65,12 +64,9 @@ def test_sigmoid_focal_loss_matches_oracle():
     xv = rng.randn(r, c).astype("float32")
     lv = np.array([[1], [0], [3], [-1], [2]], "int32")
     fg = np.array([2], "int32")
-    x = fluid.data(name="x", shape=[r, c], dtype="float32",
-                   append_batch_size=False)
-    lab = fluid.data(name="lab", shape=[r, 1], dtype="int32",
-                     append_batch_size=False)
-    fgn = fluid.data(name="fgn", shape=[1], dtype="int32",
-                     append_batch_size=False)
+    x = fluid.data(name="x", shape=[r, c], dtype="float32")
+    lab = fluid.data(name="lab", shape=[r, 1], dtype="int32")
+    fgn = fluid.data(name="fgn", shape=[1], dtype="int32")
     out = fluid.layers.detection.sigmoid_focal_loss(x, lab, fgn,
                                                     gamma=2.0, alpha=0.25)
     o = _exe().run(feed={"x": xv, "lab": lv, "fgn": fg},
@@ -93,10 +89,8 @@ def test_sigmoid_focal_loss_matches_oracle():
 
 
 def test_target_assign_dense():
-    gt = fluid.data(name="gt", shape=[2, 3, 4], dtype="float32",
-                    append_batch_size=False)
-    match = fluid.data(name="m", shape=[2, 2], dtype="int32",
-                       append_batch_size=False)
+    gt = fluid.data(name="gt", shape=[2, 3, 4], dtype="float32")
+    match = fluid.data(name="m", shape=[2, 2], dtype="int32")
     out, w = fluid.layers.detection.target_assign(gt, match,
                                                   mismatch_value=7.0)
     gtv = np.arange(24, dtype="float32").reshape(2, 3, 4)
@@ -121,18 +115,12 @@ def test_rpn_target_assign_dense_semantics():
     )  # (1, 2, 4)
     crowd_np = np.zeros((1, g), "int32")
     info_np = np.array([[256, 256, 1.0]], "float32")
-    anc = fluid.data(name="anc", shape=[m, 4], dtype="float32",
-                     append_batch_size=False)
-    gt = fluid.data(name="gt", shape=[1, g, 4], dtype="float32",
-                    append_batch_size=False)
-    crowd = fluid.data(name="crowd", shape=[1, g], dtype="int32",
-                       append_batch_size=False)
-    info = fluid.data(name="info", shape=[1, 3], dtype="float32",
-                      append_batch_size=False)
-    bbox_pred = fluid.data(name="bp", shape=[1, m, 4], dtype="float32",
-                           append_batch_size=False)
-    cls_logits = fluid.data(name="cl", shape=[1, m, 1], dtype="float32",
-                            append_batch_size=False)
+    anc = fluid.data(name="anc", shape=[m, 4], dtype="float32")
+    gt = fluid.data(name="gt", shape=[1, g, 4], dtype="float32")
+    crowd = fluid.data(name="crowd", shape=[1, g], dtype="int32")
+    info = fluid.data(name="info", shape=[1, 3], dtype="float32")
+    bbox_pred = fluid.data(name="bp", shape=[1, m, 4], dtype="float32")
+    cls_logits = fluid.data(name="cl", shape=[1, m, 1], dtype="float32")
     _, _, score_t, loc_t, w = fluid.layers.detection.rpn_target_assign(
         bbox_pred, cls_logits, anc, None, gt, crowd, info,
         rpn_batch_size_per_im=4, rpn_positive_overlap=0.7,
@@ -173,20 +161,13 @@ def test_retinanet_target_assign_labels_and_fg_num():
     lab_np = np.array([[3, 7]], "int32")
     crowd_np = np.zeros((1, g), "int32")
     info_np = np.array([[256, 256, 1.0]], "float32")
-    anc = fluid.data(name="anc", shape=[m, 4], dtype="float32",
-                     append_batch_size=False)
-    gt = fluid.data(name="gt", shape=[1, g, 4], dtype="float32",
-                    append_batch_size=False)
-    gl = fluid.data(name="gl", shape=[1, g], dtype="int32",
-                    append_batch_size=False)
-    crowd = fluid.data(name="crowd", shape=[1, g], dtype="int32",
-                       append_batch_size=False)
-    info = fluid.data(name="info", shape=[1, 3], dtype="float32",
-                      append_batch_size=False)
-    bp = fluid.data(name="bp", shape=[1, m, 4], dtype="float32",
-                    append_batch_size=False)
-    cl = fluid.data(name="cl", shape=[1, m, 9], dtype="float32",
-                    append_batch_size=False)
+    anc = fluid.data(name="anc", shape=[m, 4], dtype="float32")
+    gt = fluid.data(name="gt", shape=[1, g, 4], dtype="float32")
+    gl = fluid.data(name="gl", shape=[1, g], dtype="int32")
+    crowd = fluid.data(name="crowd", shape=[1, g], dtype="int32")
+    info = fluid.data(name="info", shape=[1, 3], dtype="float32")
+    bp = fluid.data(name="bp", shape=[1, m, 4], dtype="float32")
+    cl = fluid.data(name="cl", shape=[1, m, 9], dtype="float32")
     _, _, score_t, loc_t, w, fg_num = \
         fluid.layers.detection.retinanet_target_assign(
             bp, cl, anc, None, gt, gl, crowd, info, num_classes=9,
@@ -207,16 +188,11 @@ def test_retinanet_target_assign_labels_and_fg_num():
 def test_generate_proposals_shapes_and_nms():
     n, a, h, w = 1, 2, 2, 2
     m = a * h * w
-    scores = fluid.data(name="sc", shape=[n, a, h, w], dtype="float32",
-                        append_batch_size=False)
-    deltas = fluid.data(name="dl", shape=[n, a * 4, h, w], dtype="float32",
-                        append_batch_size=False)
-    info = fluid.data(name="info", shape=[n, 3], dtype="float32",
-                      append_batch_size=False)
-    anc = fluid.data(name="anc", shape=[h, w, a, 4], dtype="float32",
-                     append_batch_size=False)
-    var = fluid.data(name="var", shape=[h, w, a, 4], dtype="float32",
-                     append_batch_size=False)
+    scores = fluid.data(name="sc", shape=[n, a, h, w], dtype="float32")
+    deltas = fluid.data(name="dl", shape=[n, a * 4, h, w], dtype="float32")
+    info = fluid.data(name="info", shape=[n, 3], dtype="float32")
+    anc = fluid.data(name="anc", shape=[h, w, a, 4], dtype="float32")
+    var = fluid.data(name="var", shape=[h, w, a, 4], dtype="float32")
     rois, probs = fluid.layers.detection.generate_proposals(
         scores, deltas, info, anc, var, pre_nms_top_n=8,
         post_nms_top_n=4, nms_thresh=0.5, min_size=1.0,
@@ -246,10 +222,8 @@ def test_generate_proposals_shapes_and_nms():
 
 
 def test_detection_map_perfect_and_partial():
-    det = fluid.data(name="det", shape=[1, 3, 6], dtype="float32",
-                     append_batch_size=False)
-    gt = fluid.data(name="gt", shape=[1, 2, 6], dtype="float32",
-                    append_batch_size=False)
+    det = fluid.data(name="det", shape=[1, 3, 6], dtype="float32")
+    gt = fluid.data(name="gt", shape=[1, 2, 6], dtype="float32")
     mp = fluid.layers.detection.detection_map(det, gt, class_num=3,
                                               overlap_threshold=0.5)
     exe = _exe()
@@ -268,8 +242,7 @@ def test_detection_map_perfect_and_partial():
 
 
 def test_polygon_box_transform_oracle():
-    x = fluid.data(name="x", shape=[1, 4, 2, 3], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, 4, 2, 3], dtype="float32")
     out = fluid.layers.detection.polygon_box_transform(x)
     xv = np.random.RandomState(1).rand(1, 4, 2, 3).astype("float32")
     o = _exe().run(feed={"x": xv}, fetch_list=[out])[0]
@@ -286,14 +259,10 @@ def test_polygon_box_transform_oracle():
 
 def test_box_decoder_and_assign():
     r, c = 2, 3
-    prior = fluid.data(name="p", shape=[r, 4], dtype="float32",
-                       append_batch_size=False)
-    pvar = fluid.data(name="pv", shape=[4], dtype="float32",
-                      append_batch_size=False)
-    tb = fluid.data(name="tb", shape=[r, 4 * c], dtype="float32",
-                    append_batch_size=False)
-    sc = fluid.data(name="sc", shape=[r, c], dtype="float32",
-                    append_batch_size=False)
+    prior = fluid.data(name="p", shape=[r, 4], dtype="float32")
+    pvar = fluid.data(name="pv", shape=[4], dtype="float32")
+    tb = fluid.data(name="tb", shape=[r, 4 * c], dtype="float32")
+    sc = fluid.data(name="sc", shape=[r, c], dtype="float32")
     dec, assign = fluid.layers.detection.box_decoder_and_assign(
         prior, pvar, tb, sc, 4.135,
     )
@@ -317,12 +286,9 @@ def test_box_decoder_and_assign():
 def test_multi_box_head_and_ssd_train_step():
     """VERDICT #4 'done' criterion: an SSD-style head builds and one train
     step runs end-to-end."""
-    img = fluid.data(name="img", shape=[2, 3, 32, 32], dtype="float32",
-                     append_batch_size=False)
-    gt_box = fluid.data(name="gt_box", shape=[3, 4], dtype="float32",
-                        append_batch_size=False)
-    gt_label = fluid.data(name="gt_label", shape=[3, 1], dtype="int64",
-                          append_batch_size=False)
+    img = fluid.data(name="img", shape=[2, 3, 32, 32], dtype="float32")
+    gt_box = fluid.data(name="gt_box", shape=[3, 4], dtype="float32")
+    gt_label = fluid.data(name="gt_label", shape=[3, 1], dtype="int64")
     c1 = fluid.layers.conv2d(img, 8, 3, stride=2, padding=1)
     c2 = fluid.layers.conv2d(c1, 8, 3, stride=2, padding=1)
     locs, confs, boxes, variances = fluid.layers.detection.multi_box_head(
@@ -358,14 +324,10 @@ def test_multi_box_head_and_ssd_train_step():
 
 def test_retinanet_detection_output_basic():
     n, m, c = 1, 4, 2
-    bb = fluid.data(name="bb", shape=[n, m, 4], dtype="float32",
-                    append_batch_size=False)
-    sc = fluid.data(name="sc", shape=[n, m, c], dtype="float32",
-                    append_batch_size=False)
-    anc = fluid.data(name="anc", shape=[m, 4], dtype="float32",
-                     append_batch_size=False)
-    info = fluid.data(name="info", shape=[n, 3], dtype="float32",
-                      append_batch_size=False)
+    bb = fluid.data(name="bb", shape=[n, m, 4], dtype="float32")
+    sc = fluid.data(name="sc", shape=[n, m, c], dtype="float32")
+    anc = fluid.data(name="anc", shape=[m, 4], dtype="float32")
+    info = fluid.data(name="info", shape=[n, 3], dtype="float32")
     out = fluid.layers.detection.retinanet_detection_output(
         [bb], [sc], [anc], info, score_threshold=0.1, nms_top_k=4,
         keep_top_k=3,
@@ -389,10 +351,8 @@ def test_retinanet_detection_output_basic():
 def test_locality_aware_nms_merges_adjacent():
     """Two overlapping high-score boxes merge into a weighted average
     before NMS (the EAST pass); a distant box survives separately."""
-    bb = fluid.data(name="bb", shape=[1, 3, 4], dtype="float32",
-                    append_batch_size=False)
-    sc = fluid.data(name="sc", shape=[1, 1, 3], dtype="float32",
-                    append_batch_size=False)
+    bb = fluid.data(name="bb", shape=[1, 3, 4], dtype="float32")
+    sc = fluid.data(name="sc", shape=[1, 1, 3], dtype="float32")
     out = fluid.layers.detection.locality_aware_nms(
         bb, sc, score_threshold=0.1, nms_top_k=3, keep_top_k=2,
         nms_threshold=0.3,
@@ -415,16 +375,11 @@ def test_locality_aware_nms_merges_adjacent():
 
 def test_generate_proposal_labels_dense():
     r, g = 4, 2
-    rois = fluid.data(name="rois", shape=[1, r, 4], dtype="float32",
-                      append_batch_size=False)
-    gtc = fluid.data(name="gtc", shape=[1, g], dtype="int32",
-                     append_batch_size=False)
-    crowd = fluid.data(name="crowd", shape=[1, g], dtype="int32",
-                       append_batch_size=False)
-    gtb = fluid.data(name="gtb", shape=[1, g, 4], dtype="float32",
-                     append_batch_size=False)
-    info = fluid.data(name="info", shape=[1, 3], dtype="float32",
-                      append_batch_size=False)
+    rois = fluid.data(name="rois", shape=[1, r, 4], dtype="float32")
+    gtc = fluid.data(name="gtc", shape=[1, g], dtype="int32")
+    crowd = fluid.data(name="crowd", shape=[1, g], dtype="int32")
+    gtb = fluid.data(name="gtb", shape=[1, g, 4], dtype="float32")
+    info = fluid.data(name="info", shape=[1, 3], dtype="float32")
     outs = fluid.layers.detection.generate_proposal_labels(
         rois, gtc, crowd, gtb, info, batch_size_per_im=6,
         fg_fraction=0.5, fg_thresh=0.5,
@@ -450,10 +405,8 @@ def test_generate_proposal_labels_dense():
 
 def test_roi_perspective_transform_identity_quad():
     """An axis-aligned quad warps to a plain crop-resize."""
-    x = fluid.data(name="x", shape=[1, 1, 8, 8], dtype="float32",
-                   append_batch_size=False)
-    rois = fluid.data(name="rois", shape=[1, 8], dtype="float32",
-                      append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, 1, 8, 8], dtype="float32")
+    rois = fluid.data(name="rois", shape=[1, 8], dtype="float32")
     out = fluid.layers.detection.roi_perspective_transform(
         x, rois, transformed_height=4, transformed_width=4,
     )
@@ -472,10 +425,8 @@ def test_roi_perspective_transform_trapezoid_homography():
     """A trapezoid quad must warp with true perspective foreshortening:
     the midline sample point is NOT the uniform (ruled-surface) midpoint."""
     h = w = 32
-    x = fluid.data(name="x", shape=[1, 2, h, w], dtype="float32",
-                   append_batch_size=False)
-    rois = fluid.data(name="rois", shape=[1, 8], dtype="float32",
-                      append_batch_size=False)
+    x = fluid.data(name="x", shape=[1, 2, h, w], dtype="float32")
+    rois = fluid.data(name="rois", shape=[1, 8], dtype="float32")
     out = fluid.layers.detection.roi_perspective_transform(
         x, rois, transformed_height=8, transformed_width=8,
     )
@@ -509,16 +460,11 @@ def test_roi_perspective_transform_trapezoid_homography():
 def test_generate_proposal_labels_excludes_crowd_rows():
     """Crowd gt rows appended to the pool must not become bg samples."""
     r, g = 2, 2
-    rois = fluid.data(name="crois", shape=[1, r, 4], dtype="float32",
-                      append_batch_size=False)
-    gtc = fluid.data(name="cgtc", shape=[1, g], dtype="int32",
-                     append_batch_size=False)
-    crowd = fluid.data(name="ccrowd", shape=[1, g], dtype="int32",
-                       append_batch_size=False)
-    gtb = fluid.data(name="cgtb", shape=[1, g, 4], dtype="float32",
-                     append_batch_size=False)
-    info = fluid.data(name="cinfo", shape=[1, 3], dtype="float32",
-                      append_batch_size=False)
+    rois = fluid.data(name="crois", shape=[1, r, 4], dtype="float32")
+    gtc = fluid.data(name="cgtc", shape=[1, g], dtype="int32")
+    crowd = fluid.data(name="ccrowd", shape=[1, g], dtype="int32")
+    gtb = fluid.data(name="cgtb", shape=[1, g, 4], dtype="float32")
+    info = fluid.data(name="cinfo", shape=[1, 3], dtype="float32")
     outs = fluid.layers.detection.generate_proposal_labels(
         rois, gtc, crowd, gtb, info, batch_size_per_im=6, fg_thresh=0.5,
         fg_fraction=0.5,
@@ -546,20 +492,13 @@ def test_generate_mask_labels_rasterizes_polygon():
     # P=6 with only 4 real vertices: padding rows must not corrupt the
     # gt bbox used for roi matching
     n, g, p, r, res, ncls = 1, 1, 6, 2, 8, 3
-    info = fluid.data(name="minfo", shape=[n, 3], dtype="float32",
-                      append_batch_size=False)
-    gtc = fluid.data(name="mgtc", shape=[n, g], dtype="int32",
-                     append_batch_size=False)
-    crowd = fluid.data(name="mcrowd", shape=[n, g], dtype="int32",
-                       append_batch_size=False)
-    segms = fluid.data(name="msegms", shape=[n, g, p, 2], dtype="float32",
-                       append_batch_size=False)
-    slens = fluid.data(name="mslens", shape=[n, g], dtype="int32",
-                       append_batch_size=False)
-    rois = fluid.data(name="mrois", shape=[n, r, 4], dtype="float32",
-                      append_batch_size=False)
-    labs = fluid.data(name="mlabs", shape=[n, r], dtype="int32",
-                      append_batch_size=False)
+    info = fluid.data(name="minfo", shape=[n, 3], dtype="float32")
+    gtc = fluid.data(name="mgtc", shape=[n, g], dtype="int32")
+    crowd = fluid.data(name="mcrowd", shape=[n, g], dtype="int32")
+    segms = fluid.data(name="msegms", shape=[n, g, p, 2], dtype="float32")
+    slens = fluid.data(name="mslens", shape=[n, g], dtype="int32")
+    rois = fluid.data(name="mrois", shape=[n, r, 4], dtype="float32")
+    labs = fluid.data(name="mlabs", shape=[n, r], dtype="int32")
     outs = fluid.layers.detection.generate_mask_labels(
         info, gtc, crowd, segms, rois, labs, num_classes=ncls,
         resolution=res, gt_segm_lens=slens,
@@ -587,13 +526,11 @@ def test_generate_mask_labels_rasterizes_polygon():
 
 
 def test_fpn_distribute_and_collect():
-    rois = fluid.data(name="rois", shape=[4, 4], dtype="float32",
-                      append_batch_size=False)
+    rois = fluid.data(name="rois", shape=[4, 4], dtype="float32")
     outs, restore = fluid.layers.detection.distribute_fpn_proposals(
         rois, min_level=2, max_level=4, refer_level=3, refer_scale=224,
     )
-    scores = fluid.data(name="s", shape=[4, 1], dtype="float32",
-                        append_batch_size=False)
+    scores = fluid.data(name="s", shape=[4, 1], dtype="float32")
     collected = fluid.layers.detection.collect_fpn_proposals(
         [rois], [scores], 2, 2, post_nms_top_n=2,
     )
@@ -625,12 +562,9 @@ def test_fpn_distribute_and_collect():
 def test_metrics_detection_map_streams():
     """fluid.metrics.DetectionMAP: per-batch mAP + in-graph running mean,
     reset() starts a fresh pass."""
-    det = fluid.data(name="mm_det", shape=[1, 3, 6], dtype="float32",
-                     append_batch_size=False)
-    gtl = fluid.data(name="mm_gtl", shape=[1, 2, 1], dtype="int64",
-                     append_batch_size=False)
-    gtb = fluid.data(name="mm_gtb", shape=[1, 2, 4], dtype="float32",
-                     append_batch_size=False)
+    det = fluid.data(name="mm_det", shape=[1, 3, 6], dtype="float32")
+    gtl = fluid.data(name="mm_gtl", shape=[1, 2, 1], dtype="int64")
+    gtb = fluid.data(name="mm_gtb", shape=[1, 2, 4], dtype="float32")
     m = fluid.metrics.DetectionMAP(det, gtl, gtb, class_num=3,
                                    overlap_threshold=0.5)
     cur, accum = m.get_map_var()

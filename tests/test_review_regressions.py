@@ -11,7 +11,7 @@ def _setup():
 
 def test_two_minimize_on_one_program():
     """GAN-style: two losses, two optimizers, one program — both must train."""
-    x = fluid.data(name="x", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
     h1 = fluid.layers.fc(input=x, size=8, act="relu", name="net1")
     loss1 = fluid.layers.mean(h1)
     h2 = fluid.layers.fc(input=x, size=8, act="relu", name="net2")
@@ -34,7 +34,7 @@ def test_two_minimize_on_one_program():
 
 
 def test_clone_for_test_drops_grad_consumers():
-    x = fluid.data(name="x", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
     y = fluid.layers.fc(input=x, size=3)
     loss = fluid.layers.mean(y)
     opt = fluid.optimizer.SGD(
@@ -53,7 +53,7 @@ def test_clone_for_test_drops_grad_consumers():
 
 
 def test_lookahead_optimizer_runs():
-    x = fluid.data(name="x", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
     y = fluid.layers.fc(input=x, size=3)
     loss = fluid.layers.mean(y)
     la = fluid.optimizer.LookaheadOptimizer(
@@ -68,8 +68,8 @@ def test_lookahead_optimizer_runs():
 
 
 def test_variable_equality_is_python_identity():
-    x = fluid.data(name="x", shape=[4], dtype="float32")
-    y = fluid.data(name="y", shape=[4], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+    y = fluid.data(name="y", shape=[None, 4], dtype="float32")
     n_ops = len(fluid.default_main_program().global_block().ops)
     assert (x == y) is False
     assert x != y
@@ -87,7 +87,7 @@ def test_dropout_rng_consistent_between_forward_and_backward():
     prog = fluid.default_main_program()
     prog.random_seed = 123
     fluid.default_startup_program().random_seed = 123
-    x = fluid.data(name="x", shape=[16], dtype="float32")
+    x = fluid.data(name="x", shape=[None, 16], dtype="float32")
     h = fluid.layers.fc(input=x, size=16)
     h = fluid.layers.dropout(h, dropout_prob=0.5)
     loss = fluid.layers.mean(h)

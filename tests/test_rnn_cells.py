@@ -33,7 +33,7 @@ def _sigmoid(x):
 def test_rnn_gru_cell_matches_numpy():
     _fresh()
     B, T, D_in, D = 3, 5, 4, 6
-    x = fluid.data("x", (T, D_in), "float32")
+    x = fluid.data("x", (None, T, D_in), "float32")
     cell = layers.GRUCell(hidden_size=D)
     outs, final = layers.rnn(cell, x)
     exe = fluid.Executor()
@@ -66,8 +66,8 @@ def test_rnn_gru_cell_matches_numpy():
 def test_rnn_lstm_cell_seq_len_and_reverse():
     _fresh()
     B, T, D_in, D = 2, 4, 3, 5
-    x = fluid.data("x", (T, D_in), "float32")
-    sl = fluid.data("sl", (), "int64")
+    x = fluid.data("x", (None, T, D_in), "float32")
+    sl = fluid.data("sl", (None, ), "int64")
     cell = layers.LSTMCell(hidden_size=D)
     outs, final = layers.rnn(cell, x, sequence_length=sl)
     exe = fluid.Executor()
@@ -106,7 +106,7 @@ def test_rnn_lstm_cell_seq_len_and_reverse():
 def test_rnn_is_reverse():
     _fresh()
     B, T, D_in, D = 2, 3, 3, 4
-    x = fluid.data("x", (T, D_in), "float32")
+    x = fluid.data("x", (None, T, D_in), "float32")
     cell = layers.GRUCell(hidden_size=D, name="revgru")
     outs, _ = layers.rnn(cell, x, is_reverse=True)
     exe = fluid.Executor()
@@ -134,8 +134,8 @@ def test_rnn_is_reverse():
 def test_rnn_trains():
     _fresh()
     B, T, D_in, D = 4, 6, 3, 8
-    x = fluid.data("x", (T, D_in), "float32")
-    y = fluid.data("y", (1,), "float32")
+    x = fluid.data("x", (None, T, D_in), "float32")
+    y = fluid.data("y", (None, 1,), "float32")
     cell = layers.LSTMCell(hidden_size=D)
     _, final = layers.rnn(cell, x)
     pred = layers.fc(final[0], 1)
@@ -161,7 +161,7 @@ def test_rnn_trains():
 def test_beam_search_decoder_matches_numpy():
     _fresh()
     B, V, D, beam, steps = 2, 7, 5, 3, 5
-    enc = fluid.data("enc", (D,), "float32")  # (B, D) encoder final state
+    enc = fluid.data("enc", (None, D,), "float32")  # (B, D) encoder final state
 
     emb_w = fluid.ParamAttr(name="trg_emb")
     out_w = fluid.ParamAttr(name="out_w")
@@ -259,7 +259,7 @@ def _np_beam_search_with_h0(gw, gb, cw, cb, ew, ow, B, V, D, beam, start,
 def test_dynamic_lstmp_matches_numpy():
     _fresh()
     B, T, D, P = 2, 4, 6, 3
-    xp = fluid.data("xp", (T, 4 * D), "float32")
+    xp = fluid.data("xp", (None, T, 4 * D), "float32")
     proj, cell = layers.dynamic_lstmp(
         xp, size=4 * D, proj_size=P, use_peepholes=False)
     exe = fluid.Executor()
@@ -292,7 +292,7 @@ def test_dynamic_lstmp_matches_numpy():
 def test_dynamic_lstmp_peephole_clip_runs():
     _fresh()
     B, T, D, P = 2, 3, 4, 2
-    xp = fluid.data("xp2", (T, 4 * D), "float32")
+    xp = fluid.data("xp2", (None, T, 4 * D), "float32")
     proj, cell = layers.dynamic_lstmp(
         xp, size=4 * D, proj_size=P, use_peepholes=True,
         cell_clip=1.0, proj_clip=0.5)
@@ -308,7 +308,7 @@ def test_dynamic_lstmp_peephole_clip_runs():
 
 def test_get_initial_states_structure():
     _fresh()
-    x = fluid.data("gis_x", (4,), "float32")
+    x = fluid.data("gis_x", (None, 4,), "float32")
     cell = layers.LSTMCell(hidden_size=6)
     states = cell.get_initial_states(batch_ref=x)
     assert isinstance(states, list) and len(states) == 2
@@ -342,7 +342,7 @@ def test_rnn_time_major():
 def test_dynamic_decode_final_states_are_final():
     _fresh()
     B, V, D, beam, steps = 2, 6, 4, 2, 4
-    enc = fluid.data("encf", (D,), "float32")
+    enc = fluid.data("encf", (None, D,), "float32")
     cell = layers.GRUCell(hidden_size=D, name="fsgru")
     decoder = layers.BeamSearchDecoder(
         cell, start_token=0, end_token=1, beam_size=beam,
@@ -374,7 +374,7 @@ def test_shared_param_attr_not_aliased():
     layer_helper_base.py) — regression for gate/candidate weight
     aliasing in GRUCell and Weight/ProjWeight in dynamic_lstmp."""
     _fresh()
-    x = fluid.data("pax", (5, 4), "float32")
+    x = fluid.data("pax", (None, 5, 4), "float32")
     cell = layers.GRUCell(hidden_size=6, param_attr=fluid.ParamAttr())
     outs, _ = layers.rnn(cell, x)
     prog = fluid.default_main_program()
@@ -387,7 +387,7 @@ def test_shared_param_attr_not_aliased():
     assert np.asarray(out).shape == (2, 5, 6)
 
     _fresh()
-    xp = fluid.data("paxp", (3, 24), "float32")
+    xp = fluid.data("paxp", (None, 3, 24), "float32")
     proj, _ = layers.dynamic_lstmp(
         xp, size=24, proj_size=3, param_attr=fluid.ParamAttr(),
         use_peepholes=False)
@@ -406,7 +406,7 @@ def test_basic_gru_single_layer_matches_rnn_oracle():
 
     _fresh()
     B, T, D_in, D = 2, 4, 3, 5
-    x = fluid.data("bgx", (T, D_in), "float32")
+    x = fluid.data("bgx", (None, T, D_in), "float32")
     out, last_h = basic_gru(x, None, D, num_layers=1, name="bg1")
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())
@@ -434,11 +434,11 @@ def test_basic_lstm_bidirectional_stacked():
 
     _fresh()
     B, T, D_in, D, L = 2, 5, 4, 6, 2
-    x = fluid.data("blx", (T, D_in), "float32")
+    x = fluid.data("blx", (None, T, D_in), "float32")
     out, last_h, last_c = basic_lstm(
         x, None, None, D, num_layers=L, bidirectional=True,
         dropout_prob=0.0, name="bl2")
-    y = fluid.data("bly", (1,), "float32")
+    y = fluid.data("bly", (None, 1,), "float32")
     pred = layers.fc(layers.reduce_mean(out, dim=1), 1)
     loss = layers.reduce_mean(layers.square_error_cost(pred, y))
     fluid.optimizer.Adam(0.02).minimize(loss)
@@ -465,7 +465,7 @@ def test_basic_gru_init_hidden_consumed():
 
     _fresh()
     B, T, D_in, D = 2, 3, 3, 4
-    x = fluid.data("bghx", (T, D_in), "float32")
+    x = fluid.data("bghx", (None, T, D_in), "float32")
     h0 = layers.data("bgh0", (1, -1, D), append_batch_size=False,
                      dtype="float32")
     out, last_h = basic_gru(x, h0, D, num_layers=1, name="bgh")
@@ -490,7 +490,7 @@ def test_basic_lstm_partial_init_and_named_attr():
 
     _fresh()
     B, T, D_in, D = 2, 3, 3, 4
-    x = fluid.data("plx", (T, D_in), "float32")
+    x = fluid.data("plx", (None, T, D_in), "float32")
     h0 = layers.data("plh0", (1, -1, D), append_batch_size=False,
                      dtype="float32")
     out, lh, lc = basic_lstm(
@@ -520,8 +520,8 @@ def test_rnn_cell_under_data_parallel_mesh():
     (GSPMD partitions the carried state over the batch axis)."""
     _fresh()
     B, T, D_in, D = 8, 4, 3, 6
-    x = fluid.data("dpx", (T, D_in), "float32")
-    y = fluid.data("dpy", (1,), "float32")
+    x = fluid.data("dpx", (None, T, D_in), "float32")
+    y = fluid.data("dpy", (None, 1,), "float32")
     cell = layers.GRUCell(hidden_size=D, name="dpgru")
     outs, final = layers.rnn(cell, x)
     pred = layers.fc(final, 1)

@@ -99,7 +99,7 @@ def test_static_minimize_applies_grad_clip():
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.data("x", shape=[6], dtype="float32")
+        x = fluid.data("x", shape=[None, 6], dtype="float32")
         y = fluid.layers.fc(x, size=3)
         loss = fluid.layers.reduce_mean(fluid.layers.square(y))
         opt = fluid.optimizer.SGD(learning_rate=1.0)
@@ -124,7 +124,7 @@ def test_static_minimize_applies_grad_clip():
 def test_gradients_target_gradients_scales_seed():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("x", shape=[3], dtype="float32")
+        x = fluid.data("x", shape=[None, 3], dtype="float32")
         y = fluid.layers.reduce_sum(fluid.layers.square(x))  # dy/dx = 2x
         seed = fluid.layers.fill_constant([], "float32", 5.0)
         (gx,) = fluid.gradients(y, x, target_gradients=seed)
@@ -137,7 +137,7 @@ def test_gradients_target_gradients_scales_seed():
 def test_gradients_no_grad_set_blocks_flow():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("x", shape=[3], dtype="float32")
+        x = fluid.data("x", shape=[None, 3], dtype="float32")
         h = fluid.layers.square(x)          # dh/dx = 2x
         z = fluid.layers.scale(h, scale=3.0)
         y = fluid.layers.reduce_sum(fluid.layers.elementwise_add(z, x))
@@ -155,7 +155,7 @@ def test_amp_dynamic_loss_scaling_decreases_on_overflow():
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.data("x", shape=[4], dtype="float32")
+        x = fluid.data("x", shape=[None, 4], dtype="float32")
         y = fluid.layers.fc(x, size=1)
         loss = fluid.layers.reduce_mean(y)
         opt = mp.decorate(
@@ -203,7 +203,7 @@ def test_model_average_need_restore_false_then_restore():
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.data("x", shape=[2], dtype="float32")
+        x = fluid.data("x", shape=[None, 2], dtype="float32")
         y = fluid.layers.fc(x, size=1)
         loss = fluid.layers.reduce_mean(y)
         opt = fluid.optimizer.SGD(learning_rate=0.5)
@@ -246,7 +246,7 @@ def test_flatten_contiguous_axes():
 def test_resize_nearest_nhwc_matches_nchw():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("x", shape=[3, 4, 4], dtype="float32")
+        x = fluid.data("x", shape=[None, 3, 4, 4], dtype="float32")
         up_cf = fluid.layers.resize_nearest(x, out_shape=[8, 8])
         xt = fluid.layers.transpose(x, [0, 2, 3, 1])
         up_cl = fluid.layers.resize_nearest(
@@ -272,7 +272,7 @@ def test_categorical_sample_shape():
 def test_decorate_reader_drop_last():
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("x", shape=[2], dtype="float32")
+        x = fluid.data("x", shape=[None, 2], dtype="float32")
     feeder = fluid.DataFeeder(feed_list=[x], place=fluid.CPUPlace())
 
     def batches():
@@ -315,7 +315,7 @@ def test_basic_gru_bidirectional_independent_stacks():
     D = 8
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
-        x = fluid.data("x", shape=[5, 12], dtype="float32")
+        x = fluid.data("x", shape=[None, 5, 12], dtype="float32")
         out, last_h = basic_gru(x, None, D, num_layers=2,
                                 bidirectional=True, name="bgadv")
         params = {p.name: p for p in main.global_block().all_parameters()}
@@ -340,7 +340,7 @@ def test_basic_gru_bidirectional_matches_numpy_two_stacks():
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.data("x", shape=[T, W], dtype="float32")
+        x = fluid.data("x", shape=[None, T, W], dtype="float32")
         out, _ = basic_gru(x, None, D, num_layers=2, bidirectional=True,
                            name="bgpar")
         params = {p.name: p for p in main.global_block().all_parameters()}
@@ -400,3 +400,142 @@ def test_trainer_checkpoint_retention_keeps_max():
         if drop >= 0:
             kept.discard(drop)
     assert len(kept) == 3, kept
+
+
+# ---------------------------------------------------------------------------
+# fluid.data semantics + small-module import parity
+# ---------------------------------------------------------------------------
+def test_fluid_data_full_shape_semantics():
+    """fluid.data takes the FULL shape (ref data.py) — no implicit batch
+    dim, None means any size; layers.data keeps the prepend behavior."""
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        a = fluid.data("fd_a", shape=[None, 7], dtype="float32")
+        b = fluid.data("fd_b", shape=[3, 2, 1], dtype="float32")
+        c = fluid.layers.data("fd_c", shape=[7], dtype="float32")
+    assert tuple(a.shape) == (-1, 7)
+    assert tuple(b.shape) == (3, 2, 1)
+    assert tuple(c.shape) == (-1, 7)  # layers.data prepends batch
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = exe.run(main, feed={
+        "fd_a": np.ones((5, 7), "float32"),
+        "fd_b": np.ones((3, 2, 1), "float32"),
+        "fd_c": np.ones((5, 7), "float32"),
+    }, fetch_list=[a, b, c])
+    assert out[0].shape == (5, 7) and out[1].shape == (3, 2, 1)
+
+
+def test_small_module_parity_surface(tmp_path):
+    import io as _io
+    import logging
+    import sys
+
+    # annotations.deprecated warns and forwards
+    from paddle_tpu.fluid.annotations import deprecated
+
+    @deprecated("1.5", "new_fn")
+    def old_fn(v):
+        return v * 2
+
+    stderr, sys.stderr = sys.stderr, _io.StringIO()
+    try:
+        assert old_fn(4) == 8
+        assert "deprecated" in sys.stderr.getvalue()
+    finally:
+        sys.stderr = stderr
+
+    # wrapped_decorator keeps signatures through contextmanagers
+    from paddle_tpu.fluid.wrapped_decorator import (
+        signature_safe_contextmanager,
+    )
+
+    @signature_safe_contextmanager
+    def ctx(v):
+        yield v + 1
+
+    with ctx(1) as got:
+        assert got == 2
+
+    # default_scope_funcs stack
+    from paddle_tpu.fluid import default_scope_funcs as dsf
+
+    dsf.var("dsf_x").set(np.ones(2), None)
+    dsf.enter_local_scope()
+    assert dsf.find_var("dsf_x") is not None  # parent chain
+    dsf.leave_local_scope()
+
+    # input.one_hot/embedding, fluid-level exports
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        ids = fluid.data("ip_ids", shape=[None, 3], dtype="int64")
+        emb = fluid.embedding(ids, size=[10, 4])
+        oh = fluid.one_hot(fluid.layers.reshape(ids, [-1, 1]), 10)
+    assert tuple(emb.shape)[-1] == 4 and tuple(oh.shape)[-1] == 10
+
+    # log_helper
+    from paddle_tpu.fluid.log_helper import get_logger
+
+    lg = get_logger("t_lg", logging.INFO, fmt="%(message)s")
+    assert lg.level == logging.INFO and not lg.propagate
+
+    # trainer_desc classes
+    from paddle_tpu.fluid.trainer_desc import MultiTrainer
+    from paddle_tpu.fluid.device_worker import Hogwild
+
+    td = MultiTrainer()
+    td._set_thread(4)
+    td._set_device_worker(Hogwild())
+    assert td._desc()["class_name"] == "MultiTrainer"
+    assert td._desc()["thread_num"] == 4
+
+    # fluid-level distribute_lookup_table helpers
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        ids2 = fluid.layers.data("dlt_ids", shape=[1], dtype="int64",
+                                 lod_level=1)
+        fluid.layers.embedding(
+            ids2, size=[50, 4], is_distributed=True,
+            param_attr=fluid.ParamAttr(name="dlt_emb"))
+    from paddle_tpu.fluid import distribute_lookup_table as dlt
+
+    assert dlt.find_distributed_lookup_table(main) == "dlt_emb"
+    ins = dlt.find_distributed_lookup_table_inputs(main, "dlt_emb")
+    outs = dlt.find_distributed_lookup_table_outputs(main, "dlt_emb")
+    assert ins and outs
+
+    # install_check runs end to end
+    from paddle_tpu.fluid import install_check
+
+    install_check.run_check()
+
+
+def test_input_v2_embedding_one_hot_keep_trailing_dim():
+    """fluid.embedding/one_hot (v2, ref input.py) append the new dim to
+    the id shape AS-IS; layers.* keep the v1 trailing-1 squeeze."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("v2_ids", shape=[None, 1], dtype="int64")
+        e2 = fluid.embedding(ids, size=[10, 4])
+        o2 = fluid.one_hot(ids, 10)
+        e1 = fluid.layers.embedding(ids, size=[10, 4])
+        o1 = fluid.layers.one_hot(ids, 10)
+    assert tuple(e2.shape) == (-1, 1, 4)
+    assert tuple(o2.shape) == (-1, 1, 10)
+    assert tuple(e1.shape) == (-1, 4)
+    assert tuple(o1.shape) == (-1, 10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = exe.run(main, feed={"v2_ids": np.array([[3], [7]], "int64")},
+                   fetch_list=[e2, o2, e1, o1])
+    assert outs[0].shape == (2, 1, 4) and outs[1].shape == (2, 1, 10)
+    assert outs[2].shape == (2, 4) and outs[3].shape == (2, 10)
+    np.testing.assert_allclose(outs[1][:, 0, :], outs[3])
+
+
+def test_fluid_dygraph_grad_clip_module_resolves():
+    """fluid.dygraph_grad_clip must be the real module (a stale alias to
+    clip once shadowed it)."""
+    assert hasattr(fluid.dygraph_grad_clip, "GradClipByGlobalNorm")
+    assert fluid.dygraph_grad_clip.GradClipByGlobalNorm \
+        is GradClipByGlobalNorm

@@ -21,7 +21,7 @@ def _lod_batch():
 
 def test_sequence_pool_masked():
     x = fluid.data(name="x", shape=[None, 2], dtype="float32",
-                   lod_level=1, append_batch_size=False)
+                   lod_level=1)
     avg = fluid.layers.sequence_pool(x, "average")
     mx = fluid.layers.sequence_pool(x, "max")
     last = fluid.layers.sequence_last_step(x)
@@ -36,7 +36,7 @@ def test_sequence_pool_masked():
 
 def test_sequence_softmax_sums_to_one_over_valid():
     x = fluid.data(name="x", shape=[None, 4], dtype="float32",
-                   lod_level=1, append_batch_size=False)
+                   lod_level=1)
     sm = fluid.layers.sequence_softmax(x)
     exe = _exe()
     lod = LoDTensor.from_sequences(
@@ -52,7 +52,7 @@ def test_sequence_softmax_sums_to_one_over_valid():
 def test_dynamic_lstm_and_gru_shapes_and_masking():
     d = 8
     x = fluid.data(name="x", shape=[None, 6, 4 * d], dtype="float32",
-                   lod_level=1, append_batch_size=False)
+                   lod_level=1)
     h, c = fluid.layers.dynamic_lstm(x, size=4 * d, use_peepholes=False)
     exe = _exe()
     exe.run(fluid.default_startup_program())
@@ -68,8 +68,7 @@ def test_dynamic_lstm_and_gru_shapes_and_masking():
 
 def test_static_rnn_matches_manual_scan():
     t, b, d = 4, 3, 5
-    x = fluid.data(name="x", shape=[t, b, d], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[t, b, d], dtype="float32")
     h0 = fluid.layers.fill_constant([b, d], "float32", 0.0)
     rnn = fluid.layers.StaticRNN()
     with rnn.step():
@@ -102,8 +101,7 @@ def test_while_loop_counts():
 
 
 def test_cond_branches():
-    x = fluid.data(name="x", shape=[1], dtype="float32",
-                   append_batch_size=False)
+    x = fluid.data(name="x", shape=[1], dtype="float32")
     pred = fluid.layers.greater_than(
         x, fluid.layers.fill_constant([1], "float32", 0.0)
     )
@@ -121,8 +119,7 @@ def test_cond_branches():
 
 def test_switch_piecewise():
     lr = fluid.layers.fill_constant([1], "float32", 0.0)
-    step = fluid.data(name="step", shape=[1], dtype="float32",
-                      append_batch_size=False)
+    step = fluid.data(name="step", shape=[1], dtype="float32")
     sw = fluid.layers.Switch()
     with sw.case(fluid.layers.less_than(
         step, fluid.layers.fill_constant([1], "float32", 10.0)
@@ -143,14 +140,10 @@ def test_switch_piecewise():
 
 def test_warpctc_matches_trivial_case():
     # single timestep, single label: loss = -log softmax(logit)[label]
-    logits = fluid.data(name="lg", shape=[1, 2, 3], dtype="float32",
-                        append_batch_size=False)
-    label = fluid.data(name="lb", shape=[1, 1], dtype="int64",
-                       append_batch_size=False)
-    ll = fluid.data(name="ll", shape=[1], dtype="int64",
-                    append_batch_size=False)
-    tl = fluid.data(name="tl", shape=[1], dtype="int64",
-                    append_batch_size=False)
+    logits = fluid.data(name="lg", shape=[1, 2, 3], dtype="float32")
+    label = fluid.data(name="lb", shape=[1, 1], dtype="int64")
+    ll = fluid.data(name="ll", shape=[1], dtype="int64")
+    tl = fluid.data(name="tl", shape=[1], dtype="int64")
     loss = fluid.layers.warpctc(
         logits, label, blank=0, input_length=tl, label_length=ll
     )
@@ -173,14 +166,10 @@ def test_warpctc_matches_trivial_case():
 
 def test_beam_search_step():
     beam, k, b = 2, 3, 1
-    pre_ids = fluid.data(name="pi", shape=[b * beam, 1], dtype="int64",
-                         append_batch_size=False)
-    pre_scores = fluid.data(name="ps", shape=[b * beam, 1], dtype="float32",
-                            append_batch_size=False)
-    ids = fluid.data(name="ids", shape=[b * beam, k], dtype="int64",
-                     append_batch_size=False)
-    scores = fluid.data(name="sc", shape=[b * beam, k], dtype="float32",
-                        append_batch_size=False)
+    pre_ids = fluid.data(name="pi", shape=[b * beam, 1], dtype="int64")
+    pre_scores = fluid.data(name="ps", shape=[b * beam, 1], dtype="float32")
+    ids = fluid.data(name="ids", shape=[b * beam, k], dtype="int64")
+    scores = fluid.data(name="sc", shape=[b * beam, k], dtype="float32")
     sel_ids, sel_scores = fluid.layers.beam_search(
         pre_ids, pre_scores, ids, scores, beam_size=beam, end_id=0
     )

@@ -36,8 +36,8 @@ def test_prune_program_masks_and_training_continues():
     prog, startup = fluid.Program(), fluid.Program()
     prog.random_seed = startup.random_seed = 3
     with fluid.program_guard(prog, startup):
-        x = fluid.data("sx", (8,), "float32")
-        y = fluid.data("sy", (1,), "float32")
+        x = fluid.data("sx", (None, 8,), "float32")
+        y = fluid.data("sy", (None, 1,), "float32")
         h = fluid.layers.fc(x, 16, act="relu",
                             param_attr=fluid.ParamAttr(name="fc_w1"))
         loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(
@@ -64,7 +64,7 @@ def _teacher_student_program():
     prog, startup = fluid.Program(), fluid.Program()
     prog.random_seed = startup.random_seed = 5
     with fluid.program_guard(prog, startup):
-        x = fluid.data("dx", (6,), "float32")
+        x = fluid.data("dx", (None, 6,), "float32")
         student = fluid.layers.fc(x, 4, name="student_fc")
         teacher = fluid.layers.fc(x, 4, name="teacher_fc")
     return prog, startup, student, teacher
@@ -123,7 +123,7 @@ def test_prune_program_skips_low_rank_params_for_axis1():
     instead of crashing (regression)."""
     prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(prog, startup):
-        x = fluid.data("skx", (4,), "float32")
+        x = fluid.data("skx", (None, 4,), "float32")
         fluid.layers.fc(x, 6)  # creates a (4, 6) weight AND a (6,) bias
     exe = fluid.Executor()
     exe.run(startup)

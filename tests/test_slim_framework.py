@@ -13,8 +13,8 @@ V_IN, HID, NCLS = 12, 24, 4
 def _mlp_programs(seed=0):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.data("qx", shape=[V_IN], dtype="float32")
-        y = fluid.data("qy", shape=[1], dtype="int64")
+        x = fluid.data("qx", shape=[None, V_IN], dtype="float32")
+        y = fluid.data("qy", shape=[None, 1], dtype="int64")
         h = fluid.layers.fc(x, HID, act="relu")
         logits = fluid.layers.fc(h, NCLS)
         loss = fluid.layers.mean(
@@ -224,8 +224,8 @@ def test_distillation_strategy_runs():
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        x = fluid.data("dx", shape=[V_IN], dtype="float32")
-        y = fluid.data("dy", shape=[1], dtype="int64")
+        x = fluid.data("dx", shape=[None, V_IN], dtype="float32")
+        y = fluid.data("dy", shape=[None, 1], dtype="int64")
         student = fluid.layers.fc(x, NCLS, name="student_fc")
         teacher = fluid.layers.fc(x, NCLS, name="teacher_fc")
         teacher.stop_gradient = True

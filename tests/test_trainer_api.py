@@ -11,8 +11,8 @@ from paddle_tpu.fluid.contrib import (
 
 
 def _train_func():
-    x = fluid.data(name="tx", shape=[4], dtype="float32")
-    y = fluid.data(name="ty", shape=[1], dtype="float32")
+    x = fluid.data(name="tx", shape=[None, 4], dtype="float32")
+    y = fluid.data(name="ty", shape=[None, 1], dtype="float32")
     pred = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 1)
     return fluid.layers.reduce_mean(
         fluid.layers.square_error_cost(pred, y))
@@ -51,10 +51,13 @@ def test_trainer_event_loop_and_inferencer_roundtrip(tmp_path):
         elif isinstance(event, EndEpochEvent):
             events["ee"] += 1
 
-    trainer.train(num_epochs=4, event_handler=handler, reader=_reader(),
+    # 8 epochs: init randomness depends on the session-global program
+    # uid (seed derivation), so give convergence slack against test-order
+    # dependent inits
+    trainer.train(num_epochs=8, event_handler=handler, reader=_reader(),
                   feed_order=["tx", "ty"])
-    assert events["be"] == events["ee"] == 4
-    assert events["bs"] == events["es"] == 24
+    assert events["be"] == events["ee"] == 8
+    assert events["bs"] == events["es"] == 48
     assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
 
     # test() on the pre-optimizer clone
@@ -66,7 +69,7 @@ def test_trainer_event_loop_and_inferencer_roundtrip(tmp_path):
     trainer.save_params(d)
 
     def infer_func():
-        x = fluid.data(name="tx", shape=[4], dtype="float32")
+        x = fluid.data(name="tx", shape=[None, 4], dtype="float32")
         return fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 1)
 
     inferencer = Inferencer(infer_func=infer_func, param_path=d)

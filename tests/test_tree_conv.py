@@ -72,10 +72,8 @@ def test_tree_conv_matches_bfs_oracle():
     edges = np.array(
         [[[1, 2], [1, 3], [2, 4], [2, 5], [3, 6], [0, 0]]], "int32"
     )
-    nv = fluid.data(name="nv", shape=[1, n, f], dtype="float32",
-                    append_batch_size=False)
-    es = fluid.data(name="es", shape=[1, 6, 2], dtype="int32",
-                    append_batch_size=False)
+    nv = fluid.data(name="nv", shape=[1, n, f], dtype="float32")
+    es = fluid.data(name="es", shape=[1, 6, 2], dtype="int32")
     out = fluid.layers.tree_conv(nv, es, output_size=s, num_filters=m,
                                  max_depth=depth, act=None,
                                  bias_attr=False)
@@ -103,10 +101,8 @@ def test_tree_conv_depth3_and_training():
          [[1, 2], [1, 3], [1, 4], [1, 5]]],    # a star
         "int32",
     )
-    nv = fluid.data(name="nv", shape=[2, n, f], dtype="float32",
-                    append_batch_size=False)
-    es = fluid.data(name="es", shape=[2, 4, 2], dtype="int32",
-                    append_batch_size=False)
+    nv = fluid.data(name="nv", shape=[2, n, f], dtype="float32")
+    es = fluid.data(name="es", shape=[2, 4, 2], dtype="int32")
     out = fluid.layers.tree_conv(nv, es, output_size=4, num_filters=2,
                                  max_depth=3, act=None, bias_attr=False)
     loss = fluid.layers.reduce_mean(fluid.layers.square(out))
