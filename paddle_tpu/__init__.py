@@ -7,8 +7,13 @@ Usage mirrors the reference::
     import paddle_tpu as paddle
     import paddle_tpu.fluid as fluid
 """
-from . import reader_utils as reader  # paddle.reader.*
-from .reader_utils import batch  # noqa: F401  paddle.batch
+from . import reader  # noqa: F401  paddle.reader.* (real package)
+# like the reference __init__: import the module, then rebind the name to
+# the function — paddle.batch(...) calls it, import paddle_tpu.batch works
+# (the parent attribute is only auto-set on the FIRST submodule import,
+# which is this one)
+from . import batch  # noqa: F401
+batch = batch.batch
 from . import fluid  # noqa: F401
 from . import dataset  # noqa: F401
 from . import distributed  # noqa: F401

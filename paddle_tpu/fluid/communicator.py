@@ -15,9 +15,14 @@ __all__ = ["Communicator"]
 
 
 class Communicator:
-    def __init__(self, program, mode=None, kwargs=None, envs=None):
+    def __init__(self, program, vars_info=None, trainers=None,
+                 geo_sgd_need_push_nums=None):
         self._program = program
-        self._mode = mode
+        # geo-SGD shard metadata, kept for introspection parity (the
+        # sync step subsumes delta pushing — see GeoSgdTranspiler)
+        self._vars_info = vars_info
+        self._trainers = trainers
+        self._geo_sgd_need_push_nums = geo_sgd_need_push_nums
         self._running = False
         self._warned = False
 

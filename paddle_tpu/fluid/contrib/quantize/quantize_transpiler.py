@@ -7,7 +7,15 @@ convert_to_int8 casts weight storage. Kept so reference scripts using
 the older entry point run unchanged.
 """
 
-__all__ = ["QuantizeTranspiler"]
+__all__ = ["QuantizeTranspiler", "quant"]
+
+
+def quant(x, scale, num_bits):
+    """Round x onto the num_bits int grid given scale
+    (ref quantize_transpiler.py:75)."""
+    import numpy as np
+
+    return np.round(x / scale * ((1 << (num_bits - 1)) - 1))
 
 
 class QuantizeTranspiler:

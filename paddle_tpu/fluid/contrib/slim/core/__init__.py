@@ -2,7 +2,7 @@
 from . import strategy  # noqa: F401
 from .strategy import Strategy  # noqa: F401
 from . import compressor  # noqa: F401
-from .compressor import Compressor, Context  # noqa: F401
+from .compressor import Compressor, Context, cached_reader  # noqa: F401
 from . import config  # noqa: F401
 from .config import ConfigFactory  # noqa: F401
 

@@ -14,7 +14,39 @@ from ....data_feeder import DataFeeder
 from ..graph import GraphWrapper
 from .strategy import Strategy
 
-__all__ = ["Compressor", "Context"]
+__all__ = ["Compressor", "Context", "cached_reader"]
+
+
+def cached_reader(reader, sampled_rate, cache_path, cached_id):
+    """Sample ~sampled_rate of the reader's batches and cache them to
+    disk; evaluations sharing cached_id replay the identical sample
+    (ref compressor.py:42)."""
+    rng = np.random.default_rng(cached_id)
+    cache_dir = os.path.join(cache_path, str(cached_id))
+
+    def s_reader():
+        list_path = os.path.join(cache_dir, "list")
+        if os.path.isdir(cache_dir) and os.path.exists(list_path):
+            with open(list_path) as f:
+                for file_name in f:
+                    yield list(np.load(
+                        os.path.join(cache_dir, file_name.strip()),
+                        allow_pickle=True))
+            return
+        os.makedirs(cache_dir, exist_ok=True)
+        with open(list_path, "w") as list_file:
+            batch = 0
+            for data in reader():
+                if batch == 0 or rng.uniform() < sampled_rate:
+                    np.save(
+                        os.path.join(cache_dir, "batch%d" % batch),
+                        np.asarray(data, dtype=object),
+                        allow_pickle=True)
+                    list_file.write("batch%d.npy\n" % batch)
+                    batch += 1
+                    yield data
+
+    return s_reader
 
 
 class Context:
@@ -22,7 +54,8 @@ class Context:
 
     def __init__(self, place, scope, train_graph=None, train_reader=None,
                  eval_graph=None, eval_reader=None, teacher_graphs=None,
-                 train_optimizer=None, distiller_optimizer=None):
+                 train_optimizer=None, distiller_optimizer=None,
+                 search_space=None):
         self.place = place
         self.scope = scope
         self.train_graph = train_graph
@@ -32,6 +65,7 @@ class Context:
         self.teacher_graphs = teacher_graphs or []
         self.train_optimizer = train_optimizer
         self.distiller_optimizer = distiller_optimizer
+        self.search_space = search_space
         self.optimize_graph = None
         self.epoch_id = 0
         self.batch_id = 0
@@ -64,7 +98,17 @@ class Context:
         feeder = DataFeeder(feed_vars, self.place, program=graph.program)
         totals = np.zeros(len(fetch), dtype=np.float64)
         count = 0
-        for batch in self.eval_reader():
+        reader = self.eval_reader
+        if sampled_rate:
+            import tempfile
+
+            cache_root = getattr(self, "_eval_cache_dir", None)
+            if cache_root is None:
+                cache_root = tempfile.mkdtemp(prefix="slim_eval_cache_")
+                self._eval_cache_dir = cache_root
+            reader = cached_reader(
+                reader, sampled_rate, cache_root, cached_id)
+        for batch in reader():
             vals = exe.run(graph.program, feed=feeder.feed(batch),
                            fetch_list=fetch, scope=self.scope)
             totals += np.array([float(np.mean(v)) for v in vals])

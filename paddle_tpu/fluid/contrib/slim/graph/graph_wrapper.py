@@ -249,6 +249,50 @@ class GraphWrapper:
     def update_groups_of_conv(self):
         pass
 
+    def compile(self, for_parallel=True, for_test=False, mem_opt=False):
+        """Return the executable form (ref compiles to a CompiledProgram;
+        here the executor jits programs directly, so the data-parallel
+        wrapper is only added when asked for)."""
+        prog = self.program.clone(for_test) if for_test else self.program
+        if for_parallel:
+            from ....compiler import CompiledProgram
+
+            return CompiledProgram(prog)
+        return prog
+
+    def merge(self, graph):
+        """Append another graph's ops/vars into this one (ref merge —
+        used to fold teacher graphs in): vars are shared by name, ops
+        appended in order."""
+        dst = self.program.global_block()
+        for block in graph.program.blocks:
+            for name, var in block.vars.items():
+                if not dst.has_var(name):
+                    dst.vars[name] = var
+            for op in block.ops:
+                dst.ops.append(op)
+        self.program._bump_version()
+
+    def save_persistables(self, path, exe):
+        from .... import io as _io
+
+        _io.save_persistables(exe, path, self.program)
+
+    def load_persistables(self, path, exe):
+        from .... import io as _io
+
+        _io.load_persistables(exe, path, self.program)
+
+    def save_infer_model(self, path, exe, in_out, program_only=False):
+        """ref save_infer_model(path, exe, (in_names, out_names))."""
+        from .... import io as _io
+
+        in_names, out_names = in_out
+        _io.save_inference_model(
+            path, list(in_names),
+            [self.var(n)._var for n in out_names], exe,
+            main_program=self.program, program_only=program_only)
+
     def save_model(self, path, exe):
         from .... import io as _io
 

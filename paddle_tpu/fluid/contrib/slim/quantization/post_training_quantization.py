@@ -63,7 +63,7 @@ class PostTrainingQuantization:
     def __init__(self, executor, sample_generator, model_dir,
                  model_filename=None, params_filename=None, batch_size=10,
                  batch_nums=None, scope=None, algo="KL",
-                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul"),
+                 quantizable_op_type=["conv2d", "depthwise_conv2d", "mul"],
                  is_full_quantize=False, is_use_cache_file=False,
                  cache_dir="./temp_post_training"):
         from ....executor import global_scope

@@ -83,6 +83,12 @@ class FetchHandlerMonitor:
         self._running = False
         self._thread = None
 
+    def handler_launch_func(self, scope, handler):
+        """ref trainer_factory.py:106 — run the sampling loop for an
+        explicit (scope, handler) pair; start() uses the instance's."""
+        self.scope, self.handler = scope, handler
+        self._loop()
+
     def _loop(self):
         while self._running:
             time.sleep(self.handler.period_secs)

@@ -1,0 +1,20 @@
+"""ref import path python/paddle/reader/decorator.py; one shared
+implementation in paddle_tpu/reader_utils.py."""
+from ..reader_utils import (  # noqa: F401
+    ComposeNotAligned,
+    batch,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    multiprocess_reader,
+    shuffle,
+    xmap_readers,
+)
+
+__all__ = [
+    "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+    "ComposeNotAligned", "firstn", "xmap_readers", "multiprocess_reader",
+]

@@ -85,7 +85,7 @@ ALLOW = {
     ("fluid/transpiler/__init__.py", "release_memory"): {"skip_opt_set"},  # XLA buffer assignment subsumes
     ("parallel/fleet.py", "Fleet.init"): {"is_collective"},  # collective is the only TPU mode
     ("parallel/fleet.py", "Fleet.save_inference_model"): {"export_for_deployment"},  # single format
-    ("fluid/contrib/slim/core/compressor.py", "Context.run_eval_graph"): {"sampled_rate", "cached_id"},  # iface-compat: full-eval only (no cached_reader subsampling)
+    ("fluid/contrib/slim/graph/graph_wrapper.py", "GraphWrapper.compile"): {"mem_opt"},  # XLA buffer assignment subsumes the pass
     ("fluid/dataset.py", "InMemoryDataset.global_shuffle"): {"fleet", "thread_num"},  # documented: per-worker shard shuffle (docstring)
     ("fluid/debugger.py", "run_fast_nan_inf_debug"): {"use_program_cache", "dump_core"},  # iface-compat: executor caches by program version; no core dumps
     ("reader_utils.py", "xmap_readers"): {"order"},  # results always ordered (stronger than order=True)
