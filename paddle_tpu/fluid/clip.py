@@ -216,3 +216,23 @@ def append_gradient_clip_ops(param_grads):
         if g is None:
             res.append((p, g))
     return res
+
+
+# The reference's own docstrings import the dygraph GradClip* classes
+# from fluid.clip (ref dygraph_grad_clip.py:70) — alias them here so
+# both import paths ported scripts use resolve.
+def _grad_clip_aliases():
+    from .dygraph_grad_clip import (
+        GradClipByGlobalNorm, GradClipByNorm, GradClipByValue,
+    )
+
+    return GradClipByValue, GradClipByNorm, GradClipByGlobalNorm
+
+
+def __getattr__(name):
+    if name in ("GradClipByValue", "GradClipByNorm",
+                "GradClipByGlobalNorm"):
+        v, n, g = _grad_clip_aliases()
+        return {"GradClipByValue": v, "GradClipByNorm": n,
+                "GradClipByGlobalNorm": g}[name]
+    raise AttributeError("module 'fluid.clip' has no attribute %r" % name)

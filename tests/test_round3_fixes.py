@@ -539,3 +539,22 @@ def test_fluid_dygraph_grad_clip_module_resolves():
     assert hasattr(fluid.dygraph_grad_clip, "GradClipByGlobalNorm")
     assert fluid.dygraph_grad_clip.GradClipByGlobalNorm \
         is GradClipByGlobalNorm
+
+
+def test_clip_module_grad_clip_aliases():
+    """ref docstrings import GradClipBy* from fluid.clip — both paths
+    must resolve to the same classes."""
+    from paddle_tpu.fluid.clip import (
+        GradClipByGlobalNorm as A,
+        GradClipByNorm as B,
+        GradClipByValue as C,
+    )
+
+    assert A is GradClipByGlobalNorm
+    assert B is GradClipByNorm
+    assert C is GradClipByValue
+    import pytest as _pytest
+
+    with _pytest.raises(AttributeError):
+        from paddle_tpu.fluid import clip as _clip
+        _clip.nonexistent_attr
