@@ -17,6 +17,10 @@ from . import inferencer  # noqa: F401
 from .inferencer import Inferencer  # noqa: F401
 from . import utils_stat
 from .utils_stat import memory_usage, op_freq_statistic, summary  # noqa: F401
+from . import memory_usage_calc  # noqa: F401
+from . import op_frequence  # noqa: F401
+from . import model_stat  # noqa: F401
+from . import utils  # noqa: F401
 from . import extend_optimizer
 from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
 

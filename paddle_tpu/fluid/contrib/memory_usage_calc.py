@@ -1,0 +1,5 @@
+"""ref import path contrib/memory_usage_calc.py; implementation in
+utils_stat (HBM-residency estimate)."""
+from .utils_stat import memory_usage  # noqa: F401
+
+__all__ = ["memory_usage"]

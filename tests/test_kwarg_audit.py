@@ -86,6 +86,9 @@ ALLOW = {
     ("parallel/fleet.py", "Fleet.init"): {"is_collective"},  # collective is the only TPU mode
     ("parallel/fleet.py", "Fleet.save_inference_model"): {"export_for_deployment"},  # single format
     ("fluid/contrib/slim/graph/graph_wrapper.py", "GraphWrapper.compile"): {"mem_opt"},  # XLA buffer assignment subsumes the pass
+    ("fluid/contrib/utils/lookup_table_utils.py", "load_persistables_for_increment"): {"lookup_table_var", "lookup_table_var_path"},  # unified checkpoint holds the whole table (module docstring)
+    ("fluid/contrib/utils/lookup_table_utils.py", "load_persistables_for_inference"): {"lookup_table_var_name"},  # unified checkpoint
+    ("fluid/contrib/utils/lookup_table_utils.py", "get_inference_model"): {"feeded_var_names"},  # pruner keeps feeds reachable by name
     ("fluid/dataset.py", "InMemoryDataset.global_shuffle"): {"fleet", "thread_num"},  # documented: per-worker shard shuffle (docstring)
     ("fluid/debugger.py", "run_fast_nan_inf_debug"): {"use_program_cache", "dump_core"},  # iface-compat: executor caches by program version; no core dumps
     ("reader_utils.py", "xmap_readers"): {"order"},  # results always ordered (stronger than order=True)
