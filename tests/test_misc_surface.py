@@ -117,3 +117,38 @@ def test_paddle_utils_image_util():
     t = iu.ImageTransformer(transpose=(2, 0, 1), mean=[0.5, 0.5, 0.5])
     out = t.transformer(imgs[0].copy())
     assert out.shape == (3, 40, 40)
+
+
+def test_contrib_utils_and_stat_shims():
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.contrib import (
+        memory_usage_calc, model_stat, op_frequence, utils,
+    )
+    from paddle_tpu.fluid.contrib.utils import HDFSClient, multi_download
+
+    c = HDFSClient("/opt/hadoop", {})
+    with pytest.raises(NotImplementedError, match="local disk"):
+        c.is_exist("/whatever")
+    with pytest.raises(NotImplementedError):
+        multi_download(c, "/h", "/l", 0, 1)
+
+    # lookup_table_utils reduce to the unified checkpoint (round-trip)
+    from paddle_tpu.fluid.contrib.utils.lookup_table_utils import (
+        convert_dist_to_sparse_program, create_kvs_content,
+    )
+
+    main = fluid.Program()
+    assert convert_dist_to_sparse_program(main) is main
+    text = create_kvs_content({7: [1.0, 2.0], 9: [0.5, 0.25]})
+    assert "7\t1.0,2.0" in text and "9\t0.5,0.25" in text
+
+    # stat shims resolve to the same implementations
+    from paddle_tpu.fluid.contrib.utils_stat import (
+        memory_usage, op_freq_statistic, summary,
+    )
+
+    assert memory_usage_calc.memory_usage is memory_usage
+    assert op_frequence.op_freq_statistic is op_freq_statistic
+    assert model_stat.summary is summary
