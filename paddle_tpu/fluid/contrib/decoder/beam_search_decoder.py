@@ -406,11 +406,16 @@ class BeamSearchDecoder:
                 "previous-token embedding, got %s" % (dyn_inputs,)
             )
         cell = _StateCellRNNCell(sc, dyn_inputs[0], static)
-        start_id = 0
+        # the beam seeds from the CALLER's init_ids/init_scores variables
+        # at runtime (ref decode() reads them in its While loop) — a
+        # nonzero start token decodes from that token, not from 0
         decoder = layers.BeamSearchDecoder(
-            cell, start_token=start_id, end_token=self._end_id,
+            cell,
+            start_token=(self._init_ids if self._init_ids is not None
+                         else 0),
+            end_token=self._end_id,
             beam_size=self._beam_size, embedding_fn=embedding_fn,
-            output_fn=output_fn)
+            output_fn=output_fn, init_scores=self._init_scores)
         inits = [sc.get_state(n) for n in sc._state_names]
         outputs, final_states = layers.dynamic_decode(
             decoder, inits=inits if len(inits) > 1 else inits[0],

@@ -170,11 +170,9 @@ class OptimizerWithMixedPrecision:
             nn.scale(bump, scale=self._incr_ratio - 1.0, bias=1.0),
             nn.scale(decay, scale=self._decr_ratio - 1.0, bias=1.0),
         )
+        # no floor: the reference's update_loss_scaling lets the scale
+        # decay freely below 1.0 (tiny scales just mean tiny grads)
         new_scale = nn.elementwise_mul(self._scale_var, factor)
-        # never scale below 1.0 (ref keeps the scale usable)
-        new_scale = nn.elementwise_max(
-            new_scale, tensor.fill_constant([1], "float32", 1.0)
-        )
         assign(self._scale_var, new_scale)
         assign(self._good_steps, nn.elementwise_mul(
             good, nn.scale(bump, scale=-1.0, bias=1.0)))
@@ -184,6 +182,7 @@ class OptimizerWithMixedPrecision:
     def backward(self, loss, **kwargs):
         from ..layers import nn, tensor
 
+        self._finite_flag = None
         if self._use_bf16:
             # bf16 path: no loss scaling needed (same exponent range as
             # fp32) — this is the TPU-native default
@@ -237,6 +236,10 @@ class OptimizerWithMixedPrecision:
                 (p, g if g is None else _unscale_or_zero(g))
                 for p, g in params_grads
             ]
+            # minimize() attaches this as a SkipGate on the update ops so
+            # overflow steps are TRUE skips (no beta-power advance, no
+            # moment decay) — the reference's skip-update semantics
+            self._finite_flag = finite
             self._append_dynamic_update(finite)
         elif self._loss_scaling != 1.0:
             inv = 1.0 / float(self._loss_scaling)
@@ -268,6 +271,15 @@ class OptimizerWithMixedPrecision:
         optimize_ops = self.apply_optimize(
             loss, startup_program, params_grads
         )
+        finite = getattr(self, "_finite_flag", None)
+        if finite is not None:
+            # true skip-update on overflow: gate every per-param update op
+            # (param + accumulators + beta powers all keep their old
+            # values — see lowering.apply_op's SkipGate handling)
+            for op in optimize_ops:
+                if op is not None and hasattr(op, "inputs"):
+                    op.inputs["SkipGate"] = [finite.name]
+            prog._bump_version()
         return optimize_ops, params_grads
 
     def __getattr__(self, item):

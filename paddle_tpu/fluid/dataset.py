@@ -387,7 +387,17 @@ class InMemoryDataset(DatasetBase):
     def preload_into_memory(self, thread_num=None):
         if thread_num is not None:
             self.set_thread(thread_num)
-        t = threading.Thread(target=self.load_into_memory, daemon=True)
+        # parse/pipe_command failures surface in wait_preload_done, not a
+        # misleading "call load_into_memory first" later
+        self._preload_error = []
+
+        def _load():
+            try:
+                self.load_into_memory()
+            except BaseException as e:
+                self._preload_error.append(e)
+
+        t = threading.Thread(target=_load, daemon=True)
         t.start()
         self._preload_threads = [t]
 
@@ -395,6 +405,10 @@ class InMemoryDataset(DatasetBase):
         for t in self._preload_threads or ():
             t.join()
         self._preload_threads = None
+        errs = getattr(self, "_preload_error", None)
+        if errs:
+            self._preload_error = []
+            raise errs[0]
 
     # -- shuffle --------------------------------------------------------
     def _require_memory(self):

@@ -348,9 +348,10 @@ class Executor:
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
                            fetch_handler=None):
-        """Like train_from_dataset but runs a test-pruned clone: ops from
-        the first `backward` op onward (grads + optimizer updates) are
-        dropped, mirroring the reference's infer-mode skip_ops."""
+        """Like train_from_dataset but runs a test-pruned clone: the
+        backward op, optimizer updates, and anything dataflow-dependent
+        on them are dropped (post-minimize forward/metric ops survive),
+        mirroring the reference's infer-mode skip_ops."""
         return self._run_from_dataset(
             program, dataset, scope, thread, True, debug, fetch_list,
             fetch_info, print_period, fetch_handler)
@@ -414,16 +415,48 @@ class Executor:
             dataset._finish_to_run()
         return None
 
-    @staticmethod
-    def _strip_training_ops(program):
-        """Clone with ops from the first `backward` op onward removed —
-        the single-HloModule analogue of the reference infer-mode
-        skip-ops list (grad + update ops never enter the traced step)."""
+    # per-param update op types (mirror of the reference infer-mode
+    # skip-ops list: grad + optimizer ops)
+    _OPT_UPDATE_TYPES = frozenset({
+        "sgd", "momentum", "dgc_momentum", "lars_momentum", "adagrad",
+        "decayed_adagrad", "adadelta", "adam", "adamax", "rmsprop",
+        "ftrl", "lamb", "dpsgd",
+    })
+
+    @classmethod
+    def _strip_training_ops(cls, program):
+        """Clone with the training ops removed: the symbolic `backward`
+        op, per-param update ops, and anything dataflow-dependent on
+        their outputs (clip/regularizer/loss-scaling ops consuming @GRAD
+        vars). Forward/metric ops appended AFTER minimize() survive —
+        the reference infer mode skips op types, it doesn't truncate."""
         pruned = program.clone()
         block = pruned.global_block()
-        for i, op in enumerate(block.ops):
-            if op.type == "backward":
-                block.ops = block.ops[:i]
-                pruned._bump_version()
-                break
+        dead = set()
+        defined = set()  # vars produced by kept ops so far
+        kept = []
+        for op in block.ops:
+            drop = (
+                op.type == "backward"
+                or op.type in cls._OPT_UPDATE_TYPES
+                or any(n in dead for n in op.input_arg_names)
+            )
+            if drop:
+                ins = set(op.input_arg_names)
+                for n in op.output_arg_names:
+                    # only fresh vars die: in-place writes, vars a kept
+                    # op already produced, and persistable vars (their
+                    # startup-initialized value stays valid — e.g. an
+                    # AMP loss-scaling var whose update op is dropped)
+                    var = block.vars.get(n)
+                    if (n not in ins and n not in defined
+                            and not (var is not None and var.persistable)):
+                        dead.add(n)
+            else:
+                kept.append(op)
+                defined.update(op.output_arg_names)
+                dead.difference_update(op.output_arg_names)
+        if len(kept) != len(block.ops):
+            block.ops = kept
+            pruned._bump_version()
         return pruned

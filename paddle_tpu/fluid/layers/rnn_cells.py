@@ -269,13 +269,19 @@ class BeamSearchDecoder(Decoder):
         """Beam decoding state structure (ref rnn.py:817)."""
 
     def __init__(self, cell, start_token, end_token, beam_size,
-                 embedding_fn=None, output_fn=None):
+                 embedding_fn=None, output_fn=None, init_scores=None):
+        """``start_token`` is an int like the reference — or a (B, 1)
+        int64 Variable (e.g. the contrib decoder's fed ``init_ids``), in
+        which case the beam seeds from its runtime values. Optional
+        ``init_scores`` (B, 1) float Variable seeds beam 0's cumulative
+        log-prob (ref contrib beam_search_decoder init_scores)."""
         self.cell = cell
         self.embedding_fn = embedding_fn
         self.output_fn = output_fn
         self.start_token = start_token
         self.end_token = end_token
         self.beam_size = beam_size
+        self.init_scores = init_scores
         self.kinf = 1e9
 
     @staticmethod
@@ -324,16 +330,24 @@ class BeamSearchDecoder(Decoder):
         state = flatten(initial_cell_states)[0]
         init_cell_states = map_structure(
             self._expand_to_beam_size, initial_cell_states)
-        init_ids = T.fill_constant_batch_size_like(
-            input=state, shape=[-1, self.beam_size], dtype="int64",
-            value=self.start_token)
+        if hasattr(self.start_token, "name"):      # runtime (B, 1) ids
+            init_ids = L.expand(T.cast(self.start_token, "int64"),
+                                [1, self.beam_size])
+        else:
+            init_ids = T.fill_constant_batch_size_like(
+                input=state, shape=[-1, self.beam_size], dtype="int64",
+                value=self.start_token)
         # row [0, -inf, -inf, ...]: only beam 0 is live at t=0
         row = T.assign(np.array(
             [[0.0] + [-self.kinf] * (self.beam_size - 1)], dtype="float32"))
-        zeros = T.fill_constant_batch_size_like(
-            input=state, shape=[-1, self.beam_size], dtype="float32",
-            value=0.0)
-        log_probs = L.elementwise_add(zeros, row)
+        if self.init_scores is not None:           # runtime (B, 1) base
+            base = L.expand(T.cast(self.init_scores, "float32"),
+                            [1, self.beam_size])
+        else:
+            base = T.fill_constant_batch_size_like(
+                input=state, shape=[-1, self.beam_size], dtype="float32",
+                value=0.0)
+        log_probs = L.elementwise_add(base, row)
         init_finished = T.fill_constant_batch_size_like(
             input=state, shape=[-1, self.beam_size], dtype="bool",
             value=False)

@@ -82,6 +82,12 @@ def _is_float(v):
 def apply_op(op, env, ctx, var_lookup, op_tag=0):
     fn = get_lowering(op.type)
     ins = resolve_inputs(op, env)
+    # generic skip gate (ref: adam op's SkipUpdate input / AMP found_inf):
+    # when a "SkipGate" input is attached and lowers to 0, every in-place
+    # output (an output bound to the same var as an input — param and
+    # optimizer accumulators) keeps its OLD value, so the whole update op
+    # is a true no-op. One lax.select per state var; XLA fuses it.
+    gate_vals = ins.pop("SkipGate", None)
     ctx.set_op_tag(op_tag)
     ctx.current_env = env  # control-flow ops close over the outer env
     ctx.run_ops = run_ops
@@ -94,6 +100,22 @@ def apply_op(op, env, ctx, var_lookup, op_tag=0):
             "lowering op '%s' failed: %s: %s\n  op: %s\n  defined at:\n%s"
             % (op.type, type(e).__name__, e, op, _format_callstack(op))
         ) from e
+    if gate_vals:
+        gate = jnp.reshape(gate_vals[0], ()) != 0
+        old_by_name = {
+            n: v
+            for slot, names in op.inputs.items() if slot != "SkipGate"
+            for n, v in zip(names, ins.get(slot, []))
+        }
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            vals = list(vals)
+            for i, n in enumerate(names):
+                if i < len(vals) and n in old_by_name:
+                    vals[i] = jnp.where(gate, vals[i], old_by_name[n])
+            outs[slot] = vals
     bind_outputs(op, outs, env, var_lookup)
     return env
 

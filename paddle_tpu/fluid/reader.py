@@ -195,7 +195,11 @@ class DataLoader:
         )
 
         def batches():
-            full = None
+            # the configured batch size is the truth — with QueueDataset's
+            # multi-threaded per-thread tails a PARTIAL batch can arrive
+            # first, so inferring "full" from the first batch would leak
+            # partials through drop_last
+            full = getattr(dataset, "batch_size", None)
             for b in dataset._batch_iterator():
                 if drop_last:
                     if full is None:

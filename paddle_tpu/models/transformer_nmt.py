@@ -11,7 +11,8 @@ from .. import fluid
 from ..fluid import layers
 from ..fluid.param_attr import ParamAttr
 
-__all__ = ["NMTConfig", "build_transformer_nmt", "synthetic_pair_batch"]
+__all__ = ["NMTConfig", "build_transformer_nmt", "synthetic_pair_batch",
+           "TransformerDecodeCell", "build_transformer_beam_decode"]
 
 
 class NMTConfig:
@@ -104,6 +105,15 @@ def _causal_mask(t):
     return layers.unsqueeze(neg, [0, 1])
 
 
+def _encoder_stack(enc, cfg):
+    for i in range(cfg.enc_layers):
+        n = "enc%d" % i
+        enc = _ln(layers.elementwise_add(
+            enc, _mha(enc, enc, cfg, n + ".self")), n + ".ln1")
+        enc = _ln(layers.elementwise_add(enc, _ffn(enc, cfg, n)), n + ".ln2")
+    return enc
+
+
 def build_transformer_nmt(cfg, src_len, tgt_len):
     src = fluid.data(name="src_ids", shape=[None, src_len], dtype="int64",
                      lod_level=1)
@@ -112,12 +122,8 @@ def build_transformer_nmt(cfg, src_len, tgt_len):
     labels = fluid.data(name="tgt_labels", shape=[None, tgt_len],
                         dtype="int64")
 
-    enc = _embed(src, cfg.src_vocab, cfg, "src_emb", src_len)
-    for i in range(cfg.enc_layers):
-        n = "enc%d" % i
-        enc = _ln(layers.elementwise_add(
-            enc, _mha(enc, enc, cfg, n + ".self")), n + ".ln1")
-        enc = _ln(layers.elementwise_add(enc, _ffn(enc, cfg, n)), n + ".ln2")
+    enc = _encoder_stack(
+        _embed(src, cfg.src_vocab, cfg, "src_emb", src_len), cfg)
 
     dec = _embed(tgt, cfg.tgt_vocab, cfg, "tgt_emb", tgt_len)
     cmask = _causal_mask(tgt_len)
@@ -141,6 +147,155 @@ def build_transformer_nmt(cfg, src_len, tgt_len):
         "src_ids": src, "tgt_ids": tgt, "tgt_labels": labels,
         "logits": logits, "loss": loss, "enc_out": enc,
     }
+
+
+class TransformerDecodeCell:
+    """Incremental transformer decoder step with per-layer KV caches —
+    the TPU-native replacement for the reference's while_op `fast_decode`
+    (ref: transformer book example / layers/rnn.py beam search ops).
+
+    One step costs a 1-token QKV projection + attention over the cache
+    (static `tmax` length, masked beyond `pos`) + FFN, instead of
+    re-running the whole prefix. All shapes are static so the entire
+    decode loop lowers to one lax.scan; beam bookkeeping (top-k, state
+    gather by parent beam) is BeamSearchDecoder's.
+
+    States: ``[pos (B,1) int64, k0, v0, k1, v1, ...]`` with each cache
+    (B, tmax, hidden). Parameter names match ``build_transformer_nmt``'s
+    decoder so trained weights load directly.
+    """
+
+    def __init__(self, cfg, tmax):
+        self.cfg = cfg
+        self.tmax = tmax
+
+    def _attend(self, q, k, v, mask):
+        """q (B,1,H), k/v (B,T,H), additive mask broadcastable to
+        (B,nh,1,T) -> context (B,1,H)."""
+        cfg = self.cfg
+        nh, dh = cfg.heads, cfg.hidden // cfg.heads
+
+        def heads(t):
+            t = layers.reshape(t, [0, 0, nh, dh])
+            return layers.transpose(t, [0, 2, 1, 3])
+
+        scores = layers.matmul(heads(q), heads(k), transpose_y=True,
+                               alpha=dh ** -0.5)
+        if mask is not None:
+            scores = layers.elementwise_add(scores, mask)
+        ctx = layers.matmul(layers.softmax(scores), heads(v))
+        ctx = layers.transpose(ctx, [0, 2, 1, 3])
+        return layers.reshape(ctx, [0, 0, cfg.hidden])
+
+    def call(self, inputs, states, enc_kv=None):
+        cfg = self.cfg
+        h = cfg.hidden
+        pos, caches = states[0], states[1:]
+        pos_table = layers.create_parameter(
+            shape=[cfg.max_len, h], dtype="float32", name="tgt_emb.pos")
+        x = layers.elementwise_add(
+            inputs, layers.gather_nd(pos_table, pos))      # (B, H)
+        x = layers.unsqueeze(x, [1])                        # (B, 1, H)
+
+        # cache-write one-hot and <=pos visibility mask, shared by layers
+        steps = layers.unsqueeze(
+            layers.range(0, self.tmax, 1, "int64"), [0])    # (1, T)
+        write = layers.cast(layers.equal(steps, pos), "float32")
+        write3 = layers.unsqueeze(write, [2])               # (B, T, 1)
+        keep3 = layers.scale(write3, scale=-1.0, bias=1.0)
+        seen = layers.cast(
+            layers.less_equal(steps, pos), "float32")       # (B, T)
+        self_mask = layers.scale(seen, scale=1e9, bias=-1e9)
+        self_mask = layers.unsqueeze(self_mask, [1, 2])     # (B,1,1,T)
+
+        def proj(t, name):
+            return layers.fc(t, h, num_flatten_dims=2,
+                             param_attr=ParamAttr(name=name + ".w"),
+                             bias_attr=ParamAttr(name=name + ".b"))
+
+        new_caches = []
+        for i in range(cfg.dec_layers):
+            n = "dec%d" % i
+            k_cache, v_cache = caches[2 * i], caches[2 * i + 1]
+            q = proj(x, n + ".self.q")
+            k_t = proj(x, n + ".self.k")
+            v_t = proj(x, n + ".self.v")
+            k_cache = layers.elementwise_add(
+                layers.elementwise_mul(k_cache, keep3),
+                layers.elementwise_mul(k_t, write3))
+            v_cache = layers.elementwise_add(
+                layers.elementwise_mul(v_cache, keep3),
+                layers.elementwise_mul(v_t, write3))
+            new_caches += [k_cache, v_cache]
+            attn = proj(self._attend(q, k_cache, v_cache, self_mask),
+                        n + ".self.o")
+            x = _ln(layers.elementwise_add(x, attn), n + ".ln1")
+            ek, ev = enc_kv[i]
+            cross = proj(
+                self._attend(proj(x, n + ".cross.q"), ek, ev, None),
+                n + ".cross.o")
+            x = _ln(layers.elementwise_add(x, cross), n + ".ln2")
+            x = _ln(layers.elementwise_add(x, _ffn(x, cfg, n)), n + ".ln3")
+
+        logits = layers.fc(layers.squeeze(x, [1]), cfg.tgt_vocab,
+                           param_attr=ParamAttr(name="out_proj.w"),
+                           bias_attr=ParamAttr(name="out_proj.b"))
+        one = layers.fill_constant([1], "int64", 1)
+        new_pos = layers.elementwise_add(pos, one)
+        return logits, [new_pos] + new_caches
+
+    def __call__(self, inputs, states, **kwargs):
+        return self.call(inputs, states, **kwargs)
+
+
+def build_transformer_beam_decode(cfg, src_len, max_out_len, beam_size):
+    """Beam-search translation graph: encoder + KV-cache incremental
+    decoder under dynamic_decode/BeamSearchDecoder (static beam, one
+    lax.scan). Returns predicted ids (B, T_out, beam) and beam scores."""
+    src = fluid.data(name="src_ids", shape=[None, src_len], dtype="int64",
+                     lod_level=1)
+    enc = _encoder_stack(
+        _embed(src, cfg.src_vocab, cfg, "src_emb", src_len), cfg)
+
+    cell = TransformerDecodeCell(cfg, max_out_len)
+    decoder = layers.BeamSearchDecoder(
+        cell, start_token=cfg.bos_id, end_token=cfg.eos_id,
+        beam_size=beam_size,
+        embedding_fn=lambda ids: layers.embedding(
+            ids, size=[cfg.tgt_vocab, cfg.hidden],
+            param_attr=ParamAttr(name="tgt_emb")),
+    )
+
+    # per-layer cross-attention K/V from the encoder, computed ONCE and
+    # beam-tiled (the pserver-era reference recomputes these per step
+    # inside its While loop)
+    enc_kv = []
+    for i in range(cfg.dec_layers):
+        n = "dec%d" % i
+
+        def tiled(name):
+            t = layers.fc(enc, cfg.hidden, num_flatten_dims=2,
+                          param_attr=ParamAttr(name=name + ".w"),
+                          bias_attr=ParamAttr(name=name + ".b"))
+            return layers.BeamSearchDecoder.tile_beam_merge_with_batch(
+                t, beam_size)
+
+        enc_kv.append((tiled(n + ".cross.k"), tiled(n + ".cross.v")))
+
+    pos0 = layers.fill_constant_batch_size_like(
+        enc, shape=[-1, 1], dtype="int64", value=0)
+    init_states = [pos0]
+    for _ in range(cfg.dec_layers):
+        for _ in ("k", "v"):
+            init_states.append(layers.fill_constant_batch_size_like(
+                enc, shape=[-1, max_out_len, cfg.hidden], dtype="float32",
+                value=0.0))
+
+    ids, final_states = layers.dynamic_decode(
+        decoder, inits=init_states, max_step_num=max_out_len - 1,
+        enc_kv=enc_kv)
+    return {"src_ids": src, "ids": ids,
+            "scores": final_states.log_probs}
 
 
 def synthetic_pair_batch(cfg, batch, src_len, tgt_len, seed=0):

@@ -1,0 +1,223 @@
+"""Round-4 regression tests for the round-3 advisor findings:
+contrib beam decoder honoring init_ids/init_scores, preload error
+propagation, from_dataset partial-batch handling, AMP true skip-update
+on overflow, and infer-mode op filtering."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.param_attr import ParamAttr
+
+
+# ---------------------------------------------------------------------------
+# 1. contrib BeamSearchDecoder seeds the beam from init_ids / init_scores
+# ---------------------------------------------------------------------------
+def _simple_contrib_decode(start_ids, init_scores_np, d=4, v=7, emb=3,
+                           beam=2, max_len=4):
+    from paddle_tpu.fluid.contrib.decoder import (
+        BeamSearchDecoder, InitState, StateCell)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7   # same weights every call
+    with fluid.program_guard(main, startup):
+        enc = fluid.data("enc_h", shape=[None, d], dtype="float32")
+        init_ids = fluid.data("bsd_init_ids", shape=[None, 1],
+                              dtype="int64")
+        init_scores = fluid.data("bsd_init_scores", shape=[None, 1],
+                                 dtype="float32")
+        sc = StateCell(inputs={"x": None},
+                       states={"h": InitState(init=enc)}, out_state="h")
+
+        def updater(cell):
+            x = cell.get_input("x")
+            h = cell.get_state("h")
+            nh = layers.fc(
+                layers.concat([x, h], axis=-1), d, act="tanh",
+                num_flatten_dims=len(x.shape) - 1,
+                param_attr=ParamAttr(name="r4_dec.w"),
+                bias_attr=ParamAttr(name="r4_dec.b"))
+            cell.set_state("h", nh)
+
+        sc.state_updater(updater)
+        dec = BeamSearchDecoder(
+            sc, init_ids=init_ids, init_scores=init_scores,
+            target_dict_dim=v, word_dim=emb, beam_size=beam,
+            max_len=max_len, end_id=1)
+        dec.decode()
+        ids, scores = dec()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    B = len(start_ids)
+    rng = np.random.default_rng(3)
+    feed = {
+        "enc_h": rng.standard_normal((B, d)).astype("float32"),
+        "bsd_init_ids": np.asarray(start_ids, "int64")[:, None],
+        "bsd_init_scores": np.asarray(init_scores_np, "float32")[:, None],
+    }
+    out_ids, out_scores = exe.run(main, feed=feed,
+                                  fetch_list=[ids, scores])
+    return np.asarray(out_ids), np.asarray(out_scores)
+
+
+def test_contrib_decoder_honors_init_ids():
+    """Decoding from start token 5 must differ from start token 0 (the
+    old code silently hardcoded 0)."""
+    ids0, _ = _simple_contrib_decode([0, 0], [0.0, 0.0])
+    ids5, _ = _simple_contrib_decode([5, 5], [0.0, 0.0])
+    assert not np.array_equal(ids0, ids5)
+    # and per-row start ids are honored independently
+    ids_mixed, _ = _simple_contrib_decode([0, 5], [0.0, 0.0])
+    np.testing.assert_array_equal(ids_mixed[0], ids0[0])
+    np.testing.assert_array_equal(ids_mixed[1], ids5[1])
+
+
+def test_contrib_decoder_honors_init_scores():
+    """init_scores offsets the cumulative beam scores."""
+    _, s0 = _simple_contrib_decode([2, 2], [0.0, 0.0])
+    _, s7 = _simple_contrib_decode([2, 2], [7.0, 7.0])
+    np.testing.assert_allclose(s7, s0 + 7.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 2. preload_into_memory propagates parse errors to wait_preload_done
+# ---------------------------------------------------------------------------
+def test_preload_error_surfaces_in_wait(tmp_path):
+    bad = tmp_path / "bad.txt"
+    bad.write_text("not-an-int definitely_not_numeric\n")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.data("r4_pl_x", shape=[None, 2], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(2)
+    ds.set_filelist([str(bad)])
+    ds.set_use_var([x])
+    ds.preload_into_memory()
+    with pytest.raises(Exception) as ei:
+        ds.wait_preload_done()
+    assert "load_into_memory" not in str(ei.value)  # the REAL error
+
+
+# ---------------------------------------------------------------------------
+# 3. DataLoader.from_dataset: partial batches filtered by configured size
+# ---------------------------------------------------------------------------
+def test_from_dataset_drop_last_uses_configured_batch_size():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.data("r4fd_x", shape=[None, 2], dtype="float32")
+    ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var([x])
+
+    def fake_iter(thread=0):
+        def mk(n):
+            return [(np.zeros(2, "float32"),)] * n
+
+        # a per-thread TAIL (partial) batch arrives FIRST — inferring
+        # "full" from it would then drop every real full batch
+        yield mk(3)
+        yield mk(4)
+        yield mk(4)
+        yield mk(2)
+
+    ds._batch_iterator = fake_iter
+    ds._prepare_to_run = lambda: None
+    loader = fluid.DataLoader.from_dataset(
+        ds, places=fluid.CPUPlace(), drop_last=True)
+    sizes = [b["r4fd_x"].shape[0] for b in loader()]
+    assert sizes == [4, 4], sizes
+
+
+# ---------------------------------------------------------------------------
+# 4. AMP dynamic loss scaling: overflow steps are TRUE skips
+# ---------------------------------------------------------------------------
+def test_amp_overflow_skips_optimizer_state():
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("r4amp_x", shape=[None, 4], dtype="float32")
+        y = fluid.layers.fc(x, size=1,
+                            param_attr=ParamAttr(name="r4amp.w"))
+        loss = fluid.layers.reduce_mean(y)
+        opt = mp.decorate(
+            fluid.optimizer.Adam(learning_rate=0.1),
+            init_loss_scaling=8.0, use_dynamic_loss_scaling=True,
+            use_bf16=False, decr_every_n_nan_or_inf=1, decr_ratio=0.5)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+
+    def snap():
+        out = {}
+        for name in list(scope.keys()):
+            if "moment" in name or "beta" in name or name == "r4amp.w":
+                out[name] = np.array(scope.find_value(name))
+        return out
+
+    ok = np.ones((2, 4), "float32")
+    exe.run(main, feed={"r4amp_x": ok}, fetch_list=[loss])
+    before = snap()
+    assert any("moment" in k for k in before), list(before)
+    bad = np.full((2, 4), np.inf, "float32")
+    exe.run(main, feed={"r4amp_x": bad}, fetch_list=[loss])
+    after = snap()
+    for k, v in before.items():
+        np.testing.assert_array_equal(
+            v, after[k]), "state %s advanced on overflow step" % k
+    # and a good step does advance state again
+    exe.run(main, feed={"r4amp_x": ok}, fetch_list=[loss])
+    moved = snap()
+    assert any(
+        not np.array_equal(moved[k], after[k]) for k in moved
+    ), "good step after overflow must update state"
+
+
+def test_amp_scale_decays_below_one():
+    """The reference does not floor the dynamic scale at 1.0."""
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("r4amp2_x", shape=[None, 2], dtype="float32")
+        y = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.reduce_mean(y)
+        opt = mp.decorate(
+            fluid.optimizer.SGD(learning_rate=0.1),
+            init_loss_scaling=2.0, use_dynamic_loss_scaling=True,
+            use_bf16=False, decr_every_n_nan_or_inf=1, decr_ratio=0.5)
+        opt.minimize(loss)
+        scale_var = opt.get_loss_scaling()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bad = np.full((2, 2), np.inf, "float32")
+    for _ in range(4):
+        exe.run(main, feed={"r4amp2_x": bad}, fetch_list=[loss])
+    scale = float(np.asarray(
+        fluid.global_scope().find_value(scale_var.name)))
+    assert scale < 1.0, scale
+
+
+# ---------------------------------------------------------------------------
+# 5. infer-mode strip keeps post-minimize forward/metric ops
+# ---------------------------------------------------------------------------
+def test_strip_training_ops_keeps_post_minimize_forward():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("r4s_x", shape=[None, 3], dtype="float32")
+        y = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.reduce_mean(y)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+        # a metric appended AFTER minimize (the advisor's scenario)
+        metric = fluid.layers.scale(loss, scale=3.0)
+    pruned = fluid.Executor._strip_training_ops(main)
+    types = [op.type for op in pruned.global_block().ops]
+    assert "backward" not in types
+    assert "adam" not in types
+    assert "scale" in types  # the post-minimize metric survived
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(pruned,
+                  feed={"r4s_x": np.ones((2, 3), "float32")},
+                  fetch_list=[metric])
+    assert np.isfinite(np.asarray(out[0])).all()
