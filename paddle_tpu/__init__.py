@@ -20,6 +20,12 @@ from . import distributed  # noqa: F401
 from . import compat  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import utils  # noqa: F401
+# ref paddle/__init__.py runs the Windows scipy-DLL diagnosis at import
+from .check_import_scipy import check_import_scipy
+import os as _os
+
+check_import_scipy(_os.name)
+del _os
 
 __version__ = "0.1.0"
 

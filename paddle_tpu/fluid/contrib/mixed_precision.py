@@ -170,9 +170,15 @@ class OptimizerWithMixedPrecision:
             nn.scale(bump, scale=self._incr_ratio - 1.0, bias=1.0),
             nn.scale(decay, scale=self._decr_ratio - 1.0, bias=1.0),
         )
-        # no floor: the reference's update_loss_scaling lets the scale
-        # decay freely below 1.0 (tiny scales just mean tiny grads)
         new_scale = nn.elementwise_mul(self._scale_var, factor)
+        # floor at 1.0 like the reference kernel
+        # (operators/amp/update_loss_scaling_op.h clamps the decremented
+        # scale to 1) — without it a persistently-diverging run decays
+        # the scale toward 0, and at scale==0 all grads are zero-finite
+        # while 1/scale is inf: NaNs would APPLY through the SkipGate
+        new_scale = nn.elementwise_max(
+            new_scale, tensor.fill_constant([1], "float32", 1.0)
+        )
         assign(self._scale_var, new_scale)
         assign(self._good_steps, nn.elementwise_mul(
             good, nn.scale(bump, scale=-1.0, bias=1.0)))

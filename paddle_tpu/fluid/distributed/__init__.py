@@ -1,0 +1,4 @@
+"""fluid.distributed — the pre-fleet Downpour API
+(ref: python/paddle/fluid/distributed/__init__.py)."""
+from .downpour import DownpourSGD  # noqa: F401
+from .node import DownpourServer, DownpourWorker, Server, Worker  # noqa: F401

@@ -1,0 +1,102 @@
+"""DownpourSGD — the pre-fleet Downpour distributed optimizer
+(ref: python/paddle/fluid/distributed/downpour.py:24-168).
+
+Reference flow: find the distributed lookup table, register sparse +
+dense (+ data-norm) tables on DownpourServer/Worker protobufs, append
+backward, and SKIP the lookup_table ops on workers (pservers apply the
+sparse updates asynchronously).
+
+TPU mapping: same discovery and table registry (dict descs), but the
+sparse table shards its vocab over the mesh and updates inside the
+synchronous step, so ``worker_skipped_ops`` is empty and the returned
+``ps_param`` is the dict desc. The update ops come from an inner
+SGD optimizer at this class's learning rate — Downpour's async "window"
+staleness has no synchronous counterpart and is recorded only.
+"""
+from ..distribute_lookup_table import (
+    find_distributed_lookup_table,
+    find_distributed_lookup_table_inputs,
+    find_distributed_lookup_table_outputs,
+)
+from .node import DownpourServer, DownpourWorker
+
+__all__ = ["DownpourSGD"]
+
+
+class DownpourSGD(object):
+    """ref downpour.py:24."""
+
+    def __init__(self, learning_rate=0.001, window=1):
+        self.learning_rate_ = learning_rate
+        self.window_ = window
+        self.type = "downpour"
+        self.data_norm_name = [
+            ".batch_size", ".batch_square_sum", ".batch_sum",
+        ]
+
+    def minimize(self, losses, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .. import optimizer as optimizer_mod
+
+        if not isinstance(losses, list):
+            raise ValueError("losses is a list, just like [model.cost]")
+        program = losses[0].block.program
+        table_name = find_distributed_lookup_table(program)
+        server = DownpourServer()
+        worker = DownpourWorker(self.window_)
+        sparse_idx = 0
+        if table_name is not None:
+            slots = find_distributed_lookup_table_inputs(
+                program, table_name)
+            slots_emb = find_distributed_lookup_table_outputs(
+                program, table_name)
+            server.add_sparse_table(
+                sparse_idx, self.learning_rate_, slots, slots_emb)
+            worker.add_sparse_table(
+                sparse_idx, self.learning_rate_, slots, slots_emb)
+
+        param_grads_list = []
+        dense_idx = 1
+        for loss in losses:
+            opt = optimizer_mod.SGD(self.learning_rate_)
+            _, params_grads = opt.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+            params_grads = sorted(params_grads, key=lambda x: x[0].name)
+            param_grads_list.append(params_grads)
+            dense, dnorm = [], []
+            for p, g in params_grads:
+                (dnorm if any(p.name.endswith(s)
+                              for s in self.data_norm_name)
+                 else dense).append((p, g))
+            server.add_dense_table(
+                dense_idx, self.learning_rate_,
+                [p for p, _ in dense], [g for _, g in dense])
+            worker.add_dense_table(
+                dense_idx, self.learning_rate_,
+                [p for p, _ in dense], [g for _, g in dense])
+            if dnorm:
+                dense_idx += 1
+                server.add_data_norm_table(
+                    dense_idx, self.learning_rate_,
+                    [p for p, _ in dnorm], [g for _, g in dnorm])
+                worker.add_dense_table(
+                    dense_idx, self.learning_rate_,
+                    [p for p, _ in dnorm], [g for _, g in dnorm])
+            dense_idx += 1
+
+        ps_param = {
+            "server_param": server.get_desc(),
+            "trainer_param": worker.get_desc(),
+        }
+        # nothing is remote on TPU: lookup_table runs inside the step
+        worker_skipped_ops = []
+        opt_info = {
+            "trainer": "DistMultiTrainer",
+            "device_worker": "DownpourSGD",
+            "optimizer": "DownpourSGD",
+            "fleet_desc": ps_param,
+            "worker_skipped_ops": worker_skipped_ops,
+        }
+        for loss in losses:
+            loss.block.program._fleet_opt = opt_info
+        return ps_param, param_grads_list

@@ -1,0 +1,96 @@
+"""Old-style Downpour table-config carriers
+(ref: python/paddle/fluid/distributed/node.py:17-160 — the pre-pslib
+positional API: add_sparse_table(table_id, learning_rate, slot_key_vars,
+slot_value_vars)). Dict descs instead of brpc protobufs; see the pslib
+node module for the sharded-embedding mapping these configs feed.
+"""
+
+__all__ = ["Server", "Worker", "DownpourServer", "DownpourWorker"]
+
+
+class Server(object):
+    def __init__(self):
+        self._desc = {}
+
+    def get_desc(self):
+        return self._desc
+
+
+class Worker(object):
+    def __init__(self):
+        self._desc = {}
+
+    def get_desc(self):
+        return self._desc
+
+
+class DownpourServer(Server):
+    """ref node.py:35."""
+
+    def __init__(self):
+        super().__init__()
+        self._desc = {
+            "service": {
+                "server_class": "DownpourBrpcPsServer",
+                "client_class": "DownpourBrpcPsClient",
+                "service_class": "DownpourPsService",
+            },
+            "tables": {},
+        }
+
+    def add_sparse_table(self, table_id, learning_rate, slot_key_vars,
+                         slot_value_vars):
+        self._desc["tables"][int(table_id)] = {
+            "type": "sparse",
+            "table_class": "DownpourSparseTable",
+            "accessor_class": "DownpourFeatureValueAccessor",
+            "learning_rate": float(learning_rate),
+            "slot_key": [getattr(v, "name", v)
+                         for v in (slot_key_vars or [])],
+            "slot_value": [getattr(v, "name", v)
+                           for v in (slot_value_vars or [])],
+        }
+
+    def add_dense_table(self, table_id, learning_rate, param_var, grad_var):
+        self._desc["tables"][int(table_id)] = {
+            "type": "dense",
+            "table_class": "DownpourDenseTable",
+            "accessor_class": "DownpourDenseValueAccessor",
+            "learning_rate": float(learning_rate),
+            "params": [getattr(p, "name", p) for p in (param_var or [])],
+            "grads": [getattr(g, "name", g) for g in (grad_var or [])],
+        }
+
+    def add_data_norm_table(self, table_id, learning_rate, param_var,
+                            grad_var):
+        self.add_dense_table(table_id, learning_rate, param_var, grad_var)
+        self._desc["tables"][int(table_id)]["data_norm"] = True
+
+
+class DownpourWorker(Worker):
+    """ref node.py:122."""
+
+    def __init__(self, window=1):
+        super().__init__()
+        self.window = window
+        self._desc = {"tables": {}}
+
+    def add_sparse_table(self, table_id, learning_rate, slot_key_vars,
+                         slot_value_vars):
+        self._desc["tables"][int(table_id)] = {
+            "type": "sparse",
+            "learning_rate": float(learning_rate),
+            "slot_key": [getattr(v, "name", v)
+                         for v in (slot_key_vars or [])],
+            "slot_value": [getattr(v, "name", v)
+                           for v in (slot_value_vars or [])],
+        }
+
+    def add_dense_table(self, table_id, learning_rate, param_vars,
+                        grad_vars):
+        self._desc["tables"][int(table_id)] = {
+            "type": "dense",
+            "learning_rate": float(learning_rate),
+            "params": [getattr(p, "name", p) for p in (param_vars or [])],
+            "grads": [getattr(g, "name", g) for g in (grad_vars or [])],
+        }

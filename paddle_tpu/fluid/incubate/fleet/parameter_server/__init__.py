@@ -1,8 +1,11 @@
 """fleet.parameter_server (ref: incubate/fleet/parameter_server).
 
-The reference's pserver training mode has no TPU counterpart — sparse
-updates flow over ICI collectives instead (see fluid/transpiler.py's
-documented re-mapping). The import path is kept so scripts can probe it;
-using the pserver fleet raises with that guidance.
+Two surfaces:
+- ``pslib`` — the Downpour/PSLib fleet WORKS here: sparse tables map to
+  vocab-sharded embeddings over the mesh (see pslib/__init__.py).
+- ``distribute_transpiler`` — the transpiler-based pserver fleet keeps
+  its import path but raises with guidance (sparse updates flow over
+  ICI collectives instead; see fluid/transpiler.py's re-mapping).
 """
 from . import distribute_transpiler  # noqa: F401
+from . import pslib  # noqa: F401

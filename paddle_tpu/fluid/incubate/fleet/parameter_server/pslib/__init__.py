@@ -1,0 +1,249 @@
+"""PSLib fleet — the Downpour parameter-server training surface
+(ref: incubate/fleet/parameter_server/pslib/__init__.py:28-652).
+
+TPU-native mapping (SURVEY row 30's pserver story): the reference runs
+brpc DownpourPsServer processes holding sparse tables that workers
+prefetch from and push async grads to. On TPU the "servers" are the
+chips themselves — every distributed lookup table becomes a VOCAB-
+SHARDED embedding parameter over the mesh ('mp' axis when
+strategy["embedding_parallel_degree"] > 1, else the dp axis), the
+lookup is a sharded gather XLA routes over ICI, and the update rides
+the same synchronous jitted step. Worker/server lifecycle calls become
+no-ops (documented per method); the irreducibly-async pieces
+(feature-frequency cache models, table shrink) raise with guidance.
+
+A fluid-era pslib CTR script — init / distributed_optimizer(Adam) /
+minimize / train — runs unchanged on the virtual mesh
+(tests/test_pslib.py).
+"""
+import jax
+
+from .....framework import default_main_program, default_startup_program
+from ......parallel.mesh import build_mesh
+from ......parallel.sharding import DistributedProgram, ShardingRule
+from .node import DownpourServer, DownpourWorker  # noqa: F401
+from .optimizer_factory import DistributedAdam  # noqa: F401
+
+__all__ = ["PSLib", "DownpourOptimizer", "fleet"]
+
+_ASYNC_ONLY = (
+    "it manipulates live async pserver table state (feature-frequency "
+    "accessors); on TPU the table is a sharded in-HBM parameter — use "
+    "save/load_persistables for snapshots"
+)
+
+
+class PSLib:
+    """ref pslib/__init__.py:28 (class PSLib(Fleet))."""
+
+    def __init__(self):
+        self._role_maker = None
+        self._opt_info = None
+        self._distributed_program = None
+        self._strategy = {}
+
+    # -- lifecycle (ref :42-194) -----------------------------------------
+    def init(self, role_maker=None):
+        from ......parallel.fleet import PaddleCloudRoleMaker
+
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        return self
+
+    def init_worker(self):
+        """ref :52 — brpc client setup + barrier. The mesh IS the comm
+        fabric; nothing to initialize."""
+
+    def init_server(self, model_dir=None, **kwargs):
+        """ref :128 — server-side model load. No server processes exist;
+        load into the (sharded) scope instead."""
+        if model_dir is not None:
+            from ..... import io
+            from .....executor import Executor
+
+            io.load_persistables(Executor(), model_dir,
+                                 default_main_program())
+
+    def run_server(self):
+        raise NotImplementedError(
+            "PSLib.run_server: there are no parameter-server processes "
+            "on TPU — every chip holds its vocab shard of each table "
+            "inside the training step. Run the worker path only "
+            "(is_server() is always False here)."
+        )
+
+    def stop_worker(self):
+        """ref :179 — brpc teardown; no-op."""
+
+    def _set_client_communication_config(self, request_timeout_ms=None,
+                                         connect_timeout_ms=None,
+                                         max_retry=None):
+        """ref :46 — brpc knobs; accepted and ignored (no rpc layer)."""
+
+    # -- role ------------------------------------------------------------
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def is_server(self):
+        return False  # the chips are the servers; scripts run worker path
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_num(self):
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    # -- optimize --------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._strategy = dict(strategy or {})
+        return DownpourOptimizer(optimizer, self._strategy, self)
+
+    @property
+    def main_program(self):
+        return self._distributed_program or default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    def _build(self, opt_info):
+        """Mesh + vocab-sharding rules from the table config."""
+        from jax.sharding import PartitionSpec as P
+
+        self._opt_info = opt_info
+        ndev = len(jax.devices())
+        mp = int(self._strategy.get("embedding_parallel_degree", 0))
+        if mp > 1:
+            if ndev % mp:
+                raise ValueError(
+                    "embedding_parallel_degree=%d does not divide the "
+                    "%d-device mesh" % (mp, ndev))
+            axes = {"dp": ndev // mp, "mp": mp}
+            table_axis = "mp"
+        else:
+            axes = {"dp": ndev}
+            table_axis = "dp"   # servers == workers == chips
+        mesh = build_mesh(axes)
+        import re
+
+        rules = [
+            ShardingRule("^" + re.escape(name) + "$", P(table_axis, None))
+            for name in opt_info["sparse_table_names"]
+        ]
+        self._distributed_program = DistributedProgram(
+            opt_info["program"], mesh, param_rules=rules)
+        return self._distributed_program
+
+    # -- persistence (ref :215-288) --------------------------------------
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ..... import io
+
+        return io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or default_main_program(),
+            export_for_deployment=export_for_deployment)
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          **kwargs):
+        from ..... import io
+
+        return io.save_persistables(
+            executor, dirname, main_program or default_main_program())
+
+    def print_table_stat(self, table_id):
+        """ref :241 — prints feasign count; here: rows/params of the
+        table parameter."""
+        import numpy as np
+
+        from .....executor import global_scope
+
+        names = self._opt_info["sparse_table_names"] if self._opt_info \
+            else []
+        ids = self._opt_info["sparse_table_ids"] if self._opt_info else {}
+        for name in names:
+            if ids.get(name) == int(table_id):
+                val = global_scope().find_value(name)
+                if val is not None:
+                    arr = np.asarray(val)
+                    print("table %d (%s): shape %s, l2 %.6f"
+                          % (table_id, name, arr.shape,
+                             float(np.sqrt((arr ** 2).sum()))))
+                return
+        print("table %d: not found" % table_id)
+
+    def clear_model(self):
+        """ref :392 — zero every table parameter in the scope."""
+        import numpy as np
+
+        from .....executor import global_scope
+
+        scope = global_scope()
+        prog = (self._opt_info or {}).get("program") \
+            or default_main_program()
+        for p in prog.global_block().all_parameters():
+            val = scope.find_value(p.name)
+            if val is not None:
+                scope.update(p.name, np.zeros_like(np.asarray(val)))
+
+    # -- irreducibly-async surface ---------------------------------------
+    def save_cache_model(self, executor, dirname, main_program=None,
+                         **kwargs):
+        raise NotImplementedError(
+            "PSLib.save_cache_model filters feasigns by a live access-"
+            "frequency accessor; " + _ASYNC_ONLY)
+
+    def shrink_sparse_table(self):
+        raise NotImplementedError(
+            "PSLib.shrink_sparse_table evicts cold feasigns from async "
+            "tables; " + _ASYNC_ONLY)
+
+    def shrink_dense_table(self, decay, emb_dim=11, scope=None,
+                           table_id=None):
+        raise NotImplementedError(
+            "PSLib.shrink_dense_table decays server-held dense values; "
+            + _ASYNC_ONLY)
+
+    def load_one_table(self, table_id, model_path, **kwargs):
+        raise NotImplementedError(
+            "PSLib.load_one_table streams a single brpc table; use "
+            "load_persistables (the whole sharded scope) instead")
+
+
+class DownpourOptimizer:
+    """ref pslib/__init__.py:550 (DownpourOptimizer(DistributedOptimizer)):
+    wraps a regular optimizer with DistributedAdam's table build."""
+
+    def __init__(self, optimizer, strategy=None, fleet_obj=None):
+        self._optimizer = optimizer
+        self._strategy = dict(strategy or {})
+        self._fleet = fleet_obj if fleet_obj is not None else fleet
+        self._impl = DistributedAdam(optimizer)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set,
+            callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, losses, startup_programs=None, parameter_list=None,
+                 no_grad_set=None):
+        optimize_ops, params_grads, opt_info = self._impl._minimize(
+            losses,
+            startup_programs[0] if isinstance(
+                startup_programs, (list, tuple)) else startup_programs,
+            parameter_list, no_grad_set, strategy=self._strategy)
+        self._fleet._build(opt_info)
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+fleet = PSLib()

@@ -1,0 +1,159 @@
+"""Downpour server/worker table-config carriers
+(ref: incubate/fleet/parameter_server/pslib/node.py:18-523).
+
+The reference fills brpc protobuf descs (ps_pb2) that configure live
+DownpourBrpcPsServer processes. On TPU there are no server processes —
+the sparse tables ARE the vocab-sharded embedding parameters in HBM —
+so these classes validate the same strategy keys and carry the same
+logical desc as plain dicts. PSLib's optimizer reads them to shard each
+table's vocab dim over the mesh; everything else (accessor CVM decay,
+brpc service classes) is recorded for introspection parity.
+"""
+
+__all__ = ["Server", "Worker", "DownpourServer", "DownpourWorker"]
+
+_SPARSE_TABLE_CLASSES = ("DownpourSparseTable", "DownpourSparseSSDTable")
+_SPARSE_ACCESSORS = (
+    "DownpourSparseValueAccessor", "DownpourCtrAccessor",
+    "DownpourFeatureValueAccessor",
+)
+
+
+class Server(object):
+    """ref node.py:18 — base config carrier."""
+
+    def __init__(self):
+        self._desc = {}
+
+    def get_desc(self):
+        return self._desc
+
+
+class Worker(object):
+    """ref node.py:28."""
+
+    def __init__(self):
+        self._desc = {}
+
+    def get_desc(self):
+        return self._desc
+
+
+class DownpourServer(Server):
+    """Sparse/dense table config (ref node.py:38). Table descs feed the
+    PSLib optimizer's sharding rules instead of brpc server processes."""
+
+    def __init__(self):
+        super().__init__()
+        self._desc = {
+            "service": {
+                # parity fields; no brpc service runs on TPU
+                "server_class": "DownpourBrpcPsServer",
+                "client_class": "DownpourBrpcPsClient",
+                "service_class": "DownpourPsService",
+            },
+            "tables": {},
+        }
+
+    def add_sparse_table(self, table_id, strategy):
+        """ref node.py:55. ``strategy`` keys mirror the reference
+        (sparse_table_class, sparse_accessor_class, sparse_embedx_dim,
+        sparse_learning_rate, ...)."""
+        strategy = dict(strategy or {})
+        table_id = int(table_id)
+        if table_id in self._desc["tables"]:
+            if self._desc["tables"][table_id]["type"] != "sparse":
+                raise ValueError(
+                    "table %d already defined as dense" % table_id)
+            return
+        table_class = strategy.get(
+            "sparse_table_class", "DownpourSparseTable")
+        if table_class not in _SPARSE_TABLE_CLASSES:
+            raise ValueError(
+                "unsupported sparse_table_class %r (expected one of %s)"
+                % (table_class, (_SPARSE_TABLE_CLASSES,)))
+        accessor = strategy.get(
+            "sparse_accessor_class", "DownpourCtrAccessor")
+        if accessor not in _SPARSE_ACCESSORS:
+            raise ValueError(
+                "unsupported sparse_accessor_class %r (expected one of "
+                "%s)" % (accessor, (_SPARSE_ACCESSORS,)))
+        self._desc["tables"][table_id] = {
+            "type": "sparse",
+            "table_class": table_class,
+            "accessor_class": accessor,
+            "embedx_dim": int(strategy.get("sparse_embedx_dim", 8)),
+            "fea_dim": int(strategy.get("sparse_fea_dim", 11)),
+            "learning_rate": float(
+                strategy.get("sparse_learning_rate", 0.05)),
+            "shard_num": int(strategy.get("sparse_shard_num", 1000)),
+            "strategy": strategy,
+        }
+
+    def add_dense_table(self, table_id, param_var, grad_var, strategy,
+                        sparse_table_names=None):
+        """ref node.py:245 — dense params stay replicated on TPU; the
+        desc records which vars ride this table."""
+        strategy = dict(strategy or {})
+        table_id = int(table_id)
+        if table_id in self._desc["tables"]:
+            return
+        self._desc["tables"][table_id] = {
+            "type": "dense",
+            "table_class": strategy.get(
+                "dense_table_class", "DownpourDenseTable"),
+            "accessor_class": strategy.get(
+                "dense_accessor_class", "DownpourDenseValueAccessor"),
+            "learning_rate": float(
+                strategy.get("dense_learning_rate", 5e-6)),
+            "params": [getattr(p, "name", p) for p in (param_var or [])],
+            "grads": [getattr(g, "name", g) for g in (grad_var or [])],
+            # ref threads the sparse-table names so CTR accessors can
+            # exclude them from dense pulls; recorded for introspection
+            "exclude_sparse_tables": list(sparse_table_names or []),
+        }
+
+    def add_data_norm_table(self, table_id, learning_rate, param_var,
+                            grad_var, strategy=None,
+                            sparse_table_names=None):
+        """ref node.py:309 — data-norm stats are summable dense vars."""
+        merged = dict(strategy or {})
+        merged.setdefault("dense_table_class", "DownpourDenseTable")
+        merged.setdefault("dense_accessor_class",
+                          "DownpourDenseValueAccessor")
+        merged["dense_learning_rate"] = learning_rate
+        self.add_dense_table(table_id, param_var, grad_var, merged,
+                             sparse_table_names)
+        self._desc["tables"][int(table_id)]["data_norm"] = True
+
+
+class DownpourWorker(Worker):
+    """Worker-side view of the same tables (ref node.py:375)."""
+
+    def __init__(self, window=1):
+        super().__init__()
+        self.window = window
+        self._desc = {"tables": {}}
+
+    def add_sparse_table(self, table_id, slot_key_vars=None,
+                         slot_value_vars=None, strategy=None):
+        self._desc["tables"][int(table_id)] = {
+            "type": "sparse",
+            "strategy": dict(strategy or {}),
+            "slot_key": [getattr(v, "name", v)
+                         for v in (slot_key_vars or [])],
+            "slot_value": [getattr(v, "name", v)
+                           for v in (slot_value_vars or [])],
+        }
+
+    def add_dense_table(self, table_id, learning_rate=None, param_vars=None,
+                        grad_vars=None, dense_start_table_id=None,
+                        sparse_table_names=None):
+        self._desc["tables"][int(table_id)] = {
+            "type": "dense",
+            "learning_rate": learning_rate,
+            "dense_start_table_id": dense_start_table_id,
+            "exclude_sparse_tables": list(sparse_table_names or []),
+            "params": [getattr(p, "name", p) for p in (param_vars or [])],
+            "grads": [getattr(g, "name", g) for g in (grad_vars or [])],
+        }

@@ -19,6 +19,7 @@ import warnings
 
 from .. import framework
 from . import ps_dispatcher
+from . import details  # noqa: F401
 from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
 from . import distribute_lookup_table
 from .distribute_lookup_table import (  # noqa: F401

@@ -1,0 +1,18 @@
+"""transpiler.details (ref: fluid/transpiler/details/__init__.py) —
+program introspection helpers fluid-era transpiler users import."""
+from .program_utils import (  # noqa: F401
+    delete_ops,
+    find_op_by_input_arg,
+    find_op_by_output_arg,
+    program_to_code,
+    block_to_code,
+    op_to_code,
+    variable_to_code,
+)
+from .ufind import UnionFind  # noqa: F401
+from .checkport import wait_server_ready  # noqa: F401
+from .vars_distributed import (  # noqa: F401
+    VarStruct,
+    VarDistributed,
+    VarsDistributed,
+)

@@ -1,0 +1,34 @@
+"""wait_server_ready (ref: fluid/transpiler/details/checkport.py:22).
+
+Generic TCP readiness wait — on TPU there are no pservers, but the same
+helper is useful for multi-host coordinator startup (jax.distributed
+coordinator address), so it is implemented for real rather than
+stubbed."""
+import socket
+import sys
+import time
+
+__all__ = ["wait_server_ready"]
+
+
+def wait_server_ready(endpoints):
+    """Block until every "ip:port" endpoint accepts a TCP connection."""
+    assert not isinstance(endpoints, str)
+    while True:
+        all_ok = True
+        not_ready = []
+        for ep in endpoints:
+            ip_port = ep.split(":")
+            with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+                sock.settimeout(2)
+                result = sock.connect_ex((ip_port[0], int(ip_port[1])))
+                if result != 0:
+                    all_ok = False
+                    not_ready.append(ep)
+        if not all_ok:
+            sys.stderr.write("server not ready, wait 3 sec to retry...\n")
+            sys.stderr.write("not ready endpoints:" + str(not_ready) + "\n")
+            sys.stderr.flush()
+            time.sleep(3)
+        else:
+            break

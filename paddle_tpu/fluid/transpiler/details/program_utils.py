@@ -1,0 +1,105 @@
+"""Program introspection / pretty-printing
+(ref: fluid/transpiler/details/program_utils.py:23-208).
+
+Same text layout as the reference's program_to_code (vars then ops,
+``{Out=...} = op(inputs=...)`` lines) over this framework's dict-based
+Operator records, so fluid-era debugging scripts read the same dumps.
+"""
+import sys
+
+__all__ = [
+    "delete_ops", "find_op_by_input_arg", "find_op_by_output_arg",
+    "get_indent_space", "variable_to_code", "op_to_code",
+    "block_to_code", "program_to_code",
+]
+
+
+def delete_ops(block, ops):
+    """Remove ``ops`` from ``block`` (ref program_utils.py:23)."""
+    drop = {id(op) for op in ops}
+    block.ops = [op for op in block.ops if id(op) not in drop]
+    if hasattr(block, "program") and hasattr(block.program,
+                                             "_bump_version"):
+        block.program._bump_version()
+
+
+def find_op_by_input_arg(block, arg_name):
+    """Index of the first op consuming ``arg_name`` (ref :32)."""
+    for index, op in enumerate(block.ops):
+        if arg_name in op.input_arg_names:
+            return index
+    return -1
+
+
+def find_op_by_output_arg(block, arg_name, reverse=False):
+    """Index of the op producing ``arg_name`` (ref :39)."""
+    ops = list(enumerate(block.ops))
+    if reverse:
+        ops = reversed(ops)
+    for index, op in ops:
+        if arg_name in op.output_arg_names:
+            return index
+    return -1
+
+
+def get_indent_space(indent, space_num=4):
+    return " " * indent * space_num
+
+
+def variable_to_code(var):
+    """One-line var summary (ref :62)."""
+    if getattr(var, "persistable", False):
+        prefix = "persist "
+    else:
+        prefix = ""
+    return "%svar %s : shape(%s) dtype(%s)%s" % (
+        prefix, var.name,
+        ", ".join(str(s) for s in (var.shape or ())),
+        var.dtype,
+        " stop_gradient" if getattr(var, "stop_gradient", False) else "",
+    )
+
+
+def op_to_code(op, skip_op_callstack=True):
+    """One-line op summary (ref :93)."""
+    outs = ", ".join(
+        "%s=[%s]" % (slot, ", ".join(names))
+        for slot, names in sorted(op.outputs.items())
+    )
+    ins = ", ".join(
+        "%s=[%s]" % (slot, ", ".join(names))
+        for slot, names in sorted(op.inputs.items())
+    )
+    attrs = ", ".join(
+        "%s=%r" % (k, v) for k, v in sorted(op.attrs.items())
+        if k != "op_callstack"
+    )
+    text = "{%s} = %s(inputs={%s}%s)" % (
+        outs, op.type, ins, (", " + attrs) if attrs else "")
+    if not skip_op_callstack and getattr(op, "callstack", None):
+        stack = "".join(
+            "\n    %s:%s %s" % (f.filename, f.lineno, f.line)
+            for f in op.callstack)
+        text += stack
+    return text
+
+
+def block_to_code(block, block_idx, fout=None, skip_op_callstack=False):
+    fout = fout or sys.stdout
+    indent = 0
+    print("%s{ // block %d" % (get_indent_space(indent), block_idx),
+          file=fout)
+    indent += 1
+    for var in block.vars.values():
+        print(get_indent_space(indent) + variable_to_code(var), file=fout)
+    for op in block.ops:
+        print(get_indent_space(indent)
+              + op_to_code(op, skip_op_callstack), file=fout)
+    indent -= 1
+    print("%s}" % get_indent_space(indent), file=fout)
+
+
+def program_to_code(prog, fout=None, skip_op_callstack=True):
+    """Dump a whole Program as pseudo-code (ref :190)."""
+    for block_idx, block in enumerate(prog.blocks):
+        block_to_code(block, block_idx, fout, skip_op_callstack)

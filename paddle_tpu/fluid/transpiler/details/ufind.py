@@ -1,0 +1,34 @@
+"""Union-find (ref: fluid/transpiler/details/ufind.py:18 — used by the
+memory-optimization transpiler to group aliasable vars)."""
+
+__all__ = ["UnionFind"]
+
+
+class UnionFind(object):
+    """Path-compressing union-find over arbitrary hashable elements."""
+
+    def __init__(self, elementes=None):
+        self._parents = []
+        self._index = {}
+        self._curr_idx = 0
+        for ele in elementes or []:
+            self._parents.append(self._curr_idx)
+            self._index[ele] = self._curr_idx
+            self._curr_idx += 1
+
+    def find(self, x):
+        curr_idx = self._index[x]
+        while curr_idx != self._parents[curr_idx]:
+            self._parents[curr_idx] = self._parents[
+                self._parents[curr_idx]]
+            curr_idx = self._parents[curr_idx]
+        return curr_idx
+
+    def union(self, x, y):
+        x_root = self.find(x)
+        y_root = self.find(y)
+        if x_root != y_root:
+            self._parents[x_root] = y_root
+
+    def is_connected(self, x, y):
+        return self.find(x) == self.find(y)

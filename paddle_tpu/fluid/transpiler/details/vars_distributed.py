@@ -1,0 +1,127 @@
+"""Distributed-var introspection registry
+(ref: fluid/transpiler/details/vars_distributed.py:18-280).
+
+The reference records how each parameter is sliced across pserver
+endpoints. In this framework the DistributeTranspiler maps pserver
+slices onto mesh shardings (fluid/transpiler), but the registry survives
+unchanged as introspection surface: transpiler users iterate it to see
+origin/slice relationships, vtype tags, and per-"endpoint" placement
+(endpoint here is the mesh-shard label the transpiler assigns).
+"""
+from ...framework import Variable
+
+__all__ = ["VarStruct", "VarDistributed", "VarsDistributed"]
+
+
+class VarStruct(object):
+    """Plain-data mirror of a Variable's metadata (ref :18)."""
+
+    def __init__(self, name, shape, dtype, type, lod_level, persistable):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.type = type
+        self.lod_level = lod_level
+        self.persistable = persistable
+
+    @classmethod
+    def from_var(cls, var):
+        return cls(var.name, var.shape, var.dtype,
+                   getattr(var, "type", None),
+                   getattr(var, "lod_level", 0),
+                   getattr(var, "persistable", False))
+
+
+class VarDistributed(object):
+    """origin-var <-> slice-var relationship record (ref :32)."""
+
+    def __init__(self, origin_var, slice_var, is_slice=None, block_id=None,
+                 offset=None, vtype=None, endpoint=None):
+        self.origin = (VarStruct.from_var(origin_var)
+                       if isinstance(origin_var, Variable) else origin_var)
+        self.slice = (VarStruct.from_var(slice_var)
+                      if isinstance(slice_var, Variable) else slice_var)
+        same = self.equal(self.origin, self.slice)
+        self.is_slice = (not same) if is_slice is None else is_slice
+        self.block_id = 0 if block_id is None else block_id
+        self.offset = 0 if offset is None else offset
+        self.vtype = vtype
+        self.endpoint = endpoint
+
+    @staticmethod
+    def equal(var1, var2):
+        assert isinstance(var1, VarStruct) and isinstance(var2, VarStruct)
+        return (var1.name == var2.name and var1.type == var2.type
+                and var1.shape == var2.shape and var1.dtype == var2.dtype
+                and var1.lod_level == var2.lod_level
+                and var1.persistable == var2.persistable)
+
+    def __str__(self):
+        origin = "%s : fluid.%s.shape%s.astype(%s)" % (
+            self.origin.name, self.origin.type, self.origin.shape,
+            self.origin.dtype)
+        sliced = ("%s : fluid.%s.shape%s.astype(%s)"
+                  ".slice(%s).block(%s).offset(%s)" % (
+                      self.slice.name, self.slice.type, self.slice.shape,
+                      self.slice.dtype, self.is_slice, self.block_id,
+                      self.offset))
+        return ("var owned: %s, origin var: ( %s ), slice var: ( %s ), "
+                "endpoint: %s " % (self.vtype, origin, sliced,
+                                   self.endpoint))
+
+
+class VarsDistributed(object):
+    """Registry of VarDistributed records (ref :123)."""
+
+    def __init__(self):
+        self.distributed_vars = []
+
+    def add_distributed_var(self, origin_var, slice_var, is_slice=None,
+                            block_id=None, offset=None, vtype=None,
+                            endpoint=None):
+        self.distributed_vars.append(VarDistributed(
+            origin_var, slice_var, is_slice, block_id, offset, vtype,
+            endpoint))
+
+    def get_distributed_var_by_slice(self, var_name):
+        for dist_var in self.distributed_vars:
+            if dist_var.slice.name == var_name:
+                return dist_var
+        return None
+
+    @staticmethod
+    def equal(var1, var2):
+        return (var1.name == var2.name and var1.type == var2.type
+                and var1.shape == var2.shape and var1.dtype == var2.dtype
+                and var1.lod_level == var2.lod_level
+                and var1.persistable == var2.persistable)
+
+    def get_distributed_var_by_origin_and_ep(self, origin_var_name,
+                                             endpoint):
+        for dist_var in self.distributed_vars:
+            if (dist_var.origin.name == origin_var_name
+                    and dist_var.endpoint == endpoint):
+                return dist_var
+        return None
+
+    def get_distributed_vars_by_vtypes(self, vtypes, groupby=False):
+        vtype_vars = [v for v in self.distributed_vars
+                      if v.vtype in vtypes]
+        if not groupby:
+            return vtype_vars
+        params_map = {}
+        for var in vtype_vars:
+            params_map.setdefault(var.origin.name, []).append(var)
+        return params_map
+
+    def get_distributed_vars_by_ep(self, endpoint, vtype=None):
+        endpoint_vars = [v for v in self.distributed_vars
+                         if v.endpoint == endpoint]
+        if vtype is None:
+            return endpoint_vars
+        return [v for v in endpoint_vars if v.vtype == vtype]
+
+    def overview(self):
+        """Multiline dump of every record (ref :258)."""
+        vars_str = [str(var) for var in self.distributed_vars]
+        return "\n".join(vars_str)

@@ -24,6 +24,15 @@ __all__ = [
 ]
 
 
+# accepted for API parity but semantically owned by XLA (comm channel
+# management / collective fusion happen in the compiler, so these knobs
+# are honored by construction); the strategy-attr audit test exempts
+# exactly this list
+PARITY_ONLY_STRATEGY_ATTRS = frozenset({
+    "nccl_comm_num", "fuse_all_reduce_ops",
+})
+
+
 class DistributedStrategy:
     """Collective-mode strategy knobs (ref: fleet DistributedStrategy +
     DistributedStrategy in collective fleet). TPU additions: explicit
@@ -31,9 +40,14 @@ class DistributedStrategy:
 
     def __init__(self):
         self.mode = "collective"
-        self.nccl_comm_num = 1  # parity only
+        self.nccl_comm_num = 1  # parity only: XLA owns comm channels
+        # LocalSGD collective mode (ref transpiler/collective.py LocalSGD):
+        # k-step local updates + periodic param averaging over dp
         self.use_local_sgd = False
+        self.local_sgd_k_steps = 1
         self.use_dgc = False
+        # parity only: XLA fuses collectives itself (its all-reduce
+        # combiner), so this flag is honored by construction
         self.fuse_all_reduce_ops = True
         # mesh layout
         self.tensor_parallel_degree = 1
@@ -143,6 +157,28 @@ class Fleet:
 
     def _build(self, program):
         s = self._strategy or DistributedStrategy()
+        if s.mode != "collective":
+            raise NotImplementedError(
+                "DistributedStrategy.mode=%r: only 'collective' is "
+                "implemented (pserver mode lives in "
+                "fleet.parameter_server / the DistributeTranspiler "
+                "surface)" % (s.mode,)
+            )
+        if s.use_dgc:
+            raise NotImplementedError(
+                "DistributedStrategy.use_dgc is not wired into the "
+                "collective build; use fluid.optimizer."
+                "DGCMomentumOptimizer directly (its top-k sparsified "
+                "local-accumulation semantics are implemented there)"
+            )
+        if s.pipeline_parallel_degree > 1:
+            raise NotImplementedError(
+                "DistributedStrategy.pipeline_parallel_degree: pipeline "
+                "parallelism runs through fluid.optimizer."
+                "PipelineOptimizer + fluid.pipeline_executor (gpipe "
+                "microbatch scan over the 'pp' mesh axis), not the "
+                "fleet collective build"
+            )
         ndev = len(jax.devices())
         tp = max(1, s.tensor_parallel_degree)
         sp = max(1, s.sequence_parallel_degree)
@@ -175,10 +211,24 @@ class Fleet:
                 )
             else:
                 opt_rules.append(ShardingRule(r".*", P("dp")))
-        self._distributed_program = DistributedProgram(
-            program, self._mesh, param_rules=rules,
-            opt_state_rules=opt_rules,
-        )
+        if s.use_local_sgd:
+            from .local_sgd import LocalSGDProgram
+
+            if s.sharding_degree > 1:
+                raise NotImplementedError(
+                    "use_local_sgd with sharding_degree>1: ZeRO shards "
+                    "optimizer state over dp, LocalSGD keeps divergent "
+                    "per-dp-shard state — pick one"
+                )
+            self._distributed_program = LocalSGDProgram(
+                program, self._mesh, k_steps=s.local_sgd_k_steps,
+                param_rules=rules,
+            )
+        else:
+            self._distributed_program = DistributedProgram(
+                program, self._mesh, param_rules=rules,
+                opt_state_rules=opt_rules,
+            )
         return self._distributed_program
 
     def save_inference_model(self, executor, dirname, feeded_var_names,
@@ -194,6 +244,18 @@ class Fleet:
     def save_persistables(self, executor, dirname, main_program=None):
         from ..fluid import io
 
+        if hasattr(self._distributed_program, "consolidated_scope"):
+            # LocalSGD keeps stacked per-shard copies in the scope;
+            # serialize a COLLAPSED COPY — the live training state (its
+            # k-step schedule and worker-local moments) stays untouched
+            from ..fluid.executor import global_scope, scope_guard
+
+            snap = self._distributed_program.consolidated_scope(
+                global_scope())
+            with scope_guard(snap):
+                return io.save_persistables(
+                    executor, dirname,
+                    main_program or framework.default_main_program())
         return io.save_persistables(
             executor, dirname, main_program or framework.default_main_program()
         )

@@ -1,0 +1,296 @@
+"""LocalSGD collective mode — k-step local updates + periodic parameter
+averaging over the dp axis.
+
+Reference semantics: transpiler/collective.py class LocalSGD (ref
+collective.py:270 — snapshot vars + param allreduce/average) wired by
+fleet collective mode "local_sgd" (ref incubate/fleet/collective/
+__init__.py:225-253). Each worker advances its OWN parameters from its
+OWN batch shard; every ``k_steps`` the workers' parameters are averaged.
+At k=1 with SGD this is mathematically plain synchronous dp (average of
+per-shard updates == update from averaged grads); at k>1 workers diverge
+between averaging points, trading ICI traffic for staleness.
+
+TPU-native realization: the reference rewrites the program with snapshot
+vars + c_allreduce ops over NCCL rings. Here the ONE lowered step runs
+under ``shard_map`` over the 'dp' mesh axis: per-shard parameter and
+optimizer-state copies ride a stacked leading dp dimension in the scope
+(sharded P('dp')), the per-shard RNG folds in the shard index, and the
+averaging step is a ``lax.cond``-gated ``lax.pmean`` on ICI — no
+snapshot buffers needed (the average is computed directly), and
+non-averaging steps issue NO parameter collectives, which is the entire
+point of LocalSGD.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..fluid import core
+from ..fluid.framework import Variable
+from ..fluid.lowering import build_step_fn
+from .sharding import DistributedProgram
+
+__all__ = ["LocalSGDProgram"]
+
+
+class LocalSGDProgram(DistributedProgram):
+    """Runnable through the ordinary Executor like DistributedProgram.
+
+    Scope layout: trainable params and optimizer accumulators are stored
+    STACKED with a leading dp axis (one copy per shard). Use
+    :meth:`consolidate_scope` before saving persistables.
+    """
+
+    def __init__(self, program, mesh, k_steps=1, **kw):
+        super().__init__(program, mesh, **kw)
+        if "dp" not in mesh.shape or mesh.shape["dp"] <= 1:
+            raise ValueError(
+                "LocalSGD requires a dp mesh axis of size > 1 "
+                "(got mesh %s); with one worker there is nothing to "
+                "average — use the plain collective mode" % (mesh.shape,)
+            )
+        self._k = max(1, int(k_steps))
+        block = program.global_block()
+        self._avg_names = {
+            v.name for v in block.all_parameters()
+            if getattr(v, "trainable", True)
+        }
+        opt_state = {
+            v.name for v in block.vars.values()
+            if getattr(v, "belong_to_optimizer", False)
+        }
+        # per-shard (divergent) state: params + their accumulators (the
+        # reference averages only params; moments stay worker-local)
+        self._local_names = self._avg_names | opt_state
+        self._step_i = 0
+
+    # -- state staging ----------------------------------------------------
+    def _stack_state(self, state):
+        """Scope values -> stacked-local / replicated device arrays."""
+        ndp = self._mesh.shape["dp"]
+        out = {}
+        for k, v in state.items():
+            arr = v if hasattr(v, "sharding") else np.asarray(v)
+            if k in self._local_names:
+                if hasattr(v, "sharding") and self._is_stacked_sharding(
+                        v.sharding):
+                    # already stacked on device from the previous step:
+                    # (dp, *orig) with the LEADING dim as the dp axis —
+                    # keep it there (no host round-trip, donation works)
+                    out[k] = v
+                    continue
+                np_arr = np.asarray(arr)
+                if np_arr.ndim >= 1 and np_arr.shape[0] == ndp and \
+                        self._already_stacked(k, np_arr):
+                    stacked = np_arr          # host copy, already stacked
+                else:
+                    stacked = np.broadcast_to(
+                        np_arr, (ndp,) + np_arr.shape)
+                    self._mark_stacked(k, stacked)
+                out[k] = jax.device_put(stacked, NamedSharding(
+                    self._mesh,
+                    P("dp", *([None] * (stacked.ndim - 1)))))
+            else:
+                sh = NamedSharding(self._mesh, P())
+                out[k] = (v if hasattr(v, "sharding")
+                          and v.sharding == sh
+                          else jax.device_put(np.asarray(arr), sh))
+        return out
+
+    def _is_stacked_sharding(self, sh):
+        """dp on the leading dim, nothing else — robust to jax's
+        trailing-None normalization (P('dp',) vs P('dp', None))."""
+        spec = getattr(sh, "spec", None)
+        mesh = getattr(sh, "mesh", None)
+        if spec is None or mesh is None:
+            return False
+        try:
+            if dict(mesh.shape) != dict(self._mesh.shape):
+                return False
+        except Exception:  # noqa: BLE001
+            return False
+        entries = tuple(spec)
+        return (len(entries) >= 1 and entries[0] == "dp"
+                and all(e is None for e in entries[1:]))
+
+    def _already_stacked(self, name, arr):
+        return self._stacked_shapes.get(name) == arr.shape
+
+    def _mark_stacked(self, name, arr):
+        if not hasattr(self, "_stacked_shapes"):
+            self._stacked_shapes = {}
+        self._stacked_shapes[name] = arr.shape
+
+    def _collapse(self, name, arr):
+        if np.issubdtype(arr.dtype, np.floating):
+            return arr.mean(axis=0)
+        return arr[0]
+
+    def consolidated_scope(self, scope):
+        """A COPY of ``scope`` with stacked per-shard state collapsed to
+        program-var shapes (floats: cross-shard mean; ints: shard 0) —
+        for serialization. The LIVE scope is untouched: an off-schedule
+        save must not act as a parameter sync or average away the
+        worker-local optimizer moments."""
+        from ..fluid.executor import Scope
+
+        snap = Scope()
+        for name, v in list(scope.items()):
+            arr = np.asarray(v)
+            if (name in self._local_names and
+                    getattr(self, "_stacked_shapes", {}).get(name)
+                    == arr.shape):
+                snap.set(name, self._collapse(name, arr))
+            else:
+                snap.set(name, v)
+        return snap
+
+    def consolidate_scope(self, scope):
+        """IN-PLACE collapse (end of training / before handing the
+        scope to non-LocalSGD consumers). For checkpoint-during-training
+        use :meth:`consolidated_scope` — it leaves training state
+        alone."""
+        for name in self._local_names:
+            v = scope.find_value(name)
+            if v is None:
+                continue
+            arr = np.asarray(v)
+            if getattr(self, "_stacked_shapes", {}).get(name) != arr.shape:
+                continue
+            scope.update(name, self._collapse(name, arr))
+            self._stacked_shapes.pop(name, None)
+
+    # -- executor hook ----------------------------------------------------
+    def _executor_run(self, executor, feed, fetch_list, scope,
+                      return_numpy):
+        from ..fluid.executor import global_scope
+
+        if not hasattr(self, "_stacked_shapes"):
+            self._stacked_shapes = {}
+        program = self._program
+        mesh = self._mesh
+        ndp = mesh.shape["dp"]
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
+        fetch_names = [
+            f.name if isinstance(f, Variable) else f
+            for f in (fetch_list or [])
+        ]
+        block = program.global_block()
+
+        feed_arrays, feed_specs = {}, {}
+        for name, value in feed.items():
+            value = getattr(value, "_ndarray", value)
+            arr = np.asarray(value)
+            if block.has_var(name) and block.var(name).dtype is not None:
+                want = core.np_dtype(block.var(name).dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            spec = (P("dp") if arr.ndim and arr.shape[0] % ndp == 0
+                    else P())
+            feed_specs[name] = spec
+            feed_arrays[name] = jax.device_put(
+                arr, NamedSharding(mesh, spec))
+        state = self._stack_state(
+            executor._gather_state(program, scope))
+        state_specs = {
+            k: (P("dp", *([None] * (np.ndim(v) - 1)))
+                if k in self._local_names else P())
+            for k, v in state.items()
+        }
+
+        sig = (
+            id(program), program._version,
+            tuple(sorted((k, v.shape, str(v.dtype))
+                         for k, v in feed_arrays.items())),
+            tuple(fetch_names),
+            tuple(sorted((k, v.shape, str(v.dtype))
+                         for k, v in state.items())),
+        )
+        entry = self._cache.get(sig)
+        if entry is None:
+            base_step = build_step_fn(
+                program, list(feed_arrays), fetch_names,
+                mesh_axes={a: a for a in mesh.axis_names},
+                mesh=mesh,
+            )
+            local = self._local_names
+            avg_names = self._avg_names
+            k_steps = self._k
+
+            def per_shard(st, fd, rng, step_i):
+                st = {n: (v[0] if n in local else v)
+                      for n, v in st.items()}
+                # independent per-shard randomness (dropout etc.)
+                rng = jax.random.fold_in(rng, lax.axis_index("dp"))
+                fetches, new_st = base_step(st, fd, rng)
+                do_avg = (step_i % k_steps) == 0
+
+                def averaged(vals):
+                    return [lax.pmean(v, "dp") for v in vals]
+
+                names = [n for n in sorted(avg_names) if n in new_st]
+                vals = [new_st[n] for n in names]
+                # non-averaging steps issue NO param collectives — both
+                # cond branches trace, but only the taken one runs, and
+                # the predicate is shard-uniform (step_i is replicated)
+                vals = lax.cond(do_avg, averaged, lambda vs: vs, vals)
+                for n, v in zip(names, vals):
+                    new_st[n] = v
+                new_st = {n: (v[None] if n in local else v)
+                          for n, v in new_st.items()}
+                fetches = [f[None] for f in fetches]
+                return fetches, new_st
+
+            smap_kw = dict(
+                mesh=mesh,
+                in_specs=(state_specs, feed_specs, P(), P()),
+                out_specs=([P("dp")] * len(fetch_names), state_specs),
+            )
+            try:  # replication checking: check_vma (new) / check_rep (old)
+                stepper = shard_map(per_shard, check_vma=False, **smap_kw)
+            except TypeError:
+                stepper = shard_map(per_shard, check_rep=False, **smap_kw)
+            entry = jax.jit(stepper, donate_argnums=(0,))
+            self._cache[sig] = entry
+
+        self._step_i += 1
+        rng = jax.device_put(executor._next_rng(program),
+                             NamedSharding(mesh, P()))
+        step_i = jax.device_put(jnp.asarray(self._step_i, jnp.int32),
+                                NamedSharding(mesh, P()))
+        fetches, new_state = entry(state, feed_arrays, rng, step_i)
+        for k, v in new_state.items():
+            scope.update(k, v)
+            if k in self._local_names:
+                self._stacked_shapes[k] = tuple(v.shape)
+
+        out = []
+        for name, v in zip(fetch_names, fetches):
+            # v is (ndp, *per_shard_shape)
+            var = block.vars.get(name)
+            vshape = getattr(var, "shape", None)
+            batchy = bool(vshape) and len(vshape) and (
+                vshape[0] in (None, -1)
+                # static batch dims count too: a declared leading dim
+                # equal to ndp * per-shard is a sharded batch, and
+                # averaging unrelated examples would be silent garbage
+                or (isinstance(vshape[0], int) and len(v.shape) >= 2
+                    and vshape[0] == v.shape[0] * v.shape[1])
+            )
+            if batchy:
+                # per-shard batch outputs concatenate back to the
+                # global batch
+                v = jnp.reshape(v, (-1,) + tuple(v.shape[2:]))
+            elif jnp.issubdtype(v.dtype, jnp.floating):
+                v = jnp.mean(v, axis=0)     # e.g. per-shard losses
+            else:
+                v = v[0]
+            out.append(np.asarray(v) if return_numpy else v)
+        return out

@@ -1,0 +1,244 @@
+"""LocalSGD collective mode (ref transpiler/collective.py:270 LocalSGD +
+incubate/fleet/collective/__init__.py:225-253 collective_mode="local_sgd").
+
+On the 8-virtual-device CPU mesh:
+- k=1 LocalSGD must equal plain GSPMD dp exactly (average of per-shard
+  SGD updates == update from averaged grads),
+- k=4 must diverge measurably from plain dp between averaging points
+  while the loss still decreases,
+- the DistributedStrategy attr audit: every strategy knob must be read
+  by the fleet build (or raise), so no flag can be a silent no-op again.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.parallel import fleet as fleet_mod
+from paddle_tpu.parallel.fleet import DistributedStrategy
+
+
+def _build_model(seed=11):
+    fluid.default_startup_program().random_seed = seed
+    fluid.default_main_program().random_seed = seed
+    x = fluid.data("lsx", shape=[None, 6], dtype="float32")
+    y = fluid.data("lsy", shape=[None, 1], dtype="float32")
+    h = fluid.layers.fc(x, 12, act="tanh")
+    p = fluid.layers.fc(h, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+    return loss
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 6)).astype("float32")
+    y = (x @ rng.standard_normal((6, 1))).astype("float32")
+    return x, y
+
+
+def _run(strategy, steps=6, lr=0.1, fetch_params=("fc_1.w_0",)):
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid import executor as executor_mod
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    executor_mod._scope_stack[:] = [executor_mod.Scope()]
+    fl = fleet_mod.Fleet().init()
+    loss = _build_model()
+    opt = fl.distributed_optimizer(
+        fluid.optimizer.SGD(lr), strategy=strategy)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    x, y = _data()
+    losses = []
+    for _ in range(steps):
+        out = exe.run(fl.main_program, feed={"lsx": x, "lsy": y},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(out[0])))
+    scope = fluid.global_scope()
+    params = {n: np.asarray(scope.find_value(n)) for n in fetch_params
+              if scope.find_value(n) is not None}
+    return losses, params, fl
+
+
+def test_local_sgd_k1_matches_plain_dp():
+    s_plain = DistributedStrategy()
+    plain_losses, _, _ = _run(s_plain)
+
+    s_local = DistributedStrategy()
+    s_local.use_local_sgd = True
+    s_local.local_sgd_k_steps = 1
+    local_losses, _, _ = _run(s_local)
+    np.testing.assert_allclose(local_losses, plain_losses,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_local_sgd_k4_diverges_but_converges():
+    s_plain = DistributedStrategy()
+    plain_losses, _, _ = _run(s_plain, steps=8)
+
+    s_local = DistributedStrategy()
+    s_local.use_local_sgd = True
+    s_local.local_sgd_k_steps = 4
+    local_losses, _, fl = _run(s_local, steps=8)
+    # different trajectory between averaging points...
+    assert max(abs(a - b) for a, b in
+               zip(plain_losses[1:4], local_losses[1:4])) > 1e-6
+    # ...but still training
+    assert local_losses[-1] < local_losses[0] * 0.7, local_losses
+
+    # params stay stacked per-shard in the scope; consolidation restores
+    # program shapes
+    prog = fluid.default_main_program()
+    pname = prog.global_block().all_parameters()[0].name
+    scope = fluid.global_scope()
+    stacked = np.asarray(scope.find_value(pname))
+    orig_shape = tuple(prog.global_block().var(pname).shape)
+    assert stacked.shape == (8,) + orig_shape
+    fl._distributed_program.consolidate_scope(scope)
+    assert np.asarray(scope.find_value(pname)).shape == orig_shape
+
+
+def test_local_sgd_state_stays_on_device_between_steps():
+    """The stacked params/moments must be reused as-is across steps —
+    a spec mismatch in the fast path would silently round-trip ALL
+    model state through the host every step (r4 review finding)."""
+    from paddle_tpu.parallel import local_sgd as ls
+
+    s = DistributedStrategy()
+    s.use_local_sgd = True
+    s.local_sgd_k_steps = 2
+    calls = []
+    orig_put = ls.jax.device_put
+
+    def counting_put(x, sharding=None):
+        if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 2 \
+                and x.shape[0] == 8:
+            calls.append(x.shape)
+        return orig_put(x, sharding)
+
+    ls.jax.device_put = counting_put
+    try:
+        _run(s, steps=3)
+    finally:
+        ls.jax.device_put = orig_put
+    # first run stacks host state (allowed); afterwards every stacked
+    # array must be reused without a device_put
+    n_params = 4  # 2 fc layers x (w, b)
+    assert len(calls) <= n_params, (
+        "stacked state re-device_put after the first step: %s" % calls)
+
+
+def test_local_sgd_save_does_not_mutate_training_state():
+    """fleet.save_persistables serializes a collapsed COPY; the live
+    scope keeps its stacked per-shard state and k-step schedule."""
+    import tempfile
+
+    s = DistributedStrategy()
+    s.use_local_sgd = True
+    s.local_sgd_k_steps = 4
+    losses, _, fl = _run(s, steps=3)   # mid-cycle (3 % 4 != 0)
+    scope = fluid.global_scope()
+    prog = fluid.default_main_program()
+    pname = prog.global_block().all_parameters()[0].name
+    before = np.asarray(scope.find_value(pname))
+    assert before.shape[0] == 8   # stacked
+
+    exe = fluid.Executor()
+    d = tempfile.mkdtemp()
+    fl.save_persistables(exe, d)
+    after = np.asarray(scope.find_value(pname))
+    assert after.shape == before.shape, "save collapsed the live scope"
+    np.testing.assert_array_equal(before, after)
+    # and the saved file carries the PROGRAM shape
+    import os
+
+    saved = [f for f in os.listdir(d)]
+    assert saved, "nothing saved"
+
+
+def test_local_sgd_static_batch_fetch_concats():
+    """A fetch declared with a STATIC batch dim must concatenate the
+    per-shard outputs, not average unrelated examples (r4 review
+    finding)."""
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid import executor as executor_mod
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    executor_mod._scope_stack[:] = [executor_mod.Scope()]
+    fl = fleet_mod.Fleet().init()
+    fluid.default_startup_program().random_seed = 3
+    x = fluid.data("sb_x", shape=[16, 4], dtype="float32")   # static B
+    y = fluid.data("sb_y", shape=[16, 1], dtype="float32")
+    p = fluid.layers.fc(x, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+    s = DistributedStrategy()
+    s.use_local_sgd = True
+    fl.distributed_optimizer(fluid.optimizer.SGD(0.05), s).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 4)).astype("float32")
+    out = exe.run(fl.main_program,
+                  feed={"sb_x": xv,
+                        "sb_y": xv.sum(1, keepdims=True).astype(
+                            "float32")},
+                  fetch_list=[p, loss])
+    assert np.asarray(out[0]).shape == (16, 1), np.asarray(out[0]).shape
+
+
+def test_local_sgd_requires_dp_axis():
+    from paddle_tpu.parallel.local_sgd import LocalSGDProgram
+    from paddle_tpu.parallel.mesh import build_mesh
+
+    loss = _build_model()
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    mesh = build_mesh({"tp": 8})
+    with pytest.raises(ValueError, match="dp mesh axis"):
+        LocalSGDProgram(fluid.default_main_program(), mesh, k_steps=2)
+
+
+def test_strategy_unimplemented_flags_raise():
+    s = DistributedStrategy()
+    s.use_dgc = True
+    loss = _build_model()
+    fl = fleet_mod.Fleet().init()
+    opt = fl.distributed_optimizer(fluid.optimizer.SGD(0.1), strategy=s)
+    with pytest.raises(NotImplementedError, match="DGCMomentum"):
+        opt.minimize(loss)
+
+    s2 = DistributedStrategy()
+    s2.mode = "pserver"
+    loss2 = _build_model()
+    fl2 = fleet_mod.Fleet().init()
+    opt2 = fl2.distributed_optimizer(fluid.optimizer.SGD(0.1), strategy=s2)
+    with pytest.raises(NotImplementedError, match="collective"):
+        opt2.minimize(loss2)
+
+
+def test_strategy_attrs_all_read_by_build():
+    """kwarg-audit over strategy attrs: every DistributedStrategy
+    attribute must be READ somewhere outside DistributedStrategy.__init__
+    (fleet build, meta-optimizer wiring, or an explicit raise) — a knob
+    nobody reads is exactly the silent-no-op class of bug."""
+    import inspect
+
+    from paddle_tpu.parallel import fleet as fleet_src
+    from paddle_tpu.parallel import local_sgd as local_sgd_src
+
+    attrs = set(vars(DistributedStrategy()))
+    attrs -= fleet_mod.PARITY_ONLY_STRATEGY_ATTRS  # documented exemptions
+    source = inspect.getsource(fleet_src) + inspect.getsource(local_sgd_src)
+    init_src = inspect.getsource(DistributedStrategy.__init__)
+    body = source.replace(init_src, "")
+    unread = sorted(
+        a for a in attrs
+        if ("s.%s" % a) not in body and ("strategy.%s" % a) not in body
+        and ("_strategy.%s" % a) not in body and ("self.%s" % a) not in body
+    )
+    assert not unread, (
+        "DistributedStrategy attrs never read outside __init__ "
+        "(wire them or raise): %s" % unread)

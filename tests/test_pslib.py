@@ -1,0 +1,141 @@
+"""PSLib / Downpour parameter-server surface
+(ref: incubate/fleet/parameter_server/pslib/__init__.py, node.py,
+optimizer_factory.py; fluid/distributed/downpour.py).
+
+A fluid-era pslib CTR script must import and TRAIN on the virtual mesh,
+with the sparse table genuinely vocab-sharded over the devices — the
+TPU mapping of pserver-sharded lookup tables (SURVEY row 30)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+VOCAB, EMB, NF = 8000, 8, 6
+
+
+def _ctr_model():
+    fluid.default_startup_program().random_seed = 5
+    fluid.default_main_program().random_seed = 5
+    slots = fluid.data("ps_slots", shape=[None, NF], dtype="int64")
+    label = fluid.data("ps_label", shape=[None, 1], dtype="int64")
+    emb = fluid.layers.embedding(
+        slots, size=[VOCAB, EMB], is_sparse=True, is_distributed=True,
+        param_attr=fluid.ParamAttr(name="ps_emb"))
+    feat = fluid.layers.reshape(emb, [0, NF * EMB])
+    h = fluid.layers.fc(feat, 32, act="relu")
+    prob = fluid.layers.sigmoid(fluid.layers.fc(h, 1))
+    loss = fluid.layers.mean(fluid.layers.log_loss(
+        fluid.layers.clip(prob, 1e-6, 1 - 1e-6),
+        fluid.layers.cast(label, "float32")))
+    return slots, label, loss
+
+
+def _batch(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    slots = rng.integers(0, VOCAB, size=(n, NF)).astype("int64")
+    label = (slots[:, :1] % 2).astype("int64")   # learnable from ids
+    return slots, label
+
+
+def test_pslib_ctr_script_trains_on_mesh():
+    from paddle_tpu.fluid.incubate.fleet.parameter_server.pslib import (
+        fleet)
+
+    fleet.init()
+    assert fleet.is_worker() and not fleet.is_server()
+    slots, label, loss = _ctr_model()
+    opt = fleet.distributed_optimizer(
+        fluid.optimizer.Adam(learning_rate=0.02),
+        strategy={"sparse_accessor_class": "DownpourCtrAccessor"})
+    opt.minimize(loss)
+    fleet.init_worker()   # lifecycle no-ops must not raise
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    sx, sy = _batch()
+    losses = []
+    for _ in range(12):
+        out = exe.run(fleet.main_program,
+                      feed={"ps_slots": sx, "ps_label": sy},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(out[0])))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+    # the table is genuinely vocab-sharded over the mesh
+    dp = fleet._distributed_program
+    sharding = dp.param_sharding("ps_emb", (VOCAB, EMB))
+    assert sharding.spec[0] is not None, sharding
+
+    # table introspection carried through
+    info = fleet._opt_info
+    assert info["sparse_table_names"] == ["ps_emb"]
+    desc = info["server_desc"]["tables"][0]
+    assert desc["type"] == "sparse"
+    assert desc["accessor_class"] == "DownpourCtrAccessor"
+    fleet.print_table_stat(0)
+    fleet.stop_worker()
+
+
+def test_pslib_embedding_parallel_degree():
+    from paddle_tpu.fluid.incubate.fleet.parameter_server import pslib
+
+    fl = pslib.PSLib().init()
+    _, _, loss = _ctr_model()
+    opt = fl.distributed_optimizer(
+        fluid.optimizer.SGD(0.1), strategy={
+            "embedding_parallel_degree": 4})
+    opt.minimize(loss)
+    dp = fl._distributed_program
+    assert dp._mesh.shape == {"dp": 2, "mp": 4}
+    assert dp.param_sharding("ps_emb", (VOCAB, EMB)).spec[0] == "mp"
+
+
+def test_pslib_async_only_surface_raises():
+    from paddle_tpu.fluid.incubate.fleet.parameter_server import pslib
+
+    fl = pslib.PSLib().init()
+    with pytest.raises(NotImplementedError, match="parameter-server"):
+        fl.run_server()
+    with pytest.raises(NotImplementedError, match="feasign"):
+        fl.save_cache_model(None, "/tmp/x")
+    with pytest.raises(NotImplementedError, match="feasign"):
+        fl.shrink_sparse_table()
+    with pytest.raises(NotImplementedError, match="load_persistables"):
+        fl.load_one_table(0, "/tmp/x")
+
+
+def test_pslib_node_validates_strategy():
+    from paddle_tpu.fluid.incubate.fleet.parameter_server.pslib.node \
+        import DownpourServer
+
+    s = DownpourServer()
+    with pytest.raises(ValueError, match="sparse_table_class"):
+        s.add_sparse_table(0, {"sparse_table_class": "NopeTable"})
+    with pytest.raises(ValueError, match="sparse_accessor_class"):
+        s.add_sparse_table(0, {"sparse_accessor_class": "NopeAccessor"})
+    s.add_sparse_table(0, {"sparse_embedx_dim": 16})
+    assert s.get_desc()["tables"][0]["embedx_dim"] == 16
+
+
+def test_old_downpour_sgd_api():
+    """The pre-fleet fluid.distributed.DownpourSGD flow (ref
+    fluid/distributed/downpour.py): minimize returns the desc + grads
+    and the program still trains synchronously."""
+    from paddle_tpu.fluid.distributed import DownpourSGD
+
+    _, _, loss = _ctr_model()
+    dsgd = DownpourSGD(learning_rate=0.05, window=1)
+    ps_param, param_grads_list = dsgd.minimize([loss])
+    assert ps_param["server_param"]["tables"][0]["type"] == "sparse"
+    assert len(param_grads_list) == 1
+    assert loss.block.program._fleet_opt["worker_skipped_ops"] == []
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    sx, sy = _batch()
+    first = float(np.asarray(exe.run(
+        feed={"ps_slots": sx, "ps_label": sy}, fetch_list=[loss])[0]))
+    for _ in range(10):
+        last = float(np.asarray(exe.run(
+            feed={"ps_slots": sx, "ps_label": sy}, fetch_list=[loss])[0]))
+    assert last < first, (first, last)

@@ -173,8 +173,11 @@ def test_amp_overflow_skips_optimizer_state():
     ), "good step after overflow must update state"
 
 
-def test_amp_scale_decays_below_one():
-    """The reference does not floor the dynamic scale at 1.0."""
+def test_amp_scale_floors_at_one():
+    """The reference kernel clamps the decayed dynamic scale at 1
+    (operators/amp/update_loss_scaling_op.h) — and below 1 the
+    SkipGate chain would let NaNs through at scale==0, so the floor is
+    load-bearing here too."""
     from paddle_tpu.fluid.contrib import mixed_precision as mp
 
     main, startup = fluid.Program(), fluid.Program()
@@ -195,7 +198,11 @@ def test_amp_scale_decays_below_one():
         exe.run(main, feed={"r4amp2_x": bad}, fetch_list=[loss])
     scale = float(np.asarray(
         fluid.global_scope().find_value(scale_var.name)))
-    assert scale < 1.0, scale
+    assert scale == 1.0, scale
+    # params must have survived the diverging streak finite
+    w = np.asarray(fluid.global_scope().find_value(
+        main.global_block().all_parameters()[0].name))
+    assert np.isfinite(w).all()
 
 
 # ---------------------------------------------------------------------------
