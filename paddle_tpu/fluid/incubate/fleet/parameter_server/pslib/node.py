@@ -97,6 +97,9 @@ class DownpourServer(Server):
         strategy = dict(strategy or {})
         table_id = int(table_id)
         if table_id in self._desc["tables"]:
+            if self._desc["tables"][table_id]["type"] != "dense":
+                raise ValueError(
+                    "table %d already defined as sparse" % table_id)
             return
         self._desc["tables"][table_id] = {
             "type": "dense",

@@ -258,12 +258,17 @@ def build_transformer_beam_decode(cfg, src_len, max_out_len, beam_size):
         _embed(src, cfg.src_vocab, cfg, "src_emb", src_len), cfg)
 
     cell = TransformerDecodeCell(cfg, max_out_len)
+
+    def embed_tokens(ids):
+        e = layers.embedding(ids, size=[cfg.tgt_vocab, cfg.hidden],
+                             param_attr=ParamAttr(name="tgt_emb"))
+        # (B, beam) ids with beam==1 hit embedding's trailing-1 ids
+        # convention and come back rank-2; restore (B, beam, H)
+        return layers.reshape(e, [-1, beam_size, cfg.hidden])
+
     decoder = layers.BeamSearchDecoder(
         cell, start_token=cfg.bos_id, end_token=cfg.eos_id,
-        beam_size=beam_size,
-        embedding_fn=lambda ids: layers.embedding(
-            ids, size=[cfg.tgt_vocab, cfg.hidden],
-            param_attr=ParamAttr(name="tgt_emb")),
+        beam_size=beam_size, embedding_fn=embed_tokens,
     )
 
     # per-layer cross-attention K/V from the encoder, computed ONCE and

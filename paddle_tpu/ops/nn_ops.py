@@ -460,16 +460,32 @@ def _batch_norm(ctx, ins, attrs):
         bm = jnp.mean(xf, axis=axes)
         bv = jnp.var(xf, axis=axes)
         use_mean, use_var = bm, bv
-        new_mean = momentum * mean + (1 - momentum) * bm
-        new_var = momentum * var + (1 - momentum) * bv
+        if os.environ.get("PADDLE_TPU_BN_FREEZE_STATS"):
+            # experiment knob (bench_experiments/resnet_gap.py):
+            # isolate the moving-stat update's cost; NOT for training
+            new_mean, new_var = mean, var
+        else:
+            new_mean = momentum * mean + (1 - momentum) * bm
+            new_var = momentum * var + (1 - momentum) * bv
         saved_mean = bm
         saved_var = 1.0 / jnp.sqrt(bv + eps)
     inv = lax.rsqrt(use_var.astype(jnp.float32) + eps)
-    out = (x.astype(jnp.float32) - use_mean.reshape(bshape)) * (
-        inv * scale.astype(jnp.float32)
-    ).reshape(bshape) + bias.astype(jnp.float32).reshape(bshape)
+    if os.environ.get("PADDLE_TPU_BN_BF16_APPLY") and \
+            x.dtype == jnp.bfloat16:
+        # experiment knob: per-channel scalars stay f32, the elementwise
+        # normalize runs in the activation dtype (halves the fused
+        # loop's working set on bf16 activations)
+        g16 = (inv * scale.astype(jnp.float32)).astype(x.dtype)
+        out = (x - use_mean.astype(x.dtype).reshape(bshape)) \
+            * g16.reshape(bshape) \
+            + bias.astype(x.dtype).reshape(bshape)
+    else:
+        out = (x.astype(jnp.float32) - use_mean.reshape(bshape)) * (
+            inv * scale.astype(jnp.float32)
+        ).reshape(bshape) + bias.astype(jnp.float32).reshape(bshape)
+        out = out.astype(x.dtype)
     return {
-        "Y": [out.astype(x.dtype)],
+        "Y": [out],
         "MeanOut": [new_mean.astype(mean.dtype)],
         "VarianceOut": [new_var.astype(var.dtype)],
         "SavedMean": [saved_mean],

@@ -220,6 +220,13 @@ class Fleet:
                     "optimizer state over dp, LocalSGD keeps divergent "
                     "per-dp-shard state — pick one"
                 )
+            if tp > 1 or sp > 1:
+                raise NotImplementedError(
+                    "use_local_sgd with tensor/sequence parallelism: "
+                    "LocalSGD stacks whole per-dp-shard param copies, "
+                    "which would silently override the tp/sp sharding "
+                    "rules — run LocalSGD pure-dp"
+                )
             self._distributed_program = LocalSGDProgram(
                 program, self._mesh, k_steps=s.local_sgd_k_steps,
                 param_rules=rules,

@@ -65,9 +65,20 @@ class LocalSGDProgram(DistributedProgram):
             v.name for v in block.vars.values()
             if getattr(v, "belong_to_optimizer", False)
         }
-        # per-shard (divergent) state: params + their accumulators (the
-        # reference averages only params; moments stay worker-local)
-        self._local_names = self._avg_names | opt_state
+        # per-shard (divergent) state: params + accumulators + EVERY
+        # persistable var some op writes (BN moving stats, AMP loss-scale
+        # counters, lr counters, ...). Each shard computes these from its
+        # own sub-batch, so pretending they are replicated would silently
+        # keep one shard's value; stacking them is always correct (vars
+        # that update identically just carry identical copies). Only
+        # params are averaged — the reference averages only params;
+        # everything else stays worker-local.
+        written = {n for op in block.ops for n in op.output_arg_names}
+        step_state = {
+            v.name for v in block.vars.values()
+            if getattr(v, "persistable", False) and v.name in written
+        }
+        self._local_names = self._avg_names | opt_state | step_state
         self._step_i = 0
 
     # -- state staging ----------------------------------------------------
@@ -192,8 +203,21 @@ class LocalSGDProgram(DistributedProgram):
                 want = core.np_dtype(block.var(name).dtype)
                 if arr.dtype != want:
                     arr = arr.astype(want)
-            spec = (P("dp") if arr.ndim and arr.shape[0] % ndp == 0
-                    else P())
+            # same contract as DistributedProgram.feed_sharding:
+            # explicit feed_specs win (P() opts a feed out of batch
+            # splitting), then the feed_axis heuristic
+            if name in self._feed_specs:
+                spec = self._feed_specs[name]
+                if tuple(a for a in spec if a is not None) not in (
+                        (), ("dp",)):
+                    raise NotImplementedError(
+                        "LocalSGD feeds shard over 'dp' only; feed %r "
+                        "asked for %s" % (name, spec))
+            elif (self._feed_axis and arr.ndim
+                    and arr.shape[0] % ndp == 0):
+                spec = P("dp")
+            else:
+                spec = P()
             feed_specs[name] = spec
             feed_arrays[name] = jax.device_put(
                 arr, NamedSharding(mesh, spec))

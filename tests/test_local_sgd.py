@@ -190,6 +190,87 @@ def test_local_sgd_static_batch_fetch_concats():
     assert np.asarray(out[0]).shape == (16, 1), np.asarray(out[0]).shape
 
 
+def test_local_sgd_tracks_bn_stats_per_shard():
+    """Step-mutated non-param state (BN moving stats) must ride the
+    stacked per-shard path — treating it as replicated would silently
+    keep one shard's value (r4 review finding)."""
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid import executor as executor_mod
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    executor_mod._scope_stack[:] = [executor_mod.Scope()]
+    fl = fleet_mod.Fleet().init()
+    fluid.default_startup_program().random_seed = 5
+    img = fluid.data("bnx", shape=[None, 2, 4, 4], dtype="float32")
+    lbl = fluid.data("bny", shape=[None, 1], dtype="float32")
+    h = fluid.layers.conv2d(img, 4, 3, padding=1)
+    h = fluid.layers.batch_norm(h, act="relu",
+                                moving_mean_name="ls_bn_mean",
+                                moving_variance_name="ls_bn_var")
+    p = fluid.layers.fc(h, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, lbl))
+    s = DistributedStrategy()
+    s.use_local_sgd = True
+    s.local_sgd_k_steps = 2
+    fl.distributed_optimizer(fluid.optimizer.SGD(0.05), s).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(2)
+    xv = rng.standard_normal((16, 2, 4, 4)).astype("float32")
+    feed = {"bnx": xv, "bny": rng.standard_normal((16, 1)).astype(
+        "float32")}
+    for _ in range(3):
+        out = exe.run(fl.main_program, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+    scope = fluid.global_scope()
+    mv = np.asarray(scope.find_value("ls_bn_mean"))
+    # stacked per-shard: (ndp, C), updated off its zero init on EVERY
+    # shard (each shard saw its own sub-batch)
+    assert mv.shape == (8, 4), mv.shape
+    assert (np.abs(mv).max(axis=1) > 1e-8).all(), mv
+
+
+def test_local_sgd_rejects_tp_and_honors_feed_optout():
+    s = DistributedStrategy()
+    s.use_local_sgd = True
+    s.tensor_parallel_degree = 2
+    from paddle_tpu.fluid import framework, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fl = fleet_mod.Fleet().init()
+    loss = _build_model()
+    opt = fl.distributed_optimizer(fluid.optimizer.SGD(0.1), strategy=s)
+    import pytest as _pytest
+
+    with _pytest.raises(NotImplementedError, match="pure-dp"):
+        opt.minimize(loss)
+
+    # explicit P() feed spec opts a divisible feed out of splitting
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel.local_sgd import LocalSGDProgram
+    from paddle_tpu.parallel.mesh import build_mesh
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    loss2 = _build_model()
+    fluid.optimizer.SGD(0.1).minimize(loss2)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    mesh = build_mesh({"dp": 8})
+    prog = LocalSGDProgram(
+        fluid.default_main_program(), mesh, k_steps=1,
+        feed_specs={"lsx": P(), "lsy": P()})
+    x, y = _data()
+    out = exe.run(prog, feed={"lsx": x, "lsy": y}, fetch_list=[loss2])
+    # both feeds replicated: every shard trains on the SAME full batch
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
 def test_local_sgd_requires_dp_axis():
     from paddle_tpu.parallel.local_sgd import LocalSGDProgram
     from paddle_tpu.parallel.mesh import build_mesh

@@ -1,0 +1,125 @@
+"""Switch-MoE FFN + expert parallelism (parallel/moe.py): routing
+math vs a numpy oracle, capacity-overflow dropping, training, and
+ep-sharded execution matching the replicated run."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.parallel import moe
+from paddle_tpu.parallel.mesh import build_mesh
+from paddle_tpu.parallel.sharding import DistributedProgram, ShardingRule
+
+
+def _build(B, T, H, E, F, cap=8.0, seed=3, name="moe"):
+    fluid.default_startup_program().random_seed = seed
+    fluid.default_main_program().random_seed = seed
+    x = fluid.data("moe_x", shape=[B, T, H], dtype="float32")
+    y, aux = moe.switch_ffn(x, E, F, capacity_factor=cap, name=name)
+    return x, y, aux
+
+
+def _scope_np(name):
+    return np.asarray(fluid.global_scope().find_value(name))
+
+
+def test_switch_ffn_matches_numpy_oracle():
+    B, T, H, E, F = 2, 4, 6, 3, 8
+    _, y, aux = _build(B, T, H, E, F, cap=100.0)  # ample capacity
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((B, T, H)).astype("float32")
+    got_y, got_aux = exe.run(feed={"moe_x": xv},
+                             fetch_list=[y, aux])
+    got_y = np.asarray(got_y)
+
+    gw = _scope_np("moe.gate.w")
+    w1, b1 = _scope_np("moe.w1"), _scope_np("moe.b1")
+    w2, b2 = _scope_np("moe.w2"), _scope_np("moe.b2")
+    xs = xv.reshape(-1, H)
+    logits = xs @ gw
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    idx = p.argmax(-1)
+    want = np.zeros_like(xs)
+    for s in range(xs.shape[0]):
+        e = idx[s]
+        h1 = xs[s] @ w1[e] + b1[e, 0]
+        # gelu (erf formulation, matching the framework op)
+        from scipy.special import erf  # noqa: F401
+
+        h1 = 0.5 * h1 * (1.0 + erf(h1 / np.sqrt(2.0)))
+        want[s] = (h1 @ w2[e] + b2[e, 0]) * p[s, e]
+    np.testing.assert_allclose(got_y.reshape(-1, H), want, rtol=2e-4,
+                               atol=2e-5)
+    # aux loss: E * sum frac*meanprob
+    onehot = np.eye(E)[idx]
+    want_aux = E * float((onehot.mean(0) * p.mean(0)).sum())
+    assert abs(float(np.asarray(got_aux)) - want_aux) < 1e-4
+
+
+def test_switch_ffn_drops_overflow_tokens():
+    B, T, H, E, F = 1, 8, 4, 2, 4
+    # capacity_factor tiny -> C = max(4, ceil(8/2*0.1)) = 4 per expert
+    _, y, _ = _build(B, T, H, E, F, cap=0.1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.default_rng(1).standard_normal(
+        (B, T, H)).astype("float32")
+    out = np.asarray(exe.run(feed={"moe_x": xv}, fetch_list=[y])[0])
+    # every token beyond slot 4 of its expert comes back exactly zero
+    gw = _scope_np("moe.gate.w")
+    idx = (xv.reshape(-1, H) @ gw).argmax(-1)
+    pos = {e: 0 for e in range(E)}
+    flat = out.reshape(-1, H)
+    for s, e in enumerate(idx):
+        if pos[e] >= 4:
+            np.testing.assert_array_equal(flat[s], np.zeros(H))
+        pos[e] += 1
+
+
+def test_switch_ffn_trains_and_shards_over_ep():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    B, T, H, E, F = 8, 4, 16, 4, 32
+    x, y, aux = _build(B, T, H, E, F, name="moe_ep")
+    lbl = fluid.data("moe_lbl", shape=[B, T, H], dtype="float32")
+    loss = fluid.layers.elementwise_add(
+        fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(y, lbl)),
+        fluid.layers.scale(aux, scale=0.01))
+    fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((B, T, H)).astype("float32")
+    feed = {"moe_x": xv, "moe_lbl": np.tanh(xv)[:, :, ::-1].copy()}
+
+    # replicated baseline
+    base = [float(np.asarray(exe.run(feed=feed,
+                                     fetch_list=[loss])[0]))
+            for _ in range(3)]
+    assert base[-1] < base[0]
+
+    # fresh params, ep-sharded run must track the replicated one
+    exe.run(fluid.default_startup_program())
+    mesh = build_mesh({"dp": 2, "ep": 4})
+    dist = DistributedProgram(
+        fluid.default_main_program(), mesh,
+        param_rules=[ShardingRule(pat, spec)
+                     for pat, spec in moe.moe_ep_rules("moe_ep")],
+        feed_axis="dp",
+    )
+    sharded = [float(np.asarray(exe.run(dist, feed=feed,
+                                        fetch_list=[loss])[0]))
+               for _ in range(3)]
+    # top-1 routing is discrete: a near-tie can flip under GSPMD's
+    # reduction reorder, so exact equality is not the contract — close
+    # tracking + training is
+    np.testing.assert_allclose(sharded, base, rtol=5e-2)
+    assert sharded[-1] < sharded[0]
+    w1_sh = dist.param_sharding("moe_ep.w1", (E, H, F))
+    assert w1_sh.spec[0] == "ep", w1_sh
