@@ -13,22 +13,12 @@ framework overhead worth chasing.
 Self-exiting; banks to bench_experiments/bert_s512_ablate.json after
 every variant (relay-safe).
 """
-import functools
-import json
 import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
-OUT = os.path.join(os.path.dirname(__file__), "bert_s512_ablate.json")
-RESULTS = {"variants": [], "errors": []}
-
-
-def flush():
-    with open(OUT, "w") as f:
-        json.dump(RESULTS, f, indent=1)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bank import Bank, enable_compile_cache  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -214,6 +204,7 @@ def measure_framework(tag, batch, seq, n_steps, dropout=0.1):
 
 
 def main():
+    bank = Bank(__file__)
     plan = [
         ("fw_b16", lambda: measure_framework("fw_b16", 16, 512, 12)),
         ("fw_b24", lambda: measure_framework("fw_b24", 24, 512, 12)),
@@ -230,27 +221,10 @@ def main():
          lambda: measure_purejax("purejax_b32", 32, 512, 12, 0.1)),
     ]
     for tag, fn in plan:
-        try:
-            t0 = time.time()
-            variant = fn()
-            variant["wall_s"] = round(time.time() - t0, 1)
-            RESULTS["variants"].append(variant)
-            print("[s512]", variant, flush=True)
-        except Exception as e:
-            RESULTS["errors"].append("%s: %r" % (tag, e))
-            print("[s512] FAIL", tag, repr(e), flush=True)
-        flush()
-    print("DONE", flush=True)
+        bank.run(tag, fn)
+    bank.done()
 
 
 if __name__ == "__main__":
-    import jax
-
-    cache_dir = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:
-        pass
+    enable_compile_cache()
     main()

@@ -13,21 +13,11 @@ Self-exiting; banks to bench_experiments/resnet_gap.json after every
 variant (relay-safe). Ship whichever knob wins as the default;
 document whichever doesn't in BENCHMARKS.md.
 """
-import json
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
-OUT = os.path.join(os.path.dirname(__file__), "resnet_gap.json")
-RESULTS = {"variants": [], "errors": []}
-
-
-def flush():
-    with open(OUT, "w") as f:
-        json.dump(RESULTS, f, indent=1)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bank import Bank, enable_compile_cache  # noqa: E402
 
 
 def measure(tag, env=(), sgd=False):
@@ -61,6 +51,7 @@ def measure(tag, env=(), sgd=False):
 
 
 def main():
+    bank = Bank(__file__)
     plan = [
         ("baseline", (), False),
         ("bn_bf16_apply", ("PADDLE_TPU_BN_BF16_APPLY",), False),
@@ -70,27 +61,11 @@ def main():
         ("sgd", (), True),
     ]
     for tag, env, sgd in plan:
-        try:
-            t0 = time.time()
-            variant = measure(tag, env, sgd)
-            variant["wall_s"] = round(time.time() - t0, 1)
-            RESULTS["variants"].append(variant)
-            print("[resnet_gap]", variant, flush=True)
-        except Exception as e:
-            RESULTS["errors"].append("%s: %r" % (tag, e))
-            print("[resnet_gap] FAIL", tag, repr(e), flush=True)
-        flush()
-    print("DONE", flush=True)
+        bank.run(tag, lambda tag=tag, env=env, sgd=sgd: measure(
+            tag, env, sgd))
+    bank.done()
 
 
 if __name__ == "__main__":
-    import jax
-
-    cache_dir = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    except Exception:
-        pass
+    enable_compile_cache()
     main()

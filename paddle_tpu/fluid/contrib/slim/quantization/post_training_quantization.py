@@ -60,12 +60,17 @@ class PostTrainingQuantization:
     (plain abs-max over calibration activations).
     """
 
-    def __init__(self, executor, sample_generator, model_dir,
+    def __init__(self, executor, sample_generator, model_dir=None,
                  model_filename=None, params_filename=None, batch_size=10,
                  batch_nums=None, scope=None, algo="KL",
                  quantizable_op_type=["conv2d", "depthwise_conv2d", "mul"],
                  is_full_quantize=False, is_use_cache_file=False,
-                 cache_dir="./temp_post_training"):
+                 cache_dir="./temp_post_training",
+                 program=None, feed_list=None, fetch_list=None):
+        """``model_dir`` follows the reference contract. TPU addition:
+        pass an in-memory ``program`` + ``feed_list``/``fetch_list``
+        (feed var names, fetch Variables) instead — params are already
+        in the scope, so no disk round-trip is needed."""
         from ....executor import global_scope
         from .... import io as _io
 
@@ -83,11 +88,29 @@ class PostTrainingQuantization:
         )
         # is_use_cache_file/cache_dir: calibration activations fit in host
         # memory here (samples are reduced to histograms immediately)
-        self._program, self._feed_list, self._fetch_list = (
-            _io.load_inference_model(
-                model_dir, executor, model_filename=model_filename,
-                params_filename=params_filename)
-        )
+        if program is not None:
+            if model_dir is not None:
+                raise ValueError(
+                    "pass model_dir OR program, not both (ambiguous "
+                    "calibration source)")
+            if feed_list is None or fetch_list is None:
+                raise ValueError(
+                    "program= requires feed_list (names) and "
+                    "fetch_list (Variables)")
+            # same contract as the model_dir path: calibration runs a
+            # program pruned to the fetch targets (train-only tails that
+            # need unfed labels must not survive)
+            self._program = program._prune(list(fetch_list))
+            self._feed_list = list(feed_list)
+            self._fetch_list = list(fetch_list)
+        elif model_dir is not None:
+            self._program, self._feed_list, self._fetch_list = (
+                _io.load_inference_model(
+                    model_dir, executor, model_filename=model_filename,
+                    params_filename=params_filename)
+            )
+        else:
+            raise ValueError("pass model_dir or program")
         self._quantized_program = None
 
     # ------------------------------------------------------------------

@@ -208,11 +208,17 @@ class LocalSGDProgram(DistributedProgram):
             # splitting), then the feed_axis heuristic
             if name in self._feed_specs:
                 spec = self._feed_specs[name]
-                if tuple(a for a in spec if a is not None) not in (
-                        (), ("dp",)):
+                entries = tuple(spec)
+                # P() (replicate) or P('dp') / P('dp', None, ...)
+                # (batch-split) only: 'dp' anywhere but the leading dim
+                # would slice features, not examples
+                if not (all(a is None for a in entries)
+                        or (entries[:1] == ("dp",)
+                            and all(a is None for a in entries[1:]))):
                     raise NotImplementedError(
-                        "LocalSGD feeds shard over 'dp' only; feed %r "
-                        "asked for %s" % (name, spec))
+                        "LocalSGD feeds shard over 'dp' on the LEADING "
+                        "(batch) dim only; feed %r asked for %s"
+                        % (name, spec))
             elif (self._feed_axis and arr.ndim
                     and arr.shape[0] % ndp == 0):
                 spec = P("dp")

@@ -270,6 +270,14 @@ def test_local_sgd_rejects_tp_and_honors_feed_optout():
     # both feeds replicated: every shard trains on the SAME full batch
     assert np.isfinite(np.asarray(out[0])).all()
 
+    # non-leading 'dp' in a feed spec slices features, not examples —
+    # it must raise, not silently train a garbage model
+    bad = LocalSGDProgram(
+        fluid.default_main_program(), mesh, k_steps=1,
+        feed_specs={"lsx": P(None, "dp"), "lsy": P()})
+    with _pytest.raises(NotImplementedError, match="LEADING"):
+        exe.run(bad, feed={"lsx": x, "lsy": y}, fetch_list=[loss2])
+
 
 def test_local_sgd_requires_dp_axis():
     from paddle_tpu.parallel.local_sgd import LocalSGDProgram

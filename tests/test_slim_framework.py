@@ -125,6 +125,44 @@ def test_post_training_quantization(tmp_path):
         assert lv2.shape == (8, NCLS)
 
 
+def test_post_training_quantization_in_memory_program():
+    """TPU addition: PTQ over an in-memory program (program= +
+    feed_list/fetch_list) — params already live in the scope, no disk
+    round-trip. Must match the model_dir path's behavior."""
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        PostTrainingQuantization,
+    )
+
+    main, startup, x, y, logits, loss, acc = _mlp_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs, ys = _data(512, 1)
+    test_prog = _train_fp32(main, startup, loss, exe, xs, ys)
+    fp32_acc = _accuracy(exe, test_prog, logits, xs, ys)
+
+    ptq = PostTrainingQuantization(
+        executor=exe,
+        sample_generator=lambda: ((xs[i],) for i in range(128)),
+        program=test_prog.clone(), feed_list=["qx"],
+        fetch_list=[logits], batch_size=16, batch_nums=8,
+        algo="abs_max")
+    qprog = ptq.quantize()
+    types = [op.type for op in qprog.global_block().ops]
+    assert "quantized_mul" in types, types
+    (lv,) = exe.run(qprog, feed={"qx": xs}, fetch_list=[logits])
+    ptq_acc = float((np.argmax(lv, 1) == ys[:, 0]).mean())
+    assert ptq_acc > fp32_acc - 0.01, (fp32_acc, ptq_acc)
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="feed_list"):
+        PostTrainingQuantization(
+            executor=exe, sample_generator=lambda: iter(()),
+            program=test_prog)
+    with _pytest.raises(ValueError, match="model_dir or program"):
+        PostTrainingQuantization(
+            executor=exe, sample_generator=lambda: iter(()))
+
+
 def test_graph_wrapper_queries():
     from paddle_tpu.fluid.contrib.slim import GraphWrapper
 
