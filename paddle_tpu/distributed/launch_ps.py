@@ -1,0 +1,22 @@
+"""paddle.distributed.launch_ps (ref: the pserver-mode process
+launcher spawning N servers + M trainers)."""
+import sys
+
+__all__ = ["launch"]
+
+_MSG = (
+    "launch_ps starts parameter-server processes; there are none on "
+    "TPU (tables live sharded in HBM). Launch workers with "
+    "`python -m paddle_tpu.distributed.launch script.py` (jax."
+    "distributed multi-host) and train through fleet.parameter_server."
+    "pslib or the collective fleet."
+)
+
+
+def launch():
+    raise NotImplementedError(_MSG)
+
+
+if __name__ == "__main__":
+    sys.stderr.write(_MSG + "\n")
+    sys.exit(1)

@@ -1,5 +1,10 @@
 """fluid.contrib (ref: python/paddle/fluid/contrib)."""
 from . import layers  # noqa: F401
+from .layers import (  # noqa: F401  (ref contrib/__init__ re-exports)
+    fused_elemwise_activation, var_conv_2d, match_matrix_tensor,
+    sequence_topk_avg_pooling, tree_conv, fused_embedding_seq_pool,
+    multiclass_nms2, search_pyramid_hash, ctr_metric_bundle,
+)
 from . import decoder  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import distributed_batch_reader  # noqa: F401

@@ -1,8 +1,8 @@
 """Decoupled weight decay (ref: fluid/contrib/extend_optimizer/
 extend_optimizer_with_weight_decay.py, AdamW arXiv:1711.05101):
 new_param = optimized_param - pre_update_param * coeff."""
-from .. import unique_name
-from ..framework import Variable
+from ... import unique_name
+from ...framework import Variable
 
 __all__ = ["extend_with_decoupled_weight_decay"]
 

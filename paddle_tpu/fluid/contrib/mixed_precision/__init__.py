@@ -9,9 +9,9 @@ for fp16 parity.
 """
 import numpy as np
 
-from .. import framework
-from ..framework import default_main_program
-from ..layer_helper import LayerHelper
+from ... import framework
+from ...framework import default_main_program
+from ...layer_helper import LayerHelper
 
 __all__ = ["decorate", "AutoMixedPrecisionLists", "bf16_compute_guard"]
 
@@ -107,11 +107,11 @@ class OptimizerWithMixedPrecision:
         return self._scaled_loss
 
     def _ensure_scale_state(self):
-        from ..layers import tensor
+        from ...layers import tensor
 
         if self._scale_var is not None:
             return
-        from .. import unique_name
+        from ... import unique_name
 
         # unique names: two decorated optimizers in one process must not
         # share loss-scaling state in the (name-keyed) global scope
@@ -134,7 +134,7 @@ class OptimizerWithMixedPrecision:
         finite steps scale *= incr_ratio; after ``decr_every_n_nan_or_inf``
         consecutive non-finite steps scale *= decr_ratio. All branch-free
         arithmetic selects — XLA fuses it into the step."""
-        from ..layers import nn, tensor
+        from ...layers import nn, tensor
 
         block = self._scale_var.block
 
@@ -186,7 +186,7 @@ class OptimizerWithMixedPrecision:
             bad, nn.scale(decay, scale=-1.0, bias=1.0)))
 
     def backward(self, loss, **kwargs):
-        from ..layers import nn, tensor
+        from ...layers import nn, tensor
 
         self._finite_flag = None
         if self._use_bf16:

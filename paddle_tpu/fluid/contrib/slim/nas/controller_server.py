@@ -1,0 +1,5 @@
+"""ref import path contrib/slim/nas/controller_server.py — the LightNAS machinery is
+a documented loud stub on TPU (see nas/__init__.py: the brpc
+controller-server search loop has no mapping; SAController in
+slim.searcher drives architecture search instead)."""
+from . import LightNasStrategy, SearchSpace  # noqa: F401

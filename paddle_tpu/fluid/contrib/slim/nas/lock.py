@@ -1,0 +1,17 @@
+"""contrib/slim/nas/lock.py (ref) — advisory file locks the LightNAS
+server used; generic and kept real."""
+import fcntl
+import os
+
+__all__ = ["lock", "unlock"]
+
+
+def lock(file):
+    """Block until an exclusive flock on ``file`` is held."""
+    if os.name == "posix":
+        fcntl.flock(file, fcntl.LOCK_EX)
+
+
+def unlock(file):
+    if os.name == "posix":
+        fcntl.flock(file, fcntl.LOCK_UN)

@@ -1,0 +1,16 @@
+"""ref import path contrib/slim/quantization/quantization_mkldnn_pass.py — mkldnn is an x86
+inference runtime; on TPU int8 runs through the real-int8 MXU path
+(quantization_pass.py quantized_mul/quantized_conv2d). Using the
+mkldnn entry points raises with that guidance."""
+
+__all__ = []
+
+_MSG = ("mkldnn int8 is an x86 runtime path; use "
+        "QuantizationFreezePass/ConvertToInt8Pass or "
+        "PostTrainingQuantization — int8 executes on the MXU here")
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    raise NotImplementedError("%s.%s: %s" % (__name__, name, _MSG))

@@ -1,0 +1,27 @@
+"""fluid.distributed.fleet (ref: distributed/fleet.py — the earliest
+MPI-era fleet wrapper around Downpour)."""
+
+__all__ = ["Fleet"]
+
+
+class Fleet(object):
+    """ref fleet.py — init_worker/init_server over an MPI transport.
+    Superseded twice even in the reference; here the working surfaces
+    are fleet.parameter_server.pslib (Downpour tables as mesh-sharded
+    embeddings) and the collective fleet. Every method points there."""
+
+    _MSG = (
+        "fluid.distributed.fleet is the retired MPI-era fleet; use "
+        "fluid.incubate.fleet.parameter_server.pslib (sparse-table "
+        "CTR training on the mesh) or "
+        "fluid.incubate.fleet.collective (dp/tp/sp/ZeRO/LocalSGD)"
+    )
+
+    def init(self, *a, **kw):
+        raise NotImplementedError(self._MSG)
+
+    init_worker = init
+    init_server = init
+    stop_server = init
+    run_server = init
+    stop = init

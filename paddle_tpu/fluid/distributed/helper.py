@@ -1,0 +1,38 @@
+"""fluid.distributed.helper (ref: distributed/helper.py — FileSystem
+hdfs config carrier + MPIHelper)."""
+
+__all__ = ["FileSystem", "MPIHelper"]
+
+
+class FileSystem(object):
+    """HDFS client config carrier (ref helper.py:16). The config is
+    real; actual transfers go through the loud-raising HDFSClient
+    (contrib.utils.hdfs_utils) — object stores replace HDFS here."""
+
+    def __init__(self, fs_type="afs", uri="afs://***", user=None,
+                 passwd=None, hadoop_bin=""):
+        assert user is not None
+        assert passwd is not None
+        assert hadoop_bin is not None
+        self.fs_client = {
+            "fs.default.name": uri,
+            "hadoop.job.ugi": "%s,%s" % (user, passwd),
+            "fs_type": fs_type,
+            "hadoop_bin": hadoop_bin,
+        }
+
+    def get_desc(self):
+        return self.fs_client
+
+
+class MPIHelper(object):
+    """ref helper.py:54 — mpi4py rank/size discovery. There is no MPI
+    launcher here; ranks come from jax.distributed / PADDLE_TRAINER_ID
+    env (paddle_tpu.distributed.launch)."""
+
+    def __init__(self):
+        raise NotImplementedError(
+            "MPIHelper: no MPI runtime on TPU hosts — process identity "
+            "comes from paddle_tpu.distributed.launch (jax.distributed: "
+            "PROCESS_ID / NUM_PROCESSES / COORDINATOR_ADDRESS env)"
+        )

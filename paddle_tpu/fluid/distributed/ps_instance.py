@@ -1,0 +1,19 @@
+"""fluid.distributed.ps_instance (ref: distributed/ps_instance.py —
+MPI-split pserver/trainer role assignment)."""
+
+__all__ = ["PaddlePSInstance"]
+
+
+class PaddlePSInstance(object):
+    """ref ps_instance.py:17 — splits an MPI world into servers and
+    workers. No MPI world and no server processes exist here: every
+    process is a worker over the mesh (the chips hold the tables)."""
+
+    def __init__(self, server_worker_mode=1, proc_per_node=2):
+        raise NotImplementedError(
+            "PaddlePSInstance carves an MPI world into pserver/trainer "
+            "roles; on TPU all processes are workers over the mesh "
+            "(tables live sharded in HBM). Use "
+            "fleet.parameter_server.pslib (worker-only) or the "
+            "collective fleet."
+        )

@@ -1,0 +1,18 @@
+"""ps_pb2 (ref: the brpc parameter-server protobuf wire format).
+
+No brpc servers exist on TPU — table configs are plain dict descs (see
+node.py in this package family). Any protobuf symbol access raises
+with that pointer so ref-era scripts fail loudly, not mysteriously.
+"""
+
+__all__ = []
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    raise NotImplementedError(
+        "ps_pb2.%s: the brpc pserver protobufs have no TPU counterpart "
+        "— DownpourServer/DownpourWorker carry dict descs instead "
+        "(get_desc()), and tables run as mesh-sharded embeddings" % name
+    )

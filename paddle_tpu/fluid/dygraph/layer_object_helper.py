@@ -1,0 +1,17 @@
+"""ref import path dygraph/layer_object_helper.py — parameter-creation
+helper dygraph Layers use. Here the ordinary LayerHelper already works
+in both modes (it checks in_dygraph_mode and creates eager params), so
+LayerObjectHelper is a thin name-carrying subclass."""
+from ..layer_helper import LayerHelper
+
+__all__ = ["LayerObjectHelper"]
+
+
+class LayerObjectHelper(LayerHelper):
+    def __init__(self, name):
+        super().__init__(name)
+        self._name = name
+
+    @property
+    def name(self):
+        return self._name

@@ -1,0 +1,24 @@
+"""ref import path dygraph/parallel_helper.py — process-local parallel
+context flag used by dygraph DataParallel (ref parallel_helper.py)."""
+import os
+
+__all__ = ["_is_parallel_ctx_initialized", "_set_parallel_ctx",
+           "_init_parallel_ctx"]
+
+_parallel_ctx_initialized = False
+
+
+def _is_parallel_ctx_initialized():
+    return _parallel_ctx_initialized
+
+
+def _set_parallel_ctx(ctx=True):
+    global _parallel_ctx_initialized
+    _parallel_ctx_initialized = bool(ctx)
+
+
+def _init_parallel_ctx():
+    """The mesh IS the comm context; just record the flag (the
+    reference spins up an NCCL parallel context here)."""
+    _set_parallel_ctx(True)
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))

@@ -1,0 +1,5 @@
+"""ref import path dygraph/profiler.py — re-exports the profiler
+surface (one jax.profiler wrapper serves both modes)."""
+from ..profiler import profiler, start_profiler, stop_profiler  # noqa: F401
+
+__all__ = ["start_profiler", "stop_profiler", "profiler"]

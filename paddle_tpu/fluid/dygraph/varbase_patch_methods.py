@@ -1,0 +1,11 @@
+"""ref import path dygraph/varbase_patch_methods.py — the reference
+patches methods (numpy(), backward(), gradient(), ...) onto VarBase.
+Here dygraph variables implement these natively; the patch entry point
+is a satisfied-by-construction no-op."""
+
+__all__ = ["monkey_patch_varbase"]
+
+
+def monkey_patch_varbase():
+    """Already in effect: dygraph variables carry numpy()/backward()/
+    gradient()/clear_gradient() natively."""

@@ -192,6 +192,13 @@ class Executor:
             return program._executor_run(
                 self, feed, fetch_list, scope, return_numpy
             )
+        # collective-transpiled programs (transpiler.collective) carry
+        # their mesh runner; running the plain program runs it sharded
+        dist = getattr(program, "_transpiled_dist", None)
+        if dist is not None:
+            return dist._executor_run(
+                self, feed, fetch_list, scope, return_numpy
+            )
         # PipelineOptimizer-annotated programs run the gpipe schedule
         info = getattr(program, "_parallel_info", None)
         if info and info.get("mode") == "pipeline" and not getattr(

@@ -1,0 +1,17 @@
+"""incubate.fleet.base.fleet_base (ref: fleet base classes — Fleet,
+DistributedOptimizer, Mode). The collective implementation lives in
+parallel/fleet.py; PSLib subclasses live in parameter_server.pslib."""
+from .....parallel.fleet import (  # noqa: F401
+    DistributedOptimizer,
+    Fleet,
+)
+
+__all__ = ["Fleet", "DistributedOptimizer", "Mode"]
+
+
+class Mode:
+    """ref fleet_base.py Mode enum."""
+
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3

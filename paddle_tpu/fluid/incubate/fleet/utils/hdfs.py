@@ -1,0 +1,7 @@
+"""incubate.fleet.utils.hdfs (ref: HDFSClient) — same loud-raising
+client as contrib.utils.hdfs_utils (object stores/NFS replace HDFS on
+TPU hosts; every method explains the migration)."""
+from ....contrib.utils.hdfs_utils import HDFSClient, multi_download, \
+    multi_upload  # noqa: F401
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]

@@ -1,0 +1,11 @@
+"""ref import path fluid/transpiler/distribute_transpiler.py — the
+implementation lives in the package __init__ (pserver->sharded-
+embedding mapping documented there)."""
+from . import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "HashName", "RoundRobin"]

@@ -1,0 +1,6 @@
+"""ref import path fluid/transpiler/geo_sgd_transpiler.py — GeoSgd runs
+as the synchronous special case (see package __init__: ICI beats delta
+staging)."""
+from . import GeoSgdTranspiler  # noqa: F401
+
+__all__ = ["GeoSgdTranspiler"]

@@ -1,0 +1,6 @@
+"""paddle.libs (ref: python/paddle/libs — bundled native shared
+objects: mklml, warpctc, ...). This framework's native code is the C++
+host-runtime in paddle_tpu/native (built lazily with g++); device
+kernels come from XLA, so no .so bundle ships here."""
+
+__all__ = []

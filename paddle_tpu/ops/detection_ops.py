@@ -611,10 +611,12 @@ def _multiclass_nms(ctx, ins, attrs):
         flat_scores = jnp.where(valid, flat_scores, -1.0)
 
         if nms_eta < 1.0:
-            return _nms_adaptive(
+            rows = _nms_adaptive(
                 flat_scores, flat_box, flat_cls, c, keep_top_k, nms_thresh,
                 nms_eta, boxes.dtype,
             )
+            # adaptive path doesn't track source indices
+            return rows, jnp.full((keep_top_k,), -1, jnp.int32)
 
         def body(carry, _):
             cur_scores = carry
@@ -637,13 +639,18 @@ def _multiclass_nms(ctx, ins, attrs):
                     best_box,
                 ]
             )
-            return cur_scores, row
+            # kept box's index into the input boxes (ref
+            # multiclass_nms2's Index output); -1 on padding rows
+            idx = jnp.where(best_score > 0, best % m, -1).astype(
+                jnp.int32)
+            return cur_scores, (row, idx)
 
-        _, rows = lax.scan(body, flat_scores, None, length=keep_top_k)
-        return rows
+        _, (rows, idxs) = lax.scan(body, flat_scores, None,
+                                   length=keep_top_k)
+        return rows, idxs
 
-    out = jax.vmap(per_image)(bboxes, scores)
-    return {"Out": [out]}
+    out, index = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out], "Index": [index[..., None]]}
 
 
 @register_op("bipartite_match")

@@ -1,0 +1,81 @@
+"""transpiler.collective (ref fluid/transpiler/collective.py): after
+transpile, plain exe.run(main_program) executes the mesh-sharded step —
+GradAllReduce as GSPMD dp, LocalSGD as the per-shard shard_map program."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.transpiler import collective
+
+
+def _model(seed=4):
+    fluid.default_startup_program().random_seed = seed
+    fluid.default_main_program().random_seed = seed
+    x = fluid.data("ct_x", [None, 6], "float32")
+    y = fluid.data("ct_y", [None, 1], "float32")
+    p = fluid.layers.fc(fluid.layers.fc(x, 8, act="relu"), 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    return loss
+
+
+def _eps(n):
+    return ["127.0.0.1:%d" % (6170 + i) for i in range(n)]
+
+
+def _train(loss, steps=5):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 6)).astype("float32")
+    feed = {"ct_x": xv, "ct_y": xv.sum(1, keepdims=True)}
+    return [float(np.asarray(exe.run(feed=feed,
+                                     fetch_list=[loss])[0]))
+            for _ in range(steps)]
+
+
+def test_grad_allreduce_transpile_trains_sharded():
+    loss = _model()
+    t = collective.GradAllReduce()
+    main = fluid.default_main_program()
+    t.transpile(fluid.default_startup_program(), main, 0, _eps(8),
+                _eps(8)[0])
+    assert main._transpiled_dist is not None
+    assert t.nranks == 8
+    losses = _train(loss)
+    assert losses[-1] < losses[0], losses
+
+
+def test_local_sgd_transpile_trains():
+    loss = _model()
+    t = collective.LocalSGD(k_steps=2)
+    main = fluid.default_main_program()
+    t.transpile(fluid.default_startup_program(), main, 0, _eps(8),
+                _eps(8)[0])
+    from paddle_tpu.parallel.local_sgd import LocalSGDProgram
+
+    assert isinstance(main._transpiled_dist, LocalSGDProgram)
+    losses = _train(loss)
+    assert losses[-1] < losses[0], losses
+
+
+def test_single_process_multi_thread_defaults():
+    loss = _model()
+    t = collective.SingleProcessMultiThread()
+    t.transpile(main_program=fluid.default_main_program(),
+                startup_program=fluid.default_startup_program())
+    assert t.nranks == 8  # all visible devices
+    losses = _train(loss, steps=3)
+    assert np.isfinite(losses).all()
+
+
+def test_transpile_validates_world():
+    loss = _model()
+    t = collective.GradAllReduce()
+    with pytest.raises(ValueError, match="rank"):
+        t.transpile(None, fluid.default_main_program(), 9, _eps(8),
+                    _eps(8)[0])
+    with pytest.raises(ValueError, match="device count"):
+        t.transpile(None, fluid.default_main_program(), 0, _eps(99),
+                    _eps(99)[0])
+    assert loss is not None

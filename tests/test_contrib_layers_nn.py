@@ -1,0 +1,189 @@
+"""contrib.layers.nn text-matching/CTR op family vs numpy oracles
+(ref contrib/layers/nn.py + metric_op.py), dense-padded semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import contrib
+
+
+def _run(main, startup, feed, fetches):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return [np.asarray(v) for v in exe.run(main, feed=feed,
+                                           fetch_list=fetches)], exe
+
+
+def test_fused_elemwise_activation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("fea_x", shape=[None, 4], dtype="float32")
+        y = fluid.data("fea_y", shape=[None, 4], dtype="float32")
+        o1 = contrib.fused_elemwise_activation(
+            x, y, ["elementwise_add", "relu"])      # x + relu(y)
+        o2 = contrib.fused_elemwise_activation(
+            x, y, ["relu", "elementwise_add"])      # relu(x + y)
+        o3 = contrib.fused_elemwise_activation(
+            x, y, ["scale", "elementwise_add"], scale=2.0)  # 2(x+y)
+        with pytest.raises(ValueError, match="functor_list"):
+            contrib.fused_elemwise_activation(x, y, ["relu", "tanh"])
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((3, 4)).astype("float32")
+    yv = rng.standard_normal((3, 4)).astype("float32")
+    (g1, g2, g3), _ = _run(main, startup,
+                           {"fea_x": xv, "fea_y": yv}, [o1, o2, o3])
+    np.testing.assert_allclose(g1, xv + np.maximum(yv, 0), rtol=1e-6)
+    np.testing.assert_allclose(g2, np.maximum(xv + yv, 0), rtol=1e-6)
+    np.testing.assert_allclose(g3, 2 * (xv + yv), rtol=1e-6)
+
+
+def test_match_matrix_tensor_oracle():
+    B, TX, TY, H, C = 2, 3, 4, 5, 2
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.data("mm_x", shape=[None, TX, H], dtype="float32",
+                       lod_level=1)
+        y = fluid.data("mm_y", shape=[None, TY, H], dtype="float32",
+                       lod_level=1)
+        out, tmp = contrib.match_matrix_tensor(
+            x, y, C, param_attr=fluid.ParamAttr(name="mm.w"))
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((B, TX, H)).astype("float32")
+    yv = rng.standard_normal((B, TY, H)).astype("float32")
+    (got, _), exe = _run(main, startup, {"mm_x": xv, "mm_y": yv},
+                         [out, tmp])
+    w = np.asarray(fluid.global_scope().find_value("mm.w"))
+    want = np.einsum("bih,hcg,bjg->bcij", xv, w, yv)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_sequence_topk_avg_pooling_oracle():
+    B, C, TX, TY = 1, 2, 2, 5
+    topks = [1, 3]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.data("tk_in", shape=[None, C, TX, TY],
+                         dtype="float32")
+        out = contrib.sequence_topk_avg_pooling(inp, None, None, topks,
+                                                C)
+    rng = np.random.default_rng(2)
+    xv = rng.standard_normal((B, C, TX, TY)).astype("float32")
+    (got,), _ = _run(main, startup, {"tk_in": xv}, [out])
+    assert got.shape == (B, TX, C * len(topks))
+    srt = -np.sort(-xv, axis=-1)
+    for c in range(C):
+        for ki, k in enumerate(topks):
+            want = srt[:, c, :, :k].mean(-1)
+            np.testing.assert_allclose(got[:, :, c + ki * C], want,
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_fused_embedding_seq_pool():
+    V, D, B, T = 11, 6, 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("fes_ids", shape=[None, T], dtype="int64",
+                         lod_level=1)
+        out = contrib.fused_embedding_seq_pool(
+            ids, [V, D], padding_idx=0,
+            param_attr=fluid.ParamAttr(name="fes.w"))
+    rng = np.random.default_rng(0)
+    iv = rng.integers(0, V, size=(B, T)).astype("int64")
+    (got,), _ = _run(main, startup, {"fes_ids": iv}, [out])
+    w = np.asarray(fluid.global_scope().find_value("fes.w")).copy()
+    w[0] = 0.0   # padding_idx contributes zero
+    want = w[iv].sum(axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multiclass_nms2_returns_indices():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        boxes = fluid.data("n2_b", shape=[None, 4, 4], dtype="float32")
+        scores = fluid.data("n2_s", shape=[None, 2, 4], dtype="float32")
+        out, idx = contrib.multiclass_nms2(
+            boxes, scores, score_threshold=0.1, nms_top_k=4,
+            keep_top_k=3, background_label=-1, return_index=True)
+    bv = np.array([[[0, 0, 1, 1], [0, 0, 1.05, 1], [5, 5, 6, 6],
+                    [9, 9, 10, 10]]], "float32")
+    sv = np.zeros((1, 2, 4), "float32")
+    sv[0, 0] = [0.9, 0.8, 0.7, 0.05]   # box1 suppressed by box0 (iou)
+    (o, i), _ = _run(main, startup, {"n2_b": bv, "n2_s": sv},
+                     [out, idx])
+    kept = i[0, :, 0]
+    assert kept[0] == 0 and kept[1] == 2, kept     # 1 suppressed
+    assert o.shape == (1, 3, 6) and i.shape == (1, 3, 1)
+
+
+def test_search_pyramid_hash_runs_and_trains():
+    B, T = 4, 6
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ph_ids", shape=[None, T], dtype="int64")
+        lbl = fluid.data("ph_y", shape=[None, 1], dtype="float32")
+        emb = contrib.search_pyramid_hash(
+            ids, num_emb=8, space_len=64, pyramid_layer=3, rand_len=4,
+            drop_out_percent=0.0, is_training=True, use_filter=False,
+            white_list_len=0, black_list_len=0, seed=1, lr=0.1)
+        pred = fluid.layers.fc(emb, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, lbl))
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    iv = rng.integers(0, 1000, size=(B, T)).astype("int64")
+    yv = (iv[:, :1] % 2).astype("float32")
+    losses = [float(np.asarray(exe.run(
+        main, feed={"ph_ids": iv, "ph_y": yv}, fetch_list=[loss])[0]))
+        for _ in range(25)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    # hashing is content-determined: identical rows embed identically,
+    # distinct rows distinctly (checked inside ONE run — the program
+    # trains on every run, so cross-run comparisons would drift)
+    iv3 = iv.copy()
+    iv3[2] = iv3[3]
+    e = np.asarray(exe.run(main, feed={
+        "ph_ids": iv3, "ph_y": yv}, fetch_list=[emb])[0])
+    np.testing.assert_allclose(e[2], e[3], rtol=1e-6)
+    assert not np.allclose(e[0], e[1])
+
+
+def test_ctr_metric_bundle_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.data("ctr_p", shape=[None, 1], dtype="float32")
+        y = fluid.data("ctr_y", shape=[None, 1], dtype="int64")
+        sqe, abe, prob, q = contrib.ctr_metric_bundle(p, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pv = np.array([[0.8], [0.3]], "float32")
+    yv = np.array([[1], [0]], "int64")
+    for _ in range(2):   # two batches accumulate
+        out = exe.run(main, feed={"ctr_p": pv, "ctr_y": yv},
+                      fetch_list=[sqe, abe, prob, q])
+    sq, ab, pr, qq = [float(np.asarray(v)) for v in out]
+    np.testing.assert_allclose(ab, 2 * (0.2 + 0.3), rtol=1e-5)
+    np.testing.assert_allclose(sq, 2 * (0.04 + 0.09), rtol=1e-4)
+    np.testing.assert_allclose(pr, 2 * 1.1, rtol=1e-5)
+    np.testing.assert_allclose(
+        qq, 2 * (0.8 / 0.2 + 0.3 / 0.7), rtol=1e-4)
+
+
+def test_var_conv_2d_shapes():
+    B, CI, H, W, CO = 2, 3, 6, 8, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = fluid.data("vc_x", shape=[None, CI, H, W], dtype="float32")
+        out = contrib.var_conv_2d(x, None, None, CI, CO, [3, 3],
+                                  stride=1)
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((B, CI, H, W)).astype("float32")
+    (got,), _ = _run(main, startup, {"vc_x": xv}, [out])
+    assert got.shape == (B, CO, H, W)
+    assert np.isfinite(got).all()

@@ -36,6 +36,10 @@ ALLOW = {
     ("fluid/dygraph/base.py", "create_eager_parameter"): {"startup_program"},  # iface-compat: eager init is immediate
     ("fluid/dygraph/base.py", "dygraph_minimize"): {"loss"},  # tape already holds grads keyed by param
     ("fluid/dygraph/tracer.py", "VarBase.backward"): {"backward_strategy", "retain_graph"},  # tape is retained by design
+    ("fluid/contrib/layers/nn.py", "fused_elemwise_activation"): {"save_intermediate_out"},  # iface-compat: vjp keeps what backward needs
+    ("fluid/contrib/mixed_precision/fp16_utils.py", "create_master_params_grads"): {"main_prog", "startup_prog", "loss_scaling"},  # iface-compat: params ARE the fp32 masters (identity; see docstring)
+    ("fluid/incubate/fleet/utils/fleet_barrier_util.py", "check_all_trainers_ready"): {"emit"},  # iface-compat: no file barrier to emit through
+    ("fluid/transpiler/collective.py", "Collective.transpile"): {"wait_port"},  # cuda-era: no pserver ports to wait on
     ("fluid/evaluator.py", "Accuracy.eval"): {"executor", "eval_program"},  # iface-compat: eager metric state
     ("fluid/evaluator.py", "Accuracy.reset"): {"executor", "reset_program"},  # iface-compat: eager metric state
     ("fluid/executor.py", "_TensorView.set"): {"place"},  # device-hint
