@@ -61,12 +61,22 @@ def extend_with_decoupled_weight_decay(base_optimizer):
                     name=unique_name.generate(p.name + "_decay"),
                     dtype=p.dtype, shape=p.shape,
                 )
-                block.append_op(
-                    type="scale", inputs={"X": [pre[p.name]]},
-                    outputs={"Out": [scaled]},
-                    attrs={"scale": float(self._coeff), "bias": 0.0,
-                           "bias_after_scale": True},
-                )
+                if isinstance(self._coeff, Variable):
+                    # runtime coefficient (e.g. a decayed-lr-coupled
+                    # schedule): multiply by the variable
+                    block.append_op(
+                        type="elementwise_mul",
+                        inputs={"X": [pre[p.name]], "Y": [self._coeff]},
+                        outputs={"Out": [scaled]},
+                        attrs={"axis": -1},
+                    )
+                else:
+                    block.append_op(
+                        type="scale", inputs={"X": [pre[p.name]]},
+                        outputs={"Out": [scaled]},
+                        attrs={"scale": float(self._coeff), "bias": 0.0,
+                               "bias_after_scale": True},
+                    )
                 block.append_op(
                     type="elementwise_sub",
                     inputs={"X": [p], "Y": [scaled]},

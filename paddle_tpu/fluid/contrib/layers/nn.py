@@ -335,6 +335,18 @@ def search_pyramid_hash(input, num_emb, space_len, pyramid_layer,
                 for r in range(rand_len)]
             vecs.append(T.concat(rows, axis=2))  # (B, T-n+1, rand_len)
         gram = T.concat(vecs, axis=2)            # (B, T-n+1, num_emb)
+        mask = _len_mask(input, t)
+        if mask is not None:
+            # an n-gram starting at i is real only if i+n <= sample len
+            lens = L.reduce_sum(mask, dim=[1], keep_dim=True)  # (B,1)
+            starts = L.unsqueeze(
+                T.cast(T.range(0, t - n + 1, 1, "int64"), "float32"),
+                [0])                               # (1, T-n+1)
+            valid = T.cast(CF.less_equal(
+                L.elementwise_add(
+                    starts, T.fill_constant([1], "float32", float(n))),
+                lens), "float32")
+            gram = L.elementwise_mul(gram, L.unsqueeze(valid, [2]))
         if drop_out_percent and is_training:
             gram = L.dropout(gram, float(drop_out_percent),
                              dropout_implementation="upscale_in_train")

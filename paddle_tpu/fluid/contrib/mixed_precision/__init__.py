@@ -309,13 +309,19 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2**15,
 
 
 class bf16_compute_guard:
-    """Context manager: new layers created inside get bf16 compute dtype."""
+    """Reserved context manager for scoped bf16 layer construction.
+    Nothing consults it yet — entering raises instead of silently
+    building fp32 layers; ``decorate(opt, use_bf16=True)`` is the
+    working bf16 path (it rewrites the whole program's MXU ops)."""
 
     _active = [False]
 
     def __enter__(self):
-        bf16_compute_guard._active.append(True)
-        return self
+        raise NotImplementedError(
+            "bf16_compute_guard is not wired into layer construction; "
+            "use mixed_precision.decorate(optimizer, use_bf16=True) — "
+            "it casts every white-list op's inputs to bf16 program-wide"
+        )
 
     def __exit__(self, *exc):
         bf16_compute_guard._active.pop()

@@ -103,6 +103,11 @@ class DistributedProgram:
             for v in program.global_block().vars.values()
             if getattr(v, "belong_to_optimizer", False)
         }
+        # longest-first so "emb_2"'s accumulators never match "emb"
+        self._param_names = sorted(
+            (p.name for p in program.global_block().all_parameters()),
+            key=len, reverse=True,
+        )
         # honor sharding annotations left by DistributeTranspiler.transpile
         for name, spec in (getattr(program, "_sharding_spec", None) or []):
             # exact-name anchor: a bare suffix pattern would also capture
@@ -140,6 +145,16 @@ class DistributedProgram:
             if base is not None:
                 return NamedSharding(self._mesh, base)
         spec = self._param_rule_spec(name, shape)
+        if spec is None and name in self._opt_state_names:
+            # accumulators inherit their param's layout (they share its
+            # shape; a replicated moment of a sharded param would force
+            # a resharding round-trip every step — and on multi-process
+            # meshes the host fetch outright fails). Accumulator names
+            # are "<param>_<acc>_<n>" (optimizer._add_accumulator).
+            for pname in self._param_names:
+                if name.startswith(pname + "_"):
+                    spec = self._param_rule_spec(pname, shape)
+                    break
         return NamedSharding(self._mesh, spec if spec is not None else P())
 
     def feed_sharding(self, name, shape):
@@ -156,6 +171,20 @@ class DistributedProgram:
             return NamedSharding(self._mesh, P(self._feed_axis))
         return NamedSharding(self._mesh, P())
 
+    @staticmethod
+    def _same_sharding(a, b, ndim):
+        """Sharding equivalence modulo trailing-None spec entries (jit
+        outputs normalize P('dp', None) to P('dp'); strict equality
+        would silently round-trip state through the host every step —
+        and crash outright on multi-process meshes, where np.asarray
+        can't fetch a spanning array). ``is_equivalent_to`` also checks
+        the device assignment, so differently-laid-out meshes with the
+        same axis sizes stay distinct."""
+        try:
+            return a.is_equivalent_to(b, ndim)
+        except Exception:  # noqa: BLE001 — non-NamedSharding and co.
+            return a == b
+
     def shard_state(self, state):
         """Device-put scope state onto the mesh per rules (params sharded,
         everything else replicated)."""
@@ -163,11 +192,9 @@ class DistributedProgram:
         for k, v in state.items():
             arr = np.asarray(v) if not hasattr(v, "sharding") else v
             sh = self.param_sharding(k, np.shape(arr))
-            if (
-                hasattr(v, "sharding")
-                and getattr(v.sharding, "mesh", None) is self._mesh
-                and v.sharding == sh
-            ):
+            if (hasattr(v, "sharding")
+                    and self._same_sharding(v.sharding, sh,
+                                            np.ndim(arr))):
                 out[k] = v
             else:
                 out[k] = jax.device_put(np.asarray(v), sh)
