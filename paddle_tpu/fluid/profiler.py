@@ -7,7 +7,7 @@ import time
 
 __all__ = [
     "cuda_profiler", "reset_profiler", "profiler", "start_profiler",
-    "stop_profiler",
+    "stop_profiler", "profile_op_stats",
 ]
 
 _trace_dir = None
@@ -62,3 +62,100 @@ def profiler(state, sorted_key=None, profile_path="/tmp/profile",
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+def profile_op_stats(program=None, feed=None, scope=None, steps=3,
+                     warmup=1, sorted_key="total", print_table=True):
+    """Per-op timing table like the reference profiler's summary
+    (ref profiler.py stop_profiler sorted_key table / C++ Event stats).
+
+    The production path runs the WHOLE program as one fused XLA module
+    — per-op times don't exist there (that fusion IS the speedup), so
+    this debug mode interprets the program op by op eagerly, blocking
+    on each op's outputs. Use it to find which op dominates a slow
+    program, then profile the fused step with ``profiler()``
+    (jax.profiler) for kernel truth. Returns {op_type: {calls, total,
+    min, max, avg, ratio}} over ``steps`` timed runs."""
+    import jax
+    import numpy as np
+
+    from . import core
+    from .executor import global_scope
+    from .framework import default_main_program
+    from .lowering import _make_var_lookup, apply_op, run_ops
+    from ..ops.registry import LowerContext
+
+    program = program or default_main_program()
+    scope = scope if scope is not None else global_scope()
+    block = program.global_block()
+    var_lookup = _make_var_lookup(block)
+    records = {}
+
+    for it in range(warmup + steps):
+        env = {}
+        for v in block.vars.values():
+            val = scope.find_value(v.name)
+            if val is not None:
+                env[v.name] = val
+        for name, value in (feed or {}).items():
+            arr = np.asarray(getattr(value, "_ndarray", value))
+            if block.has_var(name) and block.var(name).dtype is not None:
+                want = core.np_dtype(block.var(name).dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            env[name] = jax.device_put(arr)
+        ctx = LowerContext(
+            rng=jax.random.PRNGKey(7 + it), is_test=False,
+            program=program, platform=jax.default_backend(),
+        )
+        env0 = dict(env)
+        for tag, op in enumerate(list(block.ops)):
+            t0 = time.perf_counter()
+            if op.type == "backward":
+                # the symbolic backward op is a whole-region vjp; time
+                # it through run_ops (its true cost IS the replay+vjp)
+                bctx = LowerContext(
+                    rng=jax.random.PRNGKey(7 + it), is_test=False,
+                    program=program, platform=jax.default_backend(),
+                )
+                out_env = run_ops(block, list(block.ops[: tag + 1]),
+                                  dict(env0), bctx)
+                for gn in op.output("Grads"):
+                    env[gn] = out_env[gn]
+            else:
+                apply_op(op, env, ctx, var_lookup, op_tag=tag)
+            for n in op.output_arg_names:
+                v = env.get(n)
+                if hasattr(v, "block_until_ready"):
+                    v.block_until_ready()
+            dt = time.perf_counter() - t0
+            if it >= warmup:
+                rec = records.setdefault(op.type, [0, 0.0, float("inf"),
+                                                  0.0])
+                rec[0] += 1
+                rec[1] += dt
+                rec[2] = min(rec[2], dt)
+                rec[3] = max(rec[3], dt)
+
+    grand = sum(r[1] for r in records.values()) or 1.0
+    stats = {
+        t: {"calls": r[0], "total": r[1], "min": r[2], "max": r[3],
+            "avg": r[1] / r[0], "ratio": r[1] / grand}
+        for t, r in records.items()
+    }
+    if print_table:
+        key = {"total": "total", "calls": "calls", "max": "max",
+               "min": "min", "ave": "avg", "avg": "avg"}.get(
+            sorted_key or "total", "total")
+        rows = sorted(stats.items(), key=lambda kv: -kv[1][key])
+        print("%-28s %7s %12s %10s %10s %10s %8s"
+              % ("Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+                 "Ave(ms)", "Ratio"))
+        for t, s in rows:
+            print("%-28s %7d %12.3f %10.3f %10.3f %10.3f %7.2f%%"
+                  % (t, s["calls"], 1e3 * s["total"], 1e3 * s["min"],
+                     1e3 * s["max"], 1e3 * s["avg"], 100 * s["ratio"]))
+        print("NOTE: eager per-op interpretation — absolute times "
+              "exclude XLA fusion; the jitted step is faster. Use "
+              "profiler() for the fused-kernel trace.")
+    return stats
