@@ -8,21 +8,33 @@ Runs the full framework path — fluid Program -> single-XLA-module train step
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
 
-Robustness design (round-2, v3 — after two failed modes):
+Robustness design (round-5, v4 — after three failed modes):
   * Round 1: probe subprocess killed mid-init wedged the chip relay and the
     parent's own init hung. Lesson: never kill a chip-holding process and
     then re-init in the same run.
   * Round 2 v2: single process + watchdog THREAD. The axon plugin's C init
     can hold the GIL for 40+ minutes and then abort() — a Python thread
     never gets scheduled and the process dies printing nothing.
-  * v3 (this file): a SUPERVISOR process that never imports jax spawns one
-    CHILD that does all chip work and appends progress (stage, banked
-    results, errors) to a status file. The supervisor always prints the
-    JSON line: the child's own line if it finishes, else a line composed
-    from the last status snapshot (so a mid-run crash/hang still reports
-    any throughput measured before it). The child is SIGKILLed only at the
-    deadline, after which NOTHING re-inits jax — a wedged relay can't hurt
-    a process that is about to exit.
+  * v3: a SUPERVISOR process that never imports jax spawns one CHILD that
+    does all chip work and appends progress (stage, banked results, errors)
+    to a status file. The supervisor always prints the JSON line: the
+    child's own line if it finishes, else a line composed from the last
+    status snapshot. Killed the child only at the deadline.
+  * Rounds 3-4 failure: the child HUNG in jax init (relay wedge, no
+    exception raised), one attempt silently ate the whole 1500s window,
+    and the run reported 0.0 + last_known_good. v3's init retry only
+    handled init *raising*, never init *hanging*.
+  * v4 (this file): PROBE-FIRST. The supervisor first runs a disposable
+    probe subprocess (imports jax, lists devices, runs one matmul, exits)
+    under a 180s watchdog. A hung probe is SIGKILLed — it never finished
+    init, so it holds no chip — and retried through the window; the real
+    bench child is only spawned after a probe proves the relay healthy
+    (healthy init is ~9s). If the bench child itself then stalls in
+    jax-init (status-file heartbeat stale >240s), it is killed and the
+    supervisor goes back to probing with whatever window remains. The
+    bench is additionally run opportunistically DURING the round
+    (in-round background runs bank into .bench_last_good.json with a
+    fresh measured_unix), so the driver-time run is not the only shot.
 
 vs_baseline denominator: the reference stack's published-era BERT-base
 single-GPU training throughput on V100 (fp32/amp mixed era) ~= 5300
@@ -91,24 +103,146 @@ def _compose(status):
 # ===========================================================================
 # supervisor (never imports jax)
 # ===========================================================================
+PROBE_WATCHDOG_S = float(os.environ.get("PADDLE_TPU_PROBE_WATCHDOG_S", 180))
+INIT_STALL_S = float(os.environ.get("PADDLE_TPU_INIT_STALL_S", 240))
+
+
+def _last_good_path():
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_last_good.json"
+    )
+
+
 def _bank_last_good(result, last_good_path):
     """Persist a real accelerator measurement so a later infra-starved
-    run can surface it (clearly labeled) instead of reporting 0."""
+    run can surface it (clearly labeled) instead of reporting 0.
+
+    Detail sections measured in OTHER runs (ctr / nmt_decode / resnet50 /
+    experiment results) are merged forward so opportunistic in-round runs
+    accumulate into one bank instead of overwriting each other."""
     try:
-        if result.get("value", 0) > 0 and result.get("detail", {}).get(
-                "backend") not in (None, "cpu"):
-            with open(last_good_path, "w") as f:
-                json.dump(result, f)
+        if result.get("detail", {}).get("backend") in (None, "cpu"):
+            return
+        prev = None
+        try:
+            with open(last_good_path) as f:
+                prev = json.load(f)
+        except Exception:  # noqa: BLE001 — no/unreadable previous bank
+            prev = None
+        aux_keys = ("resnet50", "ctr", "nmt_decode", "experiments")
+        if result.get("value", 0) > 0:
+            # deep-copy detail: carried-forward bank sections must never
+            # leak into the result dict the caller is about to print
+            merged = dict(result)
+            merged["detail"] = dict(result.get("detail", {}))
+            for key in aux_keys:
+                if prev and key in prev.get("detail", {}) and \
+                        key not in merged.get("detail", {}):
+                    merged["detail"][key] = prev["detail"][key]
+                    merged["detail"].setdefault("carried_sections", []) \
+                        .append(key)
+            out = merged
+        elif prev is not None:
+            # no fresh headline this run, but aux sections (ctr / decode /
+            # resnet / experiments) may be fresh — merge them into the
+            # existing bank without touching its headline
+            changed = False
+            for key in aux_keys:
+                if key in result.get("detail", {}):
+                    prev.setdefault("detail", {})[key] = \
+                        result["detail"][key]
+                    changed = True
+            if not changed:
+                return
+            prev["detail"]["aux_measured_unix"] = int(time.time())
+            out = prev
+        else:
+            return
+        with open(last_good_path + ".tmp", "w") as f:
+            json.dump(out, f)
+        os.replace(last_good_path + ".tmp", last_good_path)
     except Exception:  # noqa: BLE001
         pass
 
 
-def supervise():
-    fd, status_path = tempfile.mkstemp(prefix="bench_status_")
-    os.close(fd)
+def _run_probe(timeout_s):
+    """Run a disposable relay probe. Returns (ok, info_str).
+
+    The probe subprocess imports jax, lists devices and runs one tiny
+    matmul, then exits. On hang it is SIGKILLed: a probe stuck inside
+    plugin init never acquired the chip, and the alternative — letting it
+    eat the whole window — is exactly the rounds-3/4 zero. A kill during
+    an already-wedged relay cannot un-wedge it, but the retry loop keeps
+    probing as the wedge clears (~25 min worst observed)."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--probe"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        line = (out or "").strip().splitlines()
+        line = line[-1] if line else ""
+        if proc.returncode == 0 and line.startswith("{"):
+            info = json.loads(line)
+            if info.get("ok"):
+                return True, "init %.1fs %s" % (
+                    info.get("init_s", -1), info.get("kind", "?"))
+            return False, "probe error: %s" % info.get("err", "?")[:160]
+        return False, "probe rc=%s out=%r" % (proc.returncode, line[:160])
+    except subprocess.TimeoutExpired:
+        try:
+            proc.kill()
+            proc.communicate(timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        return False, "probe hung >%ds (killed)" % timeout_s
+    except Exception as e:  # noqa: BLE001
+        return False, "probe failed: %s" % str(e)[:160]
+
+
+def _fake_fault_once(env_key):
+    """Test-only fault injection: if $env_key names a marker path and the
+    marker doesn't exist yet, create it and hang forever (simulates the
+    relay-wedge init hang). The NEXT process sees the marker and runs
+    normally, so recovery paths can be driven end-to-end on CPU."""
+    marker = os.environ.get(env_key)
+    if marker and not os.path.exists(marker):
+        with open(marker, "w") as f:
+            f.write("hung")
+        while True:
+            time.sleep(3600)
+
+
+def probe_main():
+    """--probe mode: disposable relay health check (own process)."""
+    _fake_fault_once("PADDLE_TPU_PROBE_FAKE_HANG_ONCE")
+    t0 = time.time()
+    try:
+        import jax
+        if os.environ.get("PADDLE_TPU_BENCH_CPU"):
+            jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        import jax.numpy as jnp
+        x = jnp.ones((128, 128), jnp.bfloat16)
+        (x @ x).block_until_ready()
+        print(json.dumps({
+            "ok": True, "init_s": round(time.time() - t0, 1),
+            "n": len(devs),
+            "kind": str(getattr(devs[0], "device_kind", "")),
+            "platform": devs[0].platform}), flush=True)
+        return 0
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"ok": False, "err": repr(e)[:300],
+                          "t": round(time.time() - t0, 1)}), flush=True)
+        return 1
+
+
+def _spawn_child(status_path, budget_s):
     env = dict(os.environ)
     env["PADDLE_TPU_BENCH_CHILD"] = status_path
-    t0 = time.time()
+    # the child's time gates must see the supervisor's REMAINING window,
+    # not the full deadline — phase-1 probing may have eaten most of it
+    env["PADDLE_TPU_BENCH_DEADLINE_S"] = str(int(max(90, budget_s)))
     child = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.PIPE,
@@ -116,7 +250,6 @@ def supervise():
         env=env,
         text=True,
     )
-
     # Read the child's stdout on a thread so a deadline can't be blocked by
     # the pipe (the supervisor has no GIL-holding C calls, threads work).
     import threading
@@ -131,45 +264,128 @@ def supervise():
 
     drainer = threading.Thread(target=_drain, daemon=True)
     drainer.start()
+    return child, child_line, drainer
 
-    while True:
-        rc = child.poll()
-        elapsed = time.time() - t0
-        if rc is not None:
-            drainer.join(timeout=10)
-            break
-        if elapsed > DEADLINE_S:
-            # deadline: kill the child (we exit right after; nothing will
-            # re-init jax against the possibly-wedged relay)
-            try:
-                child.send_signal(signal.SIGKILL)
-            except OSError:
-                pass
-            break
-        time.sleep(2)
 
-    last_good_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".bench_last_good.json"
-    )
+def _read_status(status_path):
     try:
+        with open(status_path) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def supervise():
+    fd, status_path = tempfile.mkstemp(prefix="bench_status_")
+    os.close(fd)
+    t0 = time.time()
+    sup_errors = []
+
+    def _remaining():
+        return DEADLINE_S - (time.time() - t0)
+
+    try:
+        # ---- phase 1: probe until the relay answers --------------------
+        skip_probe = bool(os.environ.get("PADDLE_TPU_BENCH_SKIP_PROBE"))
+        probes = 0
+        while not skip_probe:
+            probes += 1
+            ok, info = _run_probe(min(PROBE_WATCHDOG_S,
+                                      max(_remaining() - 60, 30)))
+            if ok:
+                sup_errors.append("probe %d ok: %s" % (probes, info))
+                break
+            sup_errors.append("probe %d: %s" % (probes, info))
+            if _remaining() < PROBE_WATCHDOG_S + 120:
+                # not enough window left for another probe + a useful
+                # bench run: report from the bank
+                status = {"stage": "relay-unavailable",
+                          "errors": sup_errors}
+                result = _compose(status)
+                try:
+                    with open(_last_good_path()) as f:
+                        result["detail"]["last_known_good"] = json.load(f)
+                except Exception:  # noqa: BLE001
+                    pass
+                print(json.dumps(result), flush=True)
+                return 0
+            time.sleep(30)
+
+        # ---- phase 2: bench child with init-stall watchdog -------------
+        child, child_line, drainer = _spawn_child(status_path, _remaining())
+        respawns = 0
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                drainer.join(timeout=10)
+                break
+            if _remaining() <= 0:
+                # deadline: kill the child (we exit right after; nothing
+                # will re-init jax against the possibly-wedged relay)
+                try:
+                    child.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+                break
+            # init-stall watchdog: if the child sits in jax-init with a
+            # stale heartbeat, it hit the hang mode the probe was supposed
+            # to rule out — kill it and re-probe with what's left.
+            status = _read_status(status_path)
+            if (status and status.get("stage") == "jax-init"
+                    and time.time() - status.get("hb", t0) > INIT_STALL_S
+                    and respawns < 3 and _remaining() > 300):
+                try:
+                    child.send_signal(signal.SIGKILL)
+                    child.wait(timeout=15)
+                except Exception:  # noqa: BLE001
+                    pass
+                respawns += 1
+                sup_errors.append(
+                    "child stalled in jax-init >%ds; respawn %d"
+                    % (INIT_STALL_S, respawns))
+                # probe until the relay answers again: cheap disposable
+                # probes, never another child doomed to hang in init
+                ok = False
+                while not ok and _remaining() > 150:
+                    ok, info = _run_probe(
+                        min(PROBE_WATCHDOG_S, _remaining() - 120))
+                    sup_errors.append("re-probe: %s %s" % (ok, info))
+                    if not ok:
+                        time.sleep(20)
+                if not ok:
+                    break   # window exhausted; compose from the snapshot
+                # reset the status file so the stale jax-init snapshot
+                # can't trip the watchdog on the fresh child before its
+                # first flush (the stalled child banked nothing — it
+                # never left jax-init)
+                prev_errors = (status or {}).get("errors", [])
+                with open(status_path + ".tmp", "w") as f:
+                    json.dump({"stage": "respawning", "hb": time.time(),
+                               "best": None, "errors": prev_errors,
+                               "variants": [], "detail": {}}, f)
+                os.replace(status_path + ".tmp", status_path)
+                child, child_line, drainer = _spawn_child(
+                    status_path, _remaining())
+            time.sleep(5)
+
+        last_good_path = _last_good_path()
         if "json" in child_line:
             try:
-                _bank_last_good(json.loads(child_line["json"]),
-                                last_good_path)
+                result = json.loads(child_line["json"])
+                result.setdefault("detail", {})["supervisor_log"] = \
+                    sup_errors
+                _bank_last_good(result, last_good_path)
+                print(json.dumps(result), flush=True)
             except Exception:  # noqa: BLE001
-                pass
-            print(child_line["json"], flush=True)
+                print(child_line["json"], flush=True)
             return 0
 
         # child crashed or was killed: compose from the last snapshot
-        status = {"stage": "no-status", "errors": []}
-        try:
-            with open(status_path) as f:
-                status = json.load(f)
-        except Exception as e:  # noqa: BLE001
-            status["errors"] = ["status file unreadable: %s" % e]
+        status = _read_status(status_path) or {"stage": "no-status",
+                                               "errors": []}
         rc = child.poll()
-        status.setdefault("errors", []).append(
+        status.setdefault("errors", []).extend(sup_errors)
+        status["errors"].append(
             "child exited rc=%s at %.0fs without a result line"
             % (rc, time.time() - t0)
         )
@@ -213,6 +429,7 @@ class _Status:
         self.flush()
 
     def flush(self):
+        self.data["hb"] = time.time()   # supervisor stall watchdog
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(self.data, f)
@@ -551,6 +768,7 @@ def child_main(status_path):
     t0 = time.time()
 
     st.stage("jax-init")
+    _fake_fault_once("PADDLE_TPU_CHILD_FAKE_STALL_ONCE")
     import jax
 
     try:
@@ -597,6 +815,7 @@ def child_main(status_path):
     device_kind = getattr(devs[0], "device_kind", "") or os.environ.get(
         "PALLAS_AXON_TPU_GEN", ""
     )
+    st.data["detail"]["backend"] = backend
     st.data["detail"]["init_s"] = round(time.time() - t0, 1)
     st.data["detail"]["n_devices"] = len(devs)
     # freshness stamp: lets the judge (and the last_known_good fallback
@@ -604,6 +823,39 @@ def child_main(status_path):
     st.data["detail"]["measured_unix"] = int(time.time())
     st.flush()
     on_accel = backend != "cpu"
+
+    # plan rotation (round 5): BASELINE configs that have NEVER produced a
+    # TPU number (CTR sparse path, NMT beam decode) run BEFORE re-measuring
+    # banked ones, whenever the bank already holds a good headline — a
+    # constrained window should extend coverage, not refresh what's known.
+    try:
+        with open(_last_good_path()) as f:
+            _bank0 = json.load(f)
+    except Exception:  # noqa: BLE001
+        _bank0 = None
+    _bank_detail = (_bank0 or {}).get("detail", {})
+    aux_never = [k for k in ("ctr", "nmt_decode") if k not in _bank_detail]
+    aux_first = bool(on_accel and _bank0 is not None
+                     and _bank0.get("value", 0) > 0 and aux_never)
+
+    def _run_aux(keys, gate):
+        fns = {"ctr": _measure_ctr, "nmt_decode": _measure_nmt_decode}
+        for key in keys:
+            if time.time() - t0 > DEADLINE_S * gate:
+                st.error("skipped %s: %.0fs elapsed"
+                         % (key, time.time() - t0))
+                continue
+            st.stage(key)
+            try:
+                st.data["detail"][key] = fns[key]()
+                st.data["detail"][key]["measured_unix"] = int(time.time())
+                st.flush()
+            except Exception as e:  # noqa: BLE001
+                st.error("%s failed: %s: %s"
+                         % (key, type(e).__name__, str(e)[:300]))
+
+    if aux_first:
+        _run_aux(aux_never, gate=0.45)
 
     if on_accel:
         # Safe config first: a number is banked (in the status file, where
@@ -659,21 +911,11 @@ def child_main(status_path):
 
     # BASELINE configs 4-5: Wide&Deep CTR (dataset trainer path) and
     # Transformer-NMT beam decode; detail-only, time-gated individually
-    # so a starved run still records whatever fits
+    # so a starved run still records whatever fits (skipped here if the
+    # rotation already ran them at the front of the window)
     if on_accel and st.data["best"] is not None:
-        for key, fn in (("ctr", _measure_ctr),
-                        ("nmt_decode", _measure_nmt_decode)):
-            if time.time() - t0 > DEADLINE_S * 0.72:
-                st.error("skipped %s: %.0fs elapsed"
-                         % (key, time.time() - t0))
-                continue
-            st.stage(key)
-            try:
-                st.data["detail"][key] = fn()
-                st.flush()
-            except Exception as e:  # noqa: BLE001
-                st.error("%s failed: %s: %s"
-                         % (key, type(e).__name__, str(e)[:300]))
+        _run_aux([k for k in ("ctr", "nmt_decode")
+                  if k not in st.data["detail"]], gate=0.72)
 
     st.stage("done")
     print(json.dumps(_compose(st.data)), flush=True)
@@ -681,6 +923,8 @@ def child_main(status_path):
 
 
 if __name__ == "__main__":
+    if "--probe" in sys.argv[1:]:
+        sys.exit(probe_main())
     status_file = os.environ.get("PADDLE_TPU_BENCH_CHILD")
     if status_file:
         try:

@@ -1,0 +1,77 @@
+"""Regression tests for bench.py's round-5 supervisor hardening.
+
+Rounds 3-4 failed with the child HUNG inside jax init (relay wedge): one
+attempt silently consumed the whole 1500s window and the bench reported
+0.0. The v4 design (probe-first + init-stall respawn) must survive a hang,
+not just a raise. These tests drive the recovery paths end-to-end on CPU
+using the test-only fault-injection hooks (_fake_fault_once).
+"""
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _run(env_extra, timeout):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_BENCH_CHILD", None)
+    env["PADDLE_TPU_BENCH_CPU"] = "1"
+    env.update(env_extra)
+    out = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert lines, "no JSON line: stdout=%r stderr=%r" % (
+        out.stdout[-500:], out.stderr[-500:])
+    return json.loads(lines[-1])
+
+
+def test_probe_hang_is_killed_and_retried(tmp_path):
+    """A hung probe must be killed at the watchdog and retried; the run
+    then completes normally (the rounds-3/4 failure mode, survived)."""
+    marker = tmp_path / "hang_once"
+    result = _run({
+        "PADDLE_TPU_PROBE_FAKE_HANG_ONCE": str(marker),
+        "PADDLE_TPU_PROBE_WATCHDOG_S": "10",
+        "PADDLE_TPU_BENCH_DEADLINE_S": "400",
+    }, timeout=390)
+    assert result["value"] > 0
+    assert result["detail"]["stage"] == "done"
+    log = " ".join(result["detail"]["supervisor_log"])
+    assert "hung >10s (killed)" in log
+    assert "probe 2 ok" in log
+
+
+def test_starved_window_reports_relay_unavailable(tmp_path):
+    """If every probe hangs and the window runs out, the supervisor must
+    still print a JSON line (stage relay-unavailable), never hang."""
+    # two markers are never both consumed: make the probe hang every time
+    # by pointing the marker at a fresh path via a wrapper dir trick —
+    # simplest is one marker + deadline too small for a second probe.
+    marker = tmp_path / "hang_once"
+    result = _run({
+        "PADDLE_TPU_PROBE_FAKE_HANG_ONCE": str(marker),
+        "PADDLE_TPU_PROBE_WATCHDOG_S": "10",
+        # after the 10s probe kill, remaining < watchdog+120 -> give up
+        "PADDLE_TPU_BENCH_DEADLINE_S": "135",
+    }, timeout=120)
+    assert result["value"] == 0.0
+    assert result["detail"]["stage"] == "relay-unavailable"
+    assert any("hung" in e for e in result["detail"]["errors"])
+
+
+def test_child_init_stall_respawns(tmp_path):
+    """A child stalled in jax-init (stale heartbeat) must be killed and
+    respawned; the respawned child completes the run."""
+    marker = tmp_path / "stall_once"
+    result = _run({
+        "PADDLE_TPU_CHILD_FAKE_STALL_ONCE": str(marker),
+        "PADDLE_TPU_INIT_STALL_S": "15",
+        "PADDLE_TPU_BENCH_DEADLINE_S": "500",
+    }, timeout=490)
+    assert result["value"] > 0
+    assert result["detail"]["stage"] == "done"
+    log = " ".join(result["detail"]["supervisor_log"])
+    assert "respawn 1" in log
