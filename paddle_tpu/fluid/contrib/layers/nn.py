@@ -148,9 +148,13 @@ def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
     (ref contrib/layers/nn.py:302). Dense form: ``input`` is
     (B, channel_num, Tx, Ty); for each (b, c, i) the j-values within
     the sample's col length are sorted descending and each
-    k in ``topks`` contributes mean(top min(k, len) values). Output
+    k in ``topks`` contributes sum(top min(k, len) values) / k. Output
     (B, Tx, channel_num * len(topks)), rows beyond the row length
-    zeroed."""
+    zeroed. When a sample has fewer than k valid values the reference
+    pads with zeros at the back and still averages over k (ref
+    docstring: 'if feature size ... is less than topk, it will padding
+    0 at the back'), so the denominator is the constant k, never the
+    clamped valid length."""
     ks = [int(k) for k in topks]
     tx = int(input.shape[2])
     ty = int(input.shape[3])
@@ -173,24 +177,13 @@ def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
             "float32")
         sorted_vals = L.elementwise_mul(sorted_vals, valid)
     csum = OPS.cumsum(sorted_vals, axis=-1)          # (B, C, Tx, Ty)
-    if cm is not None:
-        lens = L.reduce_sum(cm, dim=[1], keep_dim=True)   # (B, 1)
     feats = []
     for k in ks:
         kk = min(k, ty)
         s = L.squeeze(L.slice(csum, axes=[3], starts=[kk - 1],
                               ends=[kk]), [3])       # (B, C, Tx)
-        if cm is None:
-            denom = float(kk)
-            f = L.scale(s, scale=1.0 / denom)
-        else:
-            denom = L.elementwise_min(
-                L.reshape(lens, [-1, 1, 1]),
-                T.fill_constant([1], "float32", float(kk)))
-            denom = L.elementwise_max(
-                denom, T.fill_constant([1], "float32", 1.0))
-            f = L.elementwise_div(s, denom)
-        feats.append(f)
+        # top min(k, valid) values summed, zero-padded to k, mean over k
+        feats.append(L.scale(s, scale=1.0 / float(k)))
     out = T.concat(feats, axis=1)                    # (B, C*K, Tx)
     out = L.transpose(out, [0, 2, 1])                # (B, Tx, C*K)
     rm = _len_mask(row, tx)

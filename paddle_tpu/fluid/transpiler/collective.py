@@ -59,6 +59,8 @@ class Collective:
                 "visible — one process drives the whole mesh here, so "
                 "the endpoint list must not exceed the device count"
                 % (self.nranks, ndev))
+        # nranks < ndev is a valid rank subset: _attach builds the mesh
+        # over the first nranks devices (devices=jax.devices()[:nranks])
         self.startup_program = startup_program
         self.main_program = main_program
         self._attach(main_program)
@@ -79,7 +81,8 @@ class GradAllReduce(Collective):
         from ...parallel.mesh import build_mesh
         from ...parallel.sharding import DistributedProgram
 
-        mesh = build_mesh({"dp": self.nranks})
+        mesh = build_mesh({"dp": self.nranks},
+                          devices=jax.devices()[:self.nranks])
         main_program._transpiled_dist = DistributedProgram(
             main_program, mesh, feed_axis="dp")
 
@@ -103,7 +106,8 @@ class LocalSGD(Collective):
         from ...parallel.local_sgd import LocalSGDProgram
         from ...parallel.mesh import build_mesh
 
-        mesh = build_mesh({"dp": self.nranks})
+        mesh = build_mesh({"dp": self.nranks},
+                          devices=jax.devices()[:self.nranks])
         main_program._transpiled_dist = LocalSGDProgram(
             main_program, mesh, k_steps=self.k_steps)
 

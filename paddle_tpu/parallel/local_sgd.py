@@ -139,25 +139,45 @@ class LocalSGDProgram(DistributedProgram):
         self._stacked_shapes[name] = arr.shape
 
     def _collapse(self, name, arr):
-        if np.issubdtype(arr.dtype, np.floating):
-            return arr.mean(axis=0)
+        """Collapse a stacked (ndp, ...) value to program-var shape:
+        floats mean over the dp axis, ints take shard 0. Device values
+        stay ON DEVICE (eager jnp ops; XLA reduces over the sharded
+        leading axis) — serialization pulls only what it writes, so a
+        checkpoint-during-training save is O(bytes written), not an
+        O(params x ndp) host round-trip of the whole scope."""
+        if isinstance(arr, np.ndarray):        # already host: stay host
+            if np.issubdtype(arr.dtype, np.floating):
+                return arr.mean(axis=0)
+            return arr[0]
+        if np.issubdtype(np.dtype(arr.dtype), np.floating):
+            return jnp.mean(arr, axis=0)
         return arr[0]
+
+    def _stacked_here(self, name, v):
+        return (name in self._local_names
+                and getattr(self, "_stacked_shapes", {}).get(name)
+                is not None
+                and self._stacked_shapes[name]
+                == tuple(getattr(v, "shape", ()) or ()))
 
     def consolidated_scope(self, scope):
         """A COPY of ``scope`` with stacked per-shard state collapsed to
         program-var shapes (floats: cross-shard mean; ints: shard 0) —
         for serialization. The LIVE scope is untouched: an off-schedule
         save must not act as a parameter sync or average away the
-        worker-local optimizer moments."""
+        worker-local optimizer moments. Device values stay on device
+        (no host materialization); non-collapsed device values are
+        device-COPIED, never aliased — the live buffer may be donated
+        to the next jitted step, and a snapshot held across that step
+        must not dereference a deleted buffer."""
         from ..fluid.executor import Scope
 
         snap = Scope()
         for name, v in list(scope.items()):
-            arr = np.asarray(v)
-            if (name in self._local_names and
-                    getattr(self, "_stacked_shapes", {}).get(name)
-                    == arr.shape):
-                snap.set(name, self._collapse(name, arr))
+            if self._stacked_here(name, v):
+                snap.set(name, self._collapse(name, v))
+            elif isinstance(v, jax.Array):
+                snap.set(name, jnp.copy(v))
             else:
                 snap.set(name, v)
         return snap
@@ -171,10 +191,9 @@ class LocalSGDProgram(DistributedProgram):
             v = scope.find_value(name)
             if v is None:
                 continue
-            arr = np.asarray(v)
-            if getattr(self, "_stacked_shapes", {}).get(name) != arr.shape:
+            if not self._stacked_here(name, v):
                 continue
-            scope.update(name, self._collapse(name, arr))
+            scope.update(name, self._collapse(name, v))
             self._stacked_shapes.pop(name, None)
 
     # -- executor hook ----------------------------------------------------

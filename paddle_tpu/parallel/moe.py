@@ -37,7 +37,13 @@ def switch_ffn(x, num_experts, d_ff, capacity_factor=1.25, act="gelu",
     from ..fluid import layers
     from ..fluid.param_attr import ParamAttr
 
-    B, T, H = x.shape[0], int(x.shape[1]), int(x.shape[2])
+    if any(d is None or int(d) < 0 for d in x.shape):
+        raise ValueError(
+            "switch_ffn needs a fully static (B, T, H) input shape to "
+            "compute expert capacity; got %r. Declare the batch dim "
+            "explicitly (fluid.data(..., shape=[batch, T, H]) rather "
+            "than the default None batch)." % (tuple(x.shape),))
+    T, H = int(x.shape[1]), int(x.shape[2])
     E = int(num_experts)
     F = int(d_ff)
 

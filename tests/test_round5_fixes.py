@@ -1,0 +1,82 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md round 4).
+
+1. sequence_topk_avg_pooling: short sequences are zero-padded and averaged
+   over the CONSTANT k (ref contrib/layers/nn.py docstring), not over
+   min(k, len).
+2. Collective.transpile accepts nranks < visible devices (rank subset →
+   mesh over the first nranks devices) instead of a confusing mesh-size
+   error.
+3. switch_ffn raises a clear ValueError for dynamic (None) dims instead of
+   an opaque TypeError.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import contrib
+
+
+def test_topk_avg_pooling_short_seq_divides_by_constant_k():
+    B, C, TX, TY = 2, 1, 2, 5
+    topks = [4]                       # longer than sample 1's col length
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.data("tk5_in", shape=[None, C, TX, TY],
+                         dtype="float32")
+        col = fluid.data("tk5_col", shape=[None, TY], dtype="float32",
+                         lod_level=1)
+        out = contrib.sequence_topk_avg_pooling(inp, None, col, topks, C)
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((B, C, TX, TY)).astype("float32")
+    lens = np.array([5, 2], "int32")  # sample 1 has only 2 valid cols
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got = np.asarray(exe.run(
+        main,
+        feed={"tk5_in": xv, "tk5_col": np.zeros((B, TY), "float32"),
+              "tk5_col@SEQ_LEN": lens},
+        fetch_list=[out])[0])
+    for b, ln in enumerate(lens):
+        vals = -np.sort(-xv[b, 0, :, :ln], axis=-1)
+        take = min(topks[0], ln)
+        # reference: top-take values zero-padded to k, averaged over k
+        want = vals[:, :take].sum(-1) / float(topks[0])
+        np.testing.assert_allclose(got[b, :, 0], want, rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_collective_transpile_rank_subset():
+    import jax
+
+    from paddle_tpu.fluid.transpiler import collective
+
+    ndev = len(jax.devices())
+    assert ndev >= 4, "conftest provides the 8-device CPU mesh"
+    nranks = ndev // 2
+    eps = ["127.0.0.1:%d" % (7000 + i) for i in range(nranks)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("cts_x", shape=[None, 4], dtype="float32")
+        loss = fluid.layers.reduce_mean(fluid.layers.fc(x, 3))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    t = collective.GradAllReduce()
+    t.transpile(startup_program=startup, main_program=main, rank=0,
+                endpoints=eps, current_endpoint=eps[0])
+    dist = main._transpiled_dist
+    assert dist._mesh.devices.size == nranks
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.random.default_rng(0).standard_normal(
+        (nranks * 2, 4)).astype("float32")
+    l0 = float(exe.run(main, feed={"cts_x": xv}, fetch_list=[loss])[0])
+    assert np.isfinite(l0)
+
+
+def test_switch_ffn_dynamic_batch_raises_clearly():
+    from paddle_tpu.parallel import moe
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("moe5_x", shape=[None, 4, 8], dtype="float32")
+        with pytest.raises(ValueError, match="fully static"):
+            moe.switch_ffn(x, num_experts=2, d_ff=16)
