@@ -51,6 +51,12 @@ import time
 
 V100_BASELINE_TOKENS_PER_SEC = 5300.0
 
+
+def _atomic_write_json(path, obj):
+    with open(path + ".tmp", "w") as f:
+        json.dump(obj, f)
+    os.replace(path + ".tmp", path)
+
 # Supervisor deadline. The round-1 driver killed the bench at >=29 min;
 # leave margin so OUR line is printed first.
 DEADLINE_S = float(os.environ.get("PADDLE_TPU_BENCH_DEADLINE_S", 1500))
@@ -158,9 +164,7 @@ def _bank_last_good(result, last_good_path):
             out = prev
         else:
             return
-        with open(last_good_path + ".tmp", "w") as f:
-            json.dump(out, f)
-        os.replace(last_good_path + ".tmp", last_good_path)
+        _atomic_write_json(last_good_path, out)
     except Exception:  # noqa: BLE001
         pass
 
@@ -357,13 +361,16 @@ def supervise():
                 # reset the status file so the stale jax-init snapshot
                 # can't trip the watchdog on the fresh child before its
                 # first flush (the stalled child banked nothing — it
-                # never left jax-init)
-                prev_errors = (status or {}).get("errors", [])
-                with open(status_path + ".tmp", "w") as f:
-                    json.dump({"stage": "respawning", "hb": time.time(),
-                               "best": None, "errors": prev_errors,
-                               "variants": [], "detail": {}}, f)
-                os.replace(status_path + ".tmp", status_path)
+                # never left jax-init). Its error trail survives in
+                # sup_errors: the fresh child's _Status overwrites the
+                # file's error list.
+                sup_errors.extend("stalled child: " + e
+                                  for e in (status or {}).get("errors", []))
+                _atomic_write_json(status_path,
+                                   {"stage": "respawning",
+                                    "hb": time.time(), "best": None,
+                                    "errors": [], "variants": [],
+                                    "detail": {}})
                 child, child_line, drainer = _spawn_child(
                     status_path, _remaining())
             time.sleep(5)
@@ -430,10 +437,7 @@ class _Status:
 
     def flush(self):
         self.data["hb"] = time.time()   # supervisor stall watchdog
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.data, f)
-        os.replace(tmp, self.path)
+        _atomic_write_json(self.path, self.data)
 
     def stage(self, s):
         self.data["stage"] = s
@@ -937,9 +941,7 @@ if __name__ == "__main__":
                 data.setdefault("errors", []).append(
                     "fatal: %s: %s" % (type(e).__name__, str(e)[:300])
                 )
-                with open(status_file + ".tmp", "w") as f:
-                    json.dump(data, f)
-                os.replace(status_file + ".tmp", status_file)
+                _atomic_write_json(status_file, data)
             except Exception:
                 pass
             sys.exit(1)
