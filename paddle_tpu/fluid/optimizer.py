@@ -1137,7 +1137,8 @@ class PipelineOptimizer:
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
-                 start_cpu_core_id=0, num_microbatches=None):
+                 start_cpu_core_id=0, num_microbatches=None,
+                 mesh=None, feed_specs=None, param_rules=None):
         self._optimizer = optimizer
         self._cut_list = cut_list
         self._place_list = place_list
@@ -1145,6 +1146,18 @@ class PipelineOptimizer:
         self._queue_size = queue_size
         self._sync_steps = sync_steps
         self._num_microbatches = num_microbatches
+        # TPU-native composed parallelism (the reference reaches dp x pp
+        # composition through fleet DistributedStrategy, ref
+        # incubate/fleet/collective/__init__.py:134-253): a Mesh with a
+        # 'pp' axis plus a 'dp' axis, and feed PartitionSpecs (batch
+        # over 'dp'). The pipeline runs manual over 'pp' only; dp stays
+        # GSPMD. param_rules is accepted only to raise a descriptive
+        # error — weight sharding inside the divergent stage branches
+        # deadlocks (see pipeline_executor.py); dp x tp x pp composes
+        # via parallel.pipeline.gpipe_composed instead.
+        self._mesh = mesh
+        self._feed_specs = feed_specs
+        self._param_rules = param_rules
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -1157,6 +1170,9 @@ class PipelineOptimizer:
             "cut_list": self._cut_list,
             "sync_steps": self._sync_steps,
             "n_microbatches": self._num_microbatches,
+            "mesh": self._mesh,
+            "feed_specs": self._feed_specs,
+            "param_rules": self._param_rules,
         }
         return out
 

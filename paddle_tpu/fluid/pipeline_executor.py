@@ -23,6 +23,30 @@ sequential full-batch execution exactly (mean of microbatch means). v1
 limitations (documented, loud): stage bodies must be stateless in the
 persistable sense (no batch-norm running-stat updates inside the pipeline)
 and fetches must be producible by the last stage.
+
+Composed parallelism (dp x pp in ONE program — the fleet
+DistributedStrategy composition the reference pursues in
+incubate/fleet/collective/__init__.py:134-253): pass
+``PipelineOptimizer(..., mesh=, feed_specs=)`` a mesh that carries a
+'pp' axis PLUS other axes. The pipeline shard_map is then manual over
+'pp' ONLY (``axis_names={'pp'}``) — stage dispatch and the ppermute
+ring see their pp shard — while every other axis stays an *auto* axis:
+feeds keep their dp batch sharding and GSPMD partitions the stage
+bodies and inserts the dp collectives exactly as it does outside the
+pipeline (batch-group all-reduces are executed by every device of one
+pp coordinate, consistent with that coordinate's lax.switch branch).
+
+Param sharding over auto axes (tp) is REJECTED here, deliberately: the
+heterogeneous stage bodies live in lax.switch branches that diverge by
+pp index, and GSPMD freely inserts mesh-wide resharding
+collective-permutes inside those branches when re-laying-out sharded
+weights for a dot — devices of the other pp coordinate never reach
+them, which deadlocks the collective (observed on the 8-device CPU
+mesh: 4 threads at op_id=1, 4 at op_id=2). Uniform-body pipelines
+don't have this hazard — for true dp x tp x pp composition use the
+stacked-stage pipeline (paddle_tpu.parallel.pipeline.gpipe_composed),
+whose single stage body is executed by EVERY device so tp psums are
+structurally uniform.
 """
 import warnings
 
@@ -31,7 +55,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.registry import LowerContext
 from .lowering import (
@@ -169,11 +193,50 @@ def run_pipeline_program(executor, program, feed, fetch_list, scope,
             % (batch_dim, n_micro)
         )
 
-    mesh = Mesh(np.array(devices[:n_stages]), ("pp",))
-    from jax.sharding import NamedSharding
+    if info.get("param_rules"):
+        # Rejected on ANY mesh: on a composed mesh sharded weights make
+        # GSPMD insert mesh-wide resharding collectives inside the
+        # divergent lax.switch branches (a structural deadlock, observed
+        # as 4-vs-4 rendezvous splits on the 8-device CPU mesh); on the
+        # default pp-only mesh there is no auto axis to shard over. Both
+        # roads lead to the same advice.
+        raise OpLoweringError(
+            "PipelineOptimizer(param_rules=...) is not supported: the "
+            "heterogeneous stage bodies diverge per pp index "
+            "(lax.switch), and sharded weights make GSPMD insert "
+            "mesh-wide resharding collectives inside the divergent "
+            "branches — a structural deadlock. Shard the batch over "
+            "'dp' via feed_specs (safe: dp collective groups stay "
+            "within one pp coordinate), or use the stacked-stage "
+            "pipeline for dp x tp x pp "
+            "(paddle_tpu.parallel.pipeline.gpipe_composed).")
+    mesh = info.get("mesh")
+    if mesh is None:
+        mesh = Mesh(np.array(devices[:n_stages]), ("pp",))
+    else:
+        if "pp" not in mesh.axis_names:
+            raise OpLoweringError(
+                "PipelineOptimizer mesh must carry a 'pp' axis; got axes %s"
+                % (mesh.axis_names,))
+        if mesh.shape["pp"] != n_stages:
+            raise OpLoweringError(
+                "mesh 'pp' axis has size %d but cut_list produced %d "
+                "stages" % (mesh.shape["pp"], n_stages))
 
     repl = NamedSharding(mesh, P())
-    feed_arrays = {k: jax.device_put(v, repl) for k, v in feed_arrays.items()}
+    feed_specs = info.get("feed_specs") or {}
+    unknown = set(feed_specs) - set(feed_arrays)
+    if unknown:
+        raise OpLoweringError(
+            "PipelineOptimizer feed_specs name(s) %s match no feed "
+            "(feeds: %s) — a typo here would silently replicate the "
+            "batch instead of sharding it"
+            % (sorted(unknown), sorted(feed_arrays)))
+    feed_arrays = {
+        k: jax.device_put(v, NamedSharding(mesh, feed_specs[k]))
+        if k in feed_specs else jax.device_put(v, repl)
+        for k, v in feed_arrays.items()
+    }
     state = {k: jax.device_put(v, repl) for k, v in state.items()}
     rng = jax.device_put(executor._next_rng(program), repl)
 
@@ -218,8 +281,6 @@ def run_pipeline_program(executor, program, feed, fetch_list, scope,
 def _build_pipeline_fn(program, region, spans, ring_names, record_names,
                        target_names, bw_op, post_ops, loss_name, mesh,
                        n_micro, batch_dim):
-    from jax.experimental.shard_map import shard_map
-
     block = program.global_block()
     var_lookup = _make_var_lookup(block)
     n_stages = len(spans)
@@ -334,11 +395,16 @@ def _build_pipeline_fn(program, region, spans, ring_names, record_names,
                     lambda x: lax.psum(x, "pp"), recs
                 )
 
-            recs = shard_map(
+            # manual ONLY over 'pp' (stage switch + ppermute ring); any
+            # other mesh axis (dp/tp/...) stays auto — GSPMD keeps the
+            # feeds' dp sharding and the params' tp sharding inside the
+            # stage bodies and inserts those collectives itself
+            recs = jax.shard_map(
                 local, mesh=mesh,
                 in_specs=(P(), P(), P()),
                 out_specs=P(),
-                check_rep=False,
+                axis_names=frozenset({"pp"}),
+                check_vma=False,
             )(params, nontarget_state, feeds_mb)
             loss_mb = recs[loss_name]
             loss = jnp.mean(loss_mb.astype(jnp.float32))

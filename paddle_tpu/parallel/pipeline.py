@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["gpipe", "gpipe_sharded"]
+__all__ = ["gpipe", "gpipe_sharded", "gpipe_composed"]
 
 
 def gpipe(stage_fn, stage_params, x_microbatches, axis_name):
@@ -42,15 +42,15 @@ def gpipe(stage_fn, stage_params, x_microbatches, axis_name):
         inject = jnp.where(t < m, t, 0)
         x_in = jnp.where(idx == 0, x_microbatches[inject], buf)
         y = stage_fn(stage_params, x_in)
-        # last stage records finished microbatch (t - (n-1))
+        # last stage records finished microbatch (t - (n-1)). Arithmetic
+        # select, NOT lax.cond: every device must execute an identical
+        # op sequence so auto-axis (dp/tp) collectives under a composed
+        # mesh stay uniform — divergent branches deadlock them (see
+        # fluid/pipeline_executor.py composed-mesh notes)
         done_idx = t - (n - 1)
         record = (idx == n - 1) & (done_idx >= 0)
-        outs = lax.cond(
-            record,
-            lambda o: o.at[jnp.maximum(done_idx, 0)].set(y),
-            lambda o: o,
-            outs,
-        )
+        recorded = outs.at[jnp.maximum(done_idx, 0)].set(y)
+        outs = jnp.where(record, recorded, outs)
         buf_next = lax.ppermute(y, axis_name, perm)
         return (buf_next, outs), None
 
@@ -60,29 +60,70 @@ def gpipe(stage_fn, stage_params, x_microbatches, axis_name):
     return lax.psum(outs, axis_name)
 
 
-def gpipe_sharded(stage_fn, stacked_params, x, mesh, axis="pp",
-                  n_microbatches=None):
-    """Global entry: stacked_params has leading stage dim == mesh.shape[axis];
-    x: (B, ...) global batch split into microbatches."""
-    from jax.experimental.shard_map import shard_map
-
+def _gpipe_global(stage_fn, stacked_params, x, mesh, axis,
+                  n_microbatches, manual_axes):
+    """Shared global entry for the stacked-stage pipelines: microbatch
+    the batch, shard_map the per-device gpipe over ``axis``.
+    manual_axes=None -> every mesh axis manual (classic gpipe_sharded);
+    manual_axes={axis} -> partially-manual, other axes stay GSPMD auto
+    (the composed dp x tp x pp path)."""
     n = mesh.shape[axis]
     mb = n_microbatches or n
+    if x.shape[0] % mb:
+        raise ValueError("batch %d not divisible by %d microbatches"
+                         % (x.shape[0], mb))
     xm = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
 
     def local(params_stacked, xm_local):
         params = jax.tree_util.tree_map(lambda p: p[0], params_stacked)
         return gpipe(stage_fn, params, xm_local, axis)
 
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(
-            jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
-            P(),
-        ),
-        out_specs=P(),
-        check_rep=False,
+    in_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+        P(),
     )
-    outs = fn(stacked_params, xm)
+    if manual_axes is None:
+        fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=P(), check_vma=False)
+        outs = fn(stacked_params, xm)
+    else:
+        fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=P(),
+                           axis_names=frozenset(manual_axes),
+                           check_vma=False)
+        # partially-manual shard_map only traces under jit (eager
+        # tracing rejects auto-axis out_specs); inside an outer jitted
+        # train step this inner jit simply inlines
+        outs = jax.jit(fn)(stacked_params, xm)
     return outs.reshape((x.shape[0],) + outs.shape[2:])
+
+
+def gpipe_sharded(stage_fn, stacked_params, x, mesh, axis="pp",
+                  n_microbatches=None):
+    """Global entry: stacked_params has leading stage dim == mesh.shape[axis];
+    x: (B, ...) global batch split into microbatches."""
+    return _gpipe_global(stage_fn, stacked_params, x, mesh, axis,
+                         n_microbatches, manual_axes=None)
+
+
+def gpipe_composed(stage_fn, stacked_params, x, mesh, axis="pp",
+                   n_microbatches=None):
+    """dp x tp x pp COMPOSED stacked-stage pipeline (round 5).
+
+    Like :func:`gpipe_sharded`, but the shard_map is manual over the
+    ``axis`` ('pp') mesh axis ONLY — every other mesh axis (dp, tp, ...)
+    stays *auto*, so GSPMD keeps the batch's dp sharding and the stacked
+    weights' tp sharding inside the stage body and inserts the dp/tp
+    collectives itself. This is safe where the heterogeneous lax.switch
+    pipeline is not: the ONE stage body is executed by EVERY device each
+    tick, so auto-axis collectives are structurally uniform (no
+    divergent-branch deadlock — fluid/pipeline_executor.py notes).
+
+    stacked_params leaves carry a leading stage dim == mesh.shape[axis]
+    and may be device_put with NamedSharding(mesh, P(axis, ..., 'tp'))
+    to compose tp; ``x`` is the (B, ...) GLOBAL batch and may be sharded
+    P('dp', ...) — the microbatch reshape keeps dp on the
+    per-microbatch batch dim.
+    """
+    return _gpipe_global(stage_fn, stacked_params, x, mesh, axis,
+                         n_microbatches, manual_axes={axis})
