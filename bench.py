@@ -110,6 +110,18 @@ def _compose(status):
 # supervisor (never imports jax)
 # ===========================================================================
 PROBE_WATCHDOG_S = float(os.environ.get("PADDLE_TPU_PROBE_WATCHDOG_S", 180))
+# The observed relay wedge takes ~25 min (~1500s) to self-resolve into a
+# fast UNAVAILABLE, and killing a mid-init process may RE-wedge it
+# (round-1 lesson) — so the FIRST probe of each probing phase is patient:
+# it gets (up to) this long to either succeed or see the wedge resolve on
+# its own before any kill. Later probes only run after a fast-fail, so
+# they stay short. NOTE the patience is always capped by the remaining
+# window: under the driver's default 1500s deadline the first probe gets
+# ~1440s (best effort — a wedge present AT driver time is unrecoverable
+# either way); in-round opportunistic runs pass a larger
+# PADDLE_TPU_BENCH_DEADLINE_S so the full patience applies.
+PROBE_FIRST_WATCHDOG_S = float(
+    os.environ.get("PADDLE_TPU_PROBE_FIRST_WATCHDOG_S", 1680))
 INIT_STALL_S = float(os.environ.get("PADDLE_TPU_INIT_STALL_S", 240))
 
 
@@ -294,7 +306,9 @@ def supervise():
         probes = 0
         while not skip_probe:
             probes += 1
-            ok, info = _run_probe(min(PROBE_WATCHDOG_S,
+            watchdog = PROBE_FIRST_WATCHDOG_S if probes == 1 \
+                else PROBE_WATCHDOG_S
+            ok, info = _run_probe(min(watchdog,
                                       max(_remaining() - 60, 30)))
             if ok:
                 sup_errors.append("probe %d ok: %s" % (probes, info))
@@ -347,12 +361,19 @@ def supervise():
                 sup_errors.append(
                     "child stalled in jax-init >%ds; respawn %d"
                     % (INIT_STALL_S, respawns))
-                # probe until the relay answers again: cheap disposable
-                # probes, never another child doomed to hang in init
+                # probe until the relay answers again: disposable probes,
+                # never another child doomed to hang in init. The FIRST
+                # re-probe is patient for the same reason phase-1's is —
+                # the relay just re-wedged, and a kill cycle may keep it
+                # wedged (round-1 lesson).
                 ok = False
+                reprobes = 0
                 while not ok and _remaining() > 150:
+                    reprobes += 1
+                    watchdog = PROBE_FIRST_WATCHDOG_S if reprobes == 1 \
+                        else PROBE_WATCHDOG_S
                     ok, info = _run_probe(
-                        min(PROBE_WATCHDOG_S, _remaining() - 120))
+                        min(watchdog, _remaining() - 120))
                     sup_errors.append("re-probe: %s %s" % (ok, info))
                     if not ok:
                         time.sleep(20)

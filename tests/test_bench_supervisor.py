@@ -35,6 +35,7 @@ def test_probe_hang_is_killed_and_retried(tmp_path):
     result = _run({
         "PADDLE_TPU_PROBE_FAKE_HANG_ONCE": str(marker),
         "PADDLE_TPU_PROBE_WATCHDOG_S": "10",
+        "PADDLE_TPU_PROBE_FIRST_WATCHDOG_S": "10",
         "PADDLE_TPU_BENCH_DEADLINE_S": "400",
     }, timeout=390)
     assert result["value"] > 0
@@ -54,6 +55,7 @@ def test_starved_window_reports_relay_unavailable(tmp_path):
     result = _run({
         "PADDLE_TPU_PROBE_FAKE_HANG_ONCE": str(marker),
         "PADDLE_TPU_PROBE_WATCHDOG_S": "10",
+        "PADDLE_TPU_PROBE_FIRST_WATCHDOG_S": "10",
         # after the 10s probe kill, remaining < watchdog+120 -> give up
         "PADDLE_TPU_BENCH_DEADLINE_S": "135",
     }, timeout=120)
@@ -75,3 +77,21 @@ def test_child_init_stall_respawns(tmp_path):
     assert result["detail"]["stage"] == "done"
     log = " ".join(result["detail"]["supervisor_log"])
     assert "respawn 1" in log
+
+
+def test_first_probe_is_patient(tmp_path):
+    """The FIRST probe must use the patient watchdog (relay wedges
+    self-resolve in ~25 min; killing mid-init may re-wedge) while
+    retries stay short. FIRST=25 vs WATCHDOG=5: a hung first probe must
+    survive past 5s and be killed at 25s."""
+    marker = tmp_path / "hang_once"
+    result = _run({
+        "PADDLE_TPU_PROBE_FAKE_HANG_ONCE": str(marker),
+        "PADDLE_TPU_PROBE_WATCHDOG_S": "5",
+        "PADDLE_TPU_PROBE_FIRST_WATCHDOG_S": "25",
+        "PADDLE_TPU_BENCH_DEADLINE_S": "400",
+    }, timeout=390)
+    assert result["value"] > 0
+    log = " ".join(result["detail"]["supervisor_log"])
+    assert "hung >25s (killed)" in log, log
+    assert "probe 2 ok" in log
