@@ -10,8 +10,10 @@ TPU-native shape of the reference's pieces:
   PostTrainingQuantization calibrates without retraining (abs-max / KL).
 - core: yaml-configured Compressor scheduling strategies per epoch.
 - graph: GraphWrapper views over the symbolic Program.
-- searcher: SAController (simulated annealing); nas.LightNasStrategy is
-  a loud stub (controller-server machinery not rebuilt).
+- searcher: SAController (simulated annealing).
+- nas: the LightNAS search subsystem — socket ControllerServer +
+  SearchAgent protocol and LightNASStrategy driving the SAController
+  through the Compressor epoch loop (real since round 5).
 """
 from . import core  # noqa: F401
 from .core import Compressor, ConfigFactory, Context, Strategy  # noqa: F401

@@ -70,6 +70,7 @@ class Context:
         self.epoch_id = 0
         self.batch_id = 0
         self.eval_results = {}
+        self.skip_training = False
         self._cache = {}
 
     def put(self, key, value):
@@ -173,12 +174,6 @@ class Compressor:
         self.distiller_optimizer = distiller_optimizer
         self.init_model = None
         self.search_space = search_space
-        if search_space is not None:
-            raise NotImplementedError(
-                "NAS search is not wired into Compressor; use "
-                "slim.searcher.SAController directly (LightNAS strategy "
-                "is a documented stub)"
-            )
         self.log_period = int(log_period)
         assert self.log_period > 0
 
@@ -209,7 +204,8 @@ class Compressor:
             eval_graph=self.eval_graph, eval_reader=self.eval_reader,
             teacher_graphs=self.teacher_graphs,
             train_optimizer=self.train_optimizer,
-            distiller_optimizer=self.distiller_optimizer)
+            distiller_optimizer=self.distiller_optimizer,
+            search_space=self.search_space)
         # the optimize graph: train program + backward + updates
         if self.train_optimizer is not None:
             ctx.optimize_graph = self.train_graph.get_optimize_graph(
@@ -255,18 +251,24 @@ class Compressor:
     def _train_one_epoch(self, context):
         from ....executor import Executor
 
-        if self.train_reader is None:
+        # strategies (LightNAS) may swap the context graphs/readers per
+        # epoch, and retrain_epoch=0 search skips training entirely
+        if getattr(context, "skip_training", False):
+            return
+        train_reader = context.train_reader or self.train_reader
+        if train_reader is None:
             return
         exe = Executor(self.place)
         graph = context.optimize_graph
+        train_graph = context.train_graph or self.train_graph
         feed_vars = [
-            graph.var(n)._var for n in self.train_graph.in_nodes.values()
+            graph.var(n)._var for n in train_graph.in_nodes.values()
         ]
-        fetch_names = list(self.train_graph.out_nodes.keys())
+        fetch_names = list(train_graph.out_nodes.keys())
         fetch = [graph.var(n)._var
-                 for n in self.train_graph.out_nodes.values()]
+                 for n in train_graph.out_nodes.values()]
         feeder = DataFeeder(feed_vars, self.place, program=graph.program)
-        for batch_id, batch in enumerate(self.train_reader()):
+        for batch_id, batch in enumerate(train_reader()):
             context.batch_id = batch_id
             for s in self._active(context):
                 s.on_batch_begin(context)
@@ -285,12 +287,12 @@ class Compressor:
         if self.eval_func is not None:
             for name, func in self.eval_func.items():
                 val = func(
-                    (self.eval_graph or self.train_graph).program,
+                    (context.eval_graph or context.train_graph).program,
                     self.scope)
                 context.eval_results.setdefault(name, []).append(
                     float(val))
             return
-        if self.eval_graph is None or self.eval_reader is None:
+        if context.eval_graph is None or context.eval_reader is None:
             return
         results, names = context.run_eval_graph()
         for n in names:
@@ -323,10 +325,14 @@ class Compressor:
             for s in self._active(context):
                 s.on_epoch_begin(context)
             self._train_one_epoch(context)
-            for s in self._active(context):
-                s.on_epoch_end(context)
+            # eval BEFORE on_epoch_end, like the reference
+            # (ref compressor.py:592-598): strategies that consume
+            # eval_results in on_epoch_end (LightNAS reward) see this
+            # epoch's numbers
             if self.eval_epoch and (epoch + 1) % self.eval_epoch == 0:
                 self._eval(context)
+            for s in self._active(context):
+                s.on_epoch_end(context)
             self._save_checkpoint(context)
         for s in self.strategies:
             s.on_compression_end(context)

@@ -24,20 +24,22 @@ def _registry():
     from ..distillation import (
         DistillationStrategy, L2Distiller, SoftLabelDistiller,
     )
-    from ..nas import LightNasStrategy
+    from ..nas import LightNASStrategy
     from ..prune import (
         PruneStrategy, StructurePruner, UniformPruneStrategy,
     )
     from ..quantization import QuantizationStrategy
     from ..searcher import SAController
 
-    return {
+    classes = {
         c.__name__: c for c in (
             L2Distiller, SoftLabelDistiller, DistillationStrategy,
             StructurePruner, PruneStrategy, UniformPruneStrategy,
-            QuantizationStrategy, SAController, LightNasStrategy,
+            QuantizationStrategy, SAController, LightNASStrategy,
         )
     }
+    classes["LightNasStrategy"] = LightNASStrategy  # pre-round-5 spelling
+    return classes
 
 
 class ConfigFactory:

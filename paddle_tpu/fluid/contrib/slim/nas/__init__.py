@@ -1,34 +1,20 @@
-"""slim.nas (ref: contrib/slim/nas).
+"""slim.nas (ref: contrib/slim/nas) — the LightNAS search subsystem.
 
-LightNAS's distributed search couples a controller server, agents, and
-latency lookup tables to the pserver runtime; none of that machinery is
-rebuilt here. The search CONTROLLER itself (simulated annealing over
-token lists) lives in slim.searcher.SAController and is fully usable —
-drive it from your own evaluate loop. LightNasStrategy stays a loud
-stub so yaml configs fail with guidance instead of half-running.
+Round-5 rebuild: the socket ControllerServer + SearchAgent protocol and
+the LightNASStrategy search loop are real (they are host-side TCP with
+nothing pserver-specific), driving the SAController in slim.searcher
+and evaluating candidates through the ordinary jitted Executor.
 """
-__all__ = ["LightNasStrategy", "SearchSpace"]
+from .controller_server import ControllerServer
+from .light_nas_strategy import LightNASStrategy
+from .lock import lock, unlock
+from .search_agent import SearchAgent
+from .search_space import SearchSpace
 
+# pre-round-5 name kept importable (yaml configs in the wild)
+LightNasStrategy = LightNASStrategy
 
-class SearchSpace:
-    """Protocol for a searchable space (ref nas/search_space.py): define
-    init_tokens/range_table/create_net to drive SAController."""
-
-    def init_tokens(self):
-        raise NotImplementedError
-
-    def range_table(self):
-        raise NotImplementedError
-
-    def create_net(self, tokens=None):
-        raise NotImplementedError
-
-
-class LightNasStrategy:
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "LightNasStrategy's controller-server search loop is not "
-            "rebuilt; drive slim.searcher.SAController directly with a "
-            "SearchSpace (init_tokens/range_table/create_net) and your "
-            "eval function"
-        )
+__all__ = [
+    "ControllerServer", "LightNASStrategy", "LightNasStrategy",
+    "SearchAgent", "SearchSpace", "lock", "unlock",
+]
