@@ -1,0 +1,31 @@
+#!/bin/bash
+# First-healthy-window experiment queue (round 5). Runs AFTER the
+# opportunistic bench (r5_attempt2) finishes — waits for its output
+# line, then chains the staged experiments sequentially. Everything is
+# self-exiting; nothing here is ever killed (relay protocol).
+cd /root/repo
+LOG=.bench_runs/orchestrate.log
+echo "orchestrator start $(date -u)" >> $LOG
+
+# wait (up to 4h) for the bench attempt to finish
+for i in $(seq 1 480); do
+  if [ -s .bench_runs/r5_attempt2.out ]; then break; fi
+  sleep 30
+done
+echo "bench attempt output present at $(date -u)" >> $LOG
+
+# only proceed to experiments if the relay is actually answering:
+# quick self-exiting probe (no kill — give it up to 30 min)
+timeout 1800 python bench.py --probe > .bench_runs/orch_probe.out 2>/dev/null
+if ! grep -q '"ok": true' .bench_runs/orch_probe.out; then
+  echo "relay unhealthy after bench attempt; stopping $(date -u)" >> $LOG
+  exit 0
+fi
+echo "relay healthy; running experiment queue $(date -u)" >> $LOG
+
+for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
+  echo "== $s start $(date -u)" >> $LOG
+  python bench_experiments/$s.py >> .bench_runs/$s.log 2>&1
+  echo "== $s done rc=$? $(date -u)" >> $LOG
+done
+echo "orchestrator done $(date -u)" >> $LOG

@@ -322,6 +322,10 @@ class Compressor:
         start = context.epoch_id
         for epoch in range(start, self.epoch):
             context.epoch_id = epoch
+            # per-epoch flag: a strategy (LightNAS retrain_epoch=0) must
+            # re-request the skip every epoch, or training would stay
+            # silently disabled after its window ends
+            context.skip_training = False
             for s in self._active(context):
                 s.on_epoch_begin(context)
             self._train_one_epoch(context)

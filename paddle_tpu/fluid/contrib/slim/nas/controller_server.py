@@ -115,16 +115,18 @@ class ControllerServer:
     def _handle(self, conn, addr):
         message = self._recv_all(conn)
         if message.strip("\n") == "next_tokens":
-            conn.send(self._encode(self._controller.next_tokens()))
+            conn.sendall(self._encode(self._controller.next_tokens()))
             return
         parts = message.strip("\n").split("\t")
-        if len(parts) < 3 or parts[0] != self._key:
+        # compare string forms: the agent serializes its key with %s,
+        # so default key=None on both sides must still match
+        if len(parts) < 3 or parts[0] != str(self._key):
             _logger.info("recv noise from %s: [%s]" % (addr, message))
             return
         tokens = [int(t) for t in parts[1].split(",")]
         self._controller.update(tokens, float(parts[2]))
         reply = self._encode(self._controller.next_tokens())
-        conn.send(reply)
+        conn.sendall(reply)
         _logger.info("send message to %s: [%s]" % (addr, reply.decode()))
 
     @staticmethod

@@ -126,8 +126,10 @@ class LightNASStrategy(Strategy):
     def _propose_next(self, min_tokens):
         """Next candidate under the budget-retry loop. The reference
         consults the local controller directly here (strategy:157) —
-        only works on the server host; agents ask over the wire."""
-        if self._controller is not None and self._is_server:
+        valid only in the process that actually RUNS the server (a
+        reusing process's local controller instance never sees updates;
+        it must ask over the wire)."""
+        if self._controller is not None and self._server is not None:
             return self._controller.next_tokens(min_tokens)
         return self._search_agent.next_tokens()
 
@@ -202,11 +204,21 @@ class LightNASStrategy(Strategy):
                      % self._retrain_epoch == 0)):
             return
         results = context.eval_results.get(self._metric_name)
-        if not results:
+        if context.eval_results and results is None:
             raise ValueError(
                 "LightNAS reward metric %r not in eval results %s — "
                 "name one of the eval fetch display names"
                 % (self._metric_name, sorted(context.eval_results)))
+        # only reward the candidate with an eval that actually ran THIS
+        # epoch (compressor eval_epoch > 1 skips epochs; crediting a
+        # stale number to a new candidate would corrupt the SA signal)
+        n_seen = getattr(self, "_evals_consumed", 0)
+        if not results or len(results) == n_seen:
+            _logger.info(
+                "no fresh eval at epoch %d (eval_epoch gating?); "
+                "skipping controller update" % context.epoch_id)
+            return
+        self._evals_consumed = len(results)
         reward = float(results[-1])
         flops = context.eval_graph.flops()
         if flops > self._max_flops:
