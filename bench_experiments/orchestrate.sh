@@ -1,6 +1,6 @@
 #!/bin/bash
 # First-healthy-window experiment queue (round 5). Runs AFTER the
-# opportunistic bench (r5_attempt2) finishes — waits for its output
+# opportunistic bench (r5_attempt3) finishes — waits for its output
 # line, then chains the staged experiments sequentially. Everything is
 # self-exiting; nothing here is ever killed (relay protocol).
 cd /root/repo
@@ -9,7 +9,7 @@ echo "orchestrator start $(date -u)" >> $LOG
 
 # wait (up to 4h) for the bench attempt to finish
 for i in $(seq 1 480); do
-  if [ -s .bench_runs/r5_attempt2.out ]; then break; fi
+  if [ -s .bench_runs/r5_attempt3.out ]; then break; fi
   sleep 30
 done
 echo "bench attempt output present at $(date -u)" >> $LOG
