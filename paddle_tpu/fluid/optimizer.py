@@ -1138,7 +1138,8 @@ class PipelineOptimizer:
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
                  start_cpu_core_id=0, num_microbatches=None,
-                 mesh=None, feed_specs=None, param_rules=None):
+                 mesh=None, feed_specs=None, param_rules=None,
+                 opt_state_rules=None):
         self._optimizer = optimizer
         self._cut_list = cut_list
         self._place_list = place_list
@@ -1158,6 +1159,10 @@ class PipelineOptimizer:
         self._mesh = mesh
         self._feed_specs = feed_specs
         self._param_rules = param_rules
+        # ZeRO-1 x pp: ShardingRules for OPTIMIZER state (moments,
+        # accumulators) over auto axes — safe because post-pipeline
+        # update ops run outside the divergent stage branches
+        self._opt_state_rules = opt_state_rules
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -1173,6 +1178,7 @@ class PipelineOptimizer:
             "mesh": self._mesh,
             "feed_specs": self._feed_specs,
             "param_rules": self._param_rules,
+            "opt_state_rules": self._opt_state_rules,
         }
         return out
 

@@ -237,7 +237,24 @@ def run_pipeline_program(executor, program, feed, fetch_list, scope,
         if k in feed_specs else jax.device_put(v, repl)
         for k, v in feed_arrays.items()
     }
-    state = {k: jax.device_put(v, repl) for k, v in state.items()}
+
+    # ZeRO-1 composed with the pipeline (the fleet sharding_degree +
+    # pipeline composition, ref incubate/fleet/collective/__init__.py):
+    # OPTIMIZER state (belong_to_optimizer vars, like
+    # DistributedProgram._opt_state_names) may shard over auto axes
+    # because it is only read by the POST-pipeline ops (Adam/Momentum
+    # updates), which run outside the divergent lax.switch branches —
+    # unlike param_rules (rejected above). A matched opt var the
+    # forward region READS is refused for exactly that reason;
+    # non-optimizer matches are ignored, like DistributedProgram.
+    opt_rules = info.get("opt_state_rules") or []
+    if opt_rules:
+        state_shardings = _resolve_opt_shardings(
+            executor, program, region, opt_rules, mesh, repl, state)
+        state = {k: jax.device_put(v, state_shardings.get(k, repl))
+                 for k, v in state.items()}
+    else:
+        state = {k: jax.device_put(v, repl) for k, v in state.items()}
     rng = jax.device_put(executor._next_rng(program), repl)
 
     sig = (
@@ -275,6 +292,57 @@ def run_pipeline_program(executor, program, feed, fetch_list, scope,
     out = [fetches[n] for n in fetch_names]
     if return_numpy:
         return [np.asarray(v) for v in out]
+    return out
+
+
+def _resolve_opt_shardings(executor, program, region, opt_rules, mesh,
+                           repl, state):
+    """{state name -> NamedSharding} for opt_state_rules. Constant per
+    (program, rules, mesh), so it is cached on the executor — the
+    per-step cost is one dict lookup per var, not a regex sweep plus a
+    recursive region-read scan."""
+    key = ("pipe_opt_shardings", program._uid, program._version, id(mesh))
+    cached = executor._cache.get(key)
+    if cached is not None:
+        return cached
+
+    from .lowering import op_read_names
+    from ..parallel.sharding import _spec_fits
+
+    opt_names = {
+        v.name for v in program.global_block().vars.values()
+        if getattr(v, "belong_to_optimizer", False)
+    }
+    region_reads = set()
+    for op in region:
+        region_reads.update(op_read_names(op, program))
+
+    out = {}
+    for name, value in state.items():
+        if name not in opt_names:
+            continue
+        shape = np.shape(value)
+        for r in opt_rules:
+            if not r.match(name):
+                continue
+            entries = tuple(r.spec)
+            while entries and entries[-1] is None:
+                entries = entries[:-1]
+            if len(entries) > len(shape):
+                continue
+            spec = P(*entries)
+            if not _spec_fits(spec, shape, mesh):
+                continue
+            if name in region_reads:
+                raise OpLoweringError(
+                    "opt_state_rules matched %r, which the pipeline "
+                    "forward region READS — sharding it would put "
+                    "GSPMD reshard collectives inside the divergent "
+                    "stage branches (see param_rules error). Only "
+                    "post-pipeline optimizer state may shard." % name)
+            out[name] = NamedSharding(mesh, spec)
+            break
+    executor._cache[key] = out
     return out
 
 
