@@ -47,8 +47,10 @@ class ParamAttr:
             return ParamAttr(name=arg)
         if hasattr(arg, "__call__"):  # bare initializer
             return ParamAttr(initializer=arg)
-        if arg is False:
-            return False
+        if isinstance(arg, bool):
+            # ref param_attr.py:154 — True means "default attr",
+            # False means "no parameter" (e.g. bias_attr=False)
+            return ParamAttr._to_attr(None) if arg else False
         raise TypeError("cannot convert %r to ParamAttr" % (arg,))
 
     def _to_kwargs(self, with_initializer=False):
