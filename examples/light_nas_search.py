@@ -1,0 +1,130 @@
+"""LightNAS architecture search, end to end.
+
+A yaml-configured Compressor drives the SA controller through the
+socket ControllerServer/SearchAgent protocol: propose tokens ->
+SearchSpace.create_net builds the candidate -> FLOPs budget filters ->
+train + evaluate through the jitted Executor -> reward updates the
+controller. (ref workflow: contrib/slim/nas/* + slim tests
+light_nas_space.py.)
+
+Run: python examples/light_nas_search.py      (CPU-friendly toy search)
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"):
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid.contrib.slim import Compressor  # noqa: E402
+from paddle_tpu.fluid.contrib.slim.nas import SearchSpace  # noqa: E402
+
+V_IN, NCLS = 8, 3
+WIDTHS = [4, 8, 16, 64]                 # token -> hidden width
+TARGET_FLOPS = 11 * 8                   # excludes widths 16 and 64
+
+rng = np.random.default_rng(0)
+XS = rng.standard_normal((96, V_IN)).astype("float32")
+YS = np.argmax(XS[:, :NCLS], axis=1).astype("int64")[:, None]
+
+
+class WidthSpace(SearchSpace):
+    """One token choosing the hidden width of a 1-hidden-layer net.
+
+    Contract (slim.nas.SearchSpace): create_net returns the 7-tuple and
+    its fluid.data names match the Compressor's feed display names."""
+
+    def init_tokens(self):
+        return [3]                      # deliberately over budget
+
+    def range_table(self):
+        return [len(WIDTHS)]
+
+    def create_net(self, tokens=None):
+        width = WIDTHS[tokens[0]]
+        train_p, startup_p = fluid.Program(), fluid.Program()
+        with fluid.program_guard(train_p, startup_p):
+            x = fluid.data("nx", shape=[None, V_IN], dtype="float32")
+            y = fluid.data("ny", shape=[None, 1], dtype="int64")
+            h = fluid.layers.fc(x, width, act="relu")
+            logits = fluid.layers.fc(h, NCLS)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            acc = fluid.layers.accuracy(fluid.layers.softmax(logits), y)
+        test_p = train_p.clone(for_test=True)
+        with fluid.program_guard(train_p, startup_p):
+            fluid.optimizer.Adam(5e-2).minimize(loss)
+
+        def reader():
+            for i in range(0, len(XS), 32):
+                yield [(XS[j], YS[j]) for j in range(i, i + 32)]
+
+        return (startup_p, train_p, test_p, [("loss", loss.name)],
+                [("acc_top1", acc.name)], reader, reader)
+
+
+YAML = """
+version: 1.0
+controllers:
+    sa_controller:
+        class: 'SAController'
+        reduce_rate: 0.9
+        init_temperature: 1024
+strategies:
+    light_nas_strategy:
+        class: 'LightNASStrategy'
+        controller: 'sa_controller'
+        target_flops: %d
+        end_epoch: 4
+        retrain_epoch: 1
+        metric_name: 'acc_top1'
+        is_server: 1
+        server_ip: '127.0.0.1'
+compressor:
+    epoch: 5
+    strategies:
+        - light_nas_strategy
+""" % TARGET_FLOPS
+
+
+def main():
+    workdir = tempfile.mkdtemp(prefix="light_nas_")
+    os.chdir(workdir)                   # the strategy drops a flock file
+    with open("compress.yaml", "w") as f:
+        f.write(YAML)
+    exe = fluid.Executor(fluid.CPUPlace())
+    comp = Compressor(
+        place=exe.place, scope=fluid.global_scope(),
+        train_program=fluid.Program(),  # replaced per candidate
+        train_feed_list=[("nx", "nx"), ("ny", "ny")],
+        train_fetch_list=[("loss", "unused")],
+        eval_program=fluid.Program(),
+        eval_feed_list=[("nx", "nx"), ("ny", "ny")],
+        eval_fetch_list=[("acc_top1", "unused")],
+        search_space=WidthSpace(),
+        log_period=2)
+    comp.config("compress.yaml")
+    ctx = comp.run()
+
+    ctrl = comp.strategies[0]._controller
+    best_w = WIDTHS[ctrl.best_tokens[0]]
+    print("\nsearch done: best width=%d (tokens=%s) reward=%.3f "
+          "within budget=%s flops" % (best_w, ctrl.best_tokens,
+                                      ctrl.max_reward, TARGET_FLOPS))
+    print("eval accuracy per epoch:",
+          ["%.2f" % v for v in ctx.eval_results["acc_top1"]])
+    assert 11 * best_w <= TARGET_FLOPS
+
+
+if __name__ == "__main__":
+    main()
