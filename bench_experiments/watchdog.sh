@@ -1,27 +1,51 @@
 #!/bin/bash
-# Permanent chip-window watcher (round 5). Loops a patient self-exiting
-# probe (never killed) until the relay answers, then runs the full
-# bench (fresh 1h window) followed by the staged experiment queue.
-# Leaves everything banked; exits after one successful cycle.
-cd /root/repo
+# Permanent chip-window watcher (round 5; supersedes orchestrate.sh).
+# Loops: patient self-exiting probe (never killed) until the relay
+# answers -> full bench (fresh 1h window) -> if the bench actually
+# produced a result line, the staged experiment queue -> exit.
+# A bench that failed (relay re-wedged mid-run) sends the loop back to
+# probing instead of burning the experiment scripts against a dead
+# relay.
+cd /root/repo || exit 1
 LOG=.bench_runs/watchdog.log
 echo "watchdog start $(date -u)" >> $LOG
 while true; do
   python bench.py --probe > .bench_runs/wd_probe.out 2>/dev/null
-  if grep -q '"ok": true' .bench_runs/wd_probe.out; then
-    echo "relay healthy $(date -u)" >> $LOG
-    break
+  if ! grep -q '"ok": true' .bench_runs/wd_probe.out; then
+    echo "probe unhealthy $(date -u): $(head -c 120 .bench_runs/wd_probe.out)" >> $LOG
+    sleep 120
+    continue
   fi
-  echo "probe unhealthy $(date -u): $(head -c 120 .bench_runs/wd_probe.out)" >> $LOG
-  sleep 120
+  echo "relay healthy; running full bench $(date -u)" >> $LOG
+  PADDLE_TPU_BENCH_DEADLINE_S=3600 python bench.py \
+    > .bench_runs/wd_bench.out 2> .bench_runs/wd_bench.err
+  rc=$?
+  # POSITIVE success check: top-level stage "done" and a nonzero value
+  # (grepping for failure markers misses crashed/respawning children,
+  # and last_known_good nests a stale "done" inside failures)
+  if [ $rc -ne 0 ] || ! python - <<'PY'
+import json, sys
+try:
+    line = [l for l in open(".bench_runs/wd_bench.out")
+            if l.startswith("{")][-1]
+    d = json.loads(line)
+    ok = d.get("value", 0) > 0 and \
+        d.get("detail", {}).get("stage") == "done"
+except Exception:
+    ok = False
+sys.exit(0 if ok else 1)
+PY
+  then
+    echo "bench failed rc=$rc $(date -u); back to probing" >> $LOG
+    sleep 120
+    continue
+  fi
+  echo "bench done $(date -u)" >> $LOG
+  for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
+    echo "== $s start $(date -u)" >> $LOG
+    python bench_experiments/$s.py >> .bench_runs/$s.log 2>&1
+    echo "== $s done rc=$? $(date -u)" >> $LOG
+  done
+  echo "watchdog complete $(date -u)" >> $LOG
+  break
 done
-echo "running full bench $(date -u)" >> $LOG
-PADDLE_TPU_BENCH_DEADLINE_S=3600 python bench.py \
-  > .bench_runs/wd_bench.out 2> .bench_runs/wd_bench.err
-echo "bench done rc=$? $(date -u)" >> $LOG
-for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
-  echo "== $s start $(date -u)" >> $LOG
-  python bench_experiments/$s.py >> .bench_runs/$s.log 2>&1
-  echo "== $s done rc=$? $(date -u)" >> $LOG
-done
-echo "watchdog complete $(date -u)" >> $LOG

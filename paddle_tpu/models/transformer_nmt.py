@@ -172,22 +172,13 @@ class TransformerDecodeCell:
     def _attend(self, q, k, v, mask):
         """q (B,1,H), k/v (B,T,H), additive mask broadcastable to
         (B,nh,1,T) -> context (B,1,H)."""
-        cfg = self.cfg
-        nh, dh = cfg.heads, cfg.hidden // cfg.heads
+        from .decode_utils import attend
 
-        def heads(t):
-            t = layers.reshape(t, [0, 0, nh, dh])
-            return layers.transpose(t, [0, 2, 1, 3])
-
-        scores = layers.matmul(heads(q), heads(k), transpose_y=True,
-                               alpha=dh ** -0.5)
-        if mask is not None:
-            scores = layers.elementwise_add(scores, mask)
-        ctx = layers.matmul(layers.softmax(scores), heads(v))
-        ctx = layers.transpose(ctx, [0, 2, 1, 3])
-        return layers.reshape(ctx, [0, 0, cfg.hidden])
+        return attend(q, k, v, mask, self.cfg.heads, self.cfg.hidden)
 
     def call(self, inputs, states, enc_kv=None):
+        from .decode_utils import step_masks, update_cache
+
         cfg = self.cfg
         h = cfg.hidden
         pos, caches = states[0], states[1:]
@@ -198,15 +189,7 @@ class TransformerDecodeCell:
         x = layers.unsqueeze(x, [1])                        # (B, 1, H)
 
         # cache-write one-hot and <=pos visibility mask, shared by layers
-        steps = layers.unsqueeze(
-            layers.range(0, self.tmax, 1, "int64"), [0])    # (1, T)
-        write = layers.cast(layers.equal(steps, pos), "float32")
-        write3 = layers.unsqueeze(write, [2])               # (B, T, 1)
-        keep3 = layers.scale(write3, scale=-1.0, bias=1.0)
-        seen = layers.cast(
-            layers.less_equal(steps, pos), "float32")       # (B, T)
-        self_mask = layers.scale(seen, scale=1e9, bias=-1e9)
-        self_mask = layers.unsqueeze(self_mask, [1, 2])     # (B,1,1,T)
+        write3, keep3, self_mask = step_masks(pos, self.tmax)
 
         def proj(t, name):
             return layers.fc(t, h, num_flatten_dims=2,
@@ -216,16 +199,13 @@ class TransformerDecodeCell:
         new_caches = []
         for i in range(cfg.dec_layers):
             n = "dec%d" % i
-            k_cache, v_cache = caches[2 * i], caches[2 * i + 1]
             q = proj(x, n + ".self.q")
-            k_t = proj(x, n + ".self.k")
-            v_t = proj(x, n + ".self.v")
-            k_cache = layers.elementwise_add(
-                layers.elementwise_mul(k_cache, keep3),
-                layers.elementwise_mul(k_t, write3))
-            v_cache = layers.elementwise_add(
-                layers.elementwise_mul(v_cache, keep3),
-                layers.elementwise_mul(v_t, write3))
+            k_cache = update_cache(caches[2 * i],
+                                   proj(x, n + ".self.k"),
+                                   write3, keep3)
+            v_cache = update_cache(caches[2 * i + 1],
+                                   proj(x, n + ".self.v"),
+                                   write3, keep3)
             new_caches += [k_cache, v_cache]
             attn = proj(self._attend(q, k_cache, v_cache, self_mask),
                         n + ".self.o")
