@@ -1,3 +1,5 @@
 """Model zoo matching the reference's benchmark configs (BASELINE.json):
-MNIST MLP, ResNet-50, BERT-base, Transformer NMT, Wide&Deep CTR — all built
-through the paddle_tpu.fluid layer API so they exercise the framework."""
+MNIST MLP, ResNet-50, BERT-base, Transformer NMT, Wide&Deep CTR, SSD —
+all built through the paddle_tpu.fluid layer API so they exercise the
+framework. Beyond-survey: GPT decoder-only LM with KV-cache generation
+(models/gpt.py)."""
