@@ -91,3 +91,26 @@ def test_gpt_generate_rejects_overlong():
     cfg = gpt.gpt_tiny(vocab=50, max_len=8)
     with pytest.raises(ValueError, match="max_len"):
         gpt.build_gpt_generate(cfg, 6, 6)
+
+
+def test_gpt_generate_inference_model_roundtrip():
+    """Deploying generation: save_inference_model on the generate
+    program (StaticRNN sub-blocks + caches serialize), reload, run with
+    ONLY the prompt feed — outputs must be bit-identical."""
+    import tempfile
+
+    cfg, _, _, exe, _, _ = _train_tiny(steps=20)
+    gen_prog, gs = fluid.Program(), fluid.Program()
+    with fluid.program_guard(gen_prog, gs):
+        gen = gpt.build_gpt_generate(cfg, PLEN, NEW, mode="greedy")
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, cfg.vocab, size=(2, PLEN)).astype("int64")
+    want = np.asarray(exe.run(gen_prog, feed={"gpt_prompt": prompt},
+                              fetch_list=[gen["ids"]])[0])
+    d = tempfile.mkdtemp()
+    fluid.io.save_inference_model(d, ["gpt_prompt"], [gen["ids"]], exe,
+                                  main_program=gen_prog)
+    prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+    got = np.asarray(exe.run(prog2, feed={feeds[0]: prompt},
+                             fetch_list=fetches)[0])
+    np.testing.assert_array_equal(got, want)
