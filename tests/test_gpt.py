@@ -93,12 +93,10 @@ def test_gpt_generate_rejects_overlong():
         gpt.build_gpt_generate(cfg, 6, 6)
 
 
-def test_gpt_generate_inference_model_roundtrip():
+def test_gpt_generate_inference_model_roundtrip(tmp_path):
     """Deploying generation: save_inference_model on the generate
     program (StaticRNN sub-blocks + caches serialize), reload, run with
     ONLY the prompt feed — outputs must be bit-identical."""
-    import tempfile
-
     cfg, _, _, exe, _, _ = _train_tiny(steps=20)
     gen_prog, gs = fluid.Program(), fluid.Program()
     with fluid.program_guard(gen_prog, gs):
@@ -107,7 +105,7 @@ def test_gpt_generate_inference_model_roundtrip():
     prompt = rng.integers(1, cfg.vocab, size=(2, PLEN)).astype("int64")
     want = np.asarray(exe.run(gen_prog, feed={"gpt_prompt": prompt},
                               fetch_list=[gen["ids"]])[0])
-    d = tempfile.mkdtemp()
+    d = str(tmp_path)
     fluid.io.save_inference_model(d, ["gpt_prompt"], [gen["ids"]], exe,
                                   main_program=gen_prog)
     prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
