@@ -20,7 +20,8 @@ from paddle_tpu.fluid import layers
 from paddle_tpu.fluid.param_attr import ParamAttr
 
 __all__ = ["GPTConfig", "gpt_tiny", "build_gpt_lm", "GPTDecodeCell",
-           "SamplingDecoder", "build_gpt_generate", "synthetic_lm_batch"]
+           "SamplingDecoder", "build_gpt_generate", "tp_rules",
+           "synthetic_lm_batch"]
 
 
 class GPTConfig:
@@ -270,6 +271,25 @@ def build_gpt_generate(cfg, prompt_len, max_new, mode="greedy",
         decoder, inits=inits, max_step_num=prompt_len + max_new - 2)
     ids = layers.squeeze(ids, [2])                        # (B, steps)
     return {"prompt": prompt, "ids": ids}
+
+
+def tp_rules():
+    """Tensor-parallel sharding rules for the GPT parameter naming
+    (cf. bert.tp_rules): column-shard q/k/v and ffn.fc1 (+ biases),
+    row-shard the attention output and ffn.fc2, vocab-shard the token
+    embedding and the output projection's vocab dim."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"gpt\d+\.self\.[qkv]\.w", P(None, "tp")),
+        (r"gpt\d+\.self\.[qkv]\.b", P("tp")),
+        (r"gpt\d+\.ffn\.fc1\.w", P(None, "tp")),
+        (r"gpt\d+\.ffn\.fc1\.b", P("tp")),
+        (r"gpt\d+\.self\.o\.w", P("tp", None)),
+        (r"gpt\d+\.ffn\.fc2\.w", P("tp", None)),
+        (r"gpt_tok_emb", P("tp", None)),
+        (r"gpt_out\.w", P(None, "tp")),
+    ]
 
 
 def synthetic_lm_batch(cfg, batch, seq_len, seed=0):
