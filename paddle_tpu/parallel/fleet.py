@@ -45,6 +45,9 @@ class DistributedStrategy:
         # k-step local updates + periodic param averaging over dp
         self.use_local_sgd = False
         self.local_sgd_k_steps = 1
+        # beyond-reference (EQuARX-inspired): int8-quantized payload for
+        # the k-step param averaging; see parallel/quantized_collectives
+        self.local_sgd_quantized_sync = False
         self.use_dgc = False
         # parity only: XLA fuses collectives itself (its all-reduce
         # combiner), so this flag is honored by construction
@@ -229,6 +232,7 @@ class Fleet:
                 )
             self._distributed_program = LocalSGDProgram(
                 program, self._mesh, k_steps=s.local_sgd_k_steps,
+                quantized_sync=s.local_sgd_quantized_sync,
                 param_rules=rules,
             )
         else:
