@@ -296,6 +296,9 @@ class InMemoryDataset(DatasetBase):
         self._memory = None
         self._preload_threads = None
         self._shuffle_seed = 0
+        # columnar fast path cache: None = not built yet, False = not
+        # columnarizable (ragged / LoD slots), list = per-slot arrays
+        self._columns = None
 
     # -- ref knobs ------------------------------------------------------
     def set_queue_num(self, queue_num):
@@ -368,6 +371,7 @@ class InMemoryDataset(DatasetBase):
         if self.merge_size > 0:
             mem = self._merge_by_lineid(mem)
         self._memory = mem
+        self._columns = None
 
     def _merge_by_lineid(self, mem):
         import collections
@@ -424,6 +428,8 @@ class InMemoryDataset(DatasetBase):
         self._shuffle_seed += 1
         perm = rng.permutation(len(self._memory))
         self._memory = [self._memory[i] for i in perm]
+        if isinstance(self._columns, list):
+            self._columns = [c[perm] for c in self._columns]
 
     def global_shuffle(self, fleet=None, thread_num=12):
         """Single-host: identical to local_shuffle. Multi-host: every
@@ -436,6 +442,7 @@ class InMemoryDataset(DatasetBase):
 
     def release_memory(self):
         self._memory = None
+        self._columns = None
 
     def get_memory_data_size(self, fleet=None):
         """Local sample count; with a fleet, the reference all-reduces the
@@ -452,10 +459,43 @@ class InMemoryDataset(DatasetBase):
         return self.get_memory_data_size(fleet)
 
     # -- batching -------------------------------------------------------
+    def _try_columnarize(self):
+        """Stack the in-memory samples into one dense array per slot
+        (the DataFeeder.ColumnarBatch fast path). Possible iff every
+        use_var is lod_level 0 AND every sample's value list for a slot
+        has the same length — true by contract for dense slots and in
+        practice for fixed-width id lists (e.g. Criteo's 26 categorical
+        fields). Ragged or LoD slots keep the per-sample path (which
+        builds LoDTensors). Cost is paid once; every epoch after
+        batches as O(1) numpy slices."""
+        if self._columns is not None:
+            return self._columns
+        if any(v.lod_level for v in self.use_vars):
+            self._columns = False
+            return False
+        strip = 1 if self.parse_ins_id else 0
+        spec = self._slot_spec()
+        try:
+            self._columns = [
+                np.array([s[strip + si] for s in self._memory],
+                         dtype=np.int64 if is_int else np.float32)
+                for si, (is_int, _dim) in enumerate(spec)
+            ]
+        except (ValueError, TypeError):  # ragged slot somewhere
+            self._columns = False
+        return self._columns
+
     def _batch_iterator(self, thread=0):
         self._require_memory()
-        strip = 1 if self.parse_ins_id else 0
         bs = self.batch_size
+        cols = self._try_columnarize()
+        if cols is not False:
+            from .data_feeder import ColumnarBatch
+
+            for i in range(0, len(self._memory), bs):
+                yield ColumnarBatch([c[i:i + bs] for c in cols])
+            return
+        strip = 1 if self.parse_ins_id else 0
         mem = self._memory
         for i in range(0, len(mem), bs):
             chunk = mem[i:i + bs]

@@ -337,3 +337,59 @@ def test_fetch_handler_monitor():
     time.sleep(0.3)
     mon.stop()
     assert got and float(got[0]["v"][0]) == 3.25
+
+
+def test_inmemory_columnar_fast_path(tmp_path):
+    """InMemoryDataset's fixed-width batches take the columnar fast
+    path (ColumnarBatch slices) and feed IDENTICALLY to the per-sample
+    conversion; shuffle keeps columns aligned; ragged slots fall back."""
+    from paddle_tpu.fluid.data_feeder import ColumnarBatch, DataFeeder
+
+    rows = _ctr_rows(12, 9)
+    fn = str(tmp_path / "col.txt")
+    _write_multislot(fn, rows)
+    main, startup, use_vars, loss = _ctr_program()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_filelist([fn])
+    ds.set_use_var(use_vars)
+    ds.load_into_memory()
+
+    batches = list(ds._batch_iterator())
+    assert len(batches) == 3
+    assert all(isinstance(b, ColumnarBatch) for b in batches)
+    feeder = DataFeeder(use_vars, fluid.CPUPlace(), program=main)
+    for b in batches:
+        fast = feeder.feed(b)
+        # the sample-tuple view of the same batch takes the slow path
+        slow = feeder.feed([b[i] for i in range(len(b))])
+        assert set(fast) == set(slow)
+        for k in fast:
+            assert fast[k].dtype == slow[k].dtype
+            np.testing.assert_array_equal(fast[k], slow[k])
+
+    # shuffle permutes columns and samples together
+    ds.local_shuffle()
+    b0 = next(iter(ds._batch_iterator()))
+    s0 = ds._memory[0]
+    np.testing.assert_array_equal(b0.columns[0][0], np.asarray(s0[0]))
+    np.testing.assert_array_equal(b0.columns[1][0],
+                                  np.asarray(s0[1], dtype=np.float32))
+
+    # ragged slot (variable-width id list) -> per-sample fallback
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        words = fluid.data("cwords", shape=[None], dtype="int64",
+                           lod_level=1)
+        lab = fluid.data("clab", shape=[None, 1], dtype="int64")
+    ragged = [[[1, 2, 3], [1]], [[4], [0]], [[5, 6], [1]]]
+    fn2 = str(tmp_path / "ragged.txt")
+    _write_multislot(fn2, ragged)
+    ds2 = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds2.set_batch_size(2)
+    ds2.set_filelist([fn2])
+    ds2.set_use_var([words, lab])
+    ds2.load_into_memory()
+    b2 = list(ds2._batch_iterator())
+    assert not isinstance(b2[0], ColumnarBatch)
+    assert b2[0][0][0] == [1, 2, 3]
