@@ -370,3 +370,73 @@ def test_native_tsan_build_and_race_free_pipe():
     assert "PIPE-TSAN-OK" in r.stdout, (r.stdout, r.stderr[-800:])
     assert "WARNING: ThreadSanitizer" not in r.stderr, r.stderr[-1500:]
     assert r.returncode == 0, (r.returncode, r.stderr[-800:])
+
+
+def test_device_ahead_prefetch_stage(monkeypatch):
+    """_device_ahead issues the NEXT batch's device_put before yielding
+    the current one (double_buffer's device half) and engages only for
+    a single accelerator place."""
+    import jax
+
+    from paddle_tpu.fluid.reader import _GeneratorLoader
+
+    class _FakeDev:
+        platform = "tpu"
+
+    class _FakePlace:
+        def jax_device(self):
+            return _FakeDev()
+
+    loader = _GeneratorLoader(feed_list=[], capacity=2)
+    loader._places = _FakePlace()
+
+    puts = []
+
+    class _Tagged:
+        def __init__(self, arr):
+            self.arr = arr
+
+    def fake_put(v, dev):
+        puts.append(v.sum())
+        return _Tagged(v)
+
+    monkeypatch.setattr(jax, "device_put", fake_put)
+
+    batches = [{"x": np.full((2,), i)} for i in range(4)]
+    events = []
+
+    def host_iter():
+        for i, b in enumerate(batches):
+            events.append(("host", i))
+            yield b
+
+    out = []
+    for item in loader._device_ahead(host_iter()):
+        events.append(("yield", int(item["x"].arr[0])))
+        out.append(item)
+    # every batch arrives exactly once, in order, device-tagged
+    assert [int(i["x"].arr[0]) for i in out] == [0, 1, 2, 3]
+    assert len(puts) == 4
+    # pipelining: batch 1's transfer was issued BEFORE batch 0 yielded
+    assert events.index(("host", 1)) < events.index(("yield", 0))
+
+    # reader error mid-epoch: the already-staged batch still arrives
+    def failing_iter():
+        yield batches[0]
+        raise RuntimeError("reader died")
+
+    seen = []
+    with pytest.raises(RuntimeError, match="reader died"):
+        for item in loader._device_ahead(failing_iter()):
+            seen.append(item)
+    assert len(seen) == 1 and int(seen[0]["x"].arr[0]) == 0
+
+    # CPU place / placeless / multi-place: transparent numpy pass-through
+    monkeypatch.undo()
+    import paddle_tpu.fluid as fluid
+
+    for places in (None, fluid.CPUPlace(),
+                   [_FakePlace(), _FakePlace()]):
+        loader._places = places
+        got = list(loader._device_ahead(iter(batches)))
+        assert all(isinstance(b["x"], np.ndarray) for b in got)
