@@ -7,6 +7,7 @@ lookup_table_op, interpolate_op (ref: paddle/fluid/operators/...). Convs and
 matmuls lower to lax.conv_general_dilated / dot_general so XLA tiles them on
 the MXU; norms/activations are elementwise chains XLA fuses around them.
 """
+import math
 import os
 
 import numpy as np
@@ -168,21 +169,50 @@ def _log_softmax(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 # dropout (ref: paddle/fluid/operators/dropout_op.cc)
 # ---------------------------------------------------------------------------
-def _dropout_keep_mask(ctx, p, shape):
-    """Bernoulli keep-mask for dropout. Default path rides XLA's native
-    RngBitGenerator (rbg): threefry mask generation measured ~31% of a
-    BERT-base train step on TPU v5e (82ms -> 40ms with dropout ablated);
-    rbg recovers nearly all of it. The rbg key is derived from the same
-    deterministic per-(op, draw) step key, so masks stay reproducible and
-    identical between the forward pass and its vjp replay. Set
-    PADDLE_TPU_DROPOUT_RBG=0 for the threefry path."""
+def _dropout_keep_mask(ctx, p, shape, allow_quantized=True):
+    """Bernoulli keep-mask for dropout; returns ``(mask, keep_prob)``
+    where keep_prob is the EXACT probability the mask was drawn with.
+
+    Default path rides XLA's native RngBitGenerator (rbg): threefry
+    mask generation measured ~31% of a BERT-base train step on TPU v5e
+    (82ms -> 40ms with dropout ablated); rbg recovers nearly all of it.
+    PADDLE_TPU_DROPOUT_BITS=8 opts into quantized masks (only honored
+    when ``allow_quantized``, i.e. the upscale_in_train caller): 8
+    random bits per element, keep threshold quantized to t/256 (e.g.
+    p=0.1 -> 230/256) with the RETURNED keep_prob that exact value so
+    upscaling stays unbiased. Measured on v5e it is NOT the default:
+    despite 4x fewer random bits it ties at T=128 and loses 4-6% at
+    T=512 (bench_experiments/dropout_bits_ab.json) — the separate
+    bits/bitcast/compare chain denies XLA the bernoulli-into-consumer
+    fusion and the bool mask round-trips HBM. The rbg key derives from
+    the same deterministic per-(op, draw) step key, so masks stay
+    reproducible and identical between the forward pass and its vjp
+    replay. PADDLE_TPU_DROPOUT_RBG=0 restores threefry."""
     key = ctx.next_rng()
+    keep_prob = 1.0 - p
     if os.environ.get("PADDLE_TPU_DROPOUT_RBG", "1") != "0":
         kd = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
         if kd.size < 4:
             kd = jnp.concatenate([kd, kd])
         key = jax.random.wrap_key_data(kd[:4], impl="rbg")
-    return jax.random.bernoulli(key, 1.0 - p, shape)
+        t = int(round(keep_prob * 256.0))
+        # quantization gate: only take the 8-bit path when the implied
+        # DROP rate (1 - t/256) is within 5% relative of the requested
+        # p — tiny rates like p=0.002 would otherwise silently double
+        # their regularization strength (quantum is 1/256)
+        quantize_ok = (
+            allow_quantized and 0 < t < 256 and p > 0
+            and abs((1.0 - t / 256.0) - p) <= 0.05 * p
+        )
+        if quantize_ok and os.environ.get(
+                "PADDLE_TPU_DROPOUT_BITS", "32") == "8":
+            n = math.prod(shape)
+            bits32 = jax.random.bits(key, ((n + 3) // 4,),
+                                     dtype=jnp.uint32)
+            bits8 = jax.lax.bitcast_convert_type(bits32, jnp.uint8)
+            keep = (bits8.reshape(-1)[:n] < jnp.uint8(t)).reshape(shape)
+            return keep, t / 256.0
+    return jax.random.bernoulli(key, keep_prob, shape), keep_prob
 
 
 @register_op("dropout")
@@ -197,9 +227,13 @@ def _dropout(ctx, ins, attrs):
         else:
             out = x
         return {"Out": [out], "Mask": [jnp.ones_like(x)]}
-    keep = _dropout_keep_mask(ctx, p, x.shape)
+    # downgrade_in_infer scales by (1-p) at INFER time, so its train
+    # mask must be drawn at exactly 1-p (no quantized threshold);
+    # upscale_in_train rescales by whatever exact prob the mask used
+    keep, keep_prob = _dropout_keep_mask(
+        ctx, p, x.shape, allow_quantized=(impl == "upscale_in_train"))
     if impl == "upscale_in_train":
-        out = jnp.where(keep, x / max(1.0 - p, 1e-8), 0.0)
+        out = jnp.where(keep, x / max(keep_prob, 1e-8), 0.0)
     else:
         out = jnp.where(keep, x, 0.0)
     return {"Out": [out.astype(x.dtype)], "Mask": [keep.astype(x.dtype)]}
