@@ -138,6 +138,25 @@ class DatasetBase:
     def _finish_to_run(self):
         self._prepared = False
 
+    def _release_loader(self):
+        """Free the cached trainer loader (and its native pipe's
+        mlock'd arena — capacity x 64MB of locked host memory). The
+        cache (set by Executor.train_from_dataset) otherwise lives as
+        long as the dataset so epochs reuse the pipe; call this (or
+        InMemoryDataset.release_memory, which calls it) when done
+        training from this dataset."""
+        cached = getattr(self, "_loader_cache", None)
+        if cached is None:
+            return
+        self._loader_cache = None
+        pipe = getattr(cached[1], "_pipe", None)
+        if pipe is not None:
+            cached[1]._pipe = None
+            try:
+                pipe.close()
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass
+
     # ref internal hooks, kept for API parity with fleet integrations
     def _dynamic_adjust_before_train(self, thread_num):
         pass
@@ -443,6 +462,7 @@ class InMemoryDataset(DatasetBase):
     def release_memory(self):
         self._memory = None
         self._columns = None
+        self._release_loader()
 
     def get_memory_data_size(self, fleet=None):
         """Local sample count; with a fleet, the reference all-reduces the

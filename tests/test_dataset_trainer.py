@@ -393,3 +393,32 @@ def test_inmemory_columnar_fast_path(tmp_path):
     b2 = list(ds2._batch_iterator())
     assert not isinstance(b2[0], ColumnarBatch)
     assert b2[0][0][0] == [1, 2, 3]
+
+
+def test_trainer_loader_cache_and_release(tmp_path):
+    """train_from_dataset reuses ONE loader (and native pipe) across
+    epochs; changing use_var refreshes the feed list; release_memory
+    frees the cached loader and its pipe."""
+    rows = _ctr_rows(16, 2)
+    fn = str(tmp_path / "cache.txt")
+    _write_multislot(fn, rows)
+    main, startup, use_vars, loss = _ctr_program()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist([fn])
+    ds.set_use_var(use_vars)
+    ds.load_into_memory()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.train_from_dataset(program=main, dataset=ds)
+    cached1 = ds._loader_cache
+    assert cached1 is not None
+    exe.train_from_dataset(program=main, dataset=ds)
+    assert ds._loader_cache[1] is cached1[1]  # same loader reused
+    # feed list refreshed from the dataset's current use_vars each call
+    assert ds._loader_cache[1]._feed_list == list(ds.use_vars)
+    pipe = getattr(cached1[1], "_pipe", None)
+    ds.release_memory()
+    assert ds._loader_cache is None
+    if pipe is not None:      # native toolchain present
+        assert pipe._handle is None  # arena destroyed, mlock released
