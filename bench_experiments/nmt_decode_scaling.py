@@ -1,0 +1,31 @@
+"""NMT beam-decode throughput vs batch (first-ever TPU decode numbers
+landed this round at b32 = 9.9k tok/s, 160ms/batch). The decoder is one
+lax.scan over 48 steps of small matmuls (hidden 512, 4 layers, beam 4
+-> 128 rows at b32), i.e. latency-bound per step on the MXU — scaling
+batch should raise tokens/sec near-linearly until the matmuls fill the
+chip. Records the curve so the latency-vs-throughput tradeoff is a
+documented property, not a guess.
+
+Self-exiting; banks to nmt_decode_scaling.json per variant.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bank import Bank, enable_compile_cache  # noqa: E402
+
+
+def main():
+    import bench
+
+    bank = Bank(__file__)
+    for batch, iters in ((32, 8), (64, 8), (128, 6), (256, 4)):
+        bank.run("b%d" % batch,
+                 lambda b=batch, n=iters: bench._measure_nmt_decode(
+                     batch=b, n_iters=n))
+    bank.done()
+
+
+if __name__ == "__main__":
+    enable_compile_cache()
+    main()
