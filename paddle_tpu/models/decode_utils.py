@@ -46,9 +46,31 @@ def step_masks(pos, tmax):
     return write3, keep3, self_mask
 
 
-def update_cache(cache, new_t, write3, keep3):
-    """Write the (B, 1, H) step value into the (B, T, H) cache at the
-    one-hot position; all other rows pass through."""
+def update_cache(cache, new_t, write3=None, keep3=None, pos=None):
+    """Write the (B, 1, H) step value into the (B, T, H) cache.
+
+    With ``pos`` (the (B, 1) decode position, uniform across the batch
+    as in every incremental decoder here) this is an O(B·H)
+    dynamic-update-slice write. Without it, the one-hot masked rewrite
+    (``write3``/``keep3`` from :func:`step_masks`) re-reads and
+    re-writes the whole cache — kept for callers with per-row
+    positions."""
+    if pos is not None:
+        from paddle_tpu.fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("decode_cache_write")
+        out = helper.create_variable_for_type_inference(dtype=cache.dtype)
+        out.shape = cache.shape
+        helper.append_op(
+            type="decode_cache_write",
+            inputs={"Cache": [cache], "Value": [new_t], "Pos": [pos]},
+            outputs={"Out": [out]},
+        )
+        return out
+    if write3 is None or keep3 is None:
+        raise ValueError(
+            "update_cache needs either pos (uniform-position fast "
+            "path) or the write3/keep3 masks from step_masks")
     return layers.elementwise_add(
         layers.elementwise_mul(cache, keep3),
         layers.elementwise_mul(new_t, write3))

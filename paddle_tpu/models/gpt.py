@@ -139,7 +139,7 @@ class GPTDecodeCell:
             inputs, layers.gather_nd(pos_table, pos))    # (B, H)
         x = layers.unsqueeze(x, [1])                      # (B, 1, H)
 
-        write3, keep3, self_mask = step_masks(pos, self.tmax)
+        _w3, _k3, self_mask = step_masks(pos, self.tmax)  # masks dead on the pos fast path (DCE'd)
 
         new_caches = []
         for i in range(cfg.num_layers):
@@ -147,10 +147,10 @@ class GPTDecodeCell:
             q = _proj(x, h, n + ".self.q")
             k_cache = update_cache(caches[2 * i],
                                    _proj(x, h, n + ".self.k"),
-                                   write3, keep3)
+                                   pos=pos)
             v_cache = update_cache(caches[2 * i + 1],
                                    _proj(x, h, n + ".self.v"),
-                                   write3, keep3)
+                                   pos=pos)
             new_caches += [k_cache, v_cache]
             attn = _proj(_attend(cfg, q, k_cache, v_cache, self_mask),
                          h, n + ".self.o")

@@ -189,7 +189,7 @@ class TransformerDecodeCell:
         x = layers.unsqueeze(x, [1])                        # (B, 1, H)
 
         # cache-write one-hot and <=pos visibility mask, shared by layers
-        write3, keep3, self_mask = step_masks(pos, self.tmax)
+        _w3, _k3, self_mask = step_masks(pos, self.tmax)  # masks dead on the pos fast path (DCE'd)
 
         def proj(t, name):
             return layers.fc(t, h, num_flatten_dims=2,
@@ -202,10 +202,10 @@ class TransformerDecodeCell:
             q = proj(x, n + ".self.q")
             k_cache = update_cache(caches[2 * i],
                                    proj(x, n + ".self.k"),
-                                   write3, keep3)
+                                   pos=pos)
             v_cache = update_cache(caches[2 * i + 1],
                                    proj(x, n + ".self.v"),
-                                   write3, keep3)
+                                   pos=pos)
             new_caches += [k_cache, v_cache]
             attn = proj(self._attend(q, k_cache, v_cache, self_mask),
                         n + ".self.o")

@@ -430,3 +430,24 @@ def _print(ctx, ins, attrs):
 
     _jax.debug.print(attrs.get("message", "") + "{x}", x=x)
     return single(x)
+
+
+@register_op("decode_cache_write")
+def _decode_cache_write(ctx, ins, attrs):
+    """TPU-native incremental-decode KV-cache write: Out = Cache with
+    the (B, 1, H) step Value written at time index Pos along axis 1.
+
+    Contract: the decode position is UNIFORM across the batch (row 0's
+    value is used) — true for the KV-cache decoders here, where every
+    row advances one token per scan step. Lowers to
+    lax.dynamic_update_slice, an O(B·H) write, replacing the one-hot
+    masked rewrite (mul+mul+add over the whole (B, T, H) cache) that
+    re-reads and re-writes the entire cache every step — the decode
+    equivalent of the reference's in-place beam-search cache kernels
+    (ref: paddle/fluid/operators/math/beam_search.cc writes rows in
+    place rather than rebuilding the tensor)."""
+    cache, val, pos = ins["Cache"][0], ins["Value"][0], ins["Pos"][0]
+    start = pos.reshape(-1)[0].astype(jnp.int32)
+    zero = jnp.int32(0)
+    return single(lax.dynamic_update_slice(
+        cache, val.astype(cache.dtype), (zero, start, zero)))
