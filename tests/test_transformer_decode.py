@@ -79,3 +79,41 @@ def test_beam_scores_monotone_and_finite():
     # beams are cumulative log-probs: all <= 0 and beam 0 is the best
     assert (scores <= 1e-5).all()
     assert np.allclose(scores[:, 0], scores.max(axis=1))
+
+
+def test_decode_cache_write_matches_masked_path():
+    """The decode_cache_write fast path (dynamic_update_slice at the
+    uniform position) is bit-identical to the one-hot masked rewrite,
+    and update_cache without pos or masks raises."""
+    import numpy as np
+    import pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.decode_utils import step_masks, update_cache
+
+    B, T, H, P = 3, 6, 4, 2
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        cache = fluid.data("cw_cache", (None, T, H), "float32")
+        val = fluid.data("cw_val", (None, 1, H), "float32")
+        pos = fluid.data("cw_pos", (None, 1), "int64")
+        w3, k3, _ = step_masks(pos, T)
+        fast = update_cache(cache, val, pos=pos)
+        masked = update_cache(cache, val, w3, k3)
+        with pytest.raises(ValueError, match="pos .*or the write3"):
+            update_cache(cache, val)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    feed = {
+        "cw_cache": rng.standard_normal((B, T, H)).astype("float32"),
+        "cw_val": rng.standard_normal((B, 1, H)).astype("float32"),
+        "cw_pos": np.full((B, 1), P, "int64"),
+    }
+    f, m = exe.run(prog, feed=feed, fetch_list=[fast, masked])
+    np.testing.assert_array_equal(np.asarray(f), np.asarray(m))
+    # the write landed at position P and only there
+    np.testing.assert_array_equal(np.asarray(f)[:, P], feed["cw_val"][:, 0])
+    np.testing.assert_array_equal(
+        np.delete(np.asarray(f), P, axis=1),
+        np.delete(feed["cw_cache"], P, axis=1))
