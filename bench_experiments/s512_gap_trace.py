@@ -1,0 +1,109 @@
+"""Trace-diff the s512 NODROP gap (BENCHMARKS round-5: framework
+106.0k tok/s vs pure-jax control 121.3k with dropout ablated — a
+~10ms/step gap invisible at the reference recipe). Captures a
+jax.profiler trace of BOTH programs at b16/s512/dropout=0 and banks
+the aggregated device-track op tables; diffing the category shares
+(convert/transpose/fusion counts) localizes where the framework
+spends the extra time. Device-track SHARES are robust to host load;
+absolute step_ms from a traced run is not.
+
+Self-exiting; banks to s512_gap_trace.json.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bank import Bank, enable_compile_cache  # noqa: E402
+
+
+def trace_framework():
+    import time
+
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.contrib.mixed_precision import decorate
+    from paddle_tpu.models import bert
+    from profile_b48 import _aggregate_trace
+
+    os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 7
+    cfg = bert.bert_base()
+    cfg.max_seq = 512
+    cfg.dropout = 0.0
+    cfg.use_fused_attention = False
+    vs = bert.build_bert_pretrain(cfg, 512)
+    opt = decorate(fluid.optimizer.Adam(1e-4), use_bf16=True)
+    opt.minimize(vs["loss"])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    ids, labels = bert.synthetic_batch(cfg, 16, 512)
+    feed = {"input_ids": ids, "mlm_labels": labels}
+    for _ in range(3):
+        out = exe.run(feed=feed, fetch_list=[vs["loss"]],
+                      return_numpy=False)
+    float(np.asarray(out[0]))
+    tdir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".bench_runs", "s512_fw")
+    os.makedirs(tdir, exist_ok=True)
+    t0 = time.time()
+    with jax.profiler.trace(tdir):
+        for _ in range(6):
+            out = exe.run(feed=feed, fetch_list=[vs["loss"]],
+                          return_numpy=False)
+        float(np.asarray(out[0]))
+    table, err = _aggregate_trace(tdir, top_n=40)
+    res = {"traced_wall_s": round(time.time() - t0, 2)}
+    res.update(table or {"trace_error": err})
+    return res
+
+
+def trace_purejax():
+    import time
+
+    import jax
+
+    from bert_s512_ablate import _init_params, _purejax_step_fn
+    from profile_b48 import _aggregate_trace
+    import jax.numpy as jnp
+    import numpy as np
+
+    p = jax.device_put(_init_params())
+    m = jax.tree.map(jnp.zeros_like, p)
+    v = jax.tree.map(jnp.zeros_like, p)
+    t = jnp.zeros((), jnp.int32)
+    step = jax.jit(_purejax_step_fn(0.0), donate_argnums=(0, 1, 2, 3))
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(rng.integers(0, 30522, size=(16, 512),
+                                      dtype=np.int64))
+    labels = ids
+    key = jax.device_put(jax.random.key(7, impl="rbg"))
+    for _ in range(3):
+        loss, p, m, v, t = step(p, m, v, t, ids, labels, key)
+    float(loss)
+    tdir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".bench_runs", "s512_pj")
+    os.makedirs(tdir, exist_ok=True)
+    t0 = time.time()
+    with jax.profiler.trace(tdir):
+        for _ in range(6):
+            loss, p, m, v, t = step(p, m, v, t, ids, labels, key)
+        float(loss)
+    table, err = _aggregate_trace(tdir, top_n=40)
+    res = {"traced_wall_s": round(time.time() - t0, 2)}
+    res.update(table or {"trace_error": err})
+    return res
+
+
+if __name__ == "__main__":
+    enable_compile_cache()
+    bank = Bank(__file__)
+    bank.run("framework_nodrop", trace_framework)
+    bank.run("purejax_nodrop", trace_purejax)
+    bank.done()

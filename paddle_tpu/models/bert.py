@@ -62,14 +62,23 @@ def _encoder_layer(x, cfg, i, attn_mask, is_test):
         param_attr=ParamAttr(name=_attn_name(i, "qkv.w")),
         bias_attr=ParamAttr(name=_attn_name(i, "qkv.b")),
     )
-    # (B, T, 3H) -> (B, T, 3, nh, dh)
-    qkv = layers.reshape(qkv, [0, 0, 3, nh, dh])
-    q = layers.slice(qkv, axes=[2], starts=[0], ends=[1])
-    k = layers.slice(qkv, axes=[2], starts=[1], ends=[2])
-    v = layers.slice(qkv, axes=[2], starts=[2], ends=[3])
-    q = layers.transpose(layers.squeeze(q, [2]), [0, 2, 1, 3])  # (B,nh,T,dh)
-    k = layers.transpose(layers.squeeze(k, [2]), [0, 2, 1, 3])
-    v = layers.transpose(layers.squeeze(v, [2]), [0, 2, 1, 3])
+    # (B, T, 3H): split by CONTIGUOUS last-axis slices, then head-split
+    # each (B, T, H) piece. The earlier reshape-to-(B,T,3,nh,dh) +
+    # mid-axis slice + squeeze chain defeated XLA's transpose folding —
+    # the compiled s512 module carried 359 copy instructions vs 39 in a
+    # hand-written control (HLO histogram, BENCHMARKS round 5); last-
+    # axis slices are bitcast views and the (B,T,nh,dh)->(B,nh,T,dh)
+    # transpose folds into the attention dot_general.
+    from .decode_utils import split_heads
+
+    def _split(part, idx):
+        p = layers.slice(part, axes=[2], starts=[idx * h],
+                         ends=[(idx + 1) * h])            # (B, T, H)
+        return split_heads(p, nh, dh)                     # (B,nh,T,dh)
+
+    q = _split(qkv, 0)
+    k = _split(qkv, 1)
+    v = _split(qkv, 2)
     if getattr(cfg, "use_fused_attention", False) and attn_mask is None:
         ctxv = layers.fused_multihead_attention(
             q, k, v, dropout_rate=cfg.dropout if not is_test else 0.0,
