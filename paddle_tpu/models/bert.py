@@ -64,11 +64,9 @@ def _encoder_layer(x, cfg, i, attn_mask, is_test):
     )
     # (B, T, 3H): split by CONTIGUOUS last-axis slices, then head-split
     # each (B, T, H) piece. The earlier reshape-to-(B,T,3,nh,dh) +
-    # mid-axis slice + squeeze chain defeated XLA's transpose folding —
-    # the compiled s512 module carried 359 copy instructions vs 39 in a
-    # hand-written control (HLO histogram, BENCHMARKS round 5); last-
-    # axis slices are bitcast views and the (B,T,nh,dh)->(B,nh,T,dh)
-    # transpose folds into the attention dot_general.
+    # mid-axis slice + squeeze chain cost 27% more HLO copy traffic and
+    # worse attention-region fusion (BENCHMARKS round 5: b48 +2%, s512
+    # +5.6% from this change).
     from .decode_utils import split_heads
 
     def _split(part, idx):

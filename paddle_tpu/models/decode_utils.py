@@ -13,11 +13,11 @@ __all__ = ["attend", "split_heads", "step_masks", "update_cache"]
 
 
 def split_heads(t, heads, dh):
-    """(B, T, heads*dh) -> (B, heads, T, dh). Reshape + transpose only
-    — contiguous input, so XLA folds the permutation into the
-    consuming dot_general instead of materializing a copy (the
-    mid-axis slice+squeeze formulation this replaced left 359 copy
-    instructions in BERT's compiled s512 module; BENCHMARKS round 5)."""
+    """(B, T, heads*dh) -> (B, heads, T, dh). Reshape + transpose on a
+    contiguous input — XLA folds the permutation into the consuming
+    dot_general. Replacing BERT's mid-axis slice+squeeze formulation
+    with this cut HLO copy traffic 27% per step and measured +2-6%
+    (BENCHMARKS round 5)."""
     t = layers.reshape(t, [0, 0, heads, dh])
     return layers.transpose(t, [0, 2, 1, 3])
 
