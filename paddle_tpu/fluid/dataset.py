@@ -260,8 +260,12 @@ class QueueDataset(DatasetBase):
             "(ref raises the same way)"
         )
 
-    def _batch_iterator(self, thread=0):
+    def _batch_iterator(self, thread=0, rows=None):
+        """``rows`` overrides the assembled batch size (the executor's
+        scan path requests k*batch_size super-batches it splits/scans
+        on device)."""
         spec = self._slot_spec()
+        bs_rows = int(rows) if rows else self.batch_size
         nthread = min(
             thread or self.thread_num, max(len(self.filelist), 1)
         )
@@ -275,7 +279,7 @@ class QueueDataset(DatasetBase):
                 for fn in files:
                     for s in self._parse_file(fn, spec):
                         batch.append(s)
-                        if len(batch) == self.batch_size:
+                        if len(batch) == bs_rows:
                             out.put(batch)
                             batch = []
                 if batch:
@@ -505,9 +509,11 @@ class InMemoryDataset(DatasetBase):
             self._columns = False
         return self._columns
 
-    def _batch_iterator(self, thread=0):
+    def _batch_iterator(self, thread=0, rows=None):
+        """``rows`` overrides the slice size (the executor's scan path
+        requests k*batch_size super-batches)."""
         self._require_memory()
-        bs = self.batch_size
+        bs = int(rows) if rows else self.batch_size
         cols = self._try_columnarize()
         if cols is not False:
             from .data_feeder import ColumnarBatch

@@ -227,9 +227,7 @@ class Executor:
             tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
         )
         rng = self._next_rng(program)
-        entry = self._cache.get(sig) if use_program_cache else None
-        if entry is not None:
-            self._cache.move_to_end(sig)
+        entry = self._cache_lookup(sig) if use_program_cache else None
         if entry is None:
             platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
             step = build_step_fn(
@@ -258,9 +256,7 @@ class Executor:
                     )
                 entry = jitted  # fall back to the tracing path
             if use_program_cache:
-                self._cache[sig] = entry
-                while len(self._cache) > self._cache_cap:
-                    self._cache.popitem(last=False)
+                self._cache_store(sig, entry)
 
         fetches, new_state = entry(state, feed_arrays, rng)
         for k, v in new_state.items():
@@ -270,6 +266,82 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def _run_dataset_scan(self, program, feed, k, scope):
+        """Run ``k`` program steps in ONE device dispatch: the feed
+        holds k stacked minibatches (leading dim k*bs) and the jitted
+        body is ``lax.scan`` over the single-step function. This is the
+        TPU-native analogue of the reference's Hogwild worker threads —
+        they amortize per-batch framework overhead across C++ threads
+        (ref executor.py train_from_dataset); here one XLA launch
+        amortizes the host dispatch across k sequential steps.
+        Bit-identical to k sequential run() calls: scan is sequential
+        and the per-step PRNG keys consume the same _next_rng counter
+        sequence. Raises OpLoweringError if the program's state
+        structure is not scan-stable (caller falls back to single
+        steps)."""
+        scope = scope if scope is not None else global_scope()
+        feed_arrays = self._prepare_feeds(program, feed)
+        state = self._gather_state(program, scope)
+        stacked = {}
+        for name, v in feed_arrays.items():
+            if v.shape[0] % k:
+                raise OpLoweringError(
+                    "dataset scan: feed %r rows %d not divisible by "
+                    "k=%d" % (name, v.shape[0], k))
+            stacked[name] = v.reshape((k, v.shape[0] // k) + v.shape[1:])
+        counter_before = self._run_counter
+        rngs = jnp.stack([self._next_rng(program) for _ in range(k)])
+        sig = (
+            "dataset_scan", k, program._uid, program._version,
+            tuple(sorted((n, v.shape, str(v.dtype))
+                         for n, v in stacked.items())),
+            tuple(sorted((n, v.shape, str(v.dtype))
+                         for n, v in state.items())),
+        )
+        entry = self._cache_lookup(sig)
+        if entry is None:
+            platform = "cpu" if isinstance(self.place, core.CPUPlace) \
+                else "tpu"
+            step = build_step_fn(program, list(feed_arrays.keys()), [],
+                                 platform=platform)
+            state_keys = frozenset(state.keys())
+
+            def multi(st, feeds_k, rngs_k):
+                def body(carry, xs):
+                    fd, rng = xs
+                    _, new_st = step(carry, fd, rng)
+                    if frozenset(new_st.keys()) != state_keys:
+                        # trace-time structure check: scan carries must
+                        # be stable; warmup single-steps create lazy
+                        # state before this path engages
+                        raise OpLoweringError(
+                            "dataset scan: state keys changed inside "
+                            "the step (%r)" % sorted(
+                                frozenset(new_st.keys()) ^ state_keys))
+                    return new_st, ()
+
+                out, _ = jax.lax.scan(body, st, (feeds_k, rngs_k))
+                return out
+
+            jitted = jax.jit(multi, donate_argnums=(0,))
+            try:
+                entry = jitted.lower(state, stacked, rngs).compile()
+            except Exception as e:
+                # ANY compile failure (structure check, XLA resource
+                # exhaustion on the k-step module, ...) means "fall
+                # back to single steps". Nothing ran and nothing was
+                # donated, so rewind the PRNG counter — the caller's
+                # single-step replay must consume the SAME k keys or
+                # reproducibility silently breaks.
+                self._run_counter = counter_before
+                raise OpLoweringError(
+                    "dataset scan compile failed (%s: %s)"
+                    % (type(e).__name__, str(e)[:200]))
+            self._cache_store(sig, entry)
+        new_state = entry(state, stacked, rngs)
+        for name, v in new_state.items():
+            scope.update(name, v)
+
     def _prepare_feeds(self, program, feed):
         block = program.global_block()
         out = {}
@@ -335,6 +407,18 @@ class Executor:
     def close(self):
         self._cache.clear()
         self._closed = True
+
+    # -- compiled-executable LRU (shared by run + dataset-scan paths) --
+    def _cache_lookup(self, sig):
+        entry = self._cache.get(sig)
+        if entry is not None:
+            self._cache.move_to_end(sig)
+        return entry
+
+    def _cache_store(self, sig, entry):
+        self._cache[sig] = entry
+        while len(self._cache) > self._cache_cap:
+            self._cache.popitem(last=False)
 
     # -- dataset trainer path (ref executor.py:1033,1103) --------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
@@ -415,11 +499,59 @@ class Executor:
             loader = _GeneratorLoader(
                 feed_list=dataset.use_vars, capacity=8)
             dataset._loader_cache = (cache_key, loader)
+        # k steps per device dispatch (lax.scan over the step body) when
+        # nothing forces a per-step host round-trip; fetches, debug
+        # mode, and mesh/pipeline runners keep the single-step loop
+        scan_k = max(1, int(os.environ.get(
+            "PADDLE_TPU_DATASET_STEPS_PER_CALL", "8")))
+        plain_prog = not (hasattr(run_prog, "_executor_run")
+                          or getattr(run_prog, "_transpiled_dist", None)
+                          or getattr(run_prog, "_parallel_info", None))
+        use_scan = (scan_k > 1 and not fetch_vars and not debug
+                    and plain_prog
+                    and all(v.lod_level == 0 for v in dataset.use_vars))
+        bs = dataset.batch_size
         loader.set_sample_list_generator(
-            lambda: dataset._batch_iterator(thread), places=self.place)
+            lambda: dataset._batch_iterator(
+                thread, rows=scan_k * bs if use_scan else None),
+            places=self.place)
         step = 0
+        # warmth is per (program, scope): the single-step warmup creates
+        # lazily-materialized persistable STATE, which lives in the
+        # scope — a fresh scope needs its own warmup even for a warm
+        # program (else scan engages unwarmed, trips the structure
+        # check, and both the fallback and the optimization misfire)
+        flag_scope = scope if scope is not None else global_scope()
+        warm_uids = getattr(flag_scope, "_dataset_scan_warm", None)
+        if warm_uids is None:
+            warm_uids = set()
+            flag_scope._dataset_scan_warm = warm_uids
+        scan_warm = run_prog._uid in warm_uids
+        scan_ok = True
         try:
             for feed in loader():
+                if use_scan:
+                    nrows = next(iter(feed.values())).shape[0]
+                    k = nrows // bs if nrows % bs == 0 else 0
+                    if k > 1 and scan_warm and scan_ok:
+                        try:
+                            self._run_dataset_scan(run_prog, feed, k,
+                                                   scope)
+                            step += k
+                            continue
+                        except OpLoweringError:
+                            scan_ok = False  # unstable state: fall back
+                    # warmup (or fallback / ragged tail): replay the
+                    # super-batch as bs-sized single steps — the warmup
+                    # creates any lazily-materialized state so later
+                    # scan carries are structure-stable
+                    for lo in range(0, nrows, bs):
+                        sub = {n: v[lo:lo + bs] for n, v in feed.items()}
+                        self.run(run_prog, feed=sub, scope=scope)
+                        step += 1
+                    scan_warm = True
+                    warm_uids.add(run_prog._uid)
+                    continue
                 step += 1
                 want_fetch = fetch_vars and (
                     debug or step % print_period == 0)

@@ -422,3 +422,77 @@ def test_trainer_loader_cache_and_release(tmp_path):
     assert ds._loader_cache is None
     if pipe is not None:      # native toolchain present
         assert pipe._handle is None  # arena destroyed, mlock released
+
+
+def test_dataset_scan_steps_bitexact(tmp_path, monkeypatch):
+    """K steps per dispatch (lax.scan over the step body,
+    PADDLE_TPU_DATASET_STEPS_PER_CALL) trains BIT-IDENTICALLY to the
+    single-step loop: scan is sequential and consumes the same per-step
+    PRNG key sequence."""
+    rows = _ctr_rows(40, 7)
+    fn = str(tmp_path / "scan.txt")
+    _write_multislot(fn, rows)
+
+    def train(k):
+        from paddle_tpu.fluid import framework, unique_name
+
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        monkeypatch.setenv("PADDLE_TPU_DATASET_STEPS_PER_CALL", str(k))
+        main, startup, use_vars, loss = _ctr_program()
+        startup.random_seed = 7
+        main.random_seed = 11
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(4)
+        ds.set_filelist([fn])
+        ds.set_use_var(use_vars)
+        ds.load_into_memory()
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(3):   # 3 epochs: warmup + scan-path epochs
+                exe.train_from_dataset(program=main, dataset=ds)
+        ds.release_memory()
+        if k > 1:   # prove the scan path actually engaged
+            assert any(isinstance(s, tuple) and s
+                       and s[0] == "dataset_scan" for s in exe._cache)
+        names = sorted(
+            v.name for v in main.global_block().vars.values()
+            if v.persistable and scope.find_value(v.name) is not None)
+        return {n: np.asarray(scope.find_value(n)) for n in names}
+
+    single = train(1)
+    scanned = train(4)
+    assert set(single) == set(scanned)
+    for n in single:
+        np.testing.assert_array_equal(single[n], scanned[n], err_msg=n)
+
+
+def test_dataset_scan_fresh_scope_rewarms(tmp_path, monkeypatch):
+    """A warm PROGRAM with a fresh SCOPE must re-warm (the lazy state
+    lives in the scope): no structure-check fallback, scan engages in
+    the second epoch, and the PRNG sequence stays aligned."""
+    monkeypatch.setenv("PADDLE_TPU_DATASET_STEPS_PER_CALL", "4")
+    rows = _ctr_rows(32, 5)
+    fn = str(tmp_path / "scope.txt")
+    _write_multislot(fn, rows)
+    main, startup, use_vars, loss = _ctr_program()
+    startup.random_seed = 3
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_filelist([fn])
+    ds.set_use_var(use_vars)
+    ds.load_into_memory()
+    exe = fluid.Executor(fluid.CPUPlace())
+    for _ in range(2):                       # scope A, then fresh B
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.train_from_dataset(program=main, dataset=ds)
+            exe.train_from_dataset(program=main, dataset=ds)
+        assert main._uid in scope._dataset_scan_warm
+    assert any(isinstance(s, tuple) and s and s[0] == "dataset_scan"
+               for s in exe._cache)
+    ds.release_memory()
