@@ -95,3 +95,43 @@ def test_first_probe_is_patient(tmp_path):
     log = " ".join(result["detail"]["supervisor_log"])
     assert "hung >25s (detached" in log, log
     assert "probe 2 ok" in log
+
+
+def test_bank_keep_best_fresh(tmp_path):
+    """_bank_last_good: a same-day headline within the 10% noise band
+    must NOT overwrite a stronger bank (aux merges, carried marks
+    clear); >10% drops and stale banks replace honestly."""
+    import importlib.util
+    import time
+
+    spec = importlib.util.spec_from_file_location("benchmod", BENCH)
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    path = str(tmp_path / "bank.json")
+    t = int(time.time())
+
+    def mk(v, ago=0, **aux):
+        d = {"backend": "tpu", "measured_unix": t - ago}
+        d.update(aux)
+        return {"value": v, "detail": d}
+
+    b._atomic_write_json(path, mk(143000, ctr={"old": 1}))
+    # noise-band lower headline: keep prev, merge fresh aux
+    b._bank_last_good(mk(138000, ctr={"new": 2}), path)
+    o = json.load(open(path))
+    assert o["value"] == 143000 and o["detail"]["ctr"] == {"new": 2}
+    # >10% drop: honest replacement
+    b._bank_last_good(mk(100000), path)
+    assert json.load(open(path))["value"] == 100000
+    # stale bank yields to fresh lower data
+    b._atomic_write_json(path, mk(143000, ago=200000))
+    b._bank_last_good(mk(120000), path)
+    assert json.load(open(path))["value"] == 120000
+    # fresh-merged aux is no longer marked as carried
+    prev = mk(143000, ctr={"old": 1})
+    prev["detail"]["carried_sections"] = ["ctr"]
+    b._atomic_write_json(path, prev)
+    b._bank_last_good(mk(140000, ctr={"new": 2}), path)
+    o = json.load(open(path))
+    assert o["detail"]["ctr"] == {"new": 2}
+    assert "ctr" not in o["detail"].get("carried_sections", [])
