@@ -51,6 +51,11 @@ import time
 
 V100_BASELINE_TOKENS_PER_SEC = 5300.0
 
+# aux benchmark sections: every list that schedules, dedups, or
+# bank-merges them derives from this one constant
+AUX_MEASURE_KEYS = ("ctr", "nmt_decode", "nmt_decode_b128")
+AUX_BANK_KEYS = ("resnet50",) + AUX_MEASURE_KEYS + ("experiments",)
+
 
 def _atomic_write_json(path, obj):
     with open(path + ".tmp", "w") as f:
@@ -153,7 +158,7 @@ def _bank_last_good(result, last_good_path):
                 prev = json.load(f)
         except Exception:  # noqa: BLE001 — no/unreadable previous bank
             prev = None
-        aux_keys = ("resnet50", "ctr", "nmt_decode", "experiments")
+        aux_keys = AUX_BANK_KEYS
 
         def _merge_aux(dst, src):
             """Copy src's fresh aux sections into dst; un-mark them as
@@ -898,12 +903,16 @@ def child_main(status_path):
     except Exception:  # noqa: BLE001
         _bank0 = None
     _bank_detail = (_bank0 or {}).get("detail", {})
-    aux_never = [k for k in ("ctr", "nmt_decode") if k not in _bank_detail]
+    aux_never = [k for k in AUX_MEASURE_KEYS if k not in _bank_detail]
     aux_first = bool(on_accel and _bank0 is not None
                      and _bank0.get("value", 0) > 0 and aux_never)
 
     def _run_aux(keys, gate):
-        fns = {"ctr": _measure_ctr, "nmt_decode": _measure_nmt_decode}
+        fns = {"ctr": _measure_ctr, "nmt_decode": _measure_nmt_decode,
+               # decode throughput PEAKS at b128 (BENCHMARKS round-5
+               # scaling curve); b32 stays the continuity config
+               "nmt_decode_b128": lambda: _measure_nmt_decode(
+                   batch=128, n_iters=6)}
         for key in keys:
             if time.time() - t0 > DEADLINE_S * gate:
                 st.error("skipped %s: %.0fs elapsed"
@@ -978,7 +987,7 @@ def child_main(status_path):
     # so a starved run still records whatever fits (skipped here if the
     # rotation already ran them at the front of the window)
     if on_accel and st.data["best"] is not None:
-        _run_aux([k for k in ("ctr", "nmt_decode")
+        _run_aux([k for k in AUX_MEASURE_KEYS
                   if k not in st.data["detail"]], gate=0.72)
 
     st.stage("done")
