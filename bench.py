@@ -118,10 +118,16 @@ def _compose(status):
 # ~10s (healthy) or hangs ~25 min until the wedge self-resolves into a
 # fast UNAVAILABLE — and killing a mid-init process may RE-wedge the
 # relay (round-1 lesson; round-5 observed repeated 180s probe-kills
-# correlate with a wedge that would not clear). Policy: every probe is
-# PATIENT (watchdog covers the full self-resolution), and a probe that
-# outlives its watchdog is DETACHED, never killed — it holds no chip
-# and self-exits when the wedge clears; we just stop waiting for it.
+# correlate with a wedge that would not clear). Round-5 late addition:
+# the chip can also vanish MID-VARIANT (a run froze inside its timed
+# loop with earlier variants banked; that wedge lasted 70+ min). Such
+# hangs deliberately ride to the supervisor deadline — the child HOLDS
+# the chip, so killing it early risks re-wedging; the snapshot compose
+# + keep-best-fresh bank preserve everything measured. Policy: every
+# probe is PATIENT (watchdog covers the full self-resolution), and a
+# probe that outlives its watchdog is DETACHED, never killed — it
+# holds no chip and self-exits when the wedge clears; we just stop
+# waiting for it.
 # The patience is always capped by the remaining window: under the
 # driver's default 1500s deadline the first probe gets ~1440s (best
 # effort — a wedge present AT driver time is unrecoverable either way);
