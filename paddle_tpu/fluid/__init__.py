@@ -29,6 +29,13 @@ from .core import (  # noqa: F401
 )
 from . import executor
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from . import resilience
+from .resilience import (  # noqa: F401
+    FaultInjector,
+    GuardedExecutor,
+    TrainGuard,
+    run_guarded,
+)
 from . import initializer
 from . import layers
 from .data import data  # noqa: F401

@@ -106,6 +106,15 @@ class OptimizerWithMixedPrecision:
     def get_scaled_loss(self):
         return self._scaled_loss
 
+    def get_finite_flag(self):
+        """The in-graph all-grads-finite flag (a [1] float32 Variable,
+        1.0 = finite), or None before minimize()/on the bf16 path.
+        Fetch it to observe overflow-skipped steps host-side, or hand
+        the decorated optimizer to ``resilience.GuardedExecutor``/
+        ``TrainGuard`` (``amp_optimizer=``) so their non-finite guard
+        knows the update op was already skip-gated in-graph."""
+        return getattr(self, "_finite_flag", None)
+
     def _ensure_scale_state(self):
         from ...layers import tensor
 

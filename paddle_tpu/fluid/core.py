@@ -178,6 +178,13 @@ class EOFException(Exception):
     the epoch, then reader.reset()."""
 
 
+class ReaderNotStartedError(RuntimeError):
+    """Raised by Executor.run when no feed was given and the program's
+    py_reader is decorated but not started (or went EOF without a
+    reset()+start()). A config error, not a transient — never retried
+    by resilience.GuardedExecutor."""
+
+
 def __getattr__(name):
     # deployment scripts reach AnalysisConfig / create_paddle_predictor
     # through fluid.core (the reference exposes them via pybind); lazy to

@@ -19,6 +19,7 @@ from . import core
 from . import framework
 from .framework import Program, Variable, default_main_program
 from .lowering import OpLoweringError, build_step_fn
+from .resilience import fault_check
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 
@@ -174,6 +175,10 @@ class Executor:
     ):
         if self._closed:
             raise RuntimeError("Executor is closed")
+        # fault-injection hook (resilience.FaultInjector): BEFORE the
+        # reader pop so an injected run fault doesn't consume a batch —
+        # a guarded retry re-runs the same step on the same data
+        fault_check("run")
         program = program if program is not None else default_main_program()
         if not feed:
             # a started py_reader attached to the program supplies the
@@ -182,11 +187,14 @@ class Executor:
             # CompiledProgram/pipeline dispatch so every execution path
             # auto-feeds. CompiledProgram wraps the underlying Program.
             src = getattr(program, "_program", program)
-            for reader in getattr(src, "_py_readers", []):
+            readers = getattr(src, "_py_readers", [])
+            for reader in readers:
                 batch = reader._next_feed()
                 if batch is not None:
                     feed = dict(batch)
                     break
+            else:
+                self._check_unstarted_readers(src, readers)
         # CompiledProgram (data-parallel) delegates to its own runner
         if hasattr(program, "_executor_run"):
             return program._executor_run(
@@ -258,7 +266,15 @@ class Executor:
             if use_program_cache:
                 self._cache_store(sig, entry)
 
-        fetches, new_state = entry(state, feed_arrays, rng)
+        try:
+            fetches, new_state = entry(state, feed_arrays, rng)
+        except Exception:
+            # cache-safe re-run: a failed dispatch may have consumed the
+            # donated state buffers or left the executable poisoned —
+            # evict so a guarded retry recompiles against fresh state
+            # instead of replaying a dead executable
+            self._cache.pop(sig, None)
+            raise
         for k, v in new_state.items():
             scope.update(k, v)
         if return_numpy:
@@ -404,9 +420,45 @@ class Executor:
             seed = abs(hash(("paddle_tpu", program._uid))) % (2**31)
         return jax.random.PRNGKey(seed + 1000003 * self._run_counter)
 
+    @staticmethod
+    def _check_unstarted_readers(program, readers):
+        """No feed given and no attached reader produced a batch: if a
+        decorated-but-unstarted reader feeds vars the program's ops
+        actually consume, fail HERE with the fix, instead of deep in
+        lowering with a missing-value error."""
+        idle = [r for r in readers
+                if r._paddle_reader is not None and not r._started]
+        if not idle:
+            return
+        consumed = set()
+        for op in program.global_block().ops:
+            consumed.update(op.input_arg_names)
+        for r in idle:
+            needed = [v.name for v in r._feed_list if v.name in consumed]
+            if needed:
+                raise core.ReaderNotStartedError(
+                    "Executor.run got no feed and py_reader %r (feeding "
+                    "%s) is not started — call reader.start() before "
+                    "run(); after core.EOFException call reader.reset() "
+                    "then reader.start() for the next epoch"
+                    % (r._name, ", ".join(needed))
+                )
+
     def close(self):
+        """Release cached executables and flush pending async orbax
+        checkpoint writes (parallel.checkpoint.finalize) so a process
+        exiting right after a wait=False save can't lose it. Idempotent."""
+        if self._closed:
+            return
         self._cache.clear()
         self._closed = True
+        from ..parallel import checkpoint as _ckpt
+
+        try:
+            _ckpt.finalize()
+        except Exception as e:  # noqa: BLE001 — closing must not raise
+            warnings.warn("checkpoint finalize on Executor.close failed: "
+                          "%s: %s" % (type(e).__name__, e))
 
     # -- compiled-executable LRU (shared by run + dataset-scan paths) --
     def _cache_lookup(self, sig):

@@ -15,9 +15,9 @@ from .framework import Parameter, Program, Variable, default_main_program
 
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
-    "load_params", "load_persistables", "save_inference_model",
-    "load_inference_model", "batch", "save", "load",
-    "load_program_state", "set_program_state",
+    "load_params", "load_persistables", "load_latest_persistables",
+    "save_inference_model", "load_inference_model", "batch", "save",
+    "load", "load_program_state", "set_program_state",
 ]
 
 
@@ -124,6 +124,23 @@ def load_persistables(executor, dirname, main_program=None, filename=None,
         executor, dirname, main_program, predicate=is_persistable,
         filename=filename or "__persistables__.npz",
     )
+
+
+def load_latest_persistables(executor, dirname, main_program=None):
+    """Crash-resume entry point over the orbax step-managed store: load
+    the newest complete checkpoint under `dirname` into the scope and
+    return its step number, or return None (loading nothing) when no
+    checkpoint exists yet — so a cold start and a restart are the same
+    call site. ``resilience.TrainGuard`` wires this automatically."""
+    from ..parallel.checkpoint import restore_latest
+
+    found = restore_latest(dirname)
+    if found is None:
+        return None
+    step, state = found
+    main = main_program or default_main_program()
+    set_program_state(main, state)
+    return step
 
 
 def save_inference_model(

@@ -67,6 +67,7 @@ class _ProgramReader:
         self._name = name or "py_reader"
         self._paddle_reader = None
         self._queue = None
+        self._thread = None    # this epoch's producer thread
         self._generation = 0   # bumped by reset() so stale pumps abandon
         self._started = False
         program = default_main_program()
@@ -142,18 +143,36 @@ class _ProgramReader:
                 return
             _put(None)
 
-        threading.Thread(target=_pump, daemon=True).start()
+        self._thread = threading.Thread(target=_pump, daemon=True)
+        self._thread.start()
 
     def reset(self):
         self._generation += 1  # stale pump threads see this and abandon
         self._started = False
         self._queue = None
 
+    def restart(self):
+        """reset() + start(): rebuild the producer thread on a fresh
+        epoch — the recovery move for a dead/poisoned feeder (used by
+        resilience.TrainGuard, callable directly)."""
+        self.reset()
+        self.start()
+
+    def thread_alive(self):
+        """True while this epoch's producer thread is running."""
+        t = getattr(self, "_thread", None)
+        return bool(t is not None and t.is_alive())
+
     def _next_feed(self):
         from .. import core as _core
+        from ..resilience import fault_check
 
         if not self._started or self._queue is None:
             return None
+        # fault-injection hook: models a feeder that dies mid-epoch
+        # (site "feed" in PADDLE_TPU_FAULT_SPEC); placed after the
+        # started check so only real batch pops count
+        fault_check("feed")
         item = self._queue.get()
         if isinstance(item, tuple) and len(item) == 2 and \
                 item[0] == "__error__":

@@ -1,0 +1,640 @@
+"""Resilient training runtime: fault injection, guarded execution,
+auto-checkpoint/resume.
+
+A production TPU run dies for reasons that have nothing to do with the
+model: a transient XLA/runtime error on one host, a NaN loss from a bad
+batch or an overflowed fp16 step, a crashed reader feeder thread, a
+preemption mid-save. The reference framework spreads its answer across
+the trainer (checkpoint notify + restart) and the loss-scaling op; here
+the pieces already exist individually — ``Executor.run`` (one jitted
+step), the py_reader producer thread (layers/io.py), orbax step-managed
+checkpoints (parallel/checkpoint.py), AMP dynamic loss scaling with
+in-graph skip gates (contrib/mixed_precision) — and this module ties
+them into a survivable loop:
+
+- **FaultInjector** — deterministic, env-driven fault injection
+  (``PADDLE_TPU_FAULT_SPEC``) at the ``run`` / ``feed`` / ``save`` /
+  ``fetch`` sites, so every recovery path below is testable in CI
+  without flaky sleeps or monkeypatching.
+- **GuardedExecutor / run_guarded()** — ``Executor.run`` plus bounded
+  retry with exponential backoff + deterministic jitter for transient
+  errors, an optional wall-clock watchdog per run, and a non-finite
+  fetch guard that skips NaN/Inf steps (cooperating with AMP dynamic
+  loss scaling, whose skip-gate already made the update a no-op) and
+  raises after N consecutive bad steps.
+- **TrainGuard** — a loop driver wiring periodic orbax
+  auto-checkpointing with crash-resume from ``latest_step``, py_reader
+  feeder-thread restart, epoch rollover on EOF, and a structured event
+  log (step/retry/skip/save/restore/reader_restart) for observability.
+
+Fault spec grammar (clauses joined by ``;`` or ``,``)::
+
+    PADDLE_TPU_FAULT_SPEC="run:every=7:RuntimeError;fetch:at=5:nan"
+
+    clause   := site ":" trigger ":" action
+    site     := "run" | "feed" | "save" | "fetch"
+    trigger  := "every=" N | "at=" N      (N counts checks at that site,
+                                           1-based)
+    action   := exception class name (builtins or "EOFException"), or
+                "nan" (site "fetch" only: corrupt the first fetched
+                float into NaN)
+
+With the env var unset and no injector installed, the hooks are inert
+(one dict lookup per site check).
+"""
+import collections
+import os
+import random
+import re
+import threading
+import time
+
+import numpy as np
+
+from . import core
+from .lowering import OpLoweringError
+
+__all__ = [
+    "FaultInjector", "FaultSpecError", "GuardedExecutor", "TrainGuard",
+    "EventLog", "StepReport", "StepTimeoutError", "NonFiniteError",
+    "fault_check", "fault_nonfinite", "run_guarded",
+]
+
+FAULT_SPEC_ENV = "PADDLE_TPU_FAULT_SPEC"
+
+
+class FaultSpecError(ValueError):
+    """Malformed PADDLE_TPU_FAULT_SPEC."""
+
+
+class StepTimeoutError(RuntimeError):
+    """A guarded run exceeded its wall-clock budget. Not retried by
+    default: the stuck dispatch may still hold donated buffers, so a
+    blind re-run could race it — surface to the driver instead."""
+
+
+class NonFiniteError(FloatingPointError):
+    """Raised after N consecutive non-finite (NaN/Inf) guarded steps."""
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+_NAN_ACTION = "nan"
+_CLAUSE_RE = re.compile(
+    r"^(?P<site>[a-z_]+):(?P<mode>every|at)=(?P<n>\d+):(?P<action>\w+)$"
+)
+
+
+class _Clause:
+    __slots__ = ("site", "mode", "n", "action_name", "exc", "checks", "fires")
+
+    def __init__(self, site, mode, n, action_name, exc):
+        self.site = site
+        self.mode = mode
+        self.n = n
+        self.action_name = action_name
+        self.exc = exc  # exception class, or None for the "nan" action
+        self.checks = 0
+        self.fires = 0
+
+    def poke(self):
+        """Count one check at this clause's site; True when it fires."""
+        self.checks += 1
+        if self.mode == "every":
+            hit = self.checks % self.n == 0
+        else:
+            hit = self.checks == self.n
+        if hit:
+            self.fires += 1
+        return hit
+
+
+def _resolve_exception(name):
+    import builtins
+
+    if name == "EOFException":
+        return core.EOFException
+    exc = getattr(builtins, name, None)
+    if isinstance(exc, type) and issubclass(exc, BaseException):
+        return exc
+    raise FaultSpecError(
+        "unknown fault action %r (want a builtin exception name, "
+        "'EOFException', or 'nan' for the fetch site)" % name
+    )
+
+
+class FaultInjector:
+    """Deterministic fault injection at named runtime sites.
+
+    Activated either programmatically (``FaultInjector.install(spec)``,
+    paired with ``uninstall()``) or by setting ``PADDLE_TPU_FAULT_SPEC``
+    in the environment. Each site check increments per-clause counters,
+    so ``every=N`` fires on the Nth, 2Nth, ... check and ``at=N`` fires
+    exactly once. Counters live on the injector instance: reinstalling
+    (or changing the env spec) starts fresh.
+    """
+
+    SITES = frozenset({"run", "feed", "save", "fetch"})
+
+    _installed = None   # programmatic injector, wins over the env var
+    _env_cached = None  # injector parsed from the env spec, counters live
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.clauses = []
+        by_site = collections.defaultdict(list)
+        for raw in re.split(r"[;,]", spec):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _CLAUSE_RE.match(raw)
+            if m is None:
+                raise FaultSpecError(
+                    "bad fault clause %r (want site:every=N:Action or "
+                    "site:at=N:Action)" % raw
+                )
+            site, mode, n, action = (
+                m.group("site"), m.group("mode"), int(m.group("n")),
+                m.group("action"),
+            )
+            if site not in self.SITES:
+                raise FaultSpecError(
+                    "unknown fault site %r (known: %s)"
+                    % (site, ", ".join(sorted(self.SITES)))
+                )
+            if n <= 0:
+                raise FaultSpecError("fault trigger count must be >= 1")
+            if action == _NAN_ACTION:
+                if site != "fetch":
+                    raise FaultSpecError(
+                        "action 'nan' only applies to site 'fetch'")
+                exc = None
+            else:
+                exc = _resolve_exception(action)
+            clause = _Clause(site, mode, n, action, exc)
+            self.clauses.append(clause)
+            by_site[site].append(clause)
+        if not self.clauses:
+            raise FaultSpecError("empty fault spec %r" % spec)
+        self._by_site = dict(by_site)
+
+    # -- activation ------------------------------------------------------
+    @classmethod
+    def install(cls, spec):
+        """Activate programmatically (tests); returns the injector."""
+        inj = cls(spec) if isinstance(spec, str) else spec
+        cls._installed = inj
+        return inj
+
+    @classmethod
+    def uninstall(cls):
+        cls._installed = None
+        cls._env_cached = None
+
+    @classmethod
+    def active(cls):
+        """The live injector, or None. Env activation caches per spec
+        string so clause counters persist across checks."""
+        if cls._installed is not None:
+            return cls._installed
+        spec = os.environ.get(FAULT_SPEC_ENV)
+        if not spec:
+            return None
+        if cls._env_cached is None or cls._env_cached.spec != spec:
+            cls._env_cached = cls(spec)
+        return cls._env_cached
+
+    # -- firing ----------------------------------------------------------
+    def check(self, site):
+        """Count a check at `site`; raise the first triggered exception
+        clause, or return True if a 'nan' clause fired."""
+        nan_fired = False
+        fire = None
+        for clause in self._by_site.get(site, ()):
+            if clause.poke():
+                if clause.exc is None:
+                    nan_fired = True
+                elif fire is None:
+                    fire = clause
+        if fire is not None:
+            raise fire.exc(
+                "injected fault: site=%s check=%d spec=%r"
+                % (site, fire.checks, self.spec)
+            )
+        return nan_fired
+
+    def stats(self):
+        """Per-clause counters for assertions/observability."""
+        return [
+            {"site": c.site, "mode": c.mode, "n": c.n,
+             "action": c.action_name, "checks": c.checks, "fires": c.fires}
+            for c in self.clauses
+        ]
+
+
+def fault_check(site):
+    """Hook called from instrumented sites (Executor.run, py_reader
+    _next_feed, checkpoint save). No-op unless an injector is active."""
+    inj = FaultInjector.active()
+    if inj is not None:
+        inj.check(site)
+
+
+def fault_nonfinite(site="fetch"):
+    """True when a 'nan' clause fires at `site` (GuardedExecutor uses
+    this to corrupt a fetched loss, testing the non-finite guard)."""
+    inj = FaultInjector.active()
+    return bool(inj is not None and inj.check(site))
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+
+class EventLog:
+    """Bounded structured event log + per-kind counters. Events are
+    plain dicts with a 'kind' key; an optional sink callback sees each
+    event as it is emitted (wire it to print/logging/telemetry)."""
+
+    def __init__(self, maxlen=10000, sink=None):
+        self.events = collections.deque(maxlen=maxlen)
+        self.counters = collections.Counter()
+        self._sink = sink
+
+    def emit(self, kind, **fields):
+        ev = dict(kind=kind, **fields)
+        self.counters[kind] += 1
+        self.events.append(ev)
+        if self._sink is not None:
+            self._sink(ev)
+        return ev
+
+    def of(self, kind):
+        return [ev for ev in self.events if ev["kind"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# guarded execution
+# ---------------------------------------------------------------------------
+
+
+class StepReport(list):
+    """The fetch list returned by a guarded run, with step metadata.
+    Subclasses list so existing unpack-the-fetches call sites keep
+    working: ``loss, = guarded.run(...)``."""
+
+    skipped = False      # non-finite step, update assumed skipped/ignored
+    managed = False      # AMP dynamic loss scaling owned the skip
+    retries = 0          # transient failures retried away for this step
+    nonfinite = False
+
+
+def _default_transients():
+    # OSError covers ConnectionError/TimeoutError; RuntimeError is what
+    # jax/XLA raise for runtime-side failures. OpLoweringError (a
+    # RuntimeError subclass) is a *graph* error and is never retried.
+    return (RuntimeError, OSError)
+
+
+class GuardedExecutor:
+    """``Executor.run`` with bounded retry, a wall-clock watchdog, and a
+    non-finite fetch guard. Drop-in: ``run()`` takes the Executor.run
+    signature and returns the fetch list (a :class:`StepReport`).
+
+    - Transient errors (`transient_types`, default RuntimeError+OSError)
+      are retried up to `max_retries` times with exponential backoff
+      (`backoff_base * 2**attempt`, capped at `backoff_max`) plus
+      deterministic jitter. ``core.EOFException``, ``OpLoweringError``
+      and ``StepTimeoutError`` are never retried.
+    - With `timeout` set, each attempt runs under a watchdog thread and
+      raises :class:`StepTimeoutError` at expiry (the stuck dispatch
+      thread is abandoned — daemonized — and the error is not retried).
+    - Fetched float arrays are checked for NaN/Inf. A bad step is
+      counted and *skipped* (``report.skipped``) — cooperating with AMP
+      dynamic loss scaling, whose in-graph skip gate already kept the
+      params/optimizer state untouched — until
+      `max_consecutive_nonfinite` consecutive bad steps, which raise
+      :class:`NonFiniteError`. Pass ``nonfinite_action="raise"`` to
+      fail on the first bad step instead.
+    """
+
+    NEVER_RETRY = (core.EOFException, core.ReaderNotStartedError,
+                   OpLoweringError, StepTimeoutError, FaultSpecError)
+
+    def __init__(self, executor, max_retries=3, backoff_base=0.05,
+                 backoff_max=2.0, jitter=0.25, timeout=None,
+                 nonfinite_action="skip", max_consecutive_nonfinite=5,
+                 transient_types=None, amp_optimizer=None, on_event=None,
+                 seed=0):
+        if nonfinite_action not in ("skip", "raise"):
+            raise ValueError(
+                "nonfinite_action must be 'skip' or 'raise', got %r"
+                % (nonfinite_action,))
+        self._exe = executor
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.timeout = timeout
+        self.nonfinite_action = nonfinite_action
+        self.max_consecutive_nonfinite = int(max_consecutive_nonfinite)
+        self.transient_types = tuple(
+            transient_types if transient_types is not None
+            else _default_transients())
+        self.amp_optimizer = amp_optimizer
+        self.counters = collections.Counter()
+        self._on_event = on_event
+        self._consecutive_nonfinite = 0
+        self._rng = random.Random(seed)
+
+    # -- events ----------------------------------------------------------
+    def _emit(self, kind, **fields):
+        self.counters[kind] += 1
+        if self._on_event is not None:
+            self._on_event(dict(kind=kind, **fields))
+
+    # -- pieces ----------------------------------------------------------
+    def _retryable(self, exc):
+        return (isinstance(exc, self.transient_types)
+                and not isinstance(exc, self.NEVER_RETRY))
+
+    def _backoff(self, attempt):
+        delay = min(self.backoff_max,
+                    self.backoff_base * (2.0 ** (attempt - 1)))
+        return delay * (1.0 + self.jitter * self._rng.random())
+
+    def _invoke(self, args, kwargs):
+        if not self.timeout:
+            return self._exe.run(*args, **kwargs)
+        box = {}
+        done = threading.Event()
+
+        def _worker():
+            try:
+                box["result"] = self._exe.run(*args, **kwargs)
+            except BaseException as e:  # relayed to the caller below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=_worker, daemon=True, name="paddle_tpu-guarded-run")
+        t.start()
+        if not done.wait(self.timeout):
+            self._emit("timeout", timeout=self.timeout)
+            raise StepTimeoutError(
+                "Executor.run exceeded %.3fs wall-clock budget (the "
+                "dispatch thread was abandoned; its donated state may "
+                "be unusable — restore from the last checkpoint before "
+                "re-running)" % self.timeout
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _amp_managed(self):
+        opt = self.amp_optimizer
+        return bool(opt is not None
+                    and getattr(opt, "get_finite_flag", None)
+                    and opt.get_finite_flag() is not None)
+
+    @staticmethod
+    def _nonfinite(fetches):
+        for v in fetches:
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                return True
+        return False
+
+    # -- the guarded run -------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        attempt = 0
+        while True:
+            try:
+                fetches = self._invoke(
+                    (program,), dict(feed=feed, fetch_list=fetch_list,
+                                     **kwargs))
+                break
+            except self.NEVER_RETRY:
+                raise
+            except self.transient_types as e:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                delay = self._backoff(attempt)
+                self._emit("retry", attempt=attempt, delay=delay,
+                           error="%s: %s" % (type(e).__name__, e))
+                time.sleep(delay)
+
+        report = StepReport(fetches if fetches is not None else [])
+        report.retries = attempt
+        if fault_nonfinite("fetch") and len(report):
+            # injected NaN loss: corrupt the first fetch so the guard
+            # below exercises the real skip path end-to-end
+            first = np.asarray(report[0])
+            report[0] = np.full(
+                first.shape,
+                np.nan,
+                dtype=first.dtype if first.dtype.kind == "f" else "float32",
+            )
+        if self._nonfinite(report):
+            report.nonfinite = True
+            self._consecutive_nonfinite += 1
+            bad = self._consecutive_nonfinite
+            if (self.nonfinite_action == "raise"
+                    or bad >= self.max_consecutive_nonfinite):
+                raise NonFiniteError(
+                    "non-finite fetch on %d consecutive step(s) "
+                    "(threshold %d) — the run has diverged"
+                    % (bad, self.max_consecutive_nonfinite)
+                )
+            report.skipped = True
+            report.managed = self._amp_managed()
+            self._emit("skip", consecutive=bad, managed=report.managed)
+        else:
+            self._consecutive_nonfinite = 0
+        return report
+
+
+def run_guarded(executor, program=None, feed=None, fetch_list=None,
+                scope=None, **guard_opts):
+    """One-shot convenience: ``GuardedExecutor(executor, **opts).run(...)``."""
+    guard = GuardedExecutor(executor, **guard_opts)
+    kwargs = {} if scope is None else {"scope": scope}
+    return guard.run(program, feed=feed, fetch_list=fetch_list, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# the loop driver
+# ---------------------------------------------------------------------------
+
+
+class TrainGuard:
+    """Fault-tolerant training loop: guarded steps + periodic orbax
+    auto-checkpointing + crash-resume + reader restart.
+
+    ::
+
+        guard = TrainGuard(exe, program=prog, ckpt_dir=dirname,
+                           fetch_list=[loss], feed_fn=make_feed,
+                           save_every=50)
+        summary = guard.train(num_steps=1000)
+
+    Steps are 1-based; checkpoint step K means "step K completed". On
+    ``train()``, if `ckpt_dir` holds checkpoints (a previous run
+    crashed), state is restored from ``latest_step`` and training
+    resumes at the next step — completed steps are not re-run. Batches
+    come from `feed_fn(step)` or, when None, from a started py_reader
+    attached to the program (pass the reader objects via `readers` so
+    dead feeder threads can be restarted and EOF rolls the epoch over).
+
+    The event log records ``restore``/``step``/``retry``/``skip``/
+    ``save``/``eof``/``reader_restart``/``final`` events with bounded
+    memory; ``summary["counters"]`` aggregates them.
+    """
+
+    def __init__(self, executor, program=None, ckpt_dir=None,
+                 fetch_list=None, feed_fn=None, readers=None,
+                 save_every=0, final_save=True, resume=True, scope=None,
+                 reader_restarts=2, restart_on_eof=True, max_to_keep=None,
+                 save_wait=True, on_event=None, log_maxlen=10000,
+                 **guard_opts):
+        self._exe = executor
+        self._program = program
+        self._ckpt_dir = ckpt_dir
+        self._fetch_list = fetch_list
+        self._feed_fn = feed_fn
+        self._readers = list(readers or [])
+        self._save_every = int(save_every)
+        self._final_save = final_save
+        self._resume = resume
+        self._scope = scope
+        self._reader_restarts = int(reader_restarts)
+        self._restart_on_eof = restart_on_eof
+        self._max_to_keep = max_to_keep
+        self._save_wait = save_wait
+        self.log = EventLog(maxlen=log_maxlen, sink=on_event)
+        self.guard = GuardedExecutor(
+            executor, on_event=self._relay, **guard_opts)
+
+    def _relay(self, ev):
+        self.log.emit(ev.pop("kind"), **ev)
+
+    # -- checkpoint plumbing --------------------------------------------
+    def _resolve(self):
+        from .executor import global_scope
+        from .framework import default_main_program
+
+        program = self._program if self._program is not None \
+            else default_main_program()
+        scope = self._scope if self._scope is not None else global_scope()
+        return program, scope
+
+    def _maybe_resume(self, program, scope):
+        """Restore from the newest checkpoint; returns the last
+        completed step (0 when starting fresh)."""
+        if not (self._resume and self._ckpt_dir):
+            return 0
+        from ..parallel import checkpoint as ckpt
+
+        step = ckpt.latest_step(self._ckpt_dir)
+        if step is None:
+            return 0
+        state = ckpt.load_checkpoint(self._ckpt_dir, step=step)
+        src = getattr(program, "_program", program)
+        restored = 0
+        for v in src.list_vars():
+            if v.persistable and v.name in state:
+                scope.update(v.name, state[v.name])
+                restored += 1
+        self.log.emit("restore", step=step, vars=restored,
+                      dirname=self._ckpt_dir)
+        return int(step)
+
+    def save(self, step, program=None, scope=None):
+        """Checkpoint the program's persistable state as `step`."""
+        if program is None or scope is None:
+            rprogram, rscope = self._resolve()
+            program = program or rprogram
+            scope = scope or rscope
+        from ..parallel import checkpoint as ckpt
+
+        src = getattr(program, "_program", program)
+        state = self._exe._gather_state(src, scope)
+        ckpt.save_checkpoint(
+            self._ckpt_dir, state, step=int(step),
+            max_to_keep=self._max_to_keep, wait=self._save_wait)
+        self.log.emit("save", step=int(step), vars=len(state))
+
+    def _restart_readers(self, step, reason):
+        for r in self._readers:
+            r.reset()
+            r.start()
+        self.log.emit("reader_restart", step=step, reason=reason,
+                      readers=len(self._readers))
+
+    # -- the loop --------------------------------------------------------
+    def train(self, num_steps):
+        """Run steps until `num_steps` have completed (counting steps
+        finished by a previous crashed run). Returns a summary dict."""
+        program, scope = self._resolve()
+        start = self._maybe_resume(program, scope)
+        completed = start
+        last_saved = start if start else None
+        last_eof_step = None
+        step = start + 1
+        while step <= num_steps:
+            feed = self._feed_fn(step) if self._feed_fn else None
+            try:
+                report = self.guard.run(
+                    program, feed=feed, fetch_list=self._fetch_list,
+                    scope=scope)
+            except core.EOFException:
+                self.log.emit("eof", step=step)
+                if not (self._readers and self._restart_on_eof):
+                    raise
+                if last_eof_step == step:
+                    # two EOFs with no step in between: the reader
+                    # yields nothing — restarting forever won't help
+                    raise
+                last_eof_step = step
+                self._restart_readers(step, "eof")
+                continue
+            except (Exception,) as e:
+                if (self._readers
+                        and self.log.counters["reader_restart"]
+                        < self._reader_restarts
+                        and not isinstance(e, NonFiniteError)):
+                    # a dead feeder thread surfaces as the producer's
+                    # exception (once) or a missing-feed lowering error
+                    # on the next pop — a reset()+start() rebuilds the
+                    # thread and retries this step on a fresh epoch
+                    self._restart_readers(
+                        step, "%s: %s" % (type(e).__name__, e))
+                    continue
+                raise
+            completed = step
+            self.log.emit("step", step=step, skipped=report.skipped,
+                          retries=report.retries)
+            if (self._ckpt_dir and self._save_every
+                    and step % self._save_every == 0):
+                self.save(step, program, scope)
+                last_saved = step
+            step += 1
+        if (self._ckpt_dir and self._final_save and completed > start
+                and last_saved != completed):
+            self.save(completed, program, scope)
+            last_saved = completed
+        self.log.emit("final", step=completed)
+        return {
+            "resumed_from": start if start else None,
+            "first_step": start + 1,
+            "final_step": completed,
+            "steps_run": completed - start,
+            "last_saved": last_saved,
+            "counters": dict(self.log.counters),
+            "events": list(self.log.events),
+        }

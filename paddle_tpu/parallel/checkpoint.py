@@ -7,17 +7,24 @@ Restore materializes host arrays (the executor re-places them on next
 run). Pod-scale sharded restore-in-place would need the target layouts
 from the compiled program; not wired yet — restores are host-replicated.
 
-Used directly, or through ``fluid.io.save_persistables(...,
-use_orbax=True)`` / ``load_persistables(..., use_orbax=True)``.
+Used directly, through ``fluid.io.save_persistables(...,
+use_orbax=True)`` / ``load_persistables(..., use_orbax=True)``, or via
+``fluid.resilience.TrainGuard`` (periodic auto-save + crash-resume).
+
+Read-path contract (the resume path must never explode on a fresh run
+directory): ``latest_step`` on a missing/empty/garbage directory returns
+None; ``load_checkpoint`` raises an IOError naming the directory instead
+of surfacing raw orbax internals.
 """
 import os
 
 import numpy as np
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_step", "finalize"]
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
+           "restore_latest", "finalize"]
 
 # managers kept open across saves so async writes can complete in the
-# background; finalize()/process exit flushes them
+# background; finalize()/Executor.close()/process exit flushes them
 _managers = {}
 
 
@@ -38,12 +45,22 @@ def _manager(dirname, max_to_keep=None):
 
 
 def finalize(dirname=None):
-    """Flush and close the manager(s): pending async saves complete."""
+    """Flush and close the manager(s): pending async saves complete.
+    Idempotent — unknown dirnames and repeat calls are no-ops, and a
+    manager is dropped from the registry even if its close() raises (so
+    a second finalize can't re-raise on a half-dead manager)."""
     keys = [os.path.abspath(dirname)] if dirname else list(_managers)
+    first_error = None
     for k in keys:
         mgr = _managers.pop(k, None)
         if mgr is not None:
-            mgr.close()
+            try:
+                mgr.close()
+            except Exception as e:  # noqa: BLE001 — keep flushing the rest
+                if first_error is None:
+                    first_error = e
+    if first_error is not None:
+        raise first_error
 
 
 def save_checkpoint(dirname, state, step=0, max_to_keep=None, wait=True):
@@ -54,6 +71,12 @@ def save_checkpoint(dirname, state, step=0, max_to_keep=None, wait=True):
     call finalize()/a later save to join it."""
     import orbax.checkpoint as ocp
 
+    from ..fluid.resilience import fault_check
+
+    # fault-injection hook (site "save"): BEFORE the manager touches
+    # disk, modeling a process killed mid-save — the previous complete
+    # checkpoint must stay the resume point
+    fault_check("save")
     mgr = _manager(dirname, max_to_keep)
     saved = mgr.save(int(step), args=ocp.args.StandardSave(dict(state)))
     if not saved:
@@ -69,22 +92,53 @@ def save_checkpoint(dirname, state, step=0, max_to_keep=None, wait=True):
 
 
 def latest_step(dirname):
-    """The newest checkpoint step under `dirname`, or None."""
-    mgr = _manager(dirname)
-    mgr.wait_until_finished()
-    return mgr.latest_step()
+    """The newest complete checkpoint step under `dirname`, or None.
+    A missing, empty, or unreadable directory is "no checkpoint yet"
+    (None) — the resume path must survive a fresh run directory."""
+    if not os.path.isdir(dirname):
+        return None
+    try:
+        mgr = _manager(dirname)
+        mgr.wait_until_finished()
+        return mgr.latest_step()
+    except Exception:  # noqa: BLE001 — unreadable layout == no checkpoint
+        return None
 
 
 def load_checkpoint(dirname, step=None):
-    """Restore the state dict saved at `step` (newest when None)."""
+    """Restore the state dict saved at `step` (newest when None).
+    Raises IOError naming `dirname` when the directory is missing or
+    holds no (readable) checkpoint — never a raw orbax traceback."""
     import orbax.checkpoint as ocp
 
-    mgr = _manager(dirname)
-    mgr.wait_until_finished()
-    if step is None:
-        step = mgr.latest_step()
+    if not os.path.isdir(dirname):
+        raise IOError(
+            "no checkpoint directory %r (nothing was ever saved there, "
+            "or the path is wrong)" % dirname)
+    try:
+        mgr = _manager(dirname)
+        mgr.wait_until_finished()
         if step is None:
-            raise FileNotFoundError(
-                "no orbax checkpoint under %r" % dirname)
-    restored = mgr.restore(int(step), args=ocp.args.StandardRestore())
+            step = mgr.latest_step()
+        if step is None:
+            raise IOError(
+                "checkpoint directory %r contains no complete "
+                "checkpoint" % dirname)
+        restored = mgr.restore(int(step), args=ocp.args.StandardRestore())
+    except IOError:
+        raise
+    except Exception as e:  # noqa: BLE001 — orbax internals stay internal
+        raise IOError(
+            "failed to restore checkpoint step %s from %r (%s: %s)"
+            % (step, dirname, type(e).__name__, e)) from e
     return {k: np.asarray(v) for k, v in restored.items()}
+
+
+def restore_latest(dirname):
+    """Resume helper: ``(step, state)`` for the newest complete
+    checkpoint under `dirname`, or None when there is nothing to resume
+    from. The one call sites need at process start."""
+    step = latest_step(dirname)
+    if step is None:
+        return None
+    return int(step), load_checkpoint(dirname, step=step)

@@ -10,6 +10,7 @@ from ..reader_utils import (  # noqa: F401
     firstn,
     map_readers,
     multiprocess_reader,
+    retry_reader,
     shuffle,
     xmap_readers,
 )
@@ -17,4 +18,5 @@ from ..reader_utils import (  # noqa: F401
 __all__ = [
     "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
     "ComposeNotAligned", "firstn", "xmap_readers", "multiprocess_reader",
+    "retry_reader",
 ]

@@ -5,7 +5,7 @@ import random
 
 __all__ = ["batch", "shuffle", "buffered", "map_readers", "chain", "compose",
            "firstn", "xmap_readers", "cache", "ComposeNotAligned",
-           "multiprocess_reader"]
+           "multiprocess_reader", "retry_reader"]
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -168,6 +168,46 @@ def cache(reader):
 
     return cache_reader
 
+
+
+def retry_reader(reader, retries=2, exceptions=(IOError, RuntimeError),
+                 delay=0.0, on_error=None):
+    """Restart-on-failure decorator for flaky sources (network storage,
+    preprocessing races): when the wrapped reader raises one of
+    `exceptions` mid-epoch, the underlying reader is RE-OPENED from the
+    start of the epoch and items already yielded this epoch are fast-
+    forwarded past (not re-yielded), up to `retries` restarts per epoch.
+    Budget exhausted — or any other exception — re-raises. `on_error`
+    (if given) sees ``(exception, restart_number)`` before each restart;
+    `delay` seconds are slept between restarts."""
+    import time as _time
+
+    def retry_wrapped():
+        yielded = 0
+        restarts = 0
+        while True:
+            it = reader()
+            skip = yielded
+            try:
+                for item in it:
+                    if skip:
+                        skip -= 1
+                        continue
+                    yield item
+                    yielded += 1
+                return
+            except exceptions as e:  # noqa: PERF203 — per-epoch, not per-item
+                restarts += 1
+                if restarts > retries:
+                    raise
+                if on_error is not None:
+                    on_error(e, restarts)
+                if delay:
+                    _time.sleep(delay)
+
+    if retries < 0:
+        raise ValueError("retries must be >= 0")
+    return retry_wrapped
 
 
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):

@@ -14,6 +14,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 runs")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / resilience tests (fast, tier-1 "
+        "eligible; see paddle_tpu/fluid/resilience.py)")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Give every test fresh default programs + scope + name generator."""

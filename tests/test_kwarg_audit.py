@@ -55,6 +55,7 @@ ALLOW = {
     ("fluid/io.py", "save_inference_model"): {"export_for_deployment"},  # cuda-era: single serialization format
     ("fluid/io.py", "load_inference_model"): {"executor", "pserver_endpoints"},  # cuda-era / iface-compat
     ("fluid/io.py", "load"): {"executor"},  # iface-compat: scope-based load
+    ("fluid/io.py", "load_latest_persistables"): {"executor"},  # iface-compat: scope-based load (matches load/load_inference_model)
     ("fluid/layer_helper.py", "LayerHelper.create_parameter"): {"stop_gradient"},  # params' trainable flag governs
     ("fluid/layers/control_flow.py", "less_than"): {"force_cpu"},  # device-hint
     ("fluid/layers/control_flow.py", "Print"): {

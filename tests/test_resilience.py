@@ -1,0 +1,547 @@
+"""Resilient training runtime (paddle_tpu/fluid/resilience.py):
+fault-injection harness, guarded execution (retry/backoff, watchdog,
+non-finite guard), TrainGuard auto-checkpoint/resume, reader restart,
+and the checkpoint read-path hardening."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid import resilience as R
+from paddle_tpu.parallel import checkpoint as ckpt
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    """A test that fails mid-injection must not poison the next one."""
+    R.FaultInjector.uninstall()
+    yield
+    R.FaultInjector.uninstall()
+
+
+def _build_sgd_net(seed=42, lr=0.1, size=3):
+    fluid.default_startup_program().random_seed = seed
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=size,
+                        param_attr=fluid.ParamAttr(name="w"))
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return loss
+
+
+def _feed(step, rows=2):
+    rng = np.random.RandomState(step)
+    return {"x": rng.rand(rows, 4).astype("float32")}
+
+
+def _build_forward_net():
+    """No optimizer: a NaN feed must not poison persistable state, so
+    the non-finite guard tests can recover on the next finite batch."""
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+    loss = fluid.layers.mean(fluid.layers.fc(input=x, size=3))
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injector_inert_when_env_unset():
+    """Smoke: with no env var and nothing installed, the hooks cost one
+    lookup and change nothing."""
+    assert os.environ.get(R.FAULT_SPEC_ENV) is None
+    assert R.FaultInjector.active() is None
+    assert R.fault_check("run") is None
+    assert R.fault_nonfinite() is False
+    loss = _build_sgd_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out = exe.run(feed=_feed(1), fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+def test_fault_spec_parse_and_counters():
+    inj = R.FaultInjector("run:every=2:RuntimeError; save:at=3:OSError")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        [inj.check("run") for _ in range(2)]
+    assert inj.check("run") is False           # check 3: no fire
+    with pytest.raises(RuntimeError):
+        inj.check("run")                       # check 4: every=2 again
+    [inj.check("save") for _ in range(2)]
+    with pytest.raises(OSError):
+        inj.check("save")
+    assert inj.check("save") is False          # at=3 fires exactly once
+    stats = {(s["site"], s["action"]): s for s in inj.stats()}
+    assert stats[("run", "RuntimeError")]["fires"] == 2
+    assert stats[("save", "OSError")]["fires"] == 1
+
+
+def test_fault_spec_rejects_garbage():
+    for bad in ("", "run:RuntimeError", "run:every=0:RuntimeError",
+                "warp:every=2:RuntimeError", "run:every=2:NotAnException",
+                "run:every=2:nan"):
+        with pytest.raises(R.FaultSpecError):
+            R.FaultInjector(bad)
+
+
+def test_env_var_activates_and_keeps_counters(monkeypatch):
+    monkeypatch.setenv(R.FAULT_SPEC_ENV, "feed:at=2:IOError")
+    assert R.fault_check("feed") is None       # check 1
+    with pytest.raises(IOError, match="injected fault"):
+        R.fault_check("feed")                  # check 2 — same cached injector
+    assert R.fault_check("feed") is None       # at= is one-shot
+    monkeypatch.delenv(R.FAULT_SPEC_ENV)
+    R.FaultInjector.uninstall()
+    assert R.FaultInjector.active() is None
+
+
+# ---------------------------------------------------------------------------
+# GuardedExecutor
+# ---------------------------------------------------------------------------
+
+
+def test_guarded_retries_transient_run_faults():
+    loss = _build_sgd_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    R.FaultInjector.install("run:every=3:RuntimeError")
+    guard = R.GuardedExecutor(exe, max_retries=2, backoff_base=0.001)
+    reports = [guard.run(feed=_feed(s), fetch_list=[loss])
+               for s in range(1, 6)]
+    assert [r.retries for r in reports].count(1) >= 1
+    assert guard.counters["retry"] >= 1
+    assert all(np.isfinite(np.asarray(r[0])).all() for r in reports)
+
+
+def test_guarded_gives_up_after_max_retries():
+    loss = _build_sgd_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    R.FaultInjector.install("run:every=1:RuntimeError")
+    guard = R.GuardedExecutor(exe, max_retries=2, backoff_base=0.001)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        guard.run(feed=_feed(1), fetch_list=[loss])
+    assert guard.counters["retry"] == 2
+
+
+def test_guarded_does_not_retry_graph_errors():
+    """OpLoweringError is a RuntimeError subclass but a GRAPH error —
+    retrying can't fix a missing feed."""
+    loss = _build_sgd_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    guard = R.GuardedExecutor(exe, max_retries=3, backoff_base=0.001)
+    from paddle_tpu.fluid.lowering import OpLoweringError
+
+    with pytest.raises(OpLoweringError):
+        guard.run(feed={}, fetch_list=[loss])
+    assert guard.counters["retry"] == 0
+
+
+def test_nonfinite_guard_skips_then_raises():
+    loss = _build_forward_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    guard = R.GuardedExecutor(exe, max_consecutive_nonfinite=3)
+    nan_feed = {"x": np.full((2, 4), np.nan, "float32")}
+    r1 = guard.run(feed=nan_feed, fetch_list=[loss])
+    r2 = guard.run(feed=nan_feed, fetch_list=[loss])
+    assert r1.skipped and r1.nonfinite and r2.skipped
+    # a finite step resets the consecutive counter
+    ok = guard.run(feed=_feed(1), fetch_list=[loss])
+    assert not ok.skipped
+    guard.run(feed=nan_feed, fetch_list=[loss])
+    guard.run(feed=nan_feed, fetch_list=[loss])
+    with pytest.raises(R.NonFiniteError, match="3 consecutive"):
+        guard.run(feed=nan_feed, fetch_list=[loss])
+    assert guard.counters["skip"] == 4
+
+
+def test_nonfinite_action_raise_fails_fast():
+    loss = _build_forward_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    guard = R.GuardedExecutor(exe, nonfinite_action="raise")
+    with pytest.raises(R.NonFiniteError):
+        guard.run(feed={"x": np.full((2, 4), np.inf, "float32")},
+                  fetch_list=[loss])
+
+
+def test_injected_nan_fetch_trips_the_guard():
+    loss = _build_sgd_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    R.FaultInjector.install("fetch:at=2:nan")
+    guard = R.GuardedExecutor(exe)
+    assert not guard.run(feed=_feed(1), fetch_list=[loss]).skipped
+    bad = guard.run(feed=_feed(2), fetch_list=[loss])
+    assert bad.skipped and np.isnan(np.asarray(bad[0])).any()
+    assert not guard.run(feed=_feed(3), fetch_list=[loss]).skipped
+
+
+def test_timeout_watchdog_raises_and_does_not_retry():
+    class SlowExecutor:
+        calls = 0
+
+        def run(self, *a, **k):
+            SlowExecutor.calls += 1
+            time.sleep(3.0)
+
+    guard = R.GuardedExecutor(SlowExecutor(), timeout=0.15, max_retries=3)
+    t0 = time.time()
+    with pytest.raises(R.StepTimeoutError, match="wall-clock"):
+        guard.run(feed={}, fetch_list=[])
+    assert time.time() - t0 < 2.0         # did not sit out the sleep
+    assert SlowExecutor.calls == 1        # no blind re-dispatch
+    assert guard.counters["timeout"] == 1
+
+
+def test_run_guarded_oneshot():
+    loss = _build_sgd_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    R.FaultInjector.install("run:every=2:RuntimeError")
+    out = R.run_guarded(exe, feed=_feed(1), fetch_list=[loss],
+                        max_retries=1, backoff_base=0.001)
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# py_reader EOF / restart paths
+# ---------------------------------------------------------------------------
+
+
+def _reader_net(n_batches=3, name="rr"):
+    x = fluid.data(name="%s_x" % name, shape=[2, 3], dtype="float32")
+    reader = fluid.layers.create_py_reader_by_data(
+        capacity=4, feed_list=[x], name=name)
+    out = fluid.layers.reduce_mean(fluid.layers.scale(x, scale=2.0))
+
+    def gen():
+        for i in range(n_batches):
+            yield {"%s_x" % name: np.full((2, 3), float(i), "float32")}
+
+    reader.decorate_tensor_provider(gen)
+    return reader, out
+
+
+def test_eof_propagates_cleanly_through_run():
+    """Regression: end-of-epoch must surface as core.EOFException from
+    Executor.run — not a KeyError/opaque missing-feed error — and the
+    post-EOF no-reset run must say what to do."""
+    reader, out = _reader_net(n_batches=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader.start()
+    seen = 0
+    try:
+        while True:
+            exe.run(feed=None, fetch_list=[out])
+            seen += 1
+    except KeyError as e:  # the historic failure mode this test pins
+        pytest.fail("EOF surfaced as KeyError: %r" % (e,))
+    except core.EOFException:
+        pass
+    assert seen == 2
+    # post-EOF, reader not restarted: a clear config error, not a deep
+    # lowering failure
+    with pytest.raises(core.ReaderNotStartedError, match="reader.start"):
+        exe.run(feed=None, fetch_list=[out])
+    # reset + start begins a clean epoch
+    reader.restart()
+    v = exe.run(feed=None, fetch_list=[out])[0]
+    np.testing.assert_allclose(np.asarray(v), 0.0)
+    reader.reset()
+
+
+def test_guarded_never_retries_eof():
+    reader, out = _reader_net(n_batches=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader.start()
+    guard = R.GuardedExecutor(exe, max_retries=5, backoff_base=0.001)
+    guard.run(feed=None, fetch_list=[out])
+    with pytest.raises(core.EOFException):
+        guard.run(feed=None, fetch_list=[out])
+    assert guard.counters["retry"] == 0
+    reader.reset()
+
+
+def test_trainguard_restarts_dead_feeder_thread():
+    """A producer that dies mid-epoch (crashed feeder thread) is
+    restarted by TrainGuard, and training completes."""
+    x = fluid.data(name="fx", shape=[1], dtype="float32")
+    reader = fluid.layers.create_py_reader_by_data(
+        capacity=2, feed_list=[x], name="flaky")
+    out = fluid.layers.reduce_mean(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    attempts = []
+
+    def flaky_gen():
+        attempts.append(1)
+        for i in range(8):
+            if len(attempts) == 1 and i == 2:
+                raise RuntimeError("feeder died")
+            yield {"fx": np.array([float(i)], "float32")}
+
+    reader.decorate_tensor_provider(flaky_gen)
+    reader.start()
+    tg = R.TrainGuard(exe, fetch_list=[out], readers=[reader],
+                      reader_restarts=2, max_retries=1,
+                      backoff_base=0.001)
+    summary = tg.train(num_steps=5)
+    assert summary["final_step"] == 5
+    assert tg.log.counters["reader_restart"] >= 1
+    assert len(attempts) >= 2              # the generator was re-opened
+    reader.reset()
+
+
+def test_trainguard_rolls_epochs_on_eof():
+    reader, out = _reader_net(n_batches=3, name="ep")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader.start()
+    tg = R.TrainGuard(exe, fetch_list=[out], readers=[reader])
+    summary = tg.train(num_steps=7)        # 3 batches/epoch -> 3 epochs
+    assert summary["final_step"] == 7
+    assert tg.log.counters["eof"] == 2
+    assert tg.log.counters["reader_restart"] == 2
+    reader.reset()
+
+
+def test_retry_reader_fast_forwards_past_failures():
+    from paddle_tpu.reader import decorator as rdec
+
+    opens = []
+
+    def source():
+        opens.append(1)
+        for i in range(6):
+            if len(opens) == 1 and i == 3:
+                raise IOError("flaky storage")
+            yield i
+
+    wrapped = rdec.retry_reader(source, retries=1)
+    assert list(wrapped()) == [0, 1, 2, 3, 4, 5]   # no dupes, no holes
+    assert len(opens) == 2
+
+    def always_bad():
+        raise IOError("dead")
+        yield  # pragma: no cover
+
+    with pytest.raises(IOError, match="dead"):
+        list(rdec.retry_reader(always_bad, retries=2)())
+
+
+# ---------------------------------------------------------------------------
+# checkpoint read-path hardening + finalize-on-close
+# ---------------------------------------------------------------------------
+
+
+def test_latest_step_and_load_on_missing_or_empty_dir(tmp_path):
+    missing = str(tmp_path / "never_created")
+    assert ckpt.latest_step(missing) is None
+    with pytest.raises(IOError, match="never_created"):
+        ckpt.load_checkpoint(missing)
+    assert not os.path.exists(missing)     # the read path creates nothing
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert ckpt.latest_step(empty) is None
+    with pytest.raises(IOError, match="no complete"):
+        ckpt.load_checkpoint(empty)
+    assert ckpt.restore_latest(empty) is None
+    ckpt.finalize(empty)
+
+
+def test_executor_close_flushes_async_saves_and_is_idempotent(tmp_path):
+    d = str(tmp_path / "async_ck")
+    state = {"w": np.arange(6, dtype="float32").reshape(2, 3)}
+    ckpt.save_checkpoint(d, state, step=3, wait=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.close()                            # must flush the pending write
+    exe.close()                            # idempotent
+    assert ckpt.latest_step(d) == 3
+    got = ckpt.load_checkpoint(d)
+    np.testing.assert_array_equal(got["w"], state["w"])
+    ckpt.finalize()
+    ckpt.finalize()                        # finalize idempotent too
+    with pytest.raises(RuntimeError, match="closed"):
+        exe.run(fluid.default_main_program())
+
+
+def test_midsave_crash_keeps_last_complete_checkpoint(tmp_path):
+    """Kill during save: latest_step must still point at the last
+    COMPLETE checkpoint, and TrainGuard must resume from it."""
+    d = str(tmp_path / "ck")
+    loss = _build_sgd_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    R.FaultInjector.install("save:at=2:OSError")
+    tg = R.TrainGuard(exe, ckpt_dir=d, fetch_list=[loss], feed_fn=_feed,
+                      save_every=2)
+    with pytest.raises(OSError, match="injected fault"):
+        tg.train(num_steps=6)              # save @2 ok, save @4 dies
+    R.FaultInjector.uninstall()
+    assert ckpt.latest_step(d) == 2
+    assert tg.log.counters["save"] == 1    # only the completed one logged
+
+    tg2 = R.TrainGuard(exe, ckpt_dir=d, fetch_list=[loss], feed_fn=_feed,
+                       save_every=2)
+    summary = tg2.train(num_steps=6)
+    assert summary["resumed_from"] == 2
+    assert summary["final_step"] == 6
+    assert ckpt.latest_step(d) == 6
+
+
+def test_load_latest_persistables_roundtrip(tmp_path):
+    d = str(tmp_path / "lp")
+    loss = _build_sgd_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    assert fluid.io.load_latest_persistables(exe, d) is None  # cold start
+    exe.run(feed=_feed(1), fetch_list=[loss])
+    w_saved = np.asarray(fluid.global_scope().find_value("w"))
+    fluid.io.save_persistables(exe, d, use_orbax=True, step=7)
+    fluid.global_scope().set("w", np.zeros_like(w_saved))
+    assert fluid.io.load_latest_persistables(exe, d) == 7
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().find_value("w")), w_saved)
+
+
+# ---------------------------------------------------------------------------
+# AMP cooperation
+# ---------------------------------------------------------------------------
+
+
+def test_amp_dynamic_scaling_skip_cooperation():
+    """fp16 dynamic loss scaling: an overflow step is skip-gated
+    in-graph (params untouched) and the guard reports it as a managed
+    skip instead of raising."""
+    from paddle_tpu.fluid.contrib import mixed_precision as mp
+
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=3,
+                        param_attr=fluid.ParamAttr(name="w"))
+    loss = fluid.layers.mean(y)
+    opt = mp.decorate(
+        fluid.optimizer.SGD(learning_rate=0.1), use_bf16=False,
+        init_loss_scaling=2.0**10, use_dynamic_loss_scaling=True,
+        decr_every_n_nan_or_inf=1, decr_ratio=0.5)
+    opt.minimize(loss)
+    assert opt.get_finite_flag() is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    guard = R.GuardedExecutor(exe, amp_optimizer=opt,
+                              max_consecutive_nonfinite=4)
+    w0 = np.asarray(fluid.global_scope().find_value("w")).copy()
+    bad = guard.run(feed={"x": np.full((2, 4), np.nan, "float32")},
+                    fetch_list=[loss])
+    assert bad.skipped and bad.managed
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().find_value("w")), w0)
+    ok = guard.run(feed=_feed(1), fetch_list=[loss])
+    assert not ok.skipped
+    assert not np.array_equal(
+        np.asarray(fluid.global_scope().find_value("w")), w0)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: end-to-end recovery
+# ---------------------------------------------------------------------------
+
+
+def _mlp(scope, seed=11):
+    """Tiny MLP classifier built into the CURRENT default programs;
+    explicit param names so the crashed+resumed run and the clean
+    ground-truth run (built under a fresh program_guard) address the
+    same scope entries."""
+    fluid.default_startup_program().random_seed = seed
+    fluid.default_main_program().random_seed = seed
+    img = fluid.data(name="img", shape=[None, 8], dtype="float32")
+    label = fluid.data(name="label", shape=[None, 1], dtype="int64")
+    h = fluid.layers.fc(input=img, size=8, act="relu",
+                        param_attr=fluid.ParamAttr(name="mlp_w1"),
+                        bias_attr=fluid.ParamAttr(name="mlp_b1"))
+    logits = fluid.layers.fc(input=h, size=3,
+                             param_attr=fluid.ParamAttr(name="mlp_w2"),
+                             bias_attr=fluid.ParamAttr(name="mlp_b2"))
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program(), scope=scope)
+    return exe, loss, fluid.default_main_program()
+
+
+def _mlp_feed(step):
+    rng = np.random.RandomState(1000 + step)
+    return {"img": rng.rand(4, 8).astype("float32"),
+            "label": rng.randint(0, 3, (4, 1)).astype("int64")}
+
+
+def test_trainguard_end_to_end_recovery(tmp_path):
+    """The acceptance scenario: an MLP TrainGuard run with injected
+    Executor.run failures (every 5th attempt) and one injected NaN loss
+    survives them (retries + one counted skip), crashes hard mid-run,
+    and a second TrainGuard resumes from latest_step, re-runs no
+    completed-and-checkpointed step, reaches the same final step, and
+    lands bit-identical params to an uninterrupted run."""
+    d = str(tmp_path / "ck")
+    scope = fluid.Scope()
+    exe, loss, prog = _mlp(scope)
+
+    # run-site checks: steps 1-4 = 1-4; check 5 fires (step 5 retries via
+    # check 6); checks 7-9 = steps 6-8; check 10 fires (step 9); check 11
+    # = the hard crash, still step 9 — after the step-8 checkpoint, so
+    # the resume re-runs nothing that finished.
+    R.FaultInjector.install(
+        "run:every=5:RuntimeError;fetch:at=7:nan;run:at=11:ZeroDivisionError")
+    tg1 = R.TrainGuard(exe, program=prog, ckpt_dir=d, fetch_list=[loss],
+                       feed_fn=_mlp_feed, save_every=4, scope=scope,
+                       max_retries=2, backoff_base=0.001)
+    with pytest.raises(ZeroDivisionError):   # the simulated crash
+        tg1.train(num_steps=12)
+    assert tg1.log.counters["retry"] >= 1    # transient faults were retried
+    assert tg1.log.counters["skip"] == 1     # the injected NaN loss
+    skipped_steps = [e["step"] for e in tg1.log.of("step") if e["skipped"]]
+    assert skipped_steps == [7]
+    assert [e["step"] for e in tg1.log.of("save")] == [4, 8]
+    R.FaultInjector.uninstall()
+    assert ckpt.latest_step(d) == 8
+
+    # "process restart": fresh TrainGuard over the same directory
+    tg2 = R.TrainGuard(exe, program=prog, ckpt_dir=d, fetch_list=[loss],
+                       feed_fn=_mlp_feed, save_every=4, scope=scope,
+                       max_retries=2, backoff_base=0.001)
+    summary = tg2.train(num_steps=12)
+    assert summary["resumed_from"] == 8
+    assert summary["first_step"] == 9
+    assert summary["final_step"] == 12
+    ran = [e["step"] for e in tg2.log.of("step")]
+    assert ran == [9, 10, 11, 12]            # no completed step re-run
+    assert tg2.log.counters["restore"] == 1
+    assert ckpt.latest_step(d) == 12
+
+    # ground truth: the same 12 steps with no faults and no crash
+    clean_scope = fluid.Scope()
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        with fluid.unique_name.guard():
+            cexe, closs, cprog = _mlp(clean_scope)
+        ctg = R.TrainGuard(cexe, program=cprog, fetch_list=[closs],
+                           feed_fn=_mlp_feed, scope=clean_scope)
+        csummary = ctg.train(num_steps=12)
+    assert csummary["final_step"] == 12
+    for name in ("mlp_w1", "mlp_b1", "mlp_w2", "mlp_b2"):
+        got = scope.find_value(name)
+        want = clean_scope.find_value(name)
+        assert got is not None and want is not None
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
