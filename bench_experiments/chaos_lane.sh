@@ -1,0 +1,102 @@
+#!/usr/bin/env bash
+# Chaos lane: the resilience + elastic suites, an ambient-fault fleet
+# drill driven by an aggressive PADDLE_TPU_FAULT_SPEC, and the slow /
+# multihost runs (in-thread chaos fleet + a real SIGKILLed worker
+# process) that tier-1 skips via the `slow` marker.
+#
+#   bash bench_experiments/chaos_lane.sh            # full lane
+#
+# Tier-1 stays fault-free-by-default: with PADDLE_TPU_FAULT_SPEC unset
+# every injection hook is inert, and everything ambient-spec or slow
+# lives only here.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+PYTEST=(python -m pytest -q -p no:cacheprovider)
+
+echo "== lane 1: resilience + elastic + fault-spec fuzz (clean env) =="
+env -u PADDLE_TPU_FAULT_SPEC "${PYTEST[@]}" -m "not slow" \
+    tests/test_resilience.py tests/test_elastic.py \
+    tests/test_fault_spec_fuzz.py
+
+echo "== lane 2: 4-worker fleet drill under an ambient fault spec =="
+# The spec goes live only after the fleet is built, so every fault
+# lands on a guarded path: run-site transients are absorbed by retry,
+# and the one-shot heartbeat fault kills whichever worker's beacon
+# writer hits the shared counter first — survivors must shrink and
+# finish. This is the "suites under aggressive spec" drill that unit
+# tests (which assert exact fault-free behavior) cannot host.
+python - <<'EOF'
+import os, threading
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import executor as executor_mod
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.parallel import elastic as E
+
+os.environ.pop("PADDLE_TPU_FAULT_SPEC", None)
+WORLD, STEPS = 4, 30
+store = E.InMemoryStore()
+cfg = E.ElasticConfig(heartbeat_interval=0.05, miss_threshold=6,
+                      collective_timeout=10.0, startup_grace=5.0)
+guards = []
+for w in range(WORLD):
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    old = unique_name.switch()
+    scope = executor_mod.Scope()
+    fluid.default_startup_program().random_seed = 7
+    fluid.default_main_program().random_seed = 7
+    x = fluid.data("cx", shape=[None, 4], dtype="float32")
+    y = fluid.data("cy", shape=[None, 1], dtype="float32")
+    p = fluid.layers.fc(x, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+    fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program(), scope=scope)
+
+    def feed(step, guard=None):
+        rng = np.random.default_rng(step)
+        xv = rng.standard_normal((8, 4)).astype("float32")
+        return {"cx": xv,
+                "cy": (xv.sum(1, keepdims=True) * .5).astype("float32")}
+
+    guards.append(E.FleetGuard(
+        exe, program=fluid.default_main_program(), store=store,
+        worker_index=w, world_size=WORLD, config=cfg,
+        ckpt_dir="/tmp/paddle_tpu_chaos_lane_ck_%d" % os.getpid(),
+        fetch_list=[loss], feed_fn=feed, scope=scope, save_every=5))
+    unique_name.switch(old)
+
+# the fleet is built; NOW arm the ambient chaos
+os.environ["PADDLE_TPU_FAULT_SPEC"] = (
+    "run:every=23:RuntimeError;heartbeat:at=400:RuntimeError")
+results, errors = {}, {}
+
+def run(w):
+    try:
+        results[w] = guards[w].train(num_steps=STEPS)
+    except BaseException as e:
+        errors[w] = e
+
+threads = [threading.Thread(target=run, args=(w,)) for w in range(WORLD)]
+[t.start() for t in threads]
+[t.join(timeout=180) for t in threads]
+assert not any(t.is_alive() for t in threads), "fleet wedged"
+assert len(errors) == 1, "expected exactly one ambient kill: %r" % errors
+victim = next(iter(errors))
+assert len(results) == WORLD - 1, results.keys()
+for w, s in results.items():
+    assert s["final_step"] == STEPS, (w, s["final_step"])
+    assert s["generation"] >= 1 and victim not in s["members"], s
+    assert s["max_blocked"] <= cfg.collective_timeout + 1.0, s
+print("chaos drill: worker %d killed; survivors %s finished %d steps"
+      % (victim, sorted(results), STEPS))
+EOF
+
+echo "== lane 3: slow chaos fleet + multihost SIGKILL =="
+env -u PADDLE_TPU_FAULT_SPEC "${PYTEST[@]}" -m "slow" \
+    tests/test_elastic.py tests/test_multihost_elastic.py
+
+echo "chaos lane: all green"

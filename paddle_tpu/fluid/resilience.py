@@ -33,11 +33,18 @@ Fault spec grammar (clauses joined by ``;`` or ``,``)::
 
     clause   := site ":" trigger ":" action
     site     := "run" | "feed" | "save" | "fetch"
+              | "collective" | "barrier" | "heartbeat"
     trigger  := "every=" N | "at=" N      (N counts checks at that site,
                                            1-based)
     action   := exception class name (builtins or "EOFException"), or
                 "nan" (site "fetch" only: corrupt the first fetched
                 float into NaN)
+
+The fleet-level sites (see ``parallel/elastic.py``): ``collective``
+fires in the collective-op lowerings (``ops/collective_ops.py``) and
+the store-backed all-reduce, ``barrier`` in ``Fleet.barrier_worker`` /
+the elastic rendezvous paths, ``heartbeat`` in the beacon writer — so a
+"worker goes silent mid-run" drill is one env var away.
 
 With the env var unset and no injector installed, the hooks are inert
 (one dict lookup per site check).
@@ -57,7 +64,8 @@ from .lowering import OpLoweringError
 __all__ = [
     "FaultInjector", "FaultSpecError", "GuardedExecutor", "TrainGuard",
     "EventLog", "StepReport", "StepTimeoutError", "NonFiniteError",
-    "fault_check", "fault_nonfinite", "run_guarded",
+    "CollectiveTimeoutError", "collective_deadline", "collective_check",
+    "deadline_remaining", "fault_check", "fault_nonfinite", "run_guarded",
 ]
 
 FAULT_SPEC_ENV = "PADDLE_TPU_FAULT_SPEC"
@@ -75,6 +83,78 @@ class StepTimeoutError(RuntimeError):
 
 class NonFiniteError(FloatingPointError):
     """Raised after N consecutive non-finite (NaN/Inf) guarded steps."""
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A collective/barrier path exceeded its deadline. Never retried
+    blindly: the peer that missed the rendezvous may be dead, and
+    re-entering the same collective would hang again — the caller
+    (FleetGuard) must first re-establish fleet membership."""
+
+
+# ---------------------------------------------------------------------------
+# collective deadlines
+# ---------------------------------------------------------------------------
+#
+# A hung peer turns every collective into an infinite wait. The deadline
+# is carried in a thread-local so each simulated worker (thread) or real
+# process scopes its own budget; the two enforcement points are
+# (1) host-side waits (store barriers / all-reduce rendezvous in
+# parallel/elastic.py poll against it), and (2) the collective-op
+# lowerings in ops/collective_ops.py, which check it at trace/dispatch
+# time before handing the program to XLA — once a compiled step is on
+# the chip only the runtime can interrupt it, so the guarantee is "no
+# *host* wait outlives the deadline, and no new collective is issued
+# after expiry".
+
+_deadline_tls = threading.local()
+
+
+class collective_deadline:
+    """Context manager arming a wall-clock deadline (seconds) for every
+    collective/barrier check on this thread. Nesting keeps the TIGHTER
+    (earlier) deadline. ``seconds=None`` is a no-op context."""
+
+    def __init__(self, seconds):
+        self._seconds = seconds
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_deadline_tls, "at", None)
+        if self._seconds is not None:
+            at = time.monotonic() + float(self._seconds)
+            if self._prev is not None:
+                at = min(at, self._prev)
+            _deadline_tls.at = at
+        return self
+
+    def __exit__(self, *exc):
+        _deadline_tls.at = self._prev
+        return False
+
+
+def deadline_remaining():
+    """Seconds left on this thread's collective deadline, or None when
+    no deadline is armed. Never negative (expired == 0.0)."""
+    at = getattr(_deadline_tls, "at", None)
+    if at is None:
+        return None
+    return max(0.0, at - time.monotonic())
+
+
+def collective_check(what, site="collective"):
+    """One guard call per collective entry point: counts a fault-spec
+    check at `site` (raising any injected fault) and raises
+    :class:`CollectiveTimeoutError` when this thread's armed deadline
+    has expired. `what` names the op/path for the error message."""
+    fault_check(site)
+    remaining = deadline_remaining()
+    if remaining is not None and remaining <= 0.0:
+        raise CollectiveTimeoutError(
+            "collective deadline expired before %s could be issued "
+            "(a peer is presumed hung/dead; re-establish fleet "
+            "membership before retrying)" % (what,)
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -136,7 +216,8 @@ class FaultInjector:
     (or changing the env spec) starts fresh.
     """
 
-    SITES = frozenset({"run", "feed", "save", "fetch"})
+    SITES = frozenset({"run", "feed", "save", "fetch",
+                       "collective", "barrier", "heartbeat"})
 
     _installed = None   # programmatic injector, wins over the env var
     _env_cached = None  # injector parsed from the env spec, counters live
@@ -322,7 +403,8 @@ class GuardedExecutor:
     """
 
     NEVER_RETRY = (core.EOFException, core.ReaderNotStartedError,
-                   OpLoweringError, StepTimeoutError, FaultSpecError)
+                   OpLoweringError, StepTimeoutError, FaultSpecError,
+                   CollectiveTimeoutError)
 
     def __init__(self, executor, max_retries=3, backoff_base=0.05,
                  backoff_max=2.0, jitter=0.25, timeout=None,

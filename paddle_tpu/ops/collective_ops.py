@@ -25,9 +25,24 @@ def _axis(ctx, attrs):
     return ctx.mesh_axes.get(ring) or ctx.mesh_axes.get("collective")
 
 
-def _allreduce(reducer):
+def _guard(what, site="collective"):
+    """Host-side health gate, hit at trace/dispatch time — before the
+    collective is handed to XLA. Counts a fault-spec check at `site`
+    and enforces the thread's armed collective deadline (elastic
+    training arms one per step), so a fleet that already lost a peer
+    raises CollectiveTimeoutError here instead of wedging on the chip.
+    World-size-1 paths are guarded too: the entry point is the unit of
+    accounting, not the payload. Imported lazily — ops must stay
+    importable before the fluid package finishes initialising."""
+    from ..fluid.resilience import collective_check
+
+    collective_check(what, site=site)
+
+
+def _allreduce(name, reducer):
     def lower(ctx, ins, attrs):
         x = ins["X"][0]
+        _guard(name)
         ax = _axis(ctx, attrs)
         if ax is None:
             return single(x)
@@ -36,14 +51,15 @@ def _allreduce(reducer):
     return lower
 
 
-register_op("c_allreduce_sum")(_allreduce(lax.psum))
-register_op("c_allreduce_max")(_allreduce(lax.pmax))
-register_op("c_allreduce_min")(_allreduce(lax.pmin))
+register_op("c_allreduce_sum")(_allreduce("c_allreduce_sum", lax.psum))
+register_op("c_allreduce_max")(_allreduce("c_allreduce_max", lax.pmax))
+register_op("c_allreduce_min")(_allreduce("c_allreduce_min", lax.pmin))
 
 
 @register_op("c_allreduce_prod")
 def _c_allreduce_prod(ctx, ins, attrs):
     x = ins["X"][0]
+    _guard("c_allreduce_prod")
     ax = _axis(ctx, attrs)
     if ax is None:
         return single(x)
@@ -56,6 +72,7 @@ def _c_allreduce_prod(ctx, ins, attrs):
 @register_op("c_allgather")
 def _c_allgather(ctx, ins, attrs):
     x = ins["X"][0]
+    _guard("c_allgather")
     ax = _axis(ctx, attrs)
     if ax is None:
         return single(x)
@@ -67,6 +84,7 @@ def _c_allgather(ctx, ins, attrs):
 @register_op("c_broadcast")
 def _c_broadcast(ctx, ins, attrs):
     x = ins["X"][0]
+    _guard("c_broadcast")
     ax = _axis(ctx, attrs)
     if ax is None:
         return single(x)
@@ -79,6 +97,7 @@ def _c_broadcast(ctx, ins, attrs):
 @register_op("c_reducescatter")
 def _c_reducescatter(ctx, ins, attrs):
     x = ins["X"][0]
+    _guard("c_reducescatter")
     ax = _axis(ctx, attrs)
     if ax is None:
         return single(x)
@@ -136,6 +155,7 @@ def _c_gen_nccl_id(ctx, ins, attrs):
 
 @register_op("barrier")
 def _barrier(ctx, ins, attrs):
+    _guard("barrier", site="barrier")
     ax = _axis(ctx, attrs)
     if ins.get("X"):
         x = ins["X"][0]
@@ -150,6 +170,7 @@ def _barrier(ctx, ins, attrs):
 def _ppermute(ctx, ins, attrs):
     """Ring permute — building block for ring attention / pipeline."""
     x = ins["X"][0]
+    _guard("ppermute")
     ax = _axis(ctx, attrs)
     if ax is None:
         return single(x)
@@ -162,6 +183,7 @@ def _ppermute(ctx, ins, attrs):
 @register_op("all_to_all")
 def _all_to_all(ctx, ins, attrs):
     x = ins["X"][0]
+    _guard("all_to_all")
     ax = _axis(ctx, attrs)
     if ax is None:
         return single(x)

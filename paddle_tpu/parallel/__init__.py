@@ -7,3 +7,4 @@ from . import fleet  # noqa: F401
 from . import ring_attention  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import elastic  # noqa: F401

@@ -16,12 +16,17 @@ directory): ``latest_step`` on a missing/empty/garbage directory returns
 None; ``load_checkpoint`` raises an IOError naming the directory instead
 of surfacing raw orbax internals.
 """
+import json
 import os
+import time
+import warnings
 
 import numpy as np
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
-           "restore_latest", "finalize"]
+           "restore_latest", "finalize", "verify_checkpoint", "all_steps",
+           "worker_dir", "mark_save_complete", "latest_consensus_step",
+           "restore_latest_consensus", "CONSENSUS_DIR"]
 
 # managers kept open across saves so async writes can complete in the
 # background; finalize()/Executor.close()/process exit flushes them
@@ -106,9 +111,11 @@ def latest_step(dirname):
 
 
 def load_checkpoint(dirname, step=None):
-    """Restore the state dict saved at `step` (newest when None).
-    Raises IOError naming `dirname` when the directory is missing or
-    holds no (readable) checkpoint — never a raw orbax traceback."""
+    """Restore the state dict saved at `step` (newest VERIFIED step when
+    None — steps failing :func:`verify_checkpoint` are skipped with a
+    warning). Raises IOError naming `dirname` when the directory is
+    missing or holds no (readable) checkpoint — never a raw orbax
+    traceback."""
     import orbax.checkpoint as ocp
 
     if not os.path.isdir(dirname):
@@ -119,7 +126,13 @@ def load_checkpoint(dirname, step=None):
         mgr = _manager(dirname)
         mgr.wait_until_finished()
         if step is None:
-            step = mgr.latest_step()
+            for cand in all_steps(dirname):
+                if verify_checkpoint(dirname, cand):
+                    step = cand
+                    break
+                warnings.warn(
+                    "skipping corrupt/incomplete checkpoint step %d "
+                    "under %r" % (cand, dirname))
         if step is None:
             raise IOError(
                 "checkpoint directory %r contains no complete "
@@ -134,11 +147,196 @@ def load_checkpoint(dirname, step=None):
     return {k: np.asarray(v) for k, v in restored.items()}
 
 
+def all_steps(dirname):
+    """Step numbers present under `dirname` (complete or not), newest
+    first. Reads the directory layout directly — unlike the orbax
+    manager it cannot be wedged by one corrupt step dir."""
+    if not os.path.isdir(dirname):
+        return []
+    steps = []
+    for entry in os.listdir(dirname):
+        if entry.isdigit() and os.path.isdir(os.path.join(dirname, entry)):
+            steps.append(int(entry))
+    return sorted(steps, reverse=True)
+
+
+def verify_checkpoint(dirname, step):
+    """Cheap structural integrity probe for checkpoint `step`: the step
+    directory exists, holds at least one regular file, carries no
+    leftover orbax tmp entries (an interrupted atomic-rename save), and
+    no zero-byte payload file (truncation). Used by every restore path
+    before a step is trusted; a True result still does not guarantee a
+    readable payload — restore failures fall back to older steps."""
+    step_dir = os.path.join(dirname, str(int(step)))
+    if not os.path.isdir(step_dir):
+        return False
+    saw_file = False
+    for root, dirs, files in os.walk(step_dir):
+        if any("tmp" in d.lower() for d in dirs):
+            return False
+        for f in files:
+            if "tmp" in f.lower():
+                return False
+            saw_file = True
+            try:
+                size = os.path.getsize(os.path.join(root, f))
+            except OSError:
+                return False
+            # zero-byte markers are legitimate (orbax commit sentinels);
+            # zero-byte DATA is truncation
+            if size == 0 and not (f.startswith("commit")
+                                  or f.startswith(".")):
+                return False
+    return saw_file
+
+
 def restore_latest(dirname):
     """Resume helper: ``(step, state)`` for the newest complete
     checkpoint under `dirname`, or None when there is nothing to resume
-    from. The one call sites need at process start."""
-    step = latest_step(dirname)
-    if step is None:
+    from. The one call sites need at process start. A corrupt or
+    partially-written newest step (failed integrity probe OR failed
+    restore) is skipped with a warning and the previous step is used —
+    a crash mid-save must never cost more than one checkpoint
+    interval."""
+    for step in all_steps(dirname):
+        if not verify_checkpoint(dirname, step):
+            warnings.warn(
+                "skipping corrupt/incomplete checkpoint step %d under "
+                "%r" % (step, dirname))
+            continue
+        try:
+            return int(step), load_checkpoint(dirname, step=step)
+        except IOError as e:
+            warnings.warn(
+                "checkpoint step %d under %r failed to restore (%s); "
+                "falling back to the previous step" % (step, dirname, e))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# fleet-consistent (consensus) checkpoints
+# ---------------------------------------------------------------------------
+#
+# A checkpoint only counts for elastic resume once EVERY worker finished
+# (and flushed) its save of that step: a step some worker never wrote
+# would desynchronise the fleet on restore. Each worker writes payload
+# under worker_dir(dirname, i) and then an atomic per-worker done-marker;
+# the newest step with a full marker set is the fleet-consistent resume
+# point. Markers record the world size at save time, so survivors of a
+# shrink still recognise pre-failure checkpoints as complete.
+
+CONSENSUS_DIR = "fleet-consensus"
+
+
+def worker_dir(dirname, worker_index):
+    """Per-worker checkpoint payload root under a shared `dirname` —
+    the one place the elastic on-disk layout is defined."""
+    return os.path.join(dirname, "worker%05d" % int(worker_index))
+
+
+def mark_save_complete(dirname, step, worker_index, world_size,
+                       members=None):
+    """Atomically record that `worker_index` finished saving `step`.
+    `members` is the fleet membership at save time (worker indices;
+    default ``range(world_size)``) — after an elastic shrink the
+    survivors are NOT a contiguous range, and consensus requires a
+    marker from exactly the members that were supposed to save. Call
+    only AFTER the save was flushed (``save_checkpoint(..., wait=True)``
+    or ``finalize()``)."""
+    d = os.path.join(dirname, CONSENSUS_DIR, "%012d" % int(step))
+    os.makedirs(d, exist_ok=True)
+    marker = os.path.join(d, "worker%05d.done" % int(worker_index))
+    tmp = marker + ".tmp"
+    if members is None:
+        members = range(int(world_size))
+    with open(tmp, "w") as f:
+        json.dump({"worker": int(worker_index), "world": int(world_size),
+                   "members": sorted(int(m) for m in members),
+                   "step": int(step), "time": time.time()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, marker)
+    return marker
+
+
+def _consensus_required(markers, world_size):
+    """The worker set whose markers make a step fleet-consistent."""
+    if world_size is not None:
+        return set(range(int(world_size)))
+    for m in markers:
+        if m.get("members"):
+            return set(m["members"])
+    world = max(m.get("world", 0) for m in markers)
+    return set(range(int(world))) if world else None
+
+
+def _consensus_markers(dirname, step):
+    d = os.path.join(dirname, CONSENSUS_DIR, "%012d" % int(step))
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for entry in sorted(os.listdir(d)):
+        if not entry.endswith(".done"):
+            continue
+        try:
+            with open(os.path.join(d, entry)) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError):
+            continue  # torn marker == not written
+    return out
+
+
+def latest_consensus_step(dirname, world_size=None):
+    """Newest step for which all workers wrote done-markers, or None.
+    With `world_size` None the required count comes from the markers
+    themselves (the world recorded at save time) — so a shrunken fleet
+    can still find checkpoints saved by the larger pre-failure fleet."""
+    root = os.path.join(dirname, CONSENSUS_DIR)
+    if not os.path.isdir(root):
         return None
-    return int(step), load_checkpoint(dirname, step=step)
+    steps = sorted((int(e) for e in os.listdir(root) if e.isdigit()),
+                   reverse=True)
+    for step in steps:
+        markers = _consensus_markers(dirname, step)
+        if not markers:
+            continue
+        need = _consensus_required(markers, world_size)
+        have = {m.get("worker") for m in markers}
+        if need and have >= need:
+            return step
+    return None
+
+
+def restore_latest_consensus(dirname, worker_index, world_size=None):
+    """Elastic resume: ``(step, state)`` for this worker's payload at
+    the newest fleet-consistent step, or None. Consensus steps whose
+    payload fails the integrity probe or the restore are skipped with a
+    warning (same fallback contract as :func:`restore_latest`)."""
+    root = os.path.join(dirname, CONSENSUS_DIR)
+    if not os.path.isdir(root):
+        return None
+    wdir = worker_dir(dirname, worker_index)
+    steps = sorted((int(e) for e in os.listdir(root) if e.isdigit()),
+                   reverse=True)
+    for step in steps:
+        markers = _consensus_markers(dirname, step)
+        if not markers:
+            continue
+        need = _consensus_required(markers, world_size)
+        have = {m.get("worker") for m in markers}
+        if not need or not have >= need:
+            continue
+        if not verify_checkpoint(wdir, step):
+            warnings.warn(
+                "consensus step %d: worker %d payload under %r failed "
+                "the integrity probe; trying an older consensus step"
+                % (step, worker_index, wdir))
+            continue
+        try:
+            return int(step), load_checkpoint(wdir, step=step)
+        except IOError as e:
+            warnings.warn(
+                "consensus step %d: worker %d restore failed (%s); "
+                "trying an older consensus step"
+                % (step, worker_index, e))
+    return None

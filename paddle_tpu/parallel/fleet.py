@@ -20,8 +20,15 @@ from .sharding import DistributedProgram, ShardingRule
 __all__ = [
     "init", "is_worker", "is_server", "worker_num", "worker_index",
     "distributed_optimizer", "DistributedStrategy", "PaddleCloudRoleMaker",
-    "UserDefinedRoleMaker", "fleet",
+    "UserDefinedRoleMaker", "fleet", "FleetNotInitializedError",
 ]
+
+
+class FleetNotInitializedError(RuntimeError):
+    """A fleet/role-maker API that needs ``fleet.init(role_maker)`` (or
+    ``RoleMakerBase.__init__``) was called before initialization. Raised
+    instead of the bare AttributeError the half-constructed object would
+    otherwise produce."""
 
 
 # accepted for API parity but semantically owned by XLA (comm channel
@@ -68,11 +75,23 @@ class RoleMakerBase:
     def __init__(self):
         self._worker_num = 1
         self._index = 0
+        self._role_generated = False
+
+    def _require_init(self, what):
+        # a subclass that skipped super().__init__() (or a caller poking
+        # a bare class) must get the actionable error, not AttributeError
+        if not hasattr(self, "_worker_num") or not hasattr(self, "_index"):
+            raise FleetNotInitializedError(
+                "%s called on an uninitialized role maker — call "
+                "RoleMakerBase.__init__ (via super().__init__()) and "
+                "generate_role() first" % what)
 
     def worker_num(self):
+        self._require_init("worker_num()")
         return self._worker_num
 
     def worker_index(self):
+        self._require_init("worker_index()")
         return self._index
 
     def is_worker(self):
@@ -82,7 +101,8 @@ class RoleMakerBase:
         return False
 
     def generate_role(self):
-        pass
+        self._require_init("generate_role()")
+        self._role_generated = True
 
 
 class PaddleCloudRoleMaker(RoleMakerBase):
@@ -110,6 +130,7 @@ class Fleet:
         self._origin_program = None
         self._distributed_program = None
         self._optimizer = None
+        self._elastic = None  # FleetGuard (parallel/elastic.py), if any
 
     # -- lifecycle -------------------------------------------------------
     def init(self, role_maker=None, is_collective=True):
@@ -141,8 +162,31 @@ class Fleet:
         eps = ["tpu:%d" % i for i in range(self.worker_num())]
         return ",".join(eps) if to_string else eps
 
-    def barrier_worker(self):
-        pass
+    def attach_elastic(self, guard):
+        """Wire a :class:`parallel.elastic.FleetGuard` in: barriers go
+        through its heartbeat store (real cross-worker rendezvous with
+        deadlines) instead of the single-controller no-op."""
+        self._elastic = guard
+        return self
+
+    def barrier_worker(self, timeout=None):
+        """Rendezvous across workers. Requires ``init()``; honors the
+        ``barrier`` fault site and any armed collective deadline, and —
+        with an elastic guard attached — blocks at most `timeout`
+        seconds (default: the guard's collective_timeout) before
+        raising CollectiveTimeoutError."""
+        if self._role_maker is None:
+            raise FleetNotInitializedError(
+                "Fleet.barrier_worker called before fleet.init() — call "
+                "fleet.init(role_maker) first")
+        from ..fluid.resilience import collective_check
+
+        collective_check("Fleet.barrier_worker", site="barrier")
+        if self._elastic is not None:
+            return self._elastic.barrier("fleet", timeout=timeout)
+        # single-controller path: every device is driven by this one
+        # process and XLA's dataflow order already serialises — there
+        # is no peer to wait on
 
     # -- programs --------------------------------------------------------
     @property

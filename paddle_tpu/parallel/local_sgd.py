@@ -213,6 +213,53 @@ class LocalSGDProgram(DistributedProgram):
             scope.update(name, self._collapse(name, v))
             self._stacked_shapes.pop(name, None)
 
+    # -- elastic shrink ---------------------------------------------------
+    def shrink_dp(self, scope, surviving_shards, new_mesh=None):
+        """Shrink-to-survivors (parallel/elastic.py): drop the dead
+        workers' rows from every stacked per-shard value in `scope`,
+        rebuild on a mesh over the surviving devices, and invalidate the
+        jit cache so the next step re-traces on the smaller dp axis.
+        The k-step ``lax.pmean`` averaging then reduces over the NEW
+        axis size — the gradient/param-averaging denominator is
+        rescaled from the old world to the survivor count, instead of
+        silently averaging ghosts. Returns the new mesh.
+
+        Rare-event path: stacked state round-trips through the host
+        (the old mesh's device set no longer exists, so device-to-device
+        resharding has no target layout to reuse).
+        """
+        old_ndp = self._mesh.shape["dp"]
+        keep = sorted(set(surviving_shards))
+        bad = [i for i in keep if not 0 <= i < old_ndp]
+        if bad:
+            raise ValueError(
+                "surviving shard positions %s out of range for dp=%d"
+                % (bad, old_ndp))
+        if len(keep) < 2:
+            raise ValueError(
+                "LocalSGD needs >= 2 surviving shards (got %d of %d); "
+                "with one worker left, consolidate the scope and fall "
+                "back to single-worker training" % (len(keep), old_ndp))
+        if new_mesh is None:
+            from .mesh import shrink_mesh
+
+            new_mesh = shrink_mesh(self._mesh, survivors=keep)
+        if new_mesh.shape.get("dp") != len(keep):
+            raise ValueError(
+                "new mesh dp axis is %s but %d shards survive"
+                % (new_mesh.shape.get("dp"), len(keep)))
+        for name, shape in list(getattr(self, "_stacked_shapes",
+                                        {}).items()):
+            v = scope.find_value(name)
+            if v is None or tuple(getattr(v, "shape", ())) != shape:
+                continue
+            sliced = np.ascontiguousarray(np.asarray(v)[keep])
+            scope.update(name, sliced)
+            self._stacked_shapes[name] = sliced.shape
+        self._mesh = new_mesh
+        self._cache.clear()
+        return new_mesh
+
     # -- executor hook ----------------------------------------------------
     def _executor_run(self, executor, feed, fetch_list, scope,
                       return_numpy):

@@ -10,8 +10,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["build_mesh", "get_default_mesh", "set_default_mesh", "P",
-           "NamedSharding", "Mesh"]
+__all__ = ["build_mesh", "shrink_mesh", "get_default_mesh",
+           "set_default_mesh", "P", "NamedSharding", "Mesh"]
 
 _default_mesh = None
 
@@ -37,6 +37,36 @@ def build_mesh(axes=None, devices=None):
         )
     arr = np.array(devices).reshape(sizes)
     return Mesh(arr, axis_names=tuple(names))
+
+
+def shrink_mesh(mesh, survivors=None, dead=None):
+    """Shrink-to-survivors rebuild: a new pure-dp Mesh over the subset
+    of `mesh`'s devices named by `survivors` (positions into the
+    flattened device array) or, equivalently, everything NOT in `dead`.
+    Only data parallelism can absorb lost devices — a tp/sp-sharded
+    tensor has no complete copy on the survivors — so meshes with a
+    non-trivial second axis are refused."""
+    nontrivial = [n for n in mesh.axis_names
+                  if n != "dp" and mesh.shape[n] > 1]
+    if nontrivial:
+        raise NotImplementedError(
+            "shrink_mesh only supports pure-dp meshes: axis %s > 1 means "
+            "parameter shards (not copies) lived on the lost device"
+            % nontrivial)
+    devs = list(np.asarray(mesh.devices).flat)
+    if survivors is None:
+        gone = set(dead or ())
+        survivors = [i for i in range(len(devs)) if i not in gone]
+    survivors = sorted(set(survivors))
+    if not survivors:
+        raise ValueError("shrink_mesh with no survivors")
+    bad = [i for i in survivors if not 0 <= i < len(devs)]
+    if bad:
+        raise ValueError(
+            "survivor positions %s out of range for a %d-device mesh"
+            % (bad, len(devs)))
+    return build_mesh({"dp": len(survivors)},
+                      devices=[devs[i] for i in survivors])
 
 
 def set_default_mesh(mesh):

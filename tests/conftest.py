@@ -21,6 +21,10 @@ def pytest_configure(config):
         "markers",
         "faults: fault-injection / resilience tests (fast, tier-1 "
         "eligible; see paddle_tpu/fluid/resilience.py)")
+    config.addinivalue_line(
+        "markers",
+        "multihost: spawns real worker subprocesses (jax.distributed / "
+        "FileStore fleets); needs free ports + process spawn headroom")
 
 
 @pytest.fixture(autouse=True)

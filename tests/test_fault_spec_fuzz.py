@@ -1,0 +1,187 @@
+"""PADDLE_TPU_FAULT_SPEC grammar: negative + fuzz coverage, and the
+three elastic fault sites (``collective``, ``barrier``, ``heartbeat``)
+added by parallel/elastic.py.
+
+The grammar is the fleet operator's chaos interface — a malformed spec
+must fail loudly as :class:`FaultSpecError` (a typo silently injecting
+nothing would void a whole chaos run), and NOTHING else: the fuzz test
+asserts no garbage string can escape as a different exception type.
+"""
+import random
+import string
+
+import pytest
+
+from paddle_tpu.fluid import resilience as R
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector(monkeypatch):
+    monkeypatch.delenv(R.FAULT_SPEC_ENV, raising=False)
+    R.FaultInjector.uninstall()
+    yield
+    R.FaultInjector.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# negatives: every malformed shape raises FaultSpecError
+# ---------------------------------------------------------------------------
+
+BAD_SPECS = [
+    "",                                  # empty
+    "   ",                               # whitespace only
+    ";;;",                               # only separators
+    "run",                               # no mode/action
+    "run:every=3",                       # no action
+    "every=3:RuntimeError",              # no site
+    "run:every=0:RuntimeError",          # trigger count < 1
+    "run:at=0:RuntimeError",
+    "run:every=-2:RuntimeError",         # sign rejected by the regex
+    "run:sometimes=3:RuntimeError",      # unknown mode
+    "run:every=x:RuntimeError",          # non-numeric count
+    "bogus:at=1:RuntimeError",           # unknown site
+    "RUN:at=1:RuntimeError",             # sites are lowercase
+    "run:at=1:NotARealException",        # unknown action
+    "run:at=1:nan",                      # nan is fetch-only
+    "collective:at=1:nan",
+    "run:at=1:RuntimeError:extra",       # trailing garbage
+    "run:at=1:RuntimeError;barrier",     # one good + one bad clause
+    "run at=1 RuntimeError",             # wrong separators
+    "run:at==1:RuntimeError",
+]
+
+
+@pytest.mark.parametrize("spec", BAD_SPECS)
+def test_malformed_spec_raises_fault_spec_error(spec):
+    with pytest.raises(R.FaultSpecError):
+        R.FaultInjector(spec)
+
+
+def test_malformed_env_spec_fails_loudly(monkeypatch):
+    # a typo'd env spec must abort the run, not silently inject nothing
+    monkeypatch.setenv(R.FAULT_SPEC_ENV, "run:evrey=3:RuntimeError")
+    with pytest.raises(R.FaultSpecError):
+        R.fault_check("run")
+
+
+def test_fuzz_parser_never_escapes_fault_spec_error():
+    """No garbage string may raise anything but FaultSpecError (or
+    parse). Seeded: failures reproduce."""
+    rng = random.Random(1234)
+    alphabet = string.ascii_letters + string.digits + ":;=,_- \t"
+    parsed = 0
+    for _ in range(500):
+        spec = "".join(rng.choice(alphabet)
+                       for _ in range(rng.randrange(0, 40)))
+        try:
+            inj = R.FaultInjector(spec)
+        except R.FaultSpecError:
+            continue
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            pytest.fail("spec %r escaped as %s: %s"
+                        % (spec, type(e).__name__, e))
+        parsed += 1
+        assert inj.clauses  # a parse without clauses is a parser bug
+    # random 40-char soup essentially never forms a valid clause; if it
+    # did, the grammar got alarmingly loose
+    assert parsed == 0, "fuzz soup parsed as valid: %d specs" % parsed
+
+
+def test_fuzz_mutated_valid_specs():
+    """Single-character mutations of a valid spec either stay valid or
+    raise FaultSpecError — never a third behavior."""
+    base = "collective:every=3:RuntimeError;heartbeat:at=2:OSError"
+    rng = random.Random(99)
+    for _ in range(300):
+        pos = rng.randrange(len(base))
+        ch = rng.choice(string.ascii_lowercase + string.digits + ":;=")
+        mutated = base[:pos] + ch + base[pos + 1:]
+        try:
+            inj = R.FaultInjector(mutated)
+        except R.FaultSpecError:
+            continue
+        except Exception as e:  # noqa: BLE001
+            pytest.fail("mutation %r escaped as %s: %s"
+                        % (mutated, type(e).__name__, e))
+        for clause in inj.clauses:
+            assert clause.site in R.FaultInjector.SITES
+            assert clause.n >= 1
+
+
+def test_valid_grammar_separators_and_whitespace():
+    inj = R.FaultInjector(
+        " run:every=3:RuntimeError ;barrier:at=2:OSError,"
+        "heartbeat:at=5:ConnectionError ")
+    assert [c.site for c in inj.clauses] == ["run", "barrier", "heartbeat"]
+    assert [c.mode for c in inj.clauses] == ["every", "at", "at"]
+    assert [c.n for c in inj.clauses] == [3, 2, 5]
+
+
+# ---------------------------------------------------------------------------
+# the three elastic sites
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_sites_registered():
+    assert {"collective", "barrier", "heartbeat"} <= R.FaultInjector.SITES
+
+
+def test_collective_site_every_n_semantics():
+    inj = R.FaultInjector.install("collective:every=3:ConnectionError")
+    fired = []
+    for i in range(1, 10):
+        try:
+            R.collective_check("op-%d" % i)
+        except ConnectionError:
+            fired.append(i)
+    assert fired == [3, 6, 9]
+    stats = inj.stats()[0]
+    assert stats["checks"] == 9 and stats["fires"] == 3
+
+
+def test_barrier_site_at_n_fires_exactly_once():
+    R.FaultInjector.install("barrier:at=2:RuntimeError")
+    R.collective_check("b", site="barrier")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        R.collective_check("b", site="barrier")
+    for _ in range(5):  # at=N is one-shot
+        R.collective_check("b", site="barrier")
+
+
+def test_sites_count_independently():
+    inj = R.FaultInjector.install(
+        "collective:at=1:RuntimeError;barrier:at=1:OSError;"
+        "heartbeat:at=1:ConnectionError")
+    # checks at one site never consume another site's trigger
+    with pytest.raises(OSError):
+        R.fault_check("barrier")
+    with pytest.raises(ConnectionError):
+        R.fault_check("heartbeat")
+    with pytest.raises(RuntimeError):
+        R.fault_check("collective")
+    assert [c.fires for c in inj.clauses] == [1, 1, 1]
+
+
+def test_heartbeat_site_via_env(monkeypatch):
+    monkeypatch.setenv(R.FAULT_SPEC_ENV, "heartbeat:at=2:RuntimeError")
+    R.fault_check("heartbeat")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        R.fault_check("heartbeat")
+    # env-cached injector: counters persist, at=2 stays consumed
+    R.fault_check("heartbeat")
+    # changing the env spec string resets the counters
+    monkeypatch.setenv(R.FAULT_SPEC_ENV, "heartbeat:at=1:RuntimeError")
+    with pytest.raises(RuntimeError):
+        R.fault_check("heartbeat")
+
+
+def test_installed_injector_wins_over_env(monkeypatch):
+    monkeypatch.setenv(R.FAULT_SPEC_ENV, "collective:at=1:OSError")
+    R.FaultInjector.install("collective:at=1:RuntimeError")
+    with pytest.raises(RuntimeError):
+        R.fault_check("collective")
+    R.FaultInjector.uninstall()
+    with pytest.raises(OSError):
+        R.fault_check("collective")

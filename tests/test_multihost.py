@@ -12,6 +12,10 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.multihost
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

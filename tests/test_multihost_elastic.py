@@ -1,0 +1,130 @@
+"""Real multi-process elastic fleet: N worker PROCESSES coordinate
+through a FileStore on a shared tmp dir; the parent SIGKILLs one
+mid-run (no cooperation from the victim — this is the real crash
+shape, unlike the in-thread fault-site kills in test_elastic.py) and
+the survivors must detect, shrink, consensus-restore, and finish.
+
+Marked ``multihost`` + ``slow``: each worker pays a full jax import +
+trace, so the test runs in the chaos lane, not tier-1.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.multihost, pytest.mark.slow, pytest.mark.faults]
+
+_WORKER = r"""
+import json, os, sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.parallel import elastic as E
+
+w, world = int(sys.argv[1]), int(sys.argv[2])
+root, steps = sys.argv[3], int(sys.argv[4])
+
+fluid.default_startup_program().random_seed = 7
+fluid.default_main_program().random_seed = 7
+x = fluid.data("mx", shape=[None, 4], dtype="float32")
+y = fluid.data("my", shape=[None, 1], dtype="float32")
+p = fluid.layers.fc(x, 1)
+loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+
+
+def feed(step, guard=None):
+    rng = np.random.default_rng(1000 + step)
+    xv = rng.standard_normal((8, 4)).astype("float32")
+    return {"mx": xv,
+            "my": (xv.sum(1, keepdims=True) * 0.5).astype("float32")}
+
+
+cfg = E.ElasticConfig(heartbeat_interval=0.1, miss_threshold=20,
+                      collective_timeout=90.0, startup_grace=120.0)
+guard = E.FleetGuard(
+    exe, store=E.FileStore(os.path.join(root, "store")),
+    worker_index=w, world_size=world, config=cfg,
+    ckpt_dir=os.path.join(root, "ck"), fetch_list=[loss],
+    feed_fn=feed, save_every=3, sync_every=1)
+summary = guard.train(num_steps=steps)
+summary["max_blocked_ok"] = summary["max_blocked"] <= 91.0
+summary.pop("events")
+print("SUMMARY " + json.dumps(summary), flush=True)
+"""
+
+
+def _read_beacon(root, worker):
+    path = os.path.join(root, "store", "heartbeat", "%d.json" % worker)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def test_process_fleet_survives_sigkill(tmp_path):
+    root = str(tmp_path)
+    world, steps, victim = 3, 10, 1
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(w), str(world), root,
+             str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(__file__)))
+        for w in range(world)
+    ]
+    try:
+        # wait until the victim has trained past the first consensus
+        # save (save_every=3), then kill it dead — no atexit, no leave()
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            rec = _read_beacon(root, victim)
+            if rec and rec.get("step", 0) >= 5:
+                break
+            if procs[victim].poll() is not None:
+                pytest.fail("victim exited before it could be killed:\n%s"
+                            % procs[victim].communicate()[0])
+            time.sleep(0.2)
+        else:
+            pytest.fail("victim never reached step 5")
+        procs[victim].send_signal(signal.SIGKILL)
+
+        outs = {}
+        for w, p in enumerate(procs):
+            out, _ = p.communicate(timeout=240)
+            outs[w] = out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    assert procs[victim].returncode == -signal.SIGKILL
+    survivors = [w for w in range(world) if w != victim]
+    for w in survivors:
+        assert procs[w].returncode == 0, (
+            "worker %d failed:\n%s" % (w, outs[w]))
+        line = [ln for ln in outs[w].splitlines()
+                if ln.startswith("SUMMARY ")]
+        assert line, "worker %d printed no summary:\n%s" % (w, outs[w])
+        summary = json.loads(line[-1][len("SUMMARY "):])
+        assert summary["final_step"] == steps
+        assert summary["members"] == survivors
+        assert summary["generation"] >= 1
+        assert summary["counters"].get("worker_dead", 0) >= 1
+        assert summary["counters"].get("shrink", 0) >= 1
+        assert summary["counters"].get("restore", 0) >= 1
+        assert summary["max_blocked_ok"], summary["max_blocked"]

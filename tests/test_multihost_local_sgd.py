@@ -7,6 +7,10 @@ import socket
 import subprocess
 import sys
 import textwrap
+import pytest
+
+pytestmark = pytest.mark.multihost
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
