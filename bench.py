@@ -996,12 +996,38 @@ def child_main(status_path):
         _run_aux([k for k in AUX_MEASURE_KEYS
                   if k not in st.data["detail"]], gate=0.72)
 
+    tel_out = os.environ.get("PADDLE_TPU_BENCH_TELEMETRY_OUT")
+    if tel_out:
+        # --telemetry-out: the final hub snapshot (compile times, cache
+        # hit/miss, span histograms) lands next to BENCH_*.json so a
+        # regression in throughput can be cross-read against WHERE the
+        # step time went
+        try:
+            from paddle_tpu import observability as _obs
+
+            _atomic_write_json(tel_out, _obs.snapshot())
+        except Exception as e:  # noqa: BLE001 — never sink the bench
+            st.error("telemetry-out failed: %s: %s"
+                     % (type(e).__name__, str(e)[:200]))
+
     st.stage("done")
     print(json.dumps(_compose(st.data)), flush=True)
     return 0
 
 
 if __name__ == "__main__":
+    # --telemetry-out PATH: write the final Telemetry.snapshot() JSON
+    # there. Carried via env so the supervisor (which never imports
+    # jax/paddle_tpu) hands it to the chip-holding child untouched.
+    if "--telemetry-out" in sys.argv[1:]:
+        _i = sys.argv.index("--telemetry-out")
+        try:
+            os.environ["PADDLE_TPU_BENCH_TELEMETRY_OUT"] = sys.argv[_i + 1]
+        except IndexError:
+            print("bench.py: --telemetry-out requires a PATH",
+                  file=sys.stderr)
+            sys.exit(2)
+        del sys.argv[_i:_i + 2]
     if "--probe" in sys.argv[1:]:
         sys.exit(probe_main())
     status_file = os.environ.get("PADDLE_TPU_BENCH_CHILD")

@@ -99,4 +99,46 @@ echo "== lane 3: slow chaos fleet + multihost SIGKILL =="
 env -u PADDLE_TPU_FAULT_SPEC "${PYTEST[@]}" -m "slow" \
     tests/test_elastic.py tests/test_multihost_elastic.py
 
+echo "== lane 4: flight-recorder crash dump on an uncaught fault =="
+# A fault spec kills a run that nothing guards: the flight recorder's
+# excepthook must leave the black box behind (last events + active
+# spans + telemetry snapshot) before the process dies.
+DUMP="/tmp/paddle_tpu_chaos_crash_$$.json"
+rm -f "$DUMP"
+# run-site checks: 1 = startup run, 2 = first train run (survives),
+# 3 = second train run -> injected RuntimeError, uncaught
+if env PADDLE_TPU_FAULT_SPEC="run:at=3:RuntimeError" \
+       PADDLE_TPU_CRASH_DUMP="$DUMP" python - <<'EOF'
+import numpy as np
+import paddle_tpu.fluid as fluid
+
+x = fluid.data("dx", shape=[None, 4], dtype="float32")
+y = fluid.data("dy", shape=[None, 1], dtype="float32")
+p = fluid.layers.fc(x, 1)
+loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+fluid.optimizer.SGD(0.05).minimize(loss)
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+feed = {"dx": np.ones((4, 4), "float32"), "dy": np.ones((4, 1), "float32")}
+exe.run(fluid.default_main_program(), feed=feed, fetch_list=[loss])
+exe.run(fluid.default_main_program(), feed=feed, fetch_list=[loss])
+EOF
+then
+    echo "FAIL: expected the injected fault to kill the run"; exit 1
+fi
+test -s "$DUMP" || { echo "FAIL: crash dump $DUMP missing"; exit 1; }
+python - "$DUMP" <<'EOF'
+import json, sys
+
+d = json.load(open(sys.argv[1]))
+assert d["exception"]["type"] == "RuntimeError", d["exception"]
+assert "injected fault" in d["exception"]["message"], d["exception"]
+kinds = [ev["kind"] for ev in d["events"]]
+assert "compile_done" in kinds, kinds  # run 1 made it into the ring
+assert "counters" in d["telemetry"], sorted(d["telemetry"])
+print("crash dump OK: %d events, exception %s"
+      % (len(d["events"]), d["exception"]["type"]))
+EOF
+rm -f "$DUMP"
+
 echo "chaos lane: all green"

@@ -14,6 +14,7 @@ from . import reader  # noqa: F401  paddle.reader.* (real package)
 # which is this one)
 from . import batch  # noqa: F401
 batch = batch.batch
+from . import observability  # noqa: F401  paddle.observability.* (hub)
 from . import fluid  # noqa: F401
 from . import dataset  # noqa: F401
 from . import distributed  # noqa: F401

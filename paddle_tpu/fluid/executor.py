@@ -8,6 +8,7 @@ state training is a single XLA executable launch per iteration.
 """
 import collections
 import os
+import time
 import warnings
 
 import numpy as np
@@ -20,6 +21,7 @@ from . import framework
 from .framework import Program, Variable, default_main_program
 from .lowering import OpLoweringError, build_step_fn
 from .resilience import fault_check
+from .. import observability as obs
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 
@@ -224,62 +226,89 @@ class Executor:
         fetch_list = fetch_list or []
         fetch_names = [_as_name(f) for f in fetch_list]
 
-        feed_arrays = self._prepare_feeds(program, feed)
-        state = self._gather_state(program, scope)
+        with obs.span("executor.run"):
+            with obs.span("executor.feed_convert"):
+                feed_arrays = self._prepare_feeds(program, feed)
+                state = self._gather_state(program, scope)
 
-        sig = (
-            program._uid,
-            program._version,
-            tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items())),
-            tuple(fetch_names),
-            tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
-        )
-        rng = self._next_rng(program)
-        entry = self._cache_lookup(sig) if use_program_cache else None
-        if entry is None:
-            platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
-            step = build_step_fn(
-                program, list(feed_arrays.keys()), fetch_names,
-                platform=platform,
+            sig = (
+                program._uid,
+                program._version,
+                tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items())),
+                tuple(fetch_names),
+                tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
             )
-            jitted = jax.jit(step, donate_argnums=(0,))
-            # AOT-compile: freezes one executable for this signature. Without
-            # this, the donated state outputs come back in compiler-chosen
-            # layouts, and the SECOND run would retrace+recompile the whole
-            # module against those layouts (a full minutes-long compile for a
-            # big model). The AOT executable instead relayouts inputs on
-            # device, so run 2+ reuse the same binary.
-            try:
-                entry = jitted.lower(state, feed_arrays, rng).compile()
-            except OpLoweringError:
-                raise  # user graph error (missing feed, bad shape, ...)
-            except Exception as e:
-                global _aot_warned
-                if not _aot_warned:
-                    _aot_warned = True
-                    warnings.warn(
-                        "AOT compile failed (%s: %s); falling back to traced "
-                        "jit — expect one redundant recompile on the second "
-                        "run of each program" % (type(e).__name__, e)
-                    )
-                entry = jitted  # fall back to the tracing path
-            if use_program_cache:
-                self._cache_store(sig, entry)
+            rng = self._next_rng(program)
+            entry = self._cache_lookup(sig) if use_program_cache else None
+            if entry is None:
+                obs.inc("executor.cache_miss")
+                obs.event("compile_start", source="executor", count=False,
+                          program=program._uid, version=program._version)
+                t_compile = time.monotonic()
+                platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
+                step = build_step_fn(
+                    program, list(feed_arrays.keys()), fetch_names,
+                    platform=platform,
+                )
+                jitted = jax.jit(step, donate_argnums=(0,))
+                # AOT-compile: freezes one executable for this signature. Without
+                # this, the donated state outputs come back in compiler-chosen
+                # layouts, and the SECOND run would retrace+recompile the whole
+                # module against those layouts (a full minutes-long compile for a
+                # big model). The AOT executable instead relayouts inputs on
+                # device, so run 2+ reuse the same binary.
+                try:
+                    entry = jitted.lower(state, feed_arrays, rng).compile()
+                except OpLoweringError:
+                    raise  # user graph error (missing feed, bad shape, ...)
+                except Exception as e:
+                    global _aot_warned
+                    if not _aot_warned:
+                        _aot_warned = True
+                        warnings.warn(
+                            "AOT compile failed (%s: %s); falling back to traced "
+                            "jit — expect one redundant recompile on the second "
+                            "run of each program" % (type(e).__name__, e)
+                        )
+                    entry = jitted  # fall back to the tracing path
+                dt_compile = time.monotonic() - t_compile
+                obs.observe("executor.compile_seconds", dt_compile)
+                obs.event("compile_done", source="executor", count=False,
+                          program=program._uid, version=program._version,
+                          seconds=round(dt_compile, 6))
+                if use_program_cache:
+                    self._cache_store(sig, entry)
+            else:
+                obs.inc("executor.cache_hit")
 
-        try:
-            fetches, new_state = entry(state, feed_arrays, rng)
-        except Exception:
-            # cache-safe re-run: a failed dispatch may have consumed the
-            # donated state buffers or left the executable poisoned —
-            # evict so a guarded retry recompiles against fresh state
-            # instead of replaying a dead executable
-            self._cache.pop(sig, None)
-            raise
-        for k, v in new_state.items():
-            scope.update(k, v)
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+            with obs.span("executor.device_compute"):
+                try:
+                    fetches, new_state = entry(state, feed_arrays, rng)
+                except Exception:
+                    # cache-safe re-run: a failed dispatch may have consumed the
+                    # donated state buffers or left the executable poisoned —
+                    # evict so a guarded retry recompiles against fresh state
+                    # instead of replaying a dead executable
+                    if self._cache.pop(sig, None) is not None:
+                        obs.inc("executor.cache_evict")
+                    raise
+                if obs.trace_enabled():
+                    # trace mode: make the span measure true device time
+                    # (dispatch is async; only block when asked — blocking
+                    # every step would serialize the pipeline)
+                    for v in fetches:
+                        if hasattr(v, "block_until_ready"):
+                            v.block_until_ready()
+                    for v in new_state.values():
+                        if hasattr(v, "block_until_ready"):
+                            v.block_until_ready()
+
+            with obs.span("executor.fetch"):
+                for k, v in new_state.items():
+                    scope.update(k, v)
+                if return_numpy:
+                    return [np.asarray(v) for v in fetches]
+                return list(fetches)
 
     # ------------------------------------------------------------------
     def _run_dataset_scan(self, program, feed, k, scope):
@@ -316,6 +345,8 @@ class Executor:
         )
         entry = self._cache_lookup(sig)
         if entry is None:
+            obs.inc("executor.cache_miss")
+            t_compile = time.monotonic()
             platform = "cpu" if isinstance(self.place, core.CPUPlace) \
                 else "tpu"
             step = build_step_fn(program, list(feed_arrays.keys()), [],
@@ -353,7 +384,11 @@ class Executor:
                 raise OpLoweringError(
                     "dataset scan compile failed (%s: %s)"
                     % (type(e).__name__, str(e)[:200]))
+            obs.observe("executor.compile_seconds",
+                        time.monotonic() - t_compile)
             self._cache_store(sig, entry)
+        else:
+            obs.inc("executor.cache_hit")
         new_state = entry(state, stacked, rngs)
         for name, v in new_state.items():
             scope.update(name, v)
@@ -471,6 +506,7 @@ class Executor:
         self._cache[sig] = entry
         while len(self._cache) > self._cache_cap:
             self._cache.popitem(last=False)
+            obs.inc("executor.cache_evict")
 
     # -- dataset trainer path (ref executor.py:1033,1103) --------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,

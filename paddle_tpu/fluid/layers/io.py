@@ -164,6 +164,7 @@ class _ProgramReader:
         return bool(t is not None and t.is_alive())
 
     def _next_feed(self):
+        from ... import observability as obs
         from .. import core as _core
         from ..resilience import fault_check
 
@@ -173,7 +174,20 @@ class _ProgramReader:
         # (site "feed" in PADDLE_TPU_FAULT_SPEC); placed after the
         # started check so only real batch pops count
         fault_check("feed")
-        item = self._queue.get()
+        if obs.enabled():
+            # queue depth BEFORE the pop: 0 here plus a long pop wait
+            # below means the producer is the bottleneck (reader-bound
+            # step); a full queue with near-zero pop waits means the
+            # chip is the bottleneck
+            import time as _time
+
+            obs.set_gauge("reader.queue_depth", self._queue.qsize())
+            t0 = _time.monotonic()
+            item = self._queue.get()
+            obs.observe("reader.pop_wait_seconds",
+                        _time.monotonic() - t0)
+        else:
+            item = self._queue.get()
         if isinstance(item, tuple) and len(item) == 2 and \
                 item[0] == "__error__":
             self._started = False

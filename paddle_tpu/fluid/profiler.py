@@ -1,14 +1,21 @@
 """Profiler (ref: python/paddle/fluid/profiler.py) — wraps jax.profiler:
 traces go to TensorBoard-compatible xplane dumps instead of the reference's
-chrome-tracing C++ profiler."""
+chrome-tracing C++ profiler. Trace start/stop land in the telemetry hub
+(``paddle_tpu.observability``) as ``profiler.*`` events; for always-on
+step metrics use the hub directly (see README "Observability")."""
 import contextlib
 import os
 import time
+import warnings
+
+from .. import observability as obs
 
 __all__ = [
     "cuda_profiler", "reset_profiler", "profiler", "start_profiler",
     "stop_profiler", "profile_op_stats",
 ]
+
+_FALLBACK_DIR = "/tmp/paddle_tpu_profile"
 
 _trace_dir = None
 _start_time = None
@@ -29,29 +36,59 @@ def start_profiler(state, tracer_option="Default", profile_path="/tmp/profile"):
     global _trace_dir, _start_time
     import jax
 
-    _trace_dir = profile_path if os.path.isdir(str(profile_path)) else "/tmp/paddle_tpu_profile"
-    os.makedirs(_trace_dir, exist_ok=True)
-    _start_time = time.time()
+    # honor the REQUESTED path: create it if missing; only an uncreatable
+    # path falls back (and says so) — silently ignoring profile_path left
+    # every trace in the fallback dir regardless of what the user asked
+    path = str(profile_path) if profile_path else _FALLBACK_DIR
     try:
-        jax.profiler.start_trace(_trace_dir)
-    except Exception:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        warnings.warn(
+            "profiler: cannot create profile_path %r (%s: %s); traces "
+            "go to %s" % (path, type(e).__name__, e, _FALLBACK_DIR))
+        path = _FALLBACK_DIR
+        os.makedirs(path, exist_ok=True)
+    try:
+        jax.profiler.start_trace(path)
+    except Exception as e:  # noqa: BLE001 — profiling must not kill a run
+        # but it must not fail SILENTLY either: leave module state
+        # consistent (no dir, no start time) and say what happened
         _trace_dir = None
+        _start_time = None
+        warnings.warn(
+            "profiler: jax.profiler.start_trace(%r) failed (%s: %s) — "
+            "no trace is being recorded" % (path, type(e).__name__, e))
+        obs.event("trace_error", source="profiler", path=path,
+                  error="%s: %s" % (type(e).__name__, e))
+        return
+    _trace_dir = path
+    _start_time = time.time()
+    obs.event("trace_start", source="profiler", path=path)
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    global _trace_dir
+    global _trace_dir, _start_time
     import jax
 
     if _trace_dir is not None:
+        seconds = time.time() - (_start_time or time.time())
         try:
             jax.profiler.stop_trace()
-        except Exception:
-            pass
-        print(
-            "[paddle_tpu profiler] trace written to %s (%.2fs)"
-            % (_trace_dir, time.time() - (_start_time or time.time()))
-        )
+        except Exception as e:  # noqa: BLE001 — see start_profiler
+            warnings.warn(
+                "profiler: jax.profiler.stop_trace() failed (%s: %s) — "
+                "the trace under %r may be incomplete"
+                % (type(e).__name__, e, _trace_dir))
+            obs.event("trace_error", source="profiler", path=_trace_dir,
+                      error="%s: %s" % (type(e).__name__, e))
+        else:
+            # the summary line goes through the hub (flight-recorder
+            # event + counter + duration histogram), not a bare print
+            obs.event("trace_stop", source="profiler", path=_trace_dir,
+                      seconds=round(seconds, 4))
+            obs.observe("profiler.trace_seconds", seconds)
     _trace_dir = None
+    _start_time = None
 
 
 @contextlib.contextmanager

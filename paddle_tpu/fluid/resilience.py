@@ -60,6 +60,7 @@ import numpy as np
 
 from . import core
 from .lowering import OpLoweringError
+from .. import observability as obs
 
 __all__ = [
     "FaultInjector", "FaultSpecError", "GuardedExecutor", "TrainGuard",
@@ -338,19 +339,36 @@ def fault_nonfinite(site="fetch"):
 class EventLog:
     """Bounded structured event log + per-kind counters. Events are
     plain dicts with a 'kind' key; an optional sink callback sees each
-    event as it is emitted (wire it to print/logging/telemetry)."""
+    event as it is emitted (wire it to print/logging).
 
-    def __init__(self, maxlen=10000, sink=None):
+    Every emit also routes through the process-wide telemetry hub
+    (``paddle_tpu.observability``): the event lands in the flight
+    recorder (`recorder`, the global ring when None — so resilience,
+    fleet, and executor streams interleave in ONE monotonic-ordered
+    JSONL dump) and bumps the ``<source>.<kind>`` counter. With
+    ``PADDLE_TPU_TELEMETRY=off`` the routing is a no-op and only the
+    local deque/counters fill. Pass ``_forward=False`` when re-emitting
+    an event that already went through the hub at its origin (e.g. a
+    GuardedExecutor retry relayed into a TrainGuard's log) so nothing
+    double-counts."""
+
+    def __init__(self, maxlen=10000, sink=None, recorder=None,
+                 source=None):
         self.events = collections.deque(maxlen=maxlen)
         self.counters = collections.Counter()
         self._sink = sink
+        self._recorder = recorder
+        self._source = source
 
-    def emit(self, kind, **fields):
+    def emit(self, kind, _forward=True, **fields):
         ev = dict(kind=kind, **fields)
         self.counters[kind] += 1
         self.events.append(ev)
         if self._sink is not None:
             self._sink(ev)
+        if _forward:
+            obs.event(kind, source=self._source,
+                      recorder=self._recorder, **fields)
         return ev
 
     def of(self, kind):
@@ -410,12 +428,13 @@ class GuardedExecutor:
                  backoff_max=2.0, jitter=0.25, timeout=None,
                  nonfinite_action="skip", max_consecutive_nonfinite=5,
                  transient_types=None, amp_optimizer=None, on_event=None,
-                 seed=0):
+                 seed=0, recorder=None):
         if nonfinite_action not in ("skip", "raise"):
             raise ValueError(
                 "nonfinite_action must be 'skip' or 'raise', got %r"
                 % (nonfinite_action,))
         self._exe = executor
+        self._recorder = recorder
         self.max_retries = int(max_retries)
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
@@ -435,6 +454,10 @@ class GuardedExecutor:
     # -- events ----------------------------------------------------------
     def _emit(self, kind, **fields):
         self.counters[kind] += 1
+        # hub routing happens HERE, at the origin; relays into a
+        # TrainGuard/FleetGuard EventLog re-emit with _forward=False
+        obs.event(kind, source="guard", recorder=self._recorder,
+                  **fields)
         if self._on_event is not None:
             self._on_event(dict(kind=kind, **fields))
 
@@ -583,7 +606,7 @@ class TrainGuard:
                  save_every=0, final_save=True, resume=True, scope=None,
                  reader_restarts=2, restart_on_eof=True, max_to_keep=None,
                  save_wait=True, on_event=None, log_maxlen=10000,
-                 **guard_opts):
+                 recorder=None, **guard_opts):
         self._exe = executor
         self._program = program
         self._ckpt_dir = ckpt_dir
@@ -598,12 +621,15 @@ class TrainGuard:
         self._restart_on_eof = restart_on_eof
         self._max_to_keep = max_to_keep
         self._save_wait = save_wait
-        self.log = EventLog(maxlen=log_maxlen, sink=on_event)
+        self.log = EventLog(maxlen=log_maxlen, sink=on_event,
+                            recorder=recorder, source="resilience")
         self.guard = GuardedExecutor(
-            executor, on_event=self._relay, **guard_opts)
+            executor, on_event=self._relay, recorder=recorder,
+            **guard_opts)
 
     def _relay(self, ev):
-        self.log.emit(ev.pop("kind"), **ev)
+        # already hub-routed by GuardedExecutor._emit at the origin
+        self.log.emit(ev.pop("kind"), _forward=False, **ev)
 
     # -- checkpoint plumbing --------------------------------------------
     def _resolve(self):
@@ -625,6 +651,7 @@ class TrainGuard:
         step = ckpt.latest_step(self._ckpt_dir)
         if step is None:
             return 0
+        t0 = time.monotonic()
         state = ckpt.load_checkpoint(self._ckpt_dir, step=step)
         src = getattr(program, "_program", program)
         restored = 0
@@ -633,7 +660,8 @@ class TrainGuard:
                 scope.update(v.name, state[v.name])
                 restored += 1
         self.log.emit("restore", step=step, vars=restored,
-                      dirname=self._ckpt_dir)
+                      dirname=self._ckpt_dir,
+                      seconds=round(time.monotonic() - t0, 6))
         return int(step)
 
     def save(self, step, program=None, scope=None):
@@ -646,10 +674,12 @@ class TrainGuard:
 
         src = getattr(program, "_program", program)
         state = self._exe._gather_state(src, scope)
+        t0 = time.monotonic()
         ckpt.save_checkpoint(
             self._ckpt_dir, state, step=int(step),
             max_to_keep=self._max_to_keep, wait=self._save_wait)
-        self.log.emit("save", step=int(step), vars=len(state))
+        self.log.emit("save", step=int(step), vars=len(state),
+                      seconds=round(time.monotonic() - t0, 6))
 
     def _restart_readers(self, step, reason):
         for r in self._readers:

@@ -1,0 +1,110 @@
+"""paddle_tpu.observability — unified telemetry hub + flight recorder.
+
+One import point for every instrumented layer::
+
+    from paddle_tpu import observability as obs
+
+    obs.inc("executor.cache_hit")
+    obs.observe("checkpoint.save_seconds", dt)
+    obs.set_gauge("reader.queue_depth", q.qsize())
+    obs.event("retry", source="guard", attempt=2)
+    with obs.span("executor.run"):
+        ...
+
+Every helper here is gated on the live ``PADDLE_TPU_TELEMETRY`` mode
+(``off`` | ``on`` | ``trace``): with ``off`` each call is a single
+env-flag check and an early return — no allocation, no lock — so the
+instrumentation stays compiled into the hot paths permanently.
+
+Read side: ``snapshot()`` (nested dict), ``render_prom()`` (Prometheus
+text), ``get_recorder().dump_jsonl(path)`` (the event ring), and crash
+dumps written automatically on uncaught exceptions (see
+``recorder.install_excepthook``). ``reset()`` clears the hub AND the
+ring — tests use it to scope assertions to a scripted session.
+
+This package is stdlib-only (no jax/numpy imports at module level), so
+crash-path and supervisor code can use it without accelerator init.
+"""
+from . import recorder as _recorder
+from . import telemetry as _telemetry
+from . import tracing as _tracing
+from .recorder import (  # noqa: F401
+    CRASH_DUMP_ENV, FlightRecorder, crash_dump_path, get_recorder,
+    install_excepthook,
+)
+from .telemetry import (  # noqa: F401
+    OFF, ON, TRACE, TELEMETRY_ENV, Histogram, Telemetry, get_telemetry,
+    mode,
+)
+from .tracing import active_spans, current_span, span  # noqa: F401
+
+__all__ = [
+    "Telemetry", "Histogram", "FlightRecorder", "get_telemetry",
+    "get_recorder", "span", "active_spans", "current_span", "mode",
+    "enabled", "trace_enabled", "inc", "observe", "set_gauge", "event",
+    "snapshot", "render_prom", "reset", "install_excepthook",
+    "crash_dump_path", "TELEMETRY_ENV", "CRASH_DUMP_ENV",
+    "OFF", "ON", "TRACE",
+]
+
+
+def enabled():
+    """True unless PADDLE_TPU_TELEMETRY=off."""
+    return _telemetry.mode() != OFF
+
+
+def trace_enabled():
+    """True only in PADDLE_TPU_TELEMETRY=trace mode."""
+    return _telemetry.mode() == TRACE
+
+
+# -- mode-gated write helpers (the instrumentation surface) ----------------
+
+def inc(name, n=1):
+    if _telemetry.mode() == OFF:
+        return
+    _telemetry._hub.inc(name, n)
+
+
+def observe(name, value):
+    if _telemetry.mode() == OFF:
+        return
+    _telemetry._hub.observe(name, value)
+
+
+def set_gauge(name, value):
+    if _telemetry.mode() == OFF:
+        return
+    _telemetry._hub.set_gauge(name, value)
+
+
+def event(kind, source=None, recorder=None, count=True, **fields):
+    """Record a structured event into `recorder` (the global flight
+    recorder when None) and bump the ``<source>.<kind>`` counter. The
+    single entry point EventLog streams route through."""
+    if _telemetry.mode() == OFF:
+        return None
+    if count:
+        _telemetry._hub.inc(
+            "%s.%s" % (source, kind) if source else kind)
+    rec = recorder if recorder is not None else _recorder._global
+    if source is not None:
+        fields.setdefault("source", source)
+    return rec.record(kind, **fields)
+
+
+# -- read side --------------------------------------------------------------
+
+def snapshot():
+    return _telemetry._hub.snapshot()
+
+
+def render_prom():
+    return _telemetry._hub.render_prom()
+
+
+def reset():
+    """Clear the hub and the global event ring (testing / session
+    scoping). Does not uninstall the excepthook."""
+    _telemetry._hub.reset()
+    _recorder._global.clear()

@@ -1,0 +1,213 @@
+"""Process-wide telemetry hub: counters, gauges, histograms.
+
+The hub is the single metrics blackboard every layer of the stack
+reports through — the executor's compile-cache hits, the resilience
+layer's retries, the elastic fleet's collective waits, the reader's
+queue depth. Metric names are dot-separated lowercase paths
+(``executor.cache_hit``, ``checkpoint.save_seconds``); ``snapshot()``
+returns them as a nested dict and ``render_prom()`` as Prometheus
+text exposition (dots become underscores, ``paddle_tpu_`` prefix).
+
+The ``PADDLE_TPU_TELEMETRY`` env switch gates EVERY write:
+
+    off    instrumentation sites are no-ops (one env-flag check, no
+           allocation) — cheap enough to leave compiled in
+    on     counters/gauges/histograms + flight-recorder events (default)
+    trace  additionally records span start/stop events into the flight
+           recorder and blocks on device outputs so the executor's
+           device-compute phase measures true chip time
+
+The switch is read live (one ``os.environ`` lookup per check), so a
+test or a driver can flip it without restarting the process. This
+module is stdlib-only — the bench supervisor and crash-path code can
+import it without pulling in jax.
+"""
+import collections
+import math
+import os
+import re
+import threading
+
+__all__ = [
+    "Telemetry", "Histogram", "get_telemetry", "mode", "TELEMETRY_ENV",
+    "OFF", "ON", "TRACE",
+]
+
+TELEMETRY_ENV = "PADDLE_TPU_TELEMETRY"
+
+OFF, ON, TRACE = 0, 1, 2
+
+_OFF_VALUES = frozenset({"off", "0", "false", "no", "none", "disabled"})
+
+
+# last (raw env value, parsed mode): the env is still read LIVE on
+# every call — only the string parse is cached, keyed on the exact raw
+# value, so flips (including by monkeypatch) always take effect
+_mode_cache = ("", ON)
+
+
+def mode():
+    """Resolve the live telemetry mode from the environment. Unset (and
+    any unrecognised value) means ``on``."""
+    global _mode_cache
+    v = os.environ.get(TELEMETRY_ENV)
+    if v is None:
+        return ON
+    cached = _mode_cache
+    if v == cached[0]:
+        return cached[1]
+    s = v.strip().lower()
+    m = OFF if s in _OFF_VALUES else TRACE if s == "trace" else ON
+    _mode_cache = (v, m)
+    return m
+
+
+class Histogram:
+    """Streaming count/sum/min/max plus a bounded reservoir of the most
+    recent observations (deterministic — no sampling randomness) for
+    percentile estimates. Memory is bounded by ``cap`` regardless of
+    how many values are observed."""
+
+    __slots__ = ("count", "sum", "min", "max", "_reservoir")
+
+    def __init__(self, cap=512):
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._reservoir = collections.deque(maxlen=int(cap))
+
+    def observe(self, value):
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        self._reservoir.append(v)
+
+    def quantile(self, q):
+        vals = sorted(self._reservoir)
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, int(q * len(vals)))
+        return vals[idx]
+
+    def summary(self):
+        if not self.count:
+            return {"count": 0, "sum": 0.0}
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": self.sum / self.count,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name):
+    return "paddle_tpu_" + _PROM_BAD.sub("_", name)
+
+
+class Telemetry:
+    """The hub. Thread-safe; all methods are cheap enough to call from
+    hot paths once the mode gate (handled by the package-level helpers
+    in ``paddle_tpu.observability``) has passed."""
+
+    def __init__(self, reservoir_cap=512):
+        self._lock = threading.Lock()
+        self._reservoir_cap = int(reservoir_cap)
+        self._counters = collections.Counter()
+        self._gauges = {}
+        self._hists = {}
+
+    # -- writes ----------------------------------------------------------
+    def inc(self, name, n=1):
+        with self._lock:
+            self._counters[name] += n
+
+    def set_gauge(self, name, value):
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name, value):
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = Histogram(self._reservoir_cap)
+            hist.observe(value)
+
+    # -- reads -----------------------------------------------------------
+    def counter(self, name):
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def gauge(self, name):
+        with self._lock:
+            return self._gauges.get(name)
+
+    def histogram(self, name):
+        """The histogram summary dict for `name`, or None."""
+        with self._lock:
+            hist = self._hists.get(name)
+            return hist.summary() if hist is not None else None
+
+    def snapshot(self):
+        """Nested dict of everything the hub holds right now."""
+        with self._lock:
+            return {
+                "mode": {OFF: "off", ON: "on", TRACE: "trace"}[mode()],
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    name: hist.summary()
+                    for name, hist in self._hists.items()
+                },
+            }
+
+    def render_prom(self):
+        """Prometheus text exposition (counters, gauges, and histogram
+        summaries with quantile labels)."""
+        lines = []
+        with self._lock:
+            for name in sorted(self._counters):
+                pn = _prom_name(name)
+                lines.append("# TYPE %s counter" % pn)
+                lines.append("%s %d" % (pn, self._counters[name]))
+            for name in sorted(self._gauges):
+                pn = _prom_name(name)
+                lines.append("# TYPE %s gauge" % pn)
+                lines.append("%s %.9g" % (pn, self._gauges[name]))
+            for name in sorted(self._hists):
+                pn = _prom_name(name)
+                hist = self._hists[name]
+                lines.append("# TYPE %s summary" % pn)
+                for q in (0.5, 0.9, 0.99):
+                    val = hist.quantile(q)
+                    if val is not None:
+                        lines.append(
+                            '%s{quantile="%s"} %.9g' % (pn, q, val))
+                lines.append("%s_sum %.9g" % (pn, hist.sum))
+                lines.append("%s_count %d" % (pn, hist.count))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+_hub = Telemetry()
+
+
+def get_telemetry():
+    """The process-wide hub singleton."""
+    return _hub

@@ -32,10 +32,14 @@ def _guard(what, site="collective"):
     training arms one per step), so a fleet that already lost a peer
     raises CollectiveTimeoutError here instead of wedging on the chip.
     World-size-1 paths are guarded too: the entry point is the unit of
-    accounting, not the payload. Imported lazily — ops must stay
+    accounting, not the payload — the telemetry dispatch counters below
+    count lowerings the same way. Imported lazily — ops must stay
     importable before the fluid package finishes initialising."""
+    from .. import observability as obs
     from ..fluid.resilience import collective_check
 
+    obs.inc("collective.dispatch")
+    obs.inc("collective.dispatch.%s" % what)
     collective_check(what, site=site)
 
 

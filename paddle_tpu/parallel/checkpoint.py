@@ -77,11 +77,13 @@ def save_checkpoint(dirname, state, step=0, max_to_keep=None, wait=True):
     import orbax.checkpoint as ocp
 
     from ..fluid.resilience import fault_check
+    from .. import observability as obs
 
     # fault-injection hook (site "save"): BEFORE the manager touches
     # disk, modeling a process killed mid-save — the previous complete
     # checkpoint must stay the resume point
     fault_check("save")
+    t0 = time.monotonic()
     mgr = _manager(dirname, max_to_keep)
     saved = mgr.save(int(step), args=ocp.args.StandardSave(dict(state)))
     if not saved:
@@ -94,6 +96,9 @@ def save_checkpoint(dirname, state, step=0, max_to_keep=None, wait=True):
                 "orbax refused to save step %s under %r" % (step, dirname))
     if wait:
         mgr.wait_until_finished()
+    # with wait=False this measures the enqueue, not the disk write —
+    # the histogram still distinguishes sync from async save costs
+    obs.observe("checkpoint.save_seconds", time.monotonic() - t0)
 
 
 def latest_step(dirname):
@@ -118,10 +123,13 @@ def load_checkpoint(dirname, step=None):
     traceback."""
     import orbax.checkpoint as ocp
 
+    from .. import observability as obs
+
     if not os.path.isdir(dirname):
         raise IOError(
             "no checkpoint directory %r (nothing was ever saved there, "
             "or the path is wrong)" % dirname)
+    t0 = time.monotonic()
     try:
         mgr = _manager(dirname)
         mgr.wait_until_finished()
@@ -144,6 +152,7 @@ def load_checkpoint(dirname, step=None):
         raise IOError(
             "failed to restore checkpoint step %s from %r (%s: %s)"
             % (step, dirname, type(e).__name__, e)) from e
+    obs.observe("checkpoint.restore_seconds", time.monotonic() - t0)
     return {k: np.asarray(v) for k, v in restored.items()}
 
 

@@ -63,6 +63,7 @@ import time
 
 import numpy as np
 
+from .. import observability as obs
 from ..fluid import resilience as R
 from ..fluid.resilience import (  # re-exported surface  # noqa: F401
     CollectiveTimeoutError, collective_deadline, deadline_remaining,
@@ -235,7 +236,7 @@ class HeartbeatMonitor:
         self.worker_index = int(worker_index)
         self.world_size = int(world_size)
         self.config = config or ElasticConfig()
-        self.log = log if log is not None else EventLog()
+        self.log = log if log is not None else EventLog(source="fleet")
         self._fault = fault_hook or R.fault_check
         self._born = time.time()
         self._last = None           # last record this worker published
@@ -284,6 +285,7 @@ class HeartbeatMonitor:
         members = (set(range(self.world_size)) if members is None
                    else set(members))
         dead = set()
+        max_age = 0.0
         for w in members:
             if w == self.worker_index:
                 continue
@@ -295,12 +297,17 @@ class HeartbeatMonitor:
             if rec.get("state") == "left":
                 continue
             silent = now - rec["time"]
+            if silent > max_age:
+                max_age = silent
             if silent > cfg.dead_after:
                 dead.add(w)
                 self.log.emit("heartbeat_miss", worker=w,
                               silent=round(silent, 4),
                               threshold=cfg.dead_after,
                               last_step=rec.get("step"))
+        # oldest still-counted peer beacon, as THIS worker sees it — a
+        # rising gauge is the leading signal of a dying/partitioned peer
+        obs.set_gauge("fleet.heartbeat_age_seconds", max_age)
         for w in sorted(dead - self._declared_dead):
             self._declared_dead.add(w)
             self.log.emit("worker_dead", worker=w,
@@ -407,14 +414,16 @@ class FleetGuard:
                  world_size=1, config=None, ckpt_dir=None, fetch_list=None,
                  feed_fn=None, scope=None, save_every=0, sync_every=1,
                  sync_vars=None, devices=None, on_event=None,
-                 fault_spec=None, log_maxlen=10000, **guard_opts):
+                 fault_spec=None, log_maxlen=10000, recorder=None,
+                 **guard_opts):
         self.config = config or ElasticConfig()
         self.store = store if store is not None else InMemoryStore()
         self.worker_index = int(worker_index)
         self.world_size = int(world_size)
         self.members = set(range(self.world_size))
         self.generation = 0
-        self.log = EventLog(maxlen=log_maxlen, sink=on_event)
+        self.log = EventLog(maxlen=log_maxlen, sink=on_event,
+                            recorder=recorder, source="fleet")
         self._injector = (FaultInjector(fault_spec) if fault_spec else None)
         self.monitor = HeartbeatMonitor(
             self.store, self.worker_index, self.world_size,
@@ -429,7 +438,8 @@ class FleetGuard:
         self._sync_every = int(sync_every)
         self._sync_vars = sync_vars
         self.guard = GuardedExecutor(
-            executor, on_event=self._relay, **guard_opts)
+            executor, on_event=self._relay, recorder=recorder,
+            **guard_opts)
         # one device per member: the fleet's mesh view. Devices wrap
         # around when the fleet is wider than the local device count
         # (simulated workers share chips).
@@ -490,7 +500,8 @@ class FleetGuard:
             R.fault_check(site)
 
     def _relay(self, ev):
-        self.log.emit(ev.pop("kind"), **ev)
+        # already hub-routed by GuardedExecutor._emit at the origin
+        self.log.emit(ev.pop("kind"), _forward=False, **ev)
 
     def _resolve(self):
         from ..fluid.executor import global_scope
@@ -502,11 +513,13 @@ class FleetGuard:
         return program, scope
 
     # -- host-side collectives over the store ----------------------------
-    def _wait(self, namespace, need, timeout, what):
+    def _wait(self, namespace, need, timeout, what,
+              metric="fleet.wait_seconds"):
         """Poll `namespace` until every worker in `need` posted; beats
         our own keepalive while waiting; aborts with DeadPeerError the
         moment a waited-on peer is confirmed dead, and with
-        CollectiveTimeoutError at the deadline. Returns elapsed."""
+        CollectiveTimeoutError at the deadline. Returns elapsed. Every
+        wait lands in ``block_log`` AND the `metric` histogram."""
         cfg = self.config
         budget = cfg.collective_timeout if timeout is None else timeout
         armed = deadline_remaining()
@@ -542,7 +555,9 @@ class FleetGuard:
                         "%s" % (what, budget, sorted(need - have)))
                 time.sleep(cfg.poll_interval)
         finally:
-            self.block_log.append((what, time.monotonic() - t0))
+            elapsed = time.monotonic() - t0
+            self.block_log.append((what, elapsed))
+            obs.observe(metric, elapsed)
 
     def barrier(self, name="fleet", timeout=None, members=None):
         """Rendezvous the (surviving) members. Deterministic namespace:
@@ -558,7 +573,8 @@ class FleetGuard:
         self.store.put(ns, self.worker_index,
                        {"worker": self.worker_index, "time": time.time()})
         return self._wait(ns, members, timeout,
-                          "barrier %r (gen %d)" % (name, self.generation))
+                          "barrier %r (gen %d)" % (name, self.generation),
+                          metric="fleet.barrier_wait_seconds")
 
     def allreduce_mean(self, value, tag, timeout=None):
         """Fleet mean of `value` over the LIVE member set — the
@@ -574,7 +590,8 @@ class FleetGuard:
                         "shape": list(arr.shape),
                         "value": arr.ravel().tolist()})
         self._wait(ns, self.members, timeout,
-                   "allreduce %r (gen %d)" % (tag, self.generation))
+                   "allreduce %r (gen %d)" % (tag, self.generation),
+                   metric="fleet.allreduce_wait_seconds")
         posted = self.store.all(ns)
         vals = [np.asarray(posted[str(w)]["value"], dtype=np.float64)
                 .reshape(posted[str(w)]["shape"])
@@ -644,6 +661,8 @@ class FleetGuard:
         self.generation += 1
         self.monitor.generation = self.generation
         self.members = set(survivors)
+        obs.set_gauge("fleet.members", len(survivors))
+        obs.set_gauge("fleet.generation", self.generation)
         self.log.emit("shrink", generation=self.generation,
                       dead=sorted(dead), survivors=survivors)
         # announce the new generation before blocking so peers polling
