@@ -16,6 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import compile_cache
 from . import core
 from . import framework
 from .framework import Program, Variable, default_main_program
@@ -239,13 +240,27 @@ class Executor:
                 tuple(sorted((k, v.shape, str(v.dtype)) for k, v in state.items())),
             )
             rng = self._next_rng(program)
+            platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
             entry = self._cache_lookup(sig) if use_program_cache else None
+            disk_key = None
+            if entry is None and use_program_cache and compile_cache.enabled():
+                # disk tier: a hit deserializes the AOT artifact in ms and
+                # emits NO compile_start — warm processes skip the compile
+                try:
+                    disk_key = compile_cache.entry_key(
+                        program, list(feed_arrays.keys()), fetch_names,
+                        sig[2], sig[4], platform)
+                except compile_cache.Unfingerprintable:
+                    disk_key = None
+                else:
+                    entry = compile_cache.load(disk_key)
+                    if entry is not None:
+                        self._cache_store(sig, entry)
             if entry is None:
                 obs.inc("executor.cache_miss")
                 obs.event("compile_start", source="executor", count=False,
                           program=program._uid, version=program._version)
                 t_compile = time.monotonic()
-                platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
                 step = build_step_fn(
                     program, list(feed_arrays.keys()), fetch_names,
                     platform=platform,
@@ -257,12 +272,14 @@ class Executor:
                 # module against those layouts (a full minutes-long compile for a
                 # big model). The AOT executable instead relayouts inputs on
                 # device, so run 2+ reuse the same binary.
+                aot_ok = True
                 try:
                     entry = jitted.lower(state, feed_arrays, rng).compile()
                 except OpLoweringError:
                     raise  # user graph error (missing feed, bad shape, ...)
                 except Exception as e:
                     global _aot_warned
+                    aot_ok = False
                     if not _aot_warned:
                         _aot_warned = True
                         warnings.warn(
@@ -271,6 +288,11 @@ class Executor:
                             "run of each program" % (type(e).__name__, e)
                         )
                     entry = jitted  # fall back to the tracing path
+                if aot_ok and disk_key is not None:
+                    # persist the AOT artifact so the NEXT process (crash
+                    # resume, repeat bench) skips this compile entirely
+                    compile_cache.store(
+                        disk_key, jitted, (state, feed_arrays, rng))
                 dt_compile = time.monotonic() - t_compile
                 obs.observe("executor.compile_seconds", dt_compile)
                 obs.event("compile_done", source="executor", count=False,
@@ -311,6 +333,25 @@ class Executor:
                 return list(fetches)
 
     # ------------------------------------------------------------------
+    def run_pipelined(self, program=None, feeds=None, fetch_list=None,
+                      scope=None, return_numpy=True, depth=None,
+                      window=None):
+        """Pipelined step loop: returns an iterable of per-step fetch
+        lists where host-side feed conversion + device transfer for
+        batch N+1 overlap device compute for batch N (double-buffered
+        staging thread), and fetches materialize lazily behind a bounded
+        in-flight window. ``feeds`` is an iterable of feed dicts, or
+        None to pull from the program's started py_reader until EOF.
+        Step results are bit-identical to calling :meth:`run` in a loop
+        — same feed preparation, same PRNG sequence, same dispatch
+        order. See :mod:`paddle_tpu.fluid.async_pipeline`."""
+        from .async_pipeline import PipelinedRunner
+
+        return PipelinedRunner(
+            self, program, feeds, fetch_list, scope,
+            return_numpy=return_numpy, depth=depth, window=window)
+
+    # ------------------------------------------------------------------
     def _run_dataset_scan(self, program, feed, k, scope):
         """Run ``k`` program steps in ONE device dispatch: the feed
         holds k stacked minibatches (leading dim k*bs) and the jitted
@@ -343,12 +384,24 @@ class Executor:
             tuple(sorted((n, v.shape, str(v.dtype))
                          for n, v in state.items())),
         )
+        platform = "cpu" if isinstance(self.place, core.CPUPlace) \
+            else "tpu"
         entry = self._cache_lookup(sig)
+        disk_key = None
+        if entry is None and compile_cache.enabled():
+            try:
+                disk_key = compile_cache.entry_key(
+                    program, list(stacked.keys()), [], sig[4], sig[5],
+                    platform, kind="dataset_scan:%d" % k)
+            except compile_cache.Unfingerprintable:
+                disk_key = None
+            else:
+                entry = compile_cache.load(disk_key)
+                if entry is not None:
+                    self._cache_store(sig, entry)
         if entry is None:
             obs.inc("executor.cache_miss")
             t_compile = time.monotonic()
-            platform = "cpu" if isinstance(self.place, core.CPUPlace) \
-                else "tpu"
             step = build_step_fn(program, list(feed_arrays.keys()), [],
                                  platform=platform)
             state_keys = frozenset(state.keys())
@@ -384,6 +437,9 @@ class Executor:
                 raise OpLoweringError(
                     "dataset scan compile failed (%s: %s)"
                     % (type(e).__name__, str(e)[:200]))
+            if disk_key is not None:
+                compile_cache.store(disk_key, jitted,
+                                    (state, stacked, rngs))
             obs.observe("executor.compile_seconds",
                         time.monotonic() - t_compile)
             self._cache_store(sig, entry)
@@ -415,6 +471,9 @@ class Executor:
                 feed[seq_name] = np.full(
                     (shape[0],), shape[1], dtype="int32"
                 )
+        dev = self.place.jax_device()
+        ready = {}   # already device-resident (or device-bound) values
+        host = {}    # host arrays, transferred in ONE batched device_put
         for name, value in feed.items():
             value = getattr(value, "_ndarray", value)  # LoDTensor shim
             want = None
@@ -422,20 +481,30 @@ class Executor:
                 var = block.var(name)
                 if var.dtype is not None:
                     want = core.np_dtype(var.dtype)
-            dev = self.place.jax_device()
             if isinstance(value, jax.Array):
-                # already-device-resident feeds pass through without a
-                # host round-trip (device_put is a no-op on the same
-                # device) — re-feeding the same batch costs nothing, which
-                # matters when the chip is reached over a network tunnel
+                # already-device-resident feeds skip the host round-trip
+                # entirely: a committed array on the target device passes
+                # through untouched — re-feeding the same batch costs
+                # nothing, which matters when the chip is reached over a
+                # network tunnel
                 if want is not None and value.dtype != want:
                     value = value.astype(want)
-                out[name] = jax.device_put(value, dev)
+                if getattr(value, "committed", False) \
+                        and dev in value.devices():
+                    ready[name] = value
+                else:
+                    ready[name] = jax.device_put(value, dev)
                 continue
             arr = np.asarray(value)
             if want is not None and arr.dtype != want:
                 arr = arr.astype(want)
-            out[name] = jax.device_put(arr, dev)
+            host[name] = arr
+        if host:
+            # one device_put for every host-side feed: batched transfers
+            # amortize the per-call dispatch overhead vs per-tensor puts
+            ready.update(jax.device_put(host, dev))
+        for name in feed:  # preserve feed order (part of the cache sig)
+            out[name] = ready[name]
         return out
 
     def _gather_state(self, program, scope):

@@ -70,6 +70,9 @@ class _ProgramReader:
         self._thread = None    # this epoch's producer thread
         self._generation = 0   # bumped by reset() so stale pumps abandon
         self._started = False
+        self._stage_place = None   # device staging (prefetch_to_device)
+        self._stage_depth = 2
+        self._staged = None        # device-resident queue, per generation
         program = default_main_program()
         program._py_readers = getattr(program, "_py_readers", [])
         program._py_readers.append(self)
@@ -145,11 +148,87 @@ class _ProgramReader:
 
         self._thread = threading.Thread(target=_pump, daemon=True)
         self._thread.start()
+        if self._stage_place is not None:
+            self._engage_staging()
+
+    def prefetch_to_device(self, place, depth=2):
+        """Enable device-side staging: a background thread pops host
+        batches off the producer queue and ``jax.device_put``s them
+        ahead of consumption into a second bounded queue (`depth` slots
+        — 2 is classic double buffering), so the Executor pops
+        device-resident arrays and the host→device transfer for batch
+        N+1 overlaps the compute of batch N. Staged device batches are
+        bound to the reader generation: ``reset()``/``restart()``
+        discards them (the invalidation resilience.TrainGuard relies on
+        after retries and warm-starts). Engages immediately when the
+        reader is already started, else on the next ``start()``."""
+        self._stage_place = place
+        self._stage_depth = max(1, int(depth))
+        if self._started and self._queue is not None \
+                and self._staged is None:
+            self._engage_staging()
+        return self
+
+    def _engage_staging(self):
+        import queue as _queue_mod
+        import threading
+
+        import numpy as np
+
+        gen = self._generation
+        q = self._queue
+        sq = _queue_mod.Queue(self._stage_depth)
+        self._staged = sq
+        dev = self._stage_place.jax_device()
+
+        def _sput(item):
+            while self._generation == gen:
+                try:
+                    sq.put(item, timeout=0.1)
+                    return True
+                except _queue_mod.Full:
+                    continue
+            return False
+
+        def _stage():
+            import jax
+
+            from ... import observability as obs
+
+            while self._generation == gen:
+                try:
+                    item = q.get(timeout=0.1)
+                except _queue_mod.Empty:
+                    continue
+                if item is None or (isinstance(item, tuple)
+                                    and len(item) == 2
+                                    and item[0] == "__error__"):
+                    _sput(item)   # sentinel/error passes through
+                    return
+                try:
+                    with obs.span("reader.stage_feed"):
+                        # stage plain arrays in ONE batched transfer;
+                        # LoDTensor shims (seq_lens riders) stay host-side
+                        # for the executor's expansion logic
+                        host = {k: v for k, v in dict(item).items()
+                                if isinstance(v, np.ndarray)}
+                        staged = dict(item)
+                        if host:
+                            staged.update(jax.device_put(host, dev))
+                except BaseException as e:  # surfaced at the consumer
+                    _sput(("__error__", e))
+                    return
+                if not _sput(staged):
+                    return
+
+        threading.Thread(target=_stage, daemon=True,
+                         name="%s-device-stager" % self._name).start()
 
     def reset(self):
-        self._generation += 1  # stale pump threads see this and abandon
+        self._generation += 1  # stale pump + stager threads abandon
         self._started = False
         self._queue = None
+        self._staged = None    # staged device batches are invalidated
 
     def restart(self):
         """reset() + start(): rebuild the producer thread on a fresh
@@ -174,6 +253,9 @@ class _ProgramReader:
         # (site "feed" in PADDLE_TPU_FAULT_SPEC); placed after the
         # started check so only real batch pops count
         fault_check("feed")
+        # with device staging engaged, the consumer pops device-resident
+        # batches from the staged queue (the stager drains self._queue)
+        q = self._staged if self._staged is not None else self._queue
         if obs.enabled():
             # queue depth BEFORE the pop: 0 here plus a long pop wait
             # below means the producer is the bottleneck (reader-bound
@@ -181,13 +263,13 @@ class _ProgramReader:
             # chip is the bottleneck
             import time as _time
 
-            obs.set_gauge("reader.queue_depth", self._queue.qsize())
+            obs.set_gauge("reader.queue_depth", q.qsize())
             t0 = _time.monotonic()
-            item = self._queue.get()
+            item = q.get()
             obs.observe("reader.pop_wait_seconds",
                         _time.monotonic() - t0)
         else:
-            item = self._queue.get()
+            item = q.get()
         if isinstance(item, tuple) and len(item) == 2 and \
                 item[0] == "__error__":
             self._started = False

@@ -509,18 +509,34 @@ class GuardedExecutor:
     @staticmethod
     def _nonfinite(fetches):
         for v in fetches:
+            if hasattr(v, "block_until_ready"):
+                # device array (return_numpy=False path): reduce on
+                # device and transfer ONE scalar instead of
+                # materializing the whole fetch host-side
+                if getattr(v.dtype, "kind", None) == "f":
+                    import jax.numpy as jnp
+
+                    if not bool(jnp.isfinite(v).all()):
+                        return True
+                continue
             arr = np.asarray(v)
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
                 return True
         return False
 
     # -- the guarded run -------------------------------------------------
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        """Executor.run under the guard. ``return_numpy=False`` passes
+        through: the StepReport then holds lazy device handles (no
+        per-step host materialization) and the non-finite guard checks
+        them with a device-side reduction instead of a full fetch."""
         attempt = 0
         while True:
             try:
                 fetches = self._invoke(
                     (program,), dict(feed=feed, fetch_list=fetch_list,
+                                     return_numpy=return_numpy,
                                      **kwargs))
                 break
             except self.NEVER_RETRY:
@@ -606,10 +622,32 @@ class TrainGuard:
                  save_every=0, final_save=True, resume=True, scope=None,
                  reader_restarts=2, restart_on_eof=True, max_to_keep=None,
                  save_wait=True, on_event=None, log_maxlen=10000,
-                 recorder=None, **guard_opts):
+                 recorder=None, compile_cache=False, stage_to_device=False,
+                 **guard_opts):
         self._exe = executor
         self._program = program
         self._ckpt_dir = ckpt_dir
+        # compile_cache=True co-locates a persistent AOT compile cache
+        # with the checkpoints (parallel.checkpoint.compile_cache_dir):
+        # a crash-resumed process then skips the cold recompile the same
+        # way it skips completed steps. A string names an explicit cache
+        # dir; PADDLE_TPU_COMPILE_CACHE_DIR in the env always wins.
+        if compile_cache:
+            from . import compile_cache as _cc
+
+            if isinstance(compile_cache, str):
+                cache_path = compile_cache
+            else:
+                from ..parallel import checkpoint as _ckpt_mod
+
+                if not ckpt_dir:
+                    raise ValueError(
+                        "TrainGuard(compile_cache=True) needs ckpt_dir "
+                        "to co-locate the cache (or pass an explicit "
+                        "cache path string)")
+                cache_path = _ckpt_mod.compile_cache_dir(ckpt_dir)
+            _cc.activate(cache_path, configure_xla_cache=False)
+        self._stage_to_device = bool(stage_to_device)
         self._fetch_list = fetch_list
         self._feed_fn = feed_fn
         self._readers = list(readers or [])
@@ -662,6 +700,18 @@ class TrainGuard:
         self.log.emit("restore", step=step, vars=restored,
                       dirname=self._ckpt_dir,
                       seconds=round(time.monotonic() - t0, 6))
+        # warm-start invalidation: batches staged (host or device-side)
+        # before the restore belong to the pre-crash stream position —
+        # restart started readers so nothing stale is consumed. Emitted
+        # as its own event kind so it never burns the reader_restarts
+        # failure budget.
+        started = [r for r in self._readers
+                   if getattr(r, "_started", False)]
+        if started:
+            for r in started:
+                r.restart()
+            self.log.emit("staging_invalidate", step=step,
+                          reason="resume", readers=len(started))
         return int(step)
 
     def save(self, step, program=None, scope=None):
@@ -693,6 +743,14 @@ class TrainGuard:
         """Run steps until `num_steps` have completed (counting steps
         finished by a previous crashed run). Returns a summary dict."""
         program, scope = self._resolve()
+        if self._stage_to_device:
+            # overlap host→device batch transfer with device compute
+            # (layers/io.py device staging; generation-bound, so the
+            # reader restarts below also invalidate staged batches)
+            for r in self._readers:
+                stage = getattr(r, "prefetch_to_device", None)
+                if stage is not None:
+                    stage(self._exe.place)
         start = self._maybe_resume(program, scope)
         completed = start
         last_saved = start if start else None

@@ -17,10 +17,23 @@ env-flag check and an early return — no allocation, no lock — so the
 instrumentation stays compiled into the hot paths permanently.
 
 Read side: ``snapshot()`` (nested dict), ``render_prom()`` (Prometheus
-text), ``get_recorder().dump_jsonl(path)`` (the event ring), and crash
-dumps written automatically on uncaught exceptions (see
-``recorder.install_excepthook``). ``reset()`` clears the hub AND the
-ring — tests use it to scope assertions to a scripted session.
+text), single-metric probes ``counter(name)`` / ``gauge(name)`` /
+``histogram(name)``, ``get_recorder().dump_jsonl(path)`` (the event
+ring), and crash dumps written automatically on uncaught exceptions
+(see ``recorder.install_excepthook``). ``reset()`` clears the hub AND
+the ring — tests use it to scope assertions to a scripted session.
+
+Well-known executor fast-path metrics (PR 4):
+
+- ``compile_cache.disk_hit`` / ``disk_miss`` / ``corrupt`` / ``store``
+  / ``store_error`` counters and ``compile_cache.deserialize_seconds``
+  / ``serialize_seconds`` histograms — the persistent AOT compile
+  cache's disk tier (``fluid.compile_cache``).
+- ``executor.overlap_ratio`` gauge — fraction of feed-staging seconds
+  that overlapped an in-flight step in the last pipelined run
+  (``Executor.run_pipelined``); ``span.executor.stage_feed.seconds`` /
+  ``span.reader.stage_feed.seconds`` histograms time the staging
+  itself.
 
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
@@ -42,6 +55,7 @@ __all__ = [
     "Telemetry", "Histogram", "FlightRecorder", "get_telemetry",
     "get_recorder", "span", "active_spans", "current_span", "mode",
     "enabled", "trace_enabled", "inc", "observe", "set_gauge", "event",
+    "counter", "gauge", "histogram",
     "snapshot", "render_prom", "reset", "install_excepthook",
     "crash_dump_path", "TELEMETRY_ENV", "CRASH_DUMP_ENV",
     "OFF", "ON", "TRACE",
@@ -94,6 +108,23 @@ def event(kind, source=None, recorder=None, count=True, **fields):
 
 
 # -- read side --------------------------------------------------------------
+
+def counter(name):
+    """Current value of one counter (0 when never bumped) — the cheap
+    single-metric probe tests and bench reporting use instead of a full
+    snapshot()."""
+    return _telemetry._hub.counter(name)
+
+
+def gauge(name):
+    """Current value of one gauge, or None when never set."""
+    return _telemetry._hub.gauge(name)
+
+
+def histogram(name):
+    """Summary dict of one histogram, or None when never observed."""
+    return _telemetry._hub.histogram(name)
+
 
 def snapshot():
     return _telemetry._hub.snapshot()

@@ -26,11 +26,27 @@ import numpy as np
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
            "restore_latest", "finalize", "verify_checkpoint", "all_steps",
            "worker_dir", "mark_save_complete", "latest_consensus_step",
-           "restore_latest_consensus", "CONSENSUS_DIR"]
+           "restore_latest_consensus", "CONSENSUS_DIR",
+           "compile_cache_dir", "COMPILE_CACHE_SUBDIR"]
 
 # managers kept open across saves so async writes can complete in the
 # background; finalize()/Executor.close()/process exit flushes them
 _managers = {}
+
+# The persistent AOT compile cache rides next to the checkpoints it
+# warm-starts: a crash-resumed trainer finds BOTH its state and its
+# compiled executables under the one run directory. The subdir name is
+# non-numeric so the step-scanning read paths (all_steps, orbax's
+# layout walk) never mistake it for a checkpoint step.
+COMPILE_CACHE_SUBDIR = "compile-cache"
+
+
+def compile_cache_dir(dirname):
+    """The co-located persistent compile-cache directory for checkpoint
+    root `dirname` (see ``fluid.compile_cache`` /
+    ``TrainGuard(compile_cache=True)``). Layout helper only — nothing is
+    created until the executor stores an entry."""
+    return os.path.join(dirname, COMPILE_CACHE_SUBDIR)
 
 
 def _manager(dirname, max_to_keep=None):

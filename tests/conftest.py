@@ -25,6 +25,11 @@ def pytest_configure(config):
         "markers",
         "multihost: spawns real worker subprocesses (jax.distributed / "
         "FileStore fleets); needs free ports + process spawn headroom")
+    config.addinivalue_line(
+        "markers",
+        "perf: performance-path tests (compile-cache warm starts, "
+        "pipelined dispatch); `pytest -m perf` is the perf smoke lane "
+        "bench_experiments/warm_start_lane.sh runs")
 
 
 @pytest.fixture(autouse=True)

@@ -133,6 +133,82 @@ def test_missing_feed_raises():
         raise AssertionError("expected error for missing feed")
 
 
+def test_cache_eviction_order_respects_recency(monkeypatch):
+    """The LRU is a true LRU: a cache HIT refreshes the entry's
+    recency, so the next over-cap insert evicts the least-recently-USED
+    signature, not the least-recently-inserted one."""
+    from paddle_tpu import observability as obs
+
+    monkeypatch.setenv("PADDLE_TPU_EXECUTOR_CACHE_CAP", "2")
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "on")
+    x = fluid.data(name="ex", shape=[None, 4], dtype="float32")
+    out = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def run(batch):
+        return exe.run(feed={"ex": np.ones((batch, 4), "float32")},
+                       fetch_list=[out])[0]
+
+    def cached_batches():
+        # sig[2] is the sorted feed signature: ((name, shape, dtype),)
+        return sorted(sig[2][0][1][0] for sig in exe._cache)
+
+    evicts0 = obs.counter("executor.cache_evict")
+    run(1)
+    run(2)
+    assert cached_batches() == [1, 2]
+    run(1)                       # HIT: batch-1 becomes most recent
+    run(3)                       # over cap: batch-2 is now the oldest
+    assert cached_batches() == [1, 3]
+    assert obs.counter("executor.cache_evict") - evicts0 == 1
+
+
+def test_failed_dispatch_evicts_exactly_once(monkeypatch):
+    """A dispatch failure may have consumed the donated state buffers,
+    so the executor evicts the (possibly poisoned) entry — exactly one
+    ``executor.cache_evict`` bump — and a retry recompiles cleanly."""
+    from paddle_tpu import observability as obs
+
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "on")
+    x = fluid.data(name="fx", shape=[None, 4], dtype="float32")
+    out = fluid.layers.scale(x, scale=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"fx": np.ones((2, 4), "float32")}
+    exe.run(feed=feed, fetch_list=[out])
+    assert len(exe._cache) == 1
+    sig = next(iter(exe._cache))
+
+    def boom(*args):
+        raise RuntimeError("poisoned executable")
+
+    exe._cache[sig] = boom
+    evicts0 = obs.counter("executor.cache_evict")
+    try:
+        exe.run(feed=feed, fetch_list=[out])
+    except RuntimeError as e:
+        assert "poisoned" in str(e)
+    else:
+        raise AssertionError("expected the dispatch failure to surface")
+    assert obs.counter("executor.cache_evict") - evicts0 == 1
+    assert sig not in exe._cache
+    # the guarded-retry path: a re-run recompiles and succeeds
+    o = exe.run(feed=feed, fetch_list=[out])[0]
+    np.testing.assert_allclose(o, 3.0)
+    assert obs.counter("executor.cache_evict") - evicts0 == 1
+
+
+def test_return_numpy_false_returns_lazy_handles():
+    _, y = _simple_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (out,) = exe.run(feed={"x": np.ones((3, 4), "float32")},
+                     fetch_list=[y], return_numpy=False)
+    assert hasattr(out, "block_until_ready"), "expected a lazy jax array"
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((3, 2), 4 * 0.5 + 0.1, "float32"),
+                               rtol=1e-6)
+
+
 def test_executor_cache_lru_bound(monkeypatch):
     """The compile cache is LRU-bounded (each entry pins an XLA
     executable); distinct feed signatures beyond the cap evict oldest."""
