@@ -826,6 +826,85 @@ def _measure_nmt_decode(batch=32, src_len=32, max_out_len=48, beam=4,
     }
 
 
+def _measure_serving(n_clients=8, n_requests=160):
+    """Serving-engine throughput smoke (ISSUE 5): a tiny fc predictor
+    behind the micro-batching ServingEngine, mixed-shape concurrent
+    clients; reports requests/sec, latency p50/p99, and how much the
+    batcher actually coalesced (gated by PADDLE_TPU_BENCH_SERVING=1)."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.inference import Predictor
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 9
+    x = fluid.data(name="x", shape=[None, 32], dtype="float32")
+    h = fluid.layers.fc(x, size=64, act="relu")
+    out = fluid.layers.fc(h, size=8, act="softmax")
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    with tempfile.TemporaryDirectory() as d:
+        fluid.io.save_inference_model(d, ["x"], [out], exe)
+        pred = Predictor.from_model(d)
+    engine = serving.ServingEngine(
+        pred, buckets=[serving.BucketSpec(
+            {"x": (32,)}, batch_sizes=(1, 2, 4, 8, 16))],
+        max_batch_size=16, max_wait_ms=1.0, queue_capacity=256,
+        name="bench")
+    engine.warmup()
+    rng = np.random.default_rng(0)
+    shapes = (1, 2, 3, 4)
+    feeds = [rng.standard_normal((r, 32)).astype("float32")
+             for r in shapes]
+    lat = []
+    lat_lock = threading.Lock()
+    per_client = max(1, n_requests // n_clients)
+
+    def client(i):
+        for k in range(per_client):
+            fv = feeds[(i + k) % len(feeds)]
+            t0 = time.monotonic()
+            engine.predict({"x": fv})
+            dt = time.monotonic() - t0
+            with lat_lock:
+                lat.append(dt)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.monotonic() - t0
+    engine.stop(drain=True)
+    lat.sort()
+    stats = engine.stats()
+    waste = obs.histogram("serving.padding_waste") or {}
+    return {
+        "clients": n_clients,
+        "requests": len(lat),
+        "requests_per_sec": round(len(lat) / dt, 1),
+        "rows_per_sec": round(stats["rows"] / dt, 1),
+        "p50_ms": round(1000 * lat[len(lat) // 2], 3),
+        "p99_ms": round(
+            1000 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3),
+        "batches": stats["batches"],
+        "coalesced_batches": stats["coalesced"],
+        "mean_rows_per_batch": round(
+            stats["rows"] / max(1, stats["batches"]), 2),
+        "padding_waste_mean": round(waste.get("mean", 0.0) or 0.0, 4),
+    }
+
+
 def _bank(st, variant, cfg, on_accel, backend, device_kind):
     peak_v = _peak_flops(device_kind)
     if peak_v:
@@ -1031,6 +1110,17 @@ def child_main(status_path):
     if on_accel and st.data["best"] is not None:
         _run_aux([k for k in AUX_MEASURE_KEYS
                   if k not in st.data["detail"]], gate=0.72)
+
+    if os.environ.get("PADDLE_TPU_BENCH_SERVING"):
+        # serving lane (ISSUE 5): micro-batched inference throughput,
+        # detail-only — the banked headline stays training
+        st.stage("serving")
+        try:
+            st.data["detail"]["serving"] = _measure_serving()
+            st.flush()
+        except Exception as e:  # noqa: BLE001
+            st.error("serving failed: %s: %s"
+                     % (type(e).__name__, str(e)[:300]))
 
     tel_out = os.environ.get("PADDLE_TPU_BENCH_TELEMETRY_OUT")
     if tel_out:

@@ -16,6 +16,7 @@ from . import batch  # noqa: F401
 batch = batch.batch
 from . import observability  # noqa: F401  paddle.observability.* (hub)
 from . import fluid  # noqa: F401
+from . import serving  # noqa: F401  paddle.serving.* (online inference)
 from . import dataset  # noqa: F401
 from . import distributed  # noqa: F401
 from . import compat  # noqa: F401

@@ -35,6 +35,13 @@ Programs that cannot be fingerprinted stably (e.g. ``py_func`` ops
 holding Python callables) or whose export fails (unexportable custom
 calls) silently skip the disk tier — the in-memory LRU still works.
 
+Consumers: ``executor.run`` and ``_run_dataset_scan`` (training step
+executables), ``fluid.inference.Predictor`` (``kind="predict"``
+entries, one per feed-shape signature), and through the predictor the
+serving engine's shape-bucket warmup (``paddle_tpu.serving``) — a
+restarted server deserializes its whole bucket ladder instead of
+compiling.
+
 Telemetry (``paddle_tpu.observability``): ``compile_cache.disk_hit`` /
 ``disk_miss`` / ``corrupt`` / ``store`` / ``store_error`` counters and
 ``compile_cache.deserialize_seconds`` / ``serialize_seconds``
@@ -53,7 +60,7 @@ from .. import observability as obs
 
 __all__ = [
     "CACHE_DIR_ENV", "Unfingerprintable", "activate", "cache_dir",
-    "enabled", "entry_key", "load", "program_fingerprint", "store",
+    "enabled", "entry_key", "has", "load", "program_fingerprint", "store",
 ]
 
 CACHE_DIR_ENV = "PADDLE_TPU_COMPILE_CACHE_DIR"
@@ -220,6 +227,14 @@ class _DiskEntry:
 
 def _entry_path(key):
     return os.path.join(cache_dir(), key + _SUFFIX)
+
+
+def has(key):
+    """Whether an artifact for `key` is on disk, without deserializing
+    it (and without touching the hit/miss counters) — the cheap probe
+    warm-start reporting uses. False when the disk tier is off."""
+    d = cache_dir()
+    return d is not None and os.path.exists(_entry_path(key))
 
 
 def load(key):

@@ -15,14 +15,24 @@ weights + host feeds).
 Also covers the reference's TensorRT-style engine notion: the "engine" is
 the compiled XLA executable; `predictor.profile()` reports compile/run
 stats.
+
+Engines resolve through ``fluid.compile_cache``'s disk tier when it is
+active (``PADDLE_TPU_COMPILE_CACHE_DIR`` or ``compile_cache.activate``):
+a fresh process deserializes the AOT artifact per feed signature instead
+of paying XLA — the warm-start substrate ``paddle_tpu.serving`` builds
+its pre-warmed shape buckets on. ``_get_exec`` is thread-safe: concurrent
+callers of one signature serialize on a per-signature lock (one compile),
+while different signatures compile in parallel.
 """
+import threading
 import time
 
 import numpy as np
 
-from . import core
-from .executor import Executor, global_scope
+from . import compile_cache, core
+from .executor import Executor, Scope, global_scope
 from .lowering import build_step_fn
+from .. import observability as obs
 
 __all__ = ["Predictor", "create_paddle_predictor"]
 
@@ -63,49 +73,144 @@ class Predictor:
             return fetches
 
         self._fwd = fwd
+        self._platform = platform
         self._compiled = {}  # shape signature -> executable
         self.compile_seconds = {}
+        # check-then-compile must be atomic per signature: without the
+        # locks, N concurrent first callers of one shape all pay (and
+        # race to publish) the same XLA compile
+        self._lock = threading.Lock()
+        self._sig_locks = {}
+        self._state_sig = tuple(sorted(
+            (k, tuple(v.shape), str(v.dtype)) for k, v in persist.items()))
+        # feed dtype coercion targets (mirrors Executor._prepare_feeds):
+        # convert ONCE at the prepare step, never again downstream
+        block = program.global_block()
+        self._want_dtypes = {}
+        for n in self.feed_names:
+            want = None
+            if block.has_var(n):
+                var = block.var(n)
+                if var.dtype is not None:
+                    want = core.np_dtype(var.dtype)
+            self._want_dtypes[n] = want
 
     @classmethod
     def from_model(cls, dirname, model_filename=None, params_filename=None,
                    **kw):
-        """Load a save_inference_model directory (ref api: load + build)."""
+        """Load a save_inference_model directory (ref api: load + build).
+
+        Params land in a **private scope** per predictor (unless an
+        explicit ``scope=`` is passed): two loaded models with
+        overlapping var names — every default-named ``fc_0.w_0``, every
+        BN stat — must not clobber each other through the process-wide
+        ``global_scope()``."""
         from .io import load_inference_model
 
         exe = Executor(core.CPUPlace())
+        scope = kw.pop("scope", None)
+        if scope is None:
+            scope = Scope()
         program, feed_names, fetch_vars = load_inference_model(
-            dirname, exe, model_filename, params_filename
+            dirname, exe, model_filename, params_filename, scope=scope
         )
-        return cls(program, feed_names, fetch_vars, **kw)
+        return cls(program, feed_names, fetch_vars, scope=scope, **kw)
 
-    def _sig(self, feeds):
-        return tuple(
-            (n, tuple(np.asarray(feeds[n]).shape),
-             str(np.asarray(feeds[n]).dtype))
+    def _prepare(self, feeds):
+        """Normalize one request: dict (or feed_names-aligned list) ->
+        ({name: array}, shape signature). Each feed is converted at most
+        ONCE — committed device arrays pass through untouched instead of
+        bouncing off the host — and coerced to the program's declared
+        feed dtype."""
+        if not isinstance(feeds, dict):
+            feeds = dict(zip(self.feed_names, feeds))
+        jax = self._jax
+        prepared = {}
+        for n in self.feed_names:
+            v = feeds[n]
+            want = self._want_dtypes.get(n)
+            if isinstance(v, jax.Array):
+                if want is not None and v.dtype != want:
+                    v = v.astype(want)
+            else:
+                v = np.asarray(v)
+                if want is not None and v.dtype != want:
+                    v = v.astype(want)
+            prepared[n] = v
+        sig = tuple(
+            (n, tuple(prepared[n].shape), str(prepared[n].dtype))
             for n in self.feed_names
         )
+        return prepared, sig
+
+    def _sig(self, feeds):
+        return self._prepare(feeds)[1]
 
     def _get_exec(self, feeds):
-        sig = self._sig(feeds)
+        prepared, sig = self._prepare(feeds)
+        return self._ensure_exec(sig, prepared)[0]
+
+    def _ensure_exec(self, sig, prepared):
+        """The executable for `sig`, building it if needed. Returns
+        ``(executable, source)`` with source one of ``"memory"`` /
+        ``"disk"`` (compile-cache tier hit, no XLA) / ``"compile"``."""
         ex = self._compiled.get(sig)
-        if ex is None:
+        if ex is not None:
+            return ex, "memory"
+        with self._lock:
+            sig_lock = self._sig_locks.setdefault(sig, threading.Lock())
+        with sig_lock:
+            ex = self._compiled.get(sig)
+            if ex is not None:  # lost the race: the winner already built it
+                return ex, "memory"
             jax = self._jax
-            t0 = time.time()
-            lowered = jax.jit(self._fwd).lower(self._state, feeds)
-            ex = lowered.compile()
-            self.compile_seconds[sig] = time.time() - t0
-            self._compiled[sig] = ex
-        return ex
+            source = "compile"
+            disk_key = None
+            if compile_cache.enabled():
+                try:
+                    disk_key = compile_cache.entry_key(
+                        self.program, self.feed_names, self.fetch_names,
+                        sig, self._state_sig, self._platform,
+                        kind="predict")
+                except compile_cache.Unfingerprintable:
+                    disk_key = None
+                else:
+                    ex = compile_cache.load(disk_key)
+                    if ex is not None:
+                        source = "disk"
+            if ex is None:
+                obs.event("compile_start", source="predictor", count=False,
+                          sig=repr(sig))
+                t0 = time.monotonic()
+                jitted = jax.jit(self._fwd)
+                ex = jitted.lower(self._state, prepared).compile()
+                dt = time.monotonic() - t0
+                self.compile_seconds[sig] = dt
+                obs.observe("predictor.compile_seconds", dt)
+                obs.event("compile_done", source="predictor", count=False,
+                          sig=repr(sig), seconds=round(dt, 6))
+                if disk_key is not None:
+                    compile_cache.store(
+                        disk_key, jitted, (self._state, prepared))
+            with self._lock:
+                self._compiled[sig] = ex
+            return ex, source
+
+    def warm(self, feeds):
+        """Ensure the executable for this feed signature exists without
+        dispatching it; returns where it came from (``"memory"`` /
+        ``"disk"`` / ``"compile"``). The serving engine pre-warms its
+        shape buckets through this at model-load time."""
+        prepared, sig = self._prepare(feeds)
+        return self._ensure_exec(sig, prepared)[1]
 
     def run(self, feeds, return_numpy=True):
         """feeds: dict name -> array (or list aligned with feed_names)."""
-        if not isinstance(feeds, dict):
-            feeds = dict(zip(self.feed_names, feeds))
-        feeds = {n: np.asarray(feeds[n]) for n in self.feed_names}
-        outs = self._get_exec(feeds)(self._state, feeds)
+        prepared, sig = self._prepare(feeds)
+        outs = self._ensure_exec(sig, prepared)[0](self._state, prepared)
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
-        return outs
+        return list(outs)
 
     __call__ = run
 

@@ -182,15 +182,21 @@ def load_inference_model(
     model_filename=None,
     params_filename=None,
     pserver_endpoints=None,
+    scope=None,
 ):
-    """ref io.py:load_inference_model → (program, feed_names, fetch_vars)."""
+    """ref io.py:load_inference_model → (program, feed_names, fetch_vars).
+
+    `scope` selects where the params land (default: the process-wide
+    ``global_scope()``, reference semantics). ``Predictor.from_model``
+    passes a private scope so multiple loaded models with overlapping
+    var names stay isolated."""
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename)) as f:
         meta = json.load(f)
     program = Program.from_json(json.dumps(meta["program"]))
     # load params into scope
     data = _load_npz(dirname, params_filename or "__params__.npz")
-    scope = global_scope()
+    scope = scope if scope is not None else global_scope()
     for name in data.files:
         scope.set(name, np.asarray(data[name]))
     fetch_vars = [

@@ -35,6 +35,20 @@ Well-known executor fast-path metrics (PR 4):
   ``span.reader.stage_feed.seconds`` histograms time the staging
   itself.
 
+Well-known serving metrics (PR 5, ``paddle_tpu.serving``):
+
+- ``serving.queue_wait_seconds`` / ``serving.batch_size`` /
+  ``serving.batch_rows`` / ``serving.padding_waste`` /
+  ``serving.request_seconds`` histograms — per coalesced micro-batch
+  and per request through the ServingEngine.
+- ``serving.shed`` / ``serving.deadline_miss`` counters — admission
+  control rejects; every reject also records a flight-recorder event
+  (kinds ``shed`` / ``deadline_miss``, source ``serving``).
+- ``serving.queue_depth.<model>`` gauge, and
+  ``predictor.compile_seconds`` histogram with ``compile_start`` /
+  ``compile_done`` events (source ``predictor``) — absent entirely on
+  a compile-cache warm start.
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

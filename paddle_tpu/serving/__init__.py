@@ -1,0 +1,46 @@
+"""paddle_tpu.serving — TPU-native online inference.
+
+The training side of this stack keeps the chip saturated with one
+AOT-compiled executable per program signature; this package does the
+same for *traffic*: concurrent requests coalesce into padded
+micro-batches ahead of pre-warmed per-bucket executables, so serving
+cost scales with batches dispatched, not requests received.
+
+Layers::
+
+    ModelRegistry          named models, isolated scopes, atomic hot reload
+      └─ ServingEngine     bounded queue + dispatch thread, dynamic
+                           micro-batching, deadlines, load shedding
+           └─ Predictor    AOT executable per shape bucket, pre-warmed
+                           through fluid.compile_cache (restart == warm)
+    ServingServer          stdlib HTTP/JSON frontend
+                           (/v1/models/<name>:predict, /healthz, /metrics)
+
+Quick start::
+
+    from paddle_tpu import serving
+
+    reg = serving.ModelRegistry(max_batch_size=16, max_wait_ms=2.0)
+    reg.load("mnist", "/models/mnist",
+             buckets=[serving.BucketSpec({"img": (784,)},
+                                         batch_sizes=(1, 2, 4, 8, 16))])
+    server = serving.ServingServer(reg, port=8500).start()
+
+Well-known telemetry (``paddle_tpu.observability``):
+``serving.queue_wait_seconds`` / ``batch_size`` / ``batch_rows`` /
+``padding_waste`` / ``request_seconds`` histograms,
+``serving.shed`` / ``serving.deadline_miss`` counters (each reject also
+lands in the flight recorder), ``serving.queue_depth.<model>`` gauges.
+"""
+from .batcher import BucketSpec, round_up_pow2, tail_signature  # noqa: F401
+from .engine import (  # noqa: F401
+    DeadlineExceededError, EngineClosedError, ServingEngine, ShedError,
+)
+from .http import ServingHandler, ServingServer  # noqa: F401
+from .registry import ModelRegistry  # noqa: F401
+
+__all__ = [
+    "BucketSpec", "DeadlineExceededError", "EngineClosedError",
+    "ModelRegistry", "ServingEngine", "ServingHandler", "ServingServer",
+    "ShedError", "round_up_pow2", "tail_signature",
+]

@@ -1,0 +1,340 @@
+"""ServingEngine: dynamic micro-batching over an AOT-compiled Predictor.
+
+One bounded request queue + one dispatch thread per model. Concurrent
+``submit()`` calls enqueue requests; the dispatch thread coalesces them
+into micro-batches (flushing on ``max_batch_size`` rows or
+``max_wait_ms``, whichever comes first), pads each same-tail-shape
+group up to a declared :class:`~paddle_tpu.serving.batcher.BucketSpec`
+batch size, runs ONE pre-warmed AOT executable per bucket, and slices
+per-request rows back into each caller's future. ``warmup()`` compiles
+every declared (bucket, batch size) through the predictor's
+compile-cache disk tier, so a restarted server deserializes the AOT
+artifacts instead of paying XLA again (zero ``compile_start`` events on
+a warm start).
+
+Admission control (the resilience posture of PR 1, applied to serving):
+
+- **load shedding** — a full queue fast-rejects at ``submit()`` with
+  :class:`ShedError` (HTTP 429 upstream) instead of building unbounded
+  latency;
+- **deadlines** — a request whose ``deadline_ms`` expires while queued
+  is dropped at dispatch with :class:`DeadlineExceededError` (504)
+  rather than burning chip time on an answer nobody is waiting for;
+- **graceful drain** — ``stop(drain=True)`` rejects new work, finishes
+  everything queued, then parks the dispatch thread.
+
+Telemetry: ``serving.queue_wait_seconds`` / ``serving.batch_size`` /
+``serving.batch_rows`` / ``serving.padding_waste`` /
+``serving.request_seconds`` histograms, ``serving.shed`` and
+``serving.deadline_miss`` counters (every reject also lands in the
+flight recorder), and a ``serving.queue_depth.<model>`` gauge.
+"""
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+from .. import observability as obs
+from .batcher import assemble, round_up_pow2, tail_signature
+
+__all__ = [
+    "DeadlineExceededError", "EngineClosedError", "ServingEngine",
+    "ShedError",
+]
+
+
+class ShedError(RuntimeError):
+    """Fast-reject: the bounded request queue is full (load shedding)."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline expired while it waited in the queue."""
+
+
+class EngineClosedError(RuntimeError):
+    """The engine is stopped or draining; no new work is admitted."""
+
+
+class _Request:
+    __slots__ = ("feeds", "rows", "sig", "deadline", "future", "t_enqueue")
+
+
+class ServingEngine:
+    """Micro-batching dispatch loop around one Predictor (one model
+    version — :class:`~paddle_tpu.serving.registry.ModelRegistry` swaps
+    whole engines for hot reload)."""
+
+    def __init__(self, predictor, buckets=(), max_batch_size=8,
+                 max_wait_ms=2.0, queue_capacity=64,
+                 default_deadline_ms=None, request_timeout_s=60.0,
+                 name="default", auto_start=True):
+        self._predictor = predictor
+        self.name = str(name)
+        self._max_batch_size = int(max_batch_size)
+        self._max_wait_s = float(max_wait_ms) / 1000.0
+        self._default_deadline_ms = default_deadline_ms
+        self.request_timeout_s = float(request_timeout_s)
+        self._q = queue.Queue(maxsize=int(queue_capacity))
+        self._bucket_specs = tuple(buckets)
+        self._buckets = {
+            spec.signature(): spec.batch_sizes for spec in self._bucket_specs
+        }
+        self._stop_event = threading.Event()
+        self._closed = False
+        self._thread = None
+        self._stats_lock = threading.Lock()
+        self._stats = collections.Counter()
+        if auto_start:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        """Start the dispatch thread (idempotent)."""
+        if self._closed:
+            raise EngineClosedError("engine %r is closed" % self.name)
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="serving-dispatch-%s" % self.name)
+            self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        """Stop admitting work; with ``drain=True`` finish everything
+        already queued first, else fail queued requests with
+        :class:`EngineClosedError`. Idempotent."""
+        self._closed = True
+        alive = self._thread is not None and self._thread.is_alive()
+        if drain and alive:
+            t_end = time.monotonic() + float(timeout)
+            while not self._q.empty() and time.monotonic() < t_end:
+                time.sleep(0.005)
+        self._stop_event.set()
+        if alive:
+            self._thread.join(timeout=max(0.1, float(timeout)))
+        # anything still queued (no thread, or a non-drain stop that
+        # beat the loop to them) fails loudly rather than hanging
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            r.future.set_exception(EngineClosedError(
+                "engine %r stopped before dispatch" % self.name))
+        obs.event("engine_stop", source="serving", count=False,
+                  model=self.name, drained=bool(drain))
+
+    # -- admission -------------------------------------------------------
+    def submit(self, feeds, deadline_ms=None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the per-request fetch list (rows sliced back out of
+        the coalesced batch). Raises :class:`ShedError` immediately when
+        the queue is full and :class:`EngineClosedError` after
+        ``stop()``."""
+        if self._closed:
+            raise EngineClosedError(
+                "engine %r is draining/stopped" % self.name)
+        prepared, _ = self._predictor._prepare(feeds)
+        if not prepared:
+            raise ValueError("empty request: no feeds")
+        rows = int(next(iter(prepared.values())).shape[0])
+        for n, v in prepared.items():
+            if int(v.shape[0]) != rows:
+                raise ValueError(
+                    "feed %r has %d rows but %r has %d — all feeds must "
+                    "share the leading batch dim"
+                    % (n, v.shape[0], self._predictor.feed_names[0], rows))
+        if rows < 1:
+            raise ValueError("empty request: 0 rows")
+        req = _Request()
+        req.feeds = prepared
+        req.rows = rows
+        req.sig = tail_signature(prepared)
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        req.deadline = (
+            time.monotonic() + float(deadline_ms) / 1000.0
+            if deadline_ms is not None else None)
+        req.future = Future()
+        req.t_enqueue = time.monotonic()
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self._bump("shed")
+            obs.event("shed", source="serving", model=self.name, rows=rows,
+                      queue_capacity=self._q.maxsize)
+            raise ShedError(
+                "serving queue full (%d) for model %r — request shed"
+                % (self._q.maxsize, self.name))
+        self._bump("requests")
+        obs.set_gauge("serving.queue_depth.%s" % self.name, self._q.qsize())
+        return req.future
+
+    def predict(self, feeds, deadline_ms=None, timeout=None):
+        """Synchronous submit + wait: returns the fetch list for this
+        request's rows."""
+        fut = self.submit(feeds, deadline_ms=deadline_ms)
+        return fut.result(
+            timeout if timeout is not None else self.request_timeout_s)
+
+    # -- warmup ----------------------------------------------------------
+    def warmup(self):
+        """Pre-build one executable per declared (bucket, batch size)
+        through the predictor's compile-cache disk tier. On a restarted
+        server every entry resolves from disk — ``source == "disk"``,
+        zero ``compile_start`` events. Returns the per-entry report."""
+        report = []
+        for spec in self._bucket_specs:
+            for b in spec.batch_sizes:
+                source = self._predictor.warm(spec.feeds_for(b))
+                report.append({
+                    "signature": spec.signature(), "batch_size": b,
+                    "source": source,
+                })
+        if report:
+            obs.event(
+                "warmup", source="serving", count=False, model=self.name,
+                engines=len(report),
+                compiled=sum(1 for r in report if r["source"] == "compile"),
+                disk_warm=sum(1 for r in report if r["source"] == "disk"))
+        return report
+
+    # -- dispatch --------------------------------------------------------
+    def _loop(self):
+        carry = None  # request popped but not fitting the last batch
+        while True:
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    if self._stop_event.is_set():
+                        return
+                    continue
+            batch = [first]
+            rows = first.rows
+            t_flush = time.monotonic() + self._max_wait_s
+            while rows < self._max_batch_size:
+                remaining = t_flush - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    r = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if rows + r.rows > self._max_batch_size:
+                    # would overshoot the bucket ladder: starts the NEXT
+                    # micro-batch instead of forcing an ad-hoc shape
+                    carry = r
+                    break
+                batch.append(r)
+                rows += r.rows
+            obs.set_gauge(
+                "serving.queue_depth.%s" % self.name, self._q.qsize())
+            self._execute(batch)
+
+    def _execute(self, batch):
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                self._bump("deadline_miss")
+                waited_ms = round(1000 * (now - r.t_enqueue), 3)
+                obs.event("deadline_miss", source="serving",
+                          model=self.name, rows=r.rows,
+                          waited_ms=waited_ms)
+                r.future.set_exception(DeadlineExceededError(
+                    "deadline expired after %s ms in queue (model %r)"
+                    % (waited_ms, self.name)))
+            else:
+                live.append(r)
+        groups = collections.OrderedDict()
+        for r in live:
+            groups.setdefault(r.sig, []).append(r)
+        for sig, reqs in groups.items():
+            self._run_group(sig, reqs)
+
+    def _bucket_rows(self, sig, rows):
+        """The padded batch size for `rows` rows of tail-shape `sig`:
+        the smallest declared bucket that fits, exact when the request
+        outgrows every bucket, next-pow2 (capped at max_batch_size) for
+        undeclared shapes."""
+        declared = self._buckets.get(sig)
+        if declared:
+            for b in declared:
+                if b >= rows:
+                    return b
+            return rows
+        if rows >= self._max_batch_size:
+            return rows
+        return min(round_up_pow2(rows), self._max_batch_size)
+
+    def _run_group(self, sig, reqs):
+        t0 = time.monotonic()
+        rows = sum(r.rows for r in reqs)
+        target = self._bucket_rows(sig, rows)
+        for r in reqs:
+            obs.observe("serving.queue_wait_seconds", t0 - r.t_enqueue)
+        try:
+            feeds = assemble(self._predictor.feed_names, reqs, target)
+            outs = self._predictor.run(feeds, return_numpy=True)
+            for o in outs:
+                if getattr(o, "ndim", 0) < 1 or o.shape[0] != target:
+                    raise ValueError(
+                        "fetch output shape %s is not row-aligned with "
+                        "the %d-row batch — ServingEngine needs per-row "
+                        "outputs to slice results back to requests"
+                        % (getattr(o, "shape", None), target))
+        except Exception as e:  # noqa: BLE001 — fail the requests, not the loop
+            self._bump("batch_errors")
+            obs.event("batch_error", source="serving", model=self.name,
+                      rows=rows, error="%s: %s"
+                      % (type(e).__name__, str(e)[:200]))
+            for r in reqs:
+                r.future.set_exception(e)
+            return
+        self._bump("batches")
+        if len(reqs) > 1:
+            self._bump("coalesced")
+        self._bump("rows", rows)
+        obs.observe("serving.batch_size", len(reqs))
+        obs.observe("serving.batch_rows", rows)
+        obs.observe("serving.padding_waste", (target - rows) / float(target))
+        done = time.monotonic()
+        off = 0
+        for r in reqs:
+            # copy the slices: a view would pin the whole padded batch
+            # (and every other request's rows) in memory for as long as
+            # the caller holds its result
+            r.future.set_result(
+                [o[off:off + r.rows].copy() for o in outs])
+            off += r.rows
+            obs.observe("serving.request_seconds", done - r.t_enqueue)
+
+    # -- introspection ---------------------------------------------------
+    def _bump(self, key, n=1):
+        with self._stats_lock:
+            self._stats[key] += n
+
+    def stats(self):
+        """Local lifetime counters (independent of the telemetry mode):
+        requests/shed/deadline_miss/batches/coalesced/rows/batch_errors."""
+        with self._stats_lock:
+            out = dict(self._stats)
+        for k in ("requests", "shed", "deadline_miss", "batches",
+                  "coalesced", "rows", "batch_errors"):
+            out.setdefault(k, 0)
+        return out
+
+    def queue_depth(self):
+        return self._q.qsize()
+
+    @property
+    def predictor(self):
+        return self._predictor
+
+    @property
+    def closed(self):
+        return self._closed

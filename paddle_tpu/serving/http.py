@@ -1,0 +1,212 @@
+"""Stdlib HTTP/JSON frontend over a ModelRegistry.
+
+Endpoints (``http.server.ThreadingHTTPServer`` — one thread per
+connection blocks on its request's future while the single dispatch
+thread per model does the batching):
+
+- ``POST /v1/models/<name>:predict`` — body
+  ``{"feeds": {"x": [[...]]}, "dtypes": {"x": "float32"}?,
+  "deadline_ms": 50?, "timeout_s": 10?}``; replies
+  ``{"outputs": [{"data": ..., "shape": ..., "dtype": ...}]}``.
+  Feed dtypes default to the model's declared var dtypes (ints arriving
+  as JSON numbers coerce to the program's int32/int64), so a plain
+  nested-list payload round-trips bit-exact for float32 models.
+- ``GET /healthz`` — ``{"status": "ok", "models": {...}}`` with
+  per-model version, queue depth, and lifetime counters.
+- ``GET /metrics`` — the telemetry hub's Prometheus text
+  (``render_prom()``): serving histograms with p50/p90/p99 quantiles,
+  shed/deadline-miss counters, queue-depth gauges.
+
+Status mapping (the admission-control surface): 429 shed (queue full),
+504 deadline missed or wait timeout, 503 draining/stopped, 404 unknown
+model, 400 malformed request.
+
+Standalone entry point::
+
+    python -m paddle_tpu.serving.http --model mnist=/models/mnist \
+        --port 8500 --max-batch-size 16 --max-wait-ms 2
+"""
+import json
+import re
+import threading
+import time
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import observability as obs
+from .engine import DeadlineExceededError, EngineClosedError, ShedError
+
+__all__ = ["ServingHandler", "ServingServer", "main"]
+
+_PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
+
+
+class ServingHandler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-serving/0.1"
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        pass  # request logging goes through the telemetry hub, not stderr
+
+    def _send_json(self, code, doc):
+        body = json.dumps(doc).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — stdlib handler name
+        if self.path == "/healthz":
+            self._send_json(200, {
+                "status": "ok",
+                "models": self.server.registry.info(),
+            })
+        elif self.path == "/metrics":
+            body = obs.render_prom().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._send_json(404, {"error": "not found: %s" % self.path})
+
+    def do_POST(self):  # noqa: N802 — stdlib handler name
+        m = _PREDICT_RE.match(self.path)
+        if not m:
+            return self._send_json(
+                404, {"error": "not found: %s (expected "
+                               "/v1/models/<name>:predict)" % self.path})
+        name = m.group(1)
+        engine = self.server.registry.get(name)
+        if engine is None:
+            return self._send_json(404, {"error": "unknown model %r" % name})
+        import numpy as np
+
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            raw = body["feeds"]
+            dtypes = body.get("dtypes") or {}
+            feeds = {
+                k: (np.asarray(v, dtype=np.dtype(dtypes[k]))
+                    if k in dtypes else np.asarray(v))
+                for k, v in raw.items()
+            }
+            deadline_ms = body.get("deadline_ms")
+            timeout_s = body.get("timeout_s")
+        except (ValueError, KeyError, TypeError) as e:
+            return self._send_json(
+                400, {"error": "bad request: %s: %s"
+                               % (type(e).__name__, e)})
+        try:
+            fut = engine.submit(feeds, deadline_ms=deadline_ms)
+        except ShedError as e:
+            return self._send_json(429, {"error": str(e)})
+        except EngineClosedError as e:
+            return self._send_json(503, {"error": str(e)})
+        except (ValueError, KeyError) as e:
+            return self._send_json(
+                400, {"error": "bad request: %s: %s"
+                               % (type(e).__name__, e)})
+        try:
+            outs = fut.result(
+                timeout_s if timeout_s is not None
+                else engine.request_timeout_s)
+        except DeadlineExceededError as e:
+            return self._send_json(504, {"error": str(e)})
+        except _FutureTimeout:
+            return self._send_json(
+                504, {"error": "timed out waiting for model %r" % name})
+        except EngineClosedError as e:
+            return self._send_json(503, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — model errors -> 500, not a dead conn
+            return self._send_json(
+                500, {"error": "%s: %s" % (type(e).__name__, e)})
+        self._send_json(200, {"outputs": [
+            {"data": o.tolist(), "shape": list(o.shape),
+             "dtype": str(o.dtype)}
+            for o in outs
+        ]})
+
+
+class ServingServer:
+    """ThreadingHTTPServer bound to a ModelRegistry; ``start()`` serves
+    on a background thread, ``stop()`` shuts it down (and optionally
+    drains the registry)."""
+
+    def __init__(self, registry, host="127.0.0.1", port=0):
+        self.registry = registry
+        self._httpd = ThreadingHTTPServer((host, int(port)), ServingHandler)
+        self._httpd.registry = registry
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = int(self._httpd.server_address[1])
+        self._thread = None
+
+    @property
+    def url(self):
+        return "http://%s:%d" % (self.host, self.port)
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                daemon=True, name="serving-http")
+            self._thread.start()
+            obs.event("http_start", source="serving", count=False,
+                      host=self.host, port=self.port)
+        return self
+
+    def stop(self, close_registry=False):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if close_registry:
+            self.registry.close()
+
+
+def main(argv=None):
+    """CLI: serve one or more save_inference_model dirs over HTTP."""
+    import argparse
+
+    from .registry import ModelRegistry
+
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.serving.http",
+        description="JSON/HTTP serving frontend for paddle_tpu models")
+    p.add_argument("--model", action="append", required=True,
+                   metavar="NAME=DIR",
+                   help="model name=save_inference_model dir (repeatable)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--queue-capacity", type=int, default=64)
+    args = p.parse_args(argv)
+
+    registry = ModelRegistry(
+        max_batch_size=args.max_batch_size, max_wait_ms=args.max_wait_ms,
+        queue_capacity=args.queue_capacity)
+    for spec in args.model:
+        name, sep, dirname = spec.partition("=")
+        if not sep or not name or not dirname:
+            p.error("--model wants NAME=DIR, got %r" % spec)
+        registry.load(name, dirname)
+    server = ServingServer(registry, host=args.host, port=args.port).start()
+    print("serving %s on %s" % (", ".join(registry.names()), server.url),
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop(close_registry=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

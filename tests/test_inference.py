@@ -54,6 +54,39 @@ def test_engine_cache_per_shape(tmp_path):
     assert prof["n_params"] >= 4              # 2 weights + 2 biases
 
 
+def test_from_model_private_scope_does_not_pollute_global(tmp_path):
+    """PR 5 satellite: from_model loads params into a per-predictor
+    Scope, not the process-wide global_scope()."""
+    xv, ref = _build_and_save(tmp_path)
+    from paddle_tpu.fluid import executor as executor_mod
+
+    executor_mod._scope_stack[:] = [executor_mod.Scope()]
+    try:
+        pred = Predictor.from_model(str(tmp_path))
+        assert not list(fluid.global_scope().keys()), \
+            "inference load leaked params into global_scope()"
+        out, = pred.run({"x": xv})
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    finally:
+        executor_mod._scope_stack[:] = [executor_mod._global_scope]
+    # an explicit scope= still works for callers that want sharing
+    shared = executor_mod.Scope()
+    pred2 = Predictor.from_model(str(tmp_path), scope=shared)
+    assert list(shared.keys())
+    out2, = pred2.run({"x": xv})
+    np.testing.assert_allclose(out2, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_warm_sources(tmp_path):
+    """warm() reports memory/compile provenance and never double-builds
+    one signature."""
+    xv, _ = _build_and_save(tmp_path)
+    pred = Predictor.from_model(str(tmp_path))
+    assert pred.warm({"x": np.zeros_like(xv)}) == "compile"
+    assert pred.warm({"x": xv}) == "memory"   # same sig, values ignored
+    assert pred.profile()["n_engines"] == 1
+
+
 def test_analysis_config_predictor_path(tmp_path):
     """Deployment-script path: AnalysisConfig -> create_paddle_predictor
     (ref inference api), including the accepted no-op switches."""
