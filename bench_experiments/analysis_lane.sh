@@ -1,0 +1,103 @@
+#!/usr/bin/env bash
+# Analysis lane: the smoke for the static program analyzer (ISSUE 6).
+#
+#   bash bench_experiments/analysis_lane.sh
+#
+# Lane 1 runs the `analysis`-marked pytest slice (verifier, shape
+# checker, TPU-lint, scope sanitizer, CLI). Lane 2 is the
+# zero-dependency smoke: a model is trained + saved, the
+# `python -m paddle_tpu.analysis` CLI must lint it clean (exit 0) and
+# must flag a deliberately corrupted copy (exit 1, dangling input with
+# op attribution). Lane 3 prices the gate itself: a short training run
+# with PADDLE_TPU_ANALYSIS=verify, asserting the verifier's share of
+# wall time stays under 2% — the analyzer rides every first compile,
+# so its cost has to be noise.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PADDLE_TPU_TELEMETRY=on
+
+echo "== lane 1: analysis pytest slice =="
+python -m pytest -q -p no:cacheprovider -m analysis tests/
+
+echo "== lane 2: CLI over a saved model, clean + corrupted =="
+WORK_DIR="$(mktemp -d /tmp/paddle_tpu_analysis_lane.XXXXXX)"
+trap 'rm -rf "$WORK_DIR"' EXIT
+
+python - "$WORK_DIR" <<'EOF'
+import json
+import sys
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+
+work = sys.argv[1]
+fluid.default_startup_program().random_seed = 11
+x = fluid.data("x", [None, 16], dtype="float32")
+h = fluid.layers.fc(x, size=32, act="relu")
+out = fluid.layers.fc(h, size=4, act="softmax")
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+exe.run(feed={"x": np.ones((4, 16), np.float32)}, fetch_list=[out])
+fluid.io.save_inference_model(work + "/model", ["x"], [out], exe)
+
+# corrupted copy: an op reading a name nothing ever produces
+with open(work + "/model/__model__") as f:
+    doc = json.load(f)
+doc["program"]["blocks"][0]["ops"].append({
+    "type": "relu", "inputs": {"X": ["never_defined"]},
+    "outputs": {"Out": [doc["fetch_names"][0]]}, "attrs": {},
+})
+with open(work + "/bad_model.json", "w") as f:
+    json.dump(doc["program"], f)
+EOF
+
+if ! python -m paddle_tpu.analysis "$WORK_DIR/model" > "$WORK_DIR/clean.json"; then
+    echo "FAIL: CLI flagged the clean model"; cat "$WORK_DIR/clean.json"; exit 1
+fi
+echo "clean model: exit 0"
+
+set +e
+python -m paddle_tpu.analysis "$WORK_DIR/bad_model.json" > "$WORK_DIR/bad.json"
+RC=$?
+set -e
+if [ "$RC" -ne 1 ]; then
+    echo "FAIL: corrupted model exited $RC, want 1"; cat "$WORK_DIR/bad.json"; exit 1
+fi
+grep -q "dangling-input" "$WORK_DIR/bad.json" || {
+    echo "FAIL: no dangling-input diagnostic"; cat "$WORK_DIR/bad.json"; exit 1; }
+echo "corrupted model: exit 1 with dangling-input diagnostic"
+
+echo "== lane 3: verify-gate overhead under 2% of training wall =="
+python - <<'EOF'
+import time
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+
+t0 = time.monotonic()
+x = fluid.data("x", [None, 16], dtype="float32")
+y = fluid.data("y", [None, 1], dtype="float32")
+h = fluid.layers.fc(x, size=32, act="relu")
+pred = fluid.layers.fc(h, size=1)
+loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+rng = np.random.RandomState(0)
+for _ in range(30):
+    exe.run(feed={"x": rng.rand(8, 16).astype(np.float32),
+                  "y": rng.rand(8, 1).astype(np.float32)},
+            fetch_list=[loss])
+wall = time.monotonic() - t0
+h = obs.histogram("analysis.verify_seconds")
+assert h["count"] >= 1, "the verify gate never ran"
+share = h["sum"] / wall
+print("verify gate: %d run(s), %.4fs of %.3fs wall (%.2f%%)"
+      % (h["count"], h["sum"], wall, 100.0 * share))
+assert share < 0.02, "verify gate costs %.2f%% > 2%%" % (100.0 * share)
+EOF
+
+echo "analysis lane OK"

@@ -1,0 +1,63 @@
+"""paddle_tpu.analysis — static program analysis gating every compile.
+
+Three passes over the symbolic Program IR plus one runtime guard:
+
+- :mod:`.verifier` — structural verification (use-before-def, dangling
+  vars, uninitialized persistables, fetch reachability, dead code,
+  control-flow sub-block sanity). Pure-python walk; the executor and
+  predictor run it on every first compile (``PADDLE_TPU_ANALYSIS``,
+  default ``verify``).
+- :mod:`.shapes` — static shape/dtype propagation by running each op's
+  lowering under ``jax.eval_shape`` (the lowering registry IS the
+  inference rule set); mismatches report the op's recorded python
+  callstack before XLA ever runs.
+- :mod:`.tpu_lint` — TPU-shape hazards: unpadded matmul/conv lanes,
+  float64 creep, donated-buffer-also-fetched, host syncs inside scan
+  bodies, collectives without deadlines, shape-vocabulary blowups.
+- :mod:`.sanitizer` — opt-in cross-thread Scope mutation detector
+  (``PADDLE_TPU_SCOPE_SANITIZER=on``).
+
+Entry points: :func:`analyze` (all passes), :func:`verify` (structural
+only), the ``python -m paddle_tpu.analysis <model_dir>`` CLI, and the
+wired-in gates in ``Executor``/``Predictor``/``GuardedExecutor``.
+
+Submodules load lazily (PEP 562): importing ``paddle_tpu.analysis``
+costs nothing until a pass is actually used, and the stdlib-only
+:mod:`.sanitizer` stays importable without jax.
+"""
+
+__all__ = [
+    "analyze", "verify", "mode", "ANALYSIS_ENV",
+    "AnalysisReport", "Diagnostic", "ProgramVerifyError",
+    "analyzer", "verifier", "shapes", "tpu_lint", "walker",
+    "diagnostics", "sanitizer", "cli",
+]
+
+_LAZY_ATTRS = {
+    "analyze": ("analyzer", "analyze"),
+    "mode": ("analyzer", "mode"),
+    "ANALYSIS_ENV": ("analyzer", "ANALYSIS_ENV"),
+    "verify": ("verifier", "verify"),
+    "AnalysisReport": ("diagnostics", "AnalysisReport"),
+    "Diagnostic": ("diagnostics", "Diagnostic"),
+    "ProgramVerifyError": ("diagnostics", "ProgramVerifyError"),
+}
+
+_SUBMODULES = ("analyzer", "verifier", "shapes", "tpu_lint", "walker",
+               "diagnostics", "sanitizer", "cli")
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY_ATTRS:
+        mod_name, attr = _LAZY_ATTRS[name]
+        mod = importlib.import_module("." + mod_name, __name__)
+        return getattr(mod, attr)
+    if name in _SUBMODULES:
+        return importlib.import_module("." + name, __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
+def __dir__():
+    return sorted(__all__)

@@ -1,0 +1,67 @@
+"""Analysis orchestration: one entry point over the passes + the
+executor's verify-on-first-compile mode switch.
+
+``PADDLE_TPU_ANALYSIS`` selects what gates a compile:
+
+- ``off``    — no analysis (bit-for-bit the pre-analyzer executor).
+- ``verify`` — (default) the structural verifier only: a pure-python
+  walk, microseconds even on big programs, catching everything that
+  would die at lowering time with attributed diagnostics instead.
+- ``full``   — verifier + abstract shape/dtype propagation + TPU-lint.
+  Costs one ``jax.eval_shape`` per op; meant for CI lanes, the CLI, and
+  first-failure triage (GuardedExecutor re-runs it on a failed
+  dispatch), not for every interactive run.
+"""
+import os
+
+from .diagnostics import AnalysisReport
+from . import verifier
+
+__all__ = ["analyze", "mode", "ANALYSIS_ENV", "MODES"]
+
+ANALYSIS_ENV = "PADDLE_TPU_ANALYSIS"
+MODES = ("off", "verify", "full")
+
+
+def mode(default="verify"):
+    """Current analysis mode, env-driven (live read, like telemetry)."""
+    m = os.environ.get(ANALYSIS_ENV, default).lower() or default
+    return m if m in MODES else default
+
+
+def analyze(program, feed_names=(), fetch_names=(), state_names=None,
+            feed_specs=None, state_specs=None, platform="cpu",
+            level="full", is_test=False, default_dim=None):
+    """Run the analyzer at ``level`` ("verify" | "full").
+
+    Returns an :class:`AnalysisReport` merging every pass that ran.
+    ``feed_specs``/``state_specs`` (name -> array-like or
+    ShapeDtypeStruct) make the shape pass exact; omitted, shapes derive
+    from declared var metadata with -1 dims defaulted.
+    """
+    report = AnalysisReport()
+    report.extend(verifier.verify(
+        program, feed_names=feed_names, fetch_names=fetch_names,
+        state_names=state_names))
+    if level == "full" and not report.errors:
+        # shape propagation assumes structural well-formedness; on a
+        # broken program the verifier errors are the actionable output
+        from . import shapes, tpu_lint
+
+        if feed_specs is None and feed_names:
+            # derive specs for the caller's ACTUAL feed list — it may
+            # feed vars that are not declared is_data (hand-built
+            # programs), and those must enter the abstract env or every
+            # op reading them is silently skipped as unresolvable
+            feed_specs = shapes.feed_specs_from_program(
+                program, feed_names=list(feed_names),
+                default_dim=default_dim)
+        env, shape_report = shapes.propagate(
+            program, feed_specs=feed_specs, state_specs=state_specs,
+            is_test=is_test, platform=platform, default_dim=default_dim)
+        report.extend(shape_report)
+        report.extend(tpu_lint.lint(
+            program, shape_env=env, feed_names=feed_names,
+            fetch_names=fetch_names, state_names=state_names,
+            platform=platform))
+    return report

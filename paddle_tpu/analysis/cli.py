@@ -1,0 +1,112 @@
+"""``python -m paddle_tpu.analysis <program|model_dir>`` — lint saved
+inference models (or raw Program JSON) without touching an executor.
+
+Exit codes: 0 clean, 1 findings (errors+warnings; tune with
+``--fail-on``), 2 usage/load failure. Output is a stable JSON report
+(sorted keys, deterministically ordered diagnostics, no timestamps) so
+CI lanes can diff it; ``--text`` renders for humans.
+"""
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["main"]
+
+
+def _load_target(path):
+    """Resolve a CLI target to (program, feed_names, fetch_names,
+    state_specs)."""
+    import numpy as np
+
+    from ..fluid.framework import Program
+
+    model_file = path
+    params_file = None
+    if os.path.isdir(path):
+        model_file = os.path.join(path, "__model__")
+        if not os.path.exists(model_file):
+            raise IOError(
+                "%s is a directory without a __model__ file — expected a "
+                "save_inference_model dir" % path)
+        cand = os.path.join(path, "__params__.npz")
+        params_file = cand if os.path.exists(cand) else None
+    with open(model_file) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "program" in doc:
+        # save_inference_model meta: {program, feed_names, fetch_names}
+        program = Program.from_json(json.dumps(doc["program"]))
+        feed_names = list(doc.get("feed_names") or [])
+        fetch_names = list(doc.get("fetch_names") or [])
+    else:
+        # raw Program.to_json dump
+        program = Program.from_json(json.dumps(doc))
+        feed_names, fetch_names = [], []
+    state_specs = None
+    if params_file is not None:
+        data = np.load(params_file, allow_pickle=False)
+        state_specs = {n: data[n] for n in data.files}
+    return program, feed_names, fetch_names, state_specs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="Statically verify + shape-check + TPU-lint a saved "
+                    "inference model or Program JSON.")
+    ap.add_argument("target",
+                    help="save_inference_model dir, __model__ meta file, "
+                         "or Program.to_json dump")
+    ap.add_argument("--platform", choices=("tpu", "cpu"), default="tpu",
+                    help="lint target platform (default: tpu — the "
+                         "deployment target)")
+    ap.add_argument("--level", choices=("verify", "full"), default="full")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="placeholder for -1 feed dims (default: 8)")
+    ap.add_argument("--text", action="store_true",
+                    help="human-readable report instead of JSON")
+    ap.add_argument("--fail-on", choices=("findings", "error", "never"),
+                    default="findings",
+                    help="what makes the exit code nonzero "
+                         "(default: findings = errors+warnings)")
+    args = ap.parse_args(argv)
+
+    try:
+        program, feed_names, fetch_names, state_specs = _load_target(
+            args.target)
+    except Exception as e:  # noqa: BLE001 — CLI boundary
+        print("error: cannot load %s: %s: %s"
+              % (args.target, type(e).__name__, e), file=sys.stderr)
+        return 2
+
+    from .analyzer import analyze
+
+    # saved models are inference programs: analyze in test mode
+    report = analyze(
+        program, feed_names=feed_names, fetch_names=fetch_names,
+        state_names=set(state_specs) if state_specs is not None else None,
+        state_specs=state_specs, platform=args.platform, level=args.level,
+        is_test=True, default_dim=args.batch)
+
+    doc = {
+        "target": args.target,
+        "platform": args.platform,
+        "level": args.level,
+        "report": report.to_dict(),
+    }
+    if args.text:
+        print("target: %s (platform %s, level %s)"
+              % (args.target, args.platform, args.level))
+        print(str(report))
+    else:
+        print(json.dumps(doc, sort_keys=True, indent=2))
+
+    if args.fail_on == "never":
+        return 0
+    if args.fail_on == "error":
+        return 1 if report.errors else 0
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

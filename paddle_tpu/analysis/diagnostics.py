@@ -1,0 +1,201 @@
+"""Diagnostic records + analysis report.
+
+Every analysis pass (verifier, shape propagation, TPU-lint) emits
+:class:`Diagnostic` records into an :class:`AnalysisReport`. Severity
+taxonomy:
+
+- ``error``   — the program would provably fail at lowering/compile time
+                (missing input value, un-computable fetch, broken
+                sub-block reference, shape-inference failure). The
+                executor raises :class:`ProgramVerifyError` on these
+                BEFORE handing anything to XLA.
+- ``warning`` — well-formed but hazardous (float64 creep on TPU,
+                donated-buffer-also-fetched, host callbacks inside scan
+                regions, unbounded shape vocabulary). Counted as
+                *findings* by the CLI (nonzero exit) but never blocks a
+                run.
+- ``perf``    — TPU efficiency hints (matmul/conv dims not padded to
+                the 8/128 lane grid). Informational for small models by
+                design: a lane-padding hint must not fail a smoke lint.
+- ``info``    — observations (dead ops/vars relative to the fetch
+                targets, undeclared produced names).
+
+``findings`` = errors + warnings. ``to_json`` output is stable: records
+sorted on a deterministic key, ``sort_keys=True``, no timestamps.
+"""
+import json
+
+from ..fluid.lowering import OpLoweringError, _format_callstack
+
+__all__ = [
+    "Diagnostic", "AnalysisReport", "ProgramVerifyError",
+    "ERROR", "WARNING", "PERF", "INFO", "SEVERITIES",
+]
+
+ERROR = "error"
+WARNING = "warning"
+PERF = "perf"
+INFO = "info"
+SEVERITIES = (ERROR, WARNING, PERF, INFO)
+
+
+class ProgramVerifyError(OpLoweringError):
+    """A static verifier error: the program would fail at lowering time.
+
+    Subclasses :class:`OpLoweringError` so every caller that already
+    treats lowering errors as non-retryable user-graph errors
+    (``GuardedExecutor.NEVER_RETRY``, ``Executor.run``'s AOT fallback,
+    existing ``pytest.raises(OpLoweringError)`` tests) handles the
+    earlier, attributed failure identically.
+    """
+
+    def __init__(self, message, report=None):
+        super().__init__(message)
+        self.report = report
+
+
+class Diagnostic:
+    """One finding: (severity, check, message) + op/var attribution."""
+
+    __slots__ = ("severity", "check", "message", "block_idx", "op_index",
+                 "op_type", "var", "callstack")
+
+    def __init__(self, severity, check, message, block_idx=None,
+                 op_index=None, op_type=None, var=None, op=None):
+        if severity not in SEVERITIES:
+            raise ValueError("bad severity %r" % (severity,))
+        self.severity = severity
+        self.check = check
+        self.message = message
+        self.block_idx = block_idx
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var = var
+        self.callstack = None
+        if op is not None:
+            if op_type is None:
+                self.op_type = op.type
+            # the op's recorded python callstack: the build site (or the
+            # from_json load site) — how a finding maps back to user code
+            self.callstack = _format_callstack(op).split("\n")
+
+    def _key(self):
+        return (
+            SEVERITIES.index(self.severity),
+            self.block_idx if self.block_idx is not None else -1,
+            self.op_index if self.op_index is not None else -1,
+            self.check,
+            self.var or "",
+        )
+
+    def to_dict(self):
+        d = {"severity": self.severity, "check": self.check,
+             "message": self.message}
+        for k in ("block_idx", "op_index", "op_type", "var", "callstack"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+    def __str__(self):
+        loc = ""
+        if self.block_idx is not None:
+            loc = " [block %s" % self.block_idx
+            if self.op_index is not None:
+                loc += " op %s" % self.op_index
+            if self.op_type is not None:
+                loc += " '%s'" % self.op_type
+            loc += "]"
+        s = "%s(%s)%s: %s" % (self.severity, self.check, loc, self.message)
+        if self.callstack:
+            s += "\n  defined at:\n" + "\n".join(self.callstack)
+        return s
+
+    __repr__ = __str__
+
+
+class AnalysisReport:
+    """Accumulated diagnostics for one analyzed program."""
+
+    def __init__(self, checks=None):
+        self.diagnostics = []
+        self.checks = list(checks or [])  # pass names that actually ran
+        self.meta = {}  # stable program facts (n_blocks, n_ops, ...)
+
+    # -- emit -----------------------------------------------------------
+    def add(self, severity, check, message, **kw):
+        d = Diagnostic(severity, check, message, **kw)
+        self.diagnostics.append(d)
+        return d
+
+    def extend(self, other):
+        self.diagnostics.extend(other.diagnostics)
+        for c in other.checks:
+            if c not in self.checks:
+                self.checks.append(c)
+        self.meta.update(other.meta)
+        return self
+
+    # -- query ----------------------------------------------------------
+    def by_severity(self, severity):
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def errors(self):
+        return self.by_severity(ERROR)
+
+    @property
+    def findings(self):
+        """Errors + warnings — what 'lint clean' means and what makes
+        the CLI exit nonzero. perf/info records never count."""
+        return [d for d in self.diagnostics
+                if d.severity in (ERROR, WARNING)]
+
+    def counts(self):
+        c = {s: 0 for s in SEVERITIES}
+        for d in self.diagnostics:
+            c[d.severity] += 1
+        return c
+
+    def summary(self):
+        c = self.counts()
+        parts = ["%d %s" % (c[s], s) for s in SEVERITIES if c[s]]
+        head = ", ".join(parts) if parts else "clean"
+        worst = next((d for d in sorted(self.diagnostics,
+                                        key=lambda d: d._key())), None)
+        if worst is not None:
+            head += " | first: %s(%s) %s" % (
+                worst.severity, worst.check, worst.message)
+        return head
+
+    def raise_if_errors(self):
+        errs = self.errors
+        if not errs:
+            return self
+        msg = "program verification failed with %d error(s):\n\n%s" % (
+            len(errs), "\n\n".join(str(d) for d in errs[:8]))
+        if len(errs) > 8:
+            msg += "\n\n... and %d more" % (len(errs) - 8)
+        raise ProgramVerifyError(msg, report=self)
+
+    # -- render ---------------------------------------------------------
+    def to_dict(self):
+        return {
+            "checks": sorted(self.checks),
+            "counts": self.counts(),
+            "findings": len(self.findings),
+            "meta": dict(self.meta),
+            "diagnostics": [
+                d.to_dict()
+                for d in sorted(self.diagnostics, key=lambda d: d._key())
+            ],
+        }
+
+    def to_json(self, indent=None):
+        return json.dumps(self.to_dict(), sort_keys=True, indent=indent)
+
+    def __str__(self):
+        lines = ["analysis: %s" % self.summary()]
+        for d in sorted(self.diagnostics, key=lambda d: d._key()):
+            lines.append(str(d))
+        return "\n".join(lines)

@@ -1,0 +1,95 @@
+"""Runtime scope sanitizer: cross-thread Scope mutation detector.
+
+Three subsystems mutate Scopes from background threads — the serving
+dispatch thread (``serving/engine.py``), the async-pipeline stager
+(``fluid/async_pipeline.py``), and guarded/watchdog runs
+(``fluid/resilience.py``). Each is designed single-writer-per-scope; a
+refactor that silently breaks that invariant corrupts training state in
+ways that surface steps later as NaNs or stale params.
+
+Opt-in (``PADDLE_TPU_SCOPE_SANITIZER=on`` or :func:`arm`): every
+``Scope.set``/``Scope.update`` records the writing thread per
+``(scope, var)``. A write from a different thread while the previous
+writer is STILL ALIVE is an unsynchronized cross-thread mutation —
+recorded as a violation with both threads and the write-site stacks.
+Sequential handoff (previous writer already exited, e.g. a finished
+watchdog worker) transfers ownership silently: that is a
+happens-before edge, not a race.
+
+Off (the default), the hook in ``Scope`` is a single module-bool check.
+Stdlib-only (+observability) so the executor can import it at module
+level without accelerator init.
+"""
+import os
+import threading
+import traceback
+
+from .. import observability as obs
+
+__all__ = ["armed", "arm", "disarm", "record_write", "violations",
+           "reset", "SANITIZER_ENV"]
+
+SANITIZER_ENV = "PADDLE_TPU_SCOPE_SANITIZER"
+
+# the hot-path gate: Scope.set/update check this single bool
+_on = os.environ.get(SANITIZER_ENV, "").lower() in ("1", "on", "true")
+
+_lock = threading.Lock()
+_writers = {}     # (id(scope), name) -> (thread, stack_summary)
+_violations = []
+
+
+def armed():
+    return _on
+
+
+def arm():
+    """Enable tracking (tests / debugging sessions)."""
+    global _on
+    _on = True
+
+
+def disarm():
+    global _on
+    _on = False
+
+
+def record_write(scope, name):
+    """Called by Scope.set/update when armed. Never raises."""
+    me = threading.current_thread()
+    stack = traceback.extract_stack(limit=7)[:-2]
+    key = (id(scope), name)
+    with _lock:
+        prev = _writers.get(key)
+        _writers[key] = (me, stack)
+        if prev is None:
+            return
+        prev_thread, prev_stack = prev
+        if prev_thread is me or not prev_thread.is_alive():
+            return
+        v = {
+            "var": name,
+            "scope": id(scope),
+            "threads": [prev_thread.name, me.name],
+            "stacks": [
+                ["%s:%d in %s" % (f.filename, f.lineno, f.name)
+                 for f in s[-3:]]
+                for s in (prev_stack, stack)
+            ],
+        }
+        _violations.append(v)
+    obs.event("scope_race", source="sanitizer", var=name,
+              threads="%s -> %s" % (prev_thread.name, me.name))
+
+
+def violations():
+    """Snapshot of recorded violations (list of dicts)."""
+    with _lock:
+        return list(_violations)
+
+
+def reset():
+    """Clear tracked writers + violations (does not change armed state)."""
+    with _lock:
+        _writers.clear()
+        del _violations[:]

@@ -1,0 +1,195 @@
+"""Static shape/dtype propagation.
+
+Instead of hand-writing hundreds of per-op inference rules, this pass
+reuses the op lowering registry (``fluid/lowering.py`` +
+``ops/registry.py``) exactly as the executor does — but under
+``jax.eval_shape``, which runs each lowering on abstract
+``ShapeDtypeStruct`` values: full shape/dtype semantics, zero FLOPs,
+zero XLA compiles. Every lowering is already jit-trace-safe (that is
+how the executor runs it), so tracing it abstractly per op is faithful
+by construction: anything this pass rejects, ``jax.jit`` would reject
+later with a far worse error; anything it infers, XLA would compute.
+
+Failures surface as ``shape-infer-failed`` errors carrying the op's
+recorded Python callstack — the mismatch is attributed to the line of
+user code that built the op, BEFORE any XLA compile is attempted.
+
+Dims declared ``-1`` (feed-time batch/sequence dims) resolve to
+``default_dim`` in standalone mode; the executor passes the real feed
+shapes instead.
+"""
+import numpy as np
+
+from ..fluid import lowering
+from ..fluid import core
+from ..ops.registry import LowerContext
+from .diagnostics import ERROR, WARNING, AnalysisReport
+
+__all__ = ["propagate", "feed_specs_from_program", "canonical_dtype"]
+
+DEFAULT_DIM = 8  # placeholder for -1 dims in standalone analysis
+
+
+def canonical_dtype(dtype):
+    """The dtype jax will actually materialize for a declared dtype:
+    without x64, int64/float64 silently become int32/float32 — declared
+    dtypes must be canonicalized before comparing against inferred ones
+    or every int64 label var would be a false mismatch."""
+    import jax
+
+    return np.dtype(jax.dtypes.canonicalize_dtype(core.np_dtype(dtype)))
+
+
+def _spec(shape, dtype, default_dim):
+    import jax
+
+    shape = tuple(default_dim if (s is None or s < 0) else int(s)
+                  for s in (shape or ()))
+    return jax.ShapeDtypeStruct(shape, canonical_dtype(dtype))
+
+
+def feed_specs_from_program(program, feed_names=None, default_dim=None):
+    """Abstract feed specs from declared var metadata (standalone mode):
+    every -1 dim becomes ``default_dim``; ``@SEQ_LEN`` companions are
+    added the way ``Executor._prepare_feeds`` would."""
+    default_dim = DEFAULT_DIM if default_dim is None else default_dim
+    gb = program.global_block()
+    if feed_names is None:
+        feed_names = [n for n, v in gb.vars.items() if v.is_data]
+    specs = {}
+    for n in feed_names:
+        if not gb.has_var(n):
+            continue
+        v = gb.var(n)
+        specs[n] = _spec(v.shape, v.dtype or "float32", default_dim)
+        seq = n + "@SEQ_LEN"
+        if gb.has_var(seq) and seq not in feed_names:
+            specs[seq] = _spec((specs[n].shape[0],), "int32", default_dim)
+    return specs
+
+
+def _state_specs_from_program(program, default_dim):
+    specs = {}
+    for name, v in program.global_block().vars.items():
+        if v.persistable and v.shape is not None:
+            specs[name] = _spec(v.shape, v.dtype or "float32", default_dim)
+    return specs
+
+
+def propagate(program, feed_specs=None, state_specs=None, is_test=False,
+              platform="cpu", default_dim=None, check_declared=True):
+    """Propagate shapes/dtypes through the global block op by op.
+
+    ``feed_specs`` / ``state_specs``: name -> ``jax.ShapeDtypeStruct``
+    (or anything with .shape/.dtype, e.g. real arrays). ``None`` derives
+    them from declared var metadata. Returns ``(env, report)`` where
+    ``env`` maps every resolved name to its inferred spec.
+    """
+    import jax
+
+    report = AnalysisReport(checks=["shapes"])
+    default_dim = DEFAULT_DIM if default_dim is None else default_dim
+    gb = program.global_block()
+
+    if feed_specs is None:
+        feed_specs = feed_specs_from_program(
+            program, default_dim=default_dim)
+    if state_specs is None:
+        state_specs = _state_specs_from_program(program, default_dim)
+
+    env = {}
+    for src in (state_specs, feed_specs):
+        for n, v in src.items():
+            env[n] = jax.ShapeDtypeStruct(tuple(v.shape),
+                                          np.dtype(v.dtype))
+
+    var_lookup = lowering._make_var_lookup(gb)
+    rng = jax.random.PRNGKey(0)
+    unknown = set()  # names whose spec is unknowable after a failure
+
+    for i, op in enumerate(gb.ops):
+        out_names = [n for ns in op.outputs.values() for n in ns]
+        in_names = [n for ns in op.inputs.values() for n in ns]
+        if any(n in unknown or n not in env for n in in_names):
+            # upstream failure (or verifier-reported missing input):
+            # poison downstream silently instead of cascading reports
+            unknown.update(out_names)
+            continue
+
+        if op.type == "backward":
+            # exact by vjp semantics: a cotangent has the shape/dtype of
+            # its primal — no replay needed
+            targets = list(op.attrs.get("targets") or [])
+            grads = op.output("Grads")
+            ok = True
+            for t, g in zip(targets, grads):
+                if t in env and t not in unknown:
+                    env[g] = env[t]
+                else:
+                    unknown.add(g)
+                    ok = False
+            if ok:
+                _check_outputs(gb, op, i, env, report, check_declared)
+            continue
+
+        def f(e, _op=op, _i=i):
+            ctx = LowerContext(rng=rng, is_test=is_test, program=program,
+                               platform=platform)
+            ctx.run_ops = lowering.run_ops
+            e = dict(e)
+            e = lowering.apply_op(_op, e, ctx, var_lookup, op_tag=_i)
+            return {n: e[n] for ns in _op.outputs.values()
+                    for n in ns if n in e}
+
+        try:
+            outs = jax.eval_shape(f, env)
+        except Exception as e:  # noqa: BLE001 — each failure is a finding
+            msg = str(e)
+            if len(msg) > 600:
+                msg = msg[:600] + " ..."
+            report.add(
+                ERROR, "shape-infer-failed",
+                "abstract evaluation of op '%s' failed (%s): %s"
+                % (op.type, type(e).__name__, msg),
+                block_idx=0, op_index=i, op=op)
+            unknown.update(out_names)
+            continue
+        for n, v in outs.items():
+            env[n] = jax.ShapeDtypeStruct(tuple(v.shape), np.dtype(v.dtype))
+        _check_outputs(gb, op, i, env, report, check_declared)
+
+    report.meta["n_resolved"] = len(env)
+    return env, report
+
+
+def _check_outputs(gb, op, i, env, report, check_declared):
+    """Compare inferred specs against declared Variable metadata."""
+    if not check_declared:
+        return
+    for ns in op.outputs.values():
+        for n in ns:
+            if n not in env or not gb.has_var(n):
+                continue
+            var = gb.var(n)
+            got = env[n]
+            if var.dtype is not None:
+                want = canonical_dtype(var.dtype)
+                if np.dtype(got.dtype) != want:
+                    report.add(
+                        WARNING, "dtype-mismatch",
+                        "var '%s' is declared %s (canonically %s) but the "
+                        "op produces %s" % (n, var.dtype, want.name,
+                                            np.dtype(got.dtype).name),
+                        block_idx=0, op_index=i, op=op, var=n)
+            decl = var.shape
+            if decl is None or len(decl) != len(got.shape):
+                continue  # rank drift in declared metadata is common
+            for ax, (d, g) in enumerate(zip(decl, got.shape)):
+                if d is not None and d >= 0 and int(d) != int(g):
+                    report.add(
+                        WARNING, "shape-mismatch",
+                        "var '%s' axis %d is declared %d but the op "
+                        "produces %d (inferred shape %s, declared %s)"
+                        % (n, ax, d, g, tuple(got.shape), tuple(decl)),
+                        block_idx=0, op_index=i, op=op, var=n)
+                    break

@@ -1,0 +1,213 @@
+"""TPU-lint: hazards a well-formed Program still ships to the chip.
+
+Severity policy (see diagnostics.py): lane-padding hints are ``perf``
+(a small smoke model must lint clean); float64 creep and missing
+collective deadlines are ``warning`` when linting for TPU and ``info``
+on CPU, so CPU-platform test programs stay finding-free while the CLI
+(which lints for deployment, platform ``tpu`` by default) flags them.
+"""
+from ..fluid import core
+from . import walker
+from .diagnostics import INFO, PERF, WARNING, AnalysisReport
+
+__all__ = ["lint"]
+
+# MXU is 128x128, VPU lanes are 8x128; a float32 tile is (8, 128)
+# (see the pallas guide) — XLA pads unaligned dims with dead lanes.
+SUBLANE, LANE = 8, 128
+
+# ops whose operands hit the MXU
+_MATMUL_OPS = {"mul", "matmul"}
+_CONV_OPS = {"conv2d", "depthwise_conv2d", "conv2d_transpose"}
+
+# ops that synchronize with the host python interpreter per call
+_HOST_SYNC_OPS = {"py_func"}
+
+# loop-body owners: a host sync inside these runs once per scan step
+_SCAN_OWNERS = {"while", "static_rnn", "dynamic_rnn"}
+
+_COLLECTIVE_EXTRA = {"barrier", "ppermute", "all_to_all"}
+
+# estimated compile-cache entries per dynamic feed axis (a pow2 bucket
+# ladder over one axis is ~8 rungs: 1..128)
+_BUCKETS_PER_AXIS = 8
+SHAPE_VOCAB_THRESHOLD = 2048
+
+
+def lint(program, shape_env=None, feed_names=(), fetch_names=(),
+         state_names=None, platform="tpu"):
+    """Lint a Program; returns an :class:`AnalysisReport`.
+
+    ``shape_env``: inferred name -> spec from :mod:`.shapes` (falls back
+    to declared var metadata when absent). ``state_names``: persistable
+    names the executor will donate (``None`` = every persistable).
+    """
+    report = AnalysisReport(checks=["tpu_lint"])
+    gb = program.global_block()
+    on_tpu = platform == "tpu"
+    shape_env = shape_env or {}
+
+    def shape_of(block, name):
+        v = shape_env.get(name)
+        if v is not None:
+            return tuple(v.shape)
+        blk = block
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name].shape
+            blk = blk.parent_block
+        return None
+
+    owners = walker.block_owners(program)
+
+    collectives = []
+    for block, i, op in walker.iter_ops(program):
+        # -- lane padding ---------------------------------------------------
+        if op.type in _MATMUL_OPS or op.type in _CONV_OPS:
+            _lint_tiling(block, i, op, shape_of, report)
+        # -- host sync inside scan regions ----------------------------------
+        if op.type in _HOST_SYNC_OPS and block.idx != 0:
+            owner = owners.get(block.idx)
+            if owner is not None and owner.type in _SCAN_OWNERS:
+                report.add(
+                    WARNING, "host-sync-in-scan",
+                    "op '%s' synchronizes with host python inside a "
+                    "'%s' body — every loop iteration stalls the device "
+                    "on a host round-trip; hoist it out of the loop or "
+                    "precompute its values as a feed"
+                    % (op.type, owner.type),
+                    block_idx=block.idx, op_index=i, op=op)
+        if op.type.startswith("c_") or op.type in _COLLECTIVE_EXTRA:
+            collectives.append((block, i, op))
+
+    # -- float64 creep ------------------------------------------------------
+    for name, v in gb.vars.items():
+        if v.dtype == core.VarType.FP64:
+            report.add(
+                WARNING if on_tpu else INFO, "float64-creep",
+                "var '%s' is declared float64: TPUs have no f64 units, "
+                "and without jax x64 the value is SILENTLY truncated to "
+                "float32 — declare float32 (or enable x64 off-TPU) so "
+                "precision loss is explicit" % name,
+                block_idx=0, var=name)
+
+    # -- donation/aliasing hazard -------------------------------------------
+    donated = set(state_names) if state_names is not None else {
+        n for n, v in gb.vars.items() if v.persistable}
+    produced = set()
+    for op in gb.ops:
+        for ns in op.outputs.values():
+            produced.update(ns)
+    for n in fetch_names:
+        if n in donated:
+            report.add(
+                WARNING, "donated-and-fetched",
+                "fetch var '%s' is persistable state the executor "
+                "donates (donate_argnums): the fetched buffer aliases a "
+                "donated input%s — fetch a non-persistable copy (e.g. "
+                "assign it to a temp) or read it from the scope after "
+                "the run" % (
+                    n, "" if n in produced
+                    else ", and no op rewrites it, so XLA cannot reuse "
+                         "the donation at all"),
+                block_idx=0, var=n)
+
+    # -- collectives without a deadline -------------------------------------
+    if collectives:
+        from ..fluid.resilience import deadline_remaining
+
+        if deadline_remaining() is None:
+            block, i, op = collectives[0]
+            report.add(
+                WARNING if on_tpu else INFO, "collective-missing-deadline",
+                "program issues %d collective op(s) (first: '%s') and no "
+                "collective deadline is armed on this thread — a hung "
+                "peer turns every collective into an infinite wait; wrap "
+                "dispatch in resilience.collective_deadline(seconds) "
+                "(FleetGuard arms one automatically)"
+                % (len(collectives), op.type),
+                block_idx=block.idx, op_index=i, op=op)
+
+    # -- compile-cache shape vocabulary -------------------------------------
+    _lint_shape_vocab(gb, feed_names, report)
+    return report
+
+
+def _lint_tiling(block, i, op, shape_of, report):
+    """Flag MXU operand dims off the (8, 128) tile grid."""
+    checked = []
+    if op.type in _MATMUL_OPS:
+        for slot in ("X", "Y"):
+            for n in op.input(slot):
+                checked.append((n, shape_of(block, n)))
+    else:
+        for n in op.input("Filter"):
+            checked.append((n, shape_of(block, n)))
+        for n in op.output("Output"):
+            checked.append((n, shape_of(block, n)))
+    bad = []
+    for n, shape in checked:
+        if not shape or len(shape) < 2:
+            continue
+        sub, lane = shape[-2], shape[-1]
+        if lane is None or sub is None or lane < 0 or sub < 0:
+            continue  # dynamic dims: bucketing decides the padding
+        if lane % LANE or (sub % SUBLANE and sub >= SUBLANE):
+            waste = (1.0
+                     - (sub * lane)
+                     / (_round_up(sub, SUBLANE) * _round_up(lane, LANE)))
+            bad.append((n, shape, waste))
+    for n, shape, waste in bad:
+        report.add(
+            PERF, "unpadded-matmul" if op.type in _MATMUL_OPS
+            else "unpadded-conv",
+            "operand '%s' of '%s' has minor dims %s not aligned to the "
+            "8x128 tile grid — XLA pads with ~%d%% dead lanes; pad the "
+            "layer width (or fold small dims) to multiples of 128/8"
+            % (n, op.type, tuple(shape[-2:]), round(100 * waste)),
+            block_idx=block.idx, op_index=i, op=op, var=n)
+
+
+def _round_up(x, m):
+    return ((x + m - 1) // m) * m
+
+
+def _lint_shape_vocab(gb, feed_names, report):
+    """Estimate how many distinct feed signatures (≈ compiled
+    executables) this program's dynamic dims can generate. Axis 0 is the
+    batch dim shared across feeds (one ladder); every additional dynamic
+    axis multiplies the vocabulary."""
+    names = list(feed_names) or [n for n, v in gb.vars.items() if v.is_data]
+    axes = 0
+    batch_dynamic = False
+    detail = []
+    for n in names:
+        if not gb.has_var(n):
+            continue
+        shape = gb.var(n).shape or ()
+        extra = 0
+        for ax, s in enumerate(shape):
+            if s is None or s < 0:
+                if ax == 0:
+                    batch_dynamic = True
+                else:
+                    extra += 1
+        if extra:
+            detail.append("%s:%d" % (n, extra))
+        axes += extra
+    if batch_dynamic:
+        axes += 1
+    estimate = _BUCKETS_PER_AXIS ** axes if axes else 1
+    report.meta["shape_vocab_estimate"] = estimate
+    if estimate > SHAPE_VOCAB_THRESHOLD:
+        report.add(
+            WARNING, "unbounded-shape-vocab",
+            "feeds carry %d dynamic axes (%s%s) — a pow2 bucket ladder "
+            "per axis compiles ~%d executables, blowing up compile time "
+            "and the AOT cache; fix non-batch dims (pad to a single "
+            "length) or declare explicit serving BucketSpecs"
+            % (axes,
+               "batch" if batch_dynamic else "",
+               (", " + ", ".join(detail)) if detail else "",
+               estimate),
+            block_idx=0)

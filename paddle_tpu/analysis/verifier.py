@@ -1,0 +1,226 @@
+"""Static IR verifier.
+
+Proves a Program well-formed BEFORE fingerprinting/compilation by
+statically evaluating the exact conditions the lowering would hit at
+trace time (``lowering.resolve_inputs``'s missing-value error,
+``build_step_fn``'s un-computable fetch) plus structural sanity the
+lowering only discovers as an opaque KeyError deep inside a sub-block.
+
+Error-severity checks are restricted to conditions that provably fail
+at lowering time — the verifier gates every first compile by default,
+so a heuristic error here would break working programs. Heuristics
+(dead stores, dead ops/vars, undeclared outputs) report as
+warning/info.
+"""
+from . import walker
+from .diagnostics import ERROR, INFO, WARNING, AnalysisReport
+
+__all__ = ["verify"]
+
+
+def _feed_set(program, feed_names):
+    """Feed names as the executor would prepare them: every fed
+    lod_level>0 var also gets its ``@SEQ_LEN`` companion feed
+    (Executor._prepare_feeds)."""
+    gb = program.global_block()
+    feeds = set(feed_names)
+    for name in list(feeds):
+        seq = name + "@SEQ_LEN"
+        if gb.has_var(seq):
+            feeds.add(seq)
+    return feeds
+
+
+def verify(program, feed_names=(), fetch_names=(), state_names=None,
+           check_liveness=True):
+    """Verify a Program; returns an :class:`AnalysisReport`.
+
+    ``feed_names``: names fed this run (defaults to declared data vars
+    when empty). ``state_names``: persistable names with a value in the
+    scope; ``None`` assumes every persistable is initialized (standalone
+    analysis — the startup program would have run). ``fetch_names``
+    drive the reachability + dead-code checks.
+    """
+    report = AnalysisReport(checks=["verifier"])
+    gb = program.global_block()
+
+    feeds = _feed_set(program, feed_names)
+    if not feed_names:
+        # standalone mode: data vars are the feedable surface
+        feeds |= {name for name, v in gb.vars.items() if v.is_data}
+        feeds = _feed_set(program, feeds)
+
+    persistables = {name for name, v in gb.vars.items() if v.persistable}
+    if state_names is None:
+        state = set(persistables)
+    else:
+        state = set(state_names)
+
+    report.meta["n_blocks"] = len(program.blocks)
+    report.meta["n_ops"] = sum(len(b.ops) for b in program.blocks)
+
+    # ---- sub-block sanity -------------------------------------------------
+    _check_sub_blocks(program, report)
+
+    # ---- every name produced anywhere (for dangling-vs-ordering msgs) ----
+    produced_anywhere = set()
+    for block in program.blocks:
+        for op in block.ops:
+            for ns in op.outputs.values():
+                produced_anywhere.update(ns)
+
+    # ---- per-block sequential walk ---------------------------------------
+    entry0 = feeds | state
+    _walk_block(program, gb, entry0, produced_anywhere, persistables,
+                state_names is not None, report, _seen=set())
+
+    # ---- fetch reachability ----------------------------------------------
+    producible0 = set(entry0)
+    for op in gb.ops:
+        for ns in op.outputs.values():
+            producible0.update(ns)
+    for n in fetch_names:
+        if n not in producible0:
+            report.add(
+                ERROR, "fetch-unreachable",
+                "fetch var '%s' is never computed by the program (not "
+                "produced by any global-block op, not fed, not in state)"
+                % n, block_idx=0, var=n)
+
+    # ---- feed usage -------------------------------------------------------
+    if feed_names:
+        read_anywhere = set()
+        for op in gb.ops:
+            read_anywhere |= walker._op_reads(program, op)
+        for n in feed_names:
+            if n not in read_anywhere and not n.endswith("@SEQ_LEN"):
+                report.add(INFO, "unused-feed",
+                           "feed '%s' is never read by any op" % n,
+                           block_idx=0, var=n)
+
+    # ---- dead code relative to fetch targets ------------------------------
+    if check_liveness and fetch_names:
+        _live, dead_ops, dead_vars = walker.live_report(
+            program, fetch_names, state_names=None)
+        for i, op in dead_ops:
+            report.add(INFO, "dead-op",
+                       "op contributes to no fetch target and no "
+                       "persistable state", block_idx=0, op_index=i, op=op)
+        for n in dead_vars:
+            report.add(INFO, "dead-var",
+                       "var is read/written by no live op", block_idx=0,
+                       var=n)
+    return report
+
+
+def _check_sub_blocks(program, report):
+    n_blocks = len(program.blocks)
+    required_attrs = {
+        "while": ("carried_names", "cond_name"),
+        "static_rnn": ("mem_names", "mem_updated", "x_names", "out_names"),
+        "dynamic_rnn": ("mem_names", "mem_updated", "x_names", "out_names"),
+        "conditional_block": ("written_names",),
+        "cond": ("true_out_names", "false_out_names"),
+    }
+    for block, i, op in walker.iter_ops(program):
+        refs = walker.sub_block_indices(op)
+        for attr, idx in refs:
+            if not isinstance(idx, int) or not (0 <= idx < n_blocks):
+                report.add(
+                    ERROR, "bad-sub-block",
+                    "op attr %s=%r does not reference a block of this "
+                    "program (%d blocks)" % (attr, idx, n_blocks),
+                    block_idx=block.idx, op_index=i, op=op)
+            elif idx == 0:
+                report.add(
+                    ERROR, "bad-sub-block",
+                    "op attr %s references the global block — a "
+                    "control-flow body cannot be block 0" % attr,
+                    block_idx=block.idx, op_index=i, op=op)
+        if refs:
+            for a in required_attrs.get(op.type, ()):
+                if op.attrs.get(a) is None:
+                    report.add(
+                        ERROR, "bad-sub-block",
+                        "control-flow op is missing required attr %r "
+                        "(its lowering reads it unconditionally)" % a,
+                        block_idx=block.idx, op_index=i, op=op)
+
+
+def _walk_block(program, block, available, produced_anywhere, persistables,
+                have_state, report, _seen):
+    """Sequential availability walk of one block; recurses into
+    sub-blocks with the owner's available set + injected names.
+    Also runs the dead-store (conflicting write) heuristic per block."""
+    if block.idx in _seen:
+        return
+    _seen.add(block.idx)
+    available = set(available)
+    last_write = {}      # name -> op index of last write in this block
+    read_since = set()   # names read since their last write
+
+    for i, op in enumerate(block.ops):
+        reads = walker._op_reads(program, op)
+        for n in reads:
+            read_since.add(n)
+            if n in available:
+                continue
+            if n in persistables:
+                if have_state:
+                    report.add(
+                        ERROR, "uninitialized-persistable",
+                        "op reads persistable '%s' which has no value in "
+                        "the scope and is not produced earlier — was the "
+                        "startup program run?" % n,
+                        block_idx=block.idx, op_index=i, op=op, var=n)
+                # else: standalone mode assumed persistables initialized
+            elif n in produced_anywhere:
+                report.add(
+                    ERROR, "use-before-def",
+                    "op reads '%s' before any op produces it (a later op "
+                    "writes it — op ordering bug?)" % n,
+                    block_idx=block.idx, op_index=i, op=op, var=n)
+            else:
+                report.add(
+                    ERROR, "dangling-input",
+                    "op reads '%s' which no op produces and which is "
+                    "neither fed nor persistable state" % n,
+                    block_idx=block.idx, op_index=i, op=op, var=n)
+            available.add(n)  # report each missing name once per block
+
+        outs = []
+        for ns in op.outputs.values():
+            outs.extend(ns)
+        for n in outs:
+            prev = last_write.get(n)
+            if (prev is not None and n not in read_since
+                    and n not in reads):
+                report.add(
+                    WARNING, "conflicting-write",
+                    "op overwrites '%s' (written by op %d) before anything "
+                    "reads it — dead store or two ops racing for one name"
+                    % (n, prev),
+                    block_idx=block.idx, op_index=i, op=op, var=n)
+            last_write[n] = i
+            read_since.discard(n)
+            available.add(n)
+            if not _declared(block, n):
+                report.add(
+                    INFO, "undeclared-output",
+                    "op writes '%s' which is not declared as a Variable "
+                    "in the block tree" % n,
+                    block_idx=block.idx, op_index=i, op=op, var=n)
+
+        for _attr, sub in walker.sub_blocks(program, op):
+            sub_avail = available | walker.injected_names(op)
+            _walk_block(program, sub, sub_avail, produced_anywhere,
+                        persistables, have_state, report, _seen)
+
+
+def _declared(block, name):
+    blk = block
+    while blk is not None:
+        if name in blk.vars:
+            return True
+        blk = blk.parent_block
+    return False

@@ -1,0 +1,182 @@
+"""Program walker: the one place that knows the IR's control-flow shape.
+
+Every analysis pass (verifier, shape propagation, TPU-lint), the
+debugger's pretty-printer, and the graphviz dump walk Programs through
+these helpers instead of re-implementing sub-block descent — the
+conventions live in the control-flow lowerings (ops/control_ops.py) and
+drift here would mean false positives everywhere.
+
+Conventions mirrored from the lowerings:
+
+- ``BLOCK_ATTRS``: op attrs referencing a body block by index
+  (while/conditional_block/static_rnn/dynamic_rnn use ``sub_block``;
+  cond uses ``true_block``/``false_block``).
+- Sub-block bodies run in a COPY of the outer env — they read any name
+  defined in the outer block at the op's position without declaring it
+  as an op input.
+- The owning op's lowering BINDS extra names into the body env before
+  the body runs (``injected_names``): while binds its carried vars +
+  cond var, static/dynamic_rnn bind per-step memory + slice vars,
+  conditional_block binds the current values of its written vars.
+  A use-before-def pass that doesn't seed these reports every RNN body
+  as broken.
+"""
+
+__all__ = [
+    "BLOCK_ATTRS", "sub_block_indices", "sub_blocks", "injected_names",
+    "iter_blocks", "iter_ops", "block_owners", "producer_index",
+    "live_report",
+]
+
+BLOCK_ATTRS = ("sub_block", "true_block", "false_block")
+
+# owning-op type -> attrs whose names the lowering binds into the body
+# env before running body ops (see module docstring)
+_INJECTED_NAME_ATTRS = {
+    "while": ("carried_names", "cond_name"),
+    "static_rnn": ("mem_names", "x_names"),
+    "dynamic_rnn": ("mem_names", "x_names"),
+    "conditional_block": ("written_names",),
+}
+
+
+def sub_block_indices(op):
+    """Block indices an op's body attrs reference, in attr order."""
+    out = []
+    for attr in BLOCK_ATTRS:
+        idx = op.attrs.get(attr)
+        if idx is not None:
+            out.append((attr, idx))
+    return out
+
+
+def sub_blocks(program, op):
+    """Resolved (attr, Block) pairs; silently skips broken indices (the
+    verifier reports those explicitly via check_sub_blocks)."""
+    out = []
+    n = len(program.blocks)
+    for attr, idx in sub_block_indices(op):
+        if isinstance(idx, int) and 0 <= idx < n:
+            out.append((attr, program.block(idx)))
+    return out
+
+
+def injected_names(op):
+    """Names the op's lowering binds into its body env before the body
+    ops run — defined-on-entry for any sub-block analysis."""
+    attrs = _INJECTED_NAME_ATTRS.get(op.type, ())
+    names = set()
+    for a in attrs:
+        v = op.attrs.get(a)
+        if v is None:
+            continue
+        if isinstance(v, str):
+            names.add(v)
+        else:
+            names.update(v)
+    return names
+
+
+def iter_blocks(program):
+    """Yield ``(block, owner_op)`` in pre-order: block 0 with owner
+    ``None`` first, then each sub-block right after the op that owns it.
+    Blocks no op references (dead sub-blocks) come last with owner
+    ``None`` so walkers still see every block."""
+    seen = set()
+
+    def walk(block, owner):
+        if block.idx in seen:
+            return
+        seen.add(block.idx)
+        yield block, owner
+        for op in block.ops:
+            for _attr, sub in sub_blocks(program, op):
+                yield from walk(sub, op)
+
+    yield from walk(program.global_block(), None)
+    for block in program.blocks:
+        if block.idx not in seen:
+            seen.add(block.idx)
+            yield block, None
+
+
+def block_owners(program):
+    """block idx -> owning Operator (absent for block 0 / dead blocks)."""
+    owners = {}
+    for block, owner in iter_blocks(program):
+        if owner is not None:
+            owners[block.idx] = owner
+    return owners
+
+
+def iter_ops(program):
+    """Yield ``(block, op_index, op)`` over every reachable block in
+    pre-order (sub-block ops nested right after their owner)."""
+    for block, _owner in iter_blocks(program):
+        for i, op in enumerate(block.ops):
+            yield block, i, op
+
+
+def producer_index(block):
+    """name -> index of the last op in `block` writing it."""
+    produced = {}
+    for i, op in enumerate(block.ops):
+        for ns in op.outputs.values():
+            for n in ns:
+                produced[n] = i
+    return produced
+
+
+def _op_reads(program, op):
+    """All names an op may read, including sub-block closure reads
+    (mirrors lowering.op_read_names but tolerates broken block refs)."""
+    reads = set()
+    for ns in op.inputs.values():
+        reads.update(ns)
+    for _attr, sub in sub_blocks(program, op):
+        produced = set(injected_names(op))
+        for sop in sub.ops:
+            reads |= _op_reads(program, sop) - produced
+            for ns in sop.outputs.values():
+                produced.update(ns)
+    return reads
+
+
+def live_report(program, fetch_names, state_names=None):
+    """Liveness relative to the fetch targets + persistable state.
+
+    Returns ``(live_op_idx, dead_ops, dead_vars)`` for the global block:
+    ``live_op_idx`` the set of global-block op indices on the backward
+    slice from the targets, ``dead_ops`` the ``(idx, op)`` pairs off it,
+    ``dead_vars`` declared global-block var names neither read nor
+    written by any live op (and not targets/feeds/persistables).
+
+    ``state_names=None`` treats every persistable as live (executor
+    semantics: new_state collects ALL persistables, so optimizer update
+    ops are live even when nothing fetches them).
+    """
+    gb = program.global_block()
+    if state_names is None:
+        state_names = {v.name for v in gb.vars.values() if v.persistable}
+    needed = set(fetch_names) | set(state_names)
+    live = set()
+    for i in range(len(gb.ops) - 1, -1, -1):
+        op = gb.ops[i]
+        outs = set()
+        for ns in op.outputs.values():
+            outs.update(ns)
+        if outs & needed:
+            live.add(i)
+            needed |= _op_reads(program, op)
+    dead_ops = [(i, op) for i, op in enumerate(gb.ops) if i not in live]
+    used = set(fetch_names)
+    for i in live:
+        op = gb.ops[i]
+        used |= _op_reads(program, op)
+        for ns in op.outputs.values():
+            used.update(ns)
+    dead_vars = [
+        name for name, v in gb.vars.items()
+        if name not in used and not v.is_data and not v.persistable
+    ]
+    return live, dead_ops, dead_vars

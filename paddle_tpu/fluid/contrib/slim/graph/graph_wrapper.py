@@ -241,7 +241,29 @@ class GraphWrapper:
         return graph
 
     def infer_shape(self):
-        pass  # shapes are maintained eagerly by the layer builders
+        """Recompute static shapes by abstract propagation through the
+        op lowerings (:mod:`paddle_tpu.analysis.shapes`) and write them
+        back into the var metadata. Layer builders maintain shapes
+        eagerly, but a strategy that mutates a var (pruning a filter,
+        widening an embedding) leaves everything downstream stale —
+        this re-derives the whole graph from the mutated metadata.
+        Dims that depend on the feed batch stay as declared."""
+        from .....analysis import shapes as _shapes
+
+        env, _ = _shapes.propagate(self.program, check_declared=False)
+        block = self.program.global_block()
+        for name, spec in env.items():
+            if not block.has_var(name):
+                continue
+            var = block.var(name)
+            decl = var.shape
+            new = tuple(int(s) for s in spec.shape)
+            if decl is not None and len(decl) == len(new):
+                # keep declared dynamic (-1) dims dynamic: the inferred
+                # value is just the analysis placeholder batch
+                new = tuple(d if (d is not None and d < 0) else n
+                            for d, n in zip(decl, new))
+            var.shape = new
 
     def update_param_shape(self, scope=None):
         pass

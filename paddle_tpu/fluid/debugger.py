@@ -46,58 +46,129 @@ def repr_op(op):
         outs or "()", op.type, ins, (" {%s}" % attrs) if attrs else "")
 
 
-def pprint_block_codes(block, show_backward=False):
-    lines = ["# block %d" % block.idx]
+def pprint_block_codes(block, show_backward=False, owner=None,
+                       dead_op_idx=(), dead_vars=(), note=None):
+    """One block as pseudo-code. ``owner`` annotates a sub-block with
+    the op whose body it is; ``dead_op_idx``/``dead_vars`` (from
+    ``analysis.walker.live_report``) mark code off the fetch slice."""
+    dead_op_idx = set(dead_op_idx)
+    dead_vars = set(dead_vars)
+    head = "# block %d" % block.idx
+    if owner is not None:
+        head += " — body of '%s' (block %d)" % (owner.type,
+                                                block.parent_idx)
+    if note:
+        head += " — " + note
+    lines = [head]
     for name in sorted(block.vars):
         if not show_backward and "@GRAD" in name:
             continue
-        lines.append("var " + repr_var(block.vars[name]))
+        mark = "   # dead: not on the fetch slice" \
+            if name in dead_vars else ""
+        lines.append("var " + repr_var(block.vars[name]) + mark)
     lines.append("")
-    for op in block.ops:
+    for i, op in enumerate(block.ops):
         if not show_backward and op.type == "backward":
             lines.append("# (backward region: vjp over the ops above)")
             continue
-        lines.append(repr_op(op))
+        prefix = "# dead: " if i in dead_op_idx else ""
+        lines.append(prefix + repr_op(op))
     return "\n".join(lines) + "\n"
 
 
-def pprint_program_codes(program, show_backward=False):
-    return "\n".join(
-        pprint_block_codes(b, show_backward) for b in program.blocks)
+def pprint_program_codes(program, show_backward=False, fetch_names=None):
+    """Whole-program dump routed through the analyzer's walker
+    (``paddle_tpu.analysis.walker``): blocks print in pre-order with
+    each sub-block right after — and annotated with — the op that owns
+    it; blocks no op references are flagged unreachable. With
+    ``fetch_names``, global-block ops/vars off the fetch slice get
+    ``# dead`` marks (``walker.live_report``)."""
+    from ..analysis import walker
+
+    dead_op_idx, dead_vars = (), ()
+    if fetch_names:
+        live, dead_ops, dead_vars = walker.live_report(
+            program, fetch_names)
+        dead_op_idx = [i for i, _op in dead_ops]
+    chunks = []
+    for block, owner in walker.iter_blocks(program):
+        note = None
+        if block.idx != 0 and owner is None:
+            note = "UNREACHABLE (no op references this block)"
+        chunks.append(pprint_block_codes(
+            block, show_backward, owner=owner, note=note,
+            dead_op_idx=dead_op_idx if block.idx == 0 else (),
+            dead_vars=dead_vars if block.idx == 0 else ()))
+    return "\n".join(chunks)
 
 
-def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+def draw_block_graphviz(block, highlights=None, path="./temp.dot",
+                        fetch_names=None):
     """Dump a block as graphviz dot: ops are boxes, vars ellipses,
-    params octagons; `highlights` names vars to color. Returns the
-    written path (pdf when the dot binary exists)."""
+    params octagons; `highlights` names vars to color. Sub-blocks owned
+    by control-flow ops render as nested clusters (the descent goes
+    through ``analysis.walker``, so cond's true/false blocks and RNN
+    bodies all resolve), with outer vars looked up through the parent
+    chain — a param read inside a loop body renders as a param, not a
+    bare arg. With ``fetch_names``, vars off the fetch slice go gray.
+    Returns the written path (pdf when the dot binary exists)."""
     from .graphviz import GraphPreviewGenerator
+    from ..analysis import walker
 
+    program = block.program
     highlights = set(highlights or ())
+    dead = set()
+    if fetch_names:
+        _live, _dead_ops, dead_vars = walker.live_report(
+            program, fetch_names)
+        dead = set(dead_vars)
     gen = GraphPreviewGenerator("block %d" % block.idx)
     var_nodes = {}
 
-    def var_node(name):
+    def var_node(blk, name, sub):
         if name not in var_nodes:
-            var = block.vars.get(name)
+            var = blk._var_recursive(name) \
+                if blk.has_var_recursive(name) else None
             persistable = var is not None and getattr(
                 var, "persistable", False)
             if persistable:
                 var_nodes[name] = gen.add_param(
                     name, getattr(var, "dtype", "?"),
-                    highlight=name in highlights)
+                    highlight=name in highlights, subgraph=sub)
             else:
                 var_nodes[name] = gen.add_arg(
-                    name, highlight=name in highlights)
+                    name, highlight=name in highlights,
+                    dead=name in dead, subgraph=sub)
         return var_nodes[name]
 
-    for op in block.ops:
-        op_node = gen.add_op(op.type)
-        for ns in op.inputs.values():
-            for n in ns:
-                gen.add_edge(var_node(n), op_node)
-        for ns in op.outputs.values():
-            for n in ns:
-                gen.add_edge(op_node, var_node(n))
+    seen_blocks = set()
+
+    def draw(blk, sub):
+        """Draw one block's ops (into cluster `sub`); returns the first
+        op node as the anchor its owner links to."""
+        if blk.idx in seen_blocks:
+            return None  # malformed self/cyclic block refs: draw once
+        seen_blocks.add(blk.idx)
+        first = None
+        for op in blk.ops:
+            op_node = gen.add_op(op.type, subgraph=sub)
+            first = first if first is not None else op_node
+            for ns in op.inputs.values():
+                for n in ns:
+                    gen.add_edge(var_node(blk, n, sub), op_node)
+            for ns in op.outputs.values():
+                for n in ns:
+                    gen.add_edge(op_node, var_node(blk, n, sub))
+            for attr, child in walker.sub_blocks(program, op):
+                cluster = gen.add_subgraph(
+                    "block %d: %s of '%s'" % (child.idx, attr, op.type))
+                anchor = draw(child, cluster)
+                if anchor is not None:
+                    gen.add_edge(op_node, anchor, style="dashed",
+                                 label=attr)
+        return first
+
+    draw(block, None)
     return gen.graph.compile(path)
 
 

@@ -23,6 +23,9 @@ from .framework import Program, Variable, default_main_program
 from .lowering import OpLoweringError, build_step_fn
 from .resilience import fault_check
 from .. import observability as obs
+# stdlib-only runtime guard (PADDLE_TPU_SCOPE_SANITIZER); the hot-path
+# cost with the sanitizer off is one module-bool check per Scope write
+from ..analysis import sanitizer as _sanitizer
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 
@@ -55,6 +58,8 @@ class Scope:
 
     def set(self, name, value):
         self._vars[name] = value
+        if _sanitizer._on:
+            _sanitizer.record_write(self, name)
 
     def __getitem__(self, name):
         return self._vars[name]
@@ -101,9 +106,13 @@ class Scope:
         while scope is not None:
             if name in scope._vars:
                 scope._vars[name] = value
+                if _sanitizer._on:
+                    _sanitizer.record_write(scope, name)
                 return
             scope = scope._parent
         self._vars[name] = value
+        if _sanitizer._on:
+            _sanitizer.record_write(self, name)
 
     def var(self, name):
         return _TensorView(self, name)
@@ -162,6 +171,7 @@ class Executor:
         )
         self._run_counter = 0
         self._closed = False
+        self._verified = set()  # signatures the analyzer already gated
 
     # ------------------------------------------------------------------
     def run(
@@ -242,6 +252,14 @@ class Executor:
             rng = self._next_rng(program)
             platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
             entry = self._cache_lookup(sig) if use_program_cache else None
+            if entry is None and sig not in self._verified:
+                # first compile of this signature: gate it on the static
+                # analyzer (PADDLE_TPU_ANALYSIS=off|verify|full) — a
+                # broken program fails HERE with op-attributed
+                # diagnostics instead of deep inside lowering/XLA
+                self._verify_first_compile(
+                    program, feed_arrays, state, fetch_names, platform)
+                self._verified.add(sig)
             disk_key = None
             if entry is None and use_program_cache and compile_cache.enabled():
                 # disk tier: a hit deserializes the AOT artifact in ms and
@@ -563,6 +581,42 @@ class Executor:
         except Exception as e:  # noqa: BLE001 — closing must not raise
             warnings.warn("checkpoint finalize on Executor.close failed: "
                           "%s: %s" % (type(e).__name__, e))
+
+    # -- static-analysis gate (paddle_tpu.analysis) --------------------
+    def _verify_first_compile(self, program, feed_arrays, state,
+                              fetch_names, platform):
+        """Run the static analyzer before the first compile of a
+        signature. ``verify`` (the default) is a pure-python structural
+        walk; ``full`` adds shape/dtype propagation + TPU-lint; ``off``
+        restores the pre-analyzer executor exactly. Verifier errors —
+        the program would provably fail at lowering — raise
+        :class:`~paddle_tpu.analysis.ProgramVerifyError` before any XLA
+        work; everything else flows to the telemetry hub + flight
+        recorder. Analyzer *crashes* are swallowed (a gate must never be
+        the thing that breaks a healthy run)."""
+        from ..analysis import analyzer as _analyzer
+
+        level = _analyzer.mode()
+        if level == "off":
+            return
+        t0 = time.monotonic()
+        try:
+            report = _analyzer.analyze(
+                program, feed_names=list(feed_arrays.keys()),
+                fetch_names=fetch_names, state_names=set(state.keys()),
+                feed_specs=feed_arrays, state_specs=state,
+                platform=platform, level=level)
+        except Exception as e:  # noqa: BLE001 — analyzer bug, not user's
+            obs.event("analysis_failed", source="executor",
+                      error="%s: %s" % (type(e).__name__, e))
+            return
+        obs.observe("analysis.verify_seconds", time.monotonic() - t0)
+        if report.diagnostics:
+            obs.inc("analysis.findings", len(report.findings))
+            obs.event("analysis_report", source="executor", count=False,
+                      program=program._uid, version=program._version,
+                      level=level, summary=report.summary())
+        report.raise_if_errors()
 
     # -- compiled-executable LRU (shared by run + dataset-scan paths) --
     def _cache_lookup(self, sig):

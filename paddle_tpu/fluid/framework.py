@@ -290,8 +290,15 @@ class Operator:
         self.attrs = dict(attrs or {})
         self.inputs = self._canonicalize(inputs)
         self.outputs = self._canonicalize(outputs)
-        # op provenance for failure diagnosis (ref records op_callstack attr)
-        self.callstack = traceback.extract_stack(limit=8)[:-3]
+        # op provenance for failure diagnosis (ref records op_callstack
+        # attr). Trim trailing framework-internal frames by file, not by
+        # a fixed count: ops appended via block.append_op directly (no
+        # LayerHelper hop) must still keep the caller's frame.
+        stack = traceback.extract_stack(limit=10)
+        while stack and stack[-1].filename.endswith(
+                ("framework.py", "layer_helper.py")):
+            stack.pop()
+        self.callstack = stack
         self._is_backward = type.endswith("_grad") or type == "backward"
 
     @staticmethod

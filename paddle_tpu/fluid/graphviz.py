@@ -9,7 +9,7 @@ import os
 import shutil
 import subprocess
 
-__all__ = ["Graph", "Node", "Edge", "GraphPreviewGenerator"]
+__all__ = ["Graph", "Node", "Edge", "Subgraph", "GraphPreviewGenerator"]
 
 
 def crepr(v):
@@ -62,18 +62,52 @@ class Edge:
         return "%s -> %s [%s];" % (self.source.name, self.target.name, body)
 
 
+class Subgraph:
+    """A dot ``subgraph cluster_*``: nodes added to it render inside a
+    labelled box (control-flow sub-blocks in the program dumps). Edges
+    stay at the top level — dot resolves node names globally."""
+
+    counter = 1
+
+    def __init__(self, label, **attrs):
+        self.name = "cluster_%d" % Subgraph.counter
+        Subgraph.counter += 1
+        self.label = label
+        self.attrs = attrs
+        self.nodes = []
+
+    def __str__(self):
+        lines = ["subgraph %s {" % self.name,
+                 "label=%s;" % crepr(self.label)]
+        lines += ["%s=%s;" % (k, crepr(v))
+                  for k, v in sorted(self.attrs.items())]
+        lines += [str(n) for n in self.nodes]
+        lines.append("}")
+        return "\n".join(lines)
+
+
 class Graph:
     def __init__(self, title, **attrs):
         self.title = title
         self.attrs = attrs
         self.nodes = []
         self.edges = []
+        self.subgraphs = []
         self.rank_groups = {}
 
-    def add_node(self, label, prefix, description="", **attrs):
+    def add_node(self, label, prefix, description="", subgraph=None,
+                 **attrs):
         node = Node(label, prefix, description, **attrs)
-        self.nodes.append(node)
+        if subgraph is not None:
+            subgraph.nodes.append(node)
+        else:
+            self.nodes.append(node)
         return node
+
+    def add_subgraph(self, label, **attrs):
+        sub = Subgraph(label, **attrs)
+        self.subgraphs.append(sub)
+        return sub
 
     def add_edge(self, source, target, **attrs):
         edge = Edge(source, target, **attrs)
@@ -97,7 +131,8 @@ class Graph:
         head += "".join(
             "%s=%s;\n" % (k, crepr(v)) for k, v in sorted(self.attrs.items())
         )
-        parts = [str(n) for n in self.nodes]
+        parts = [str(s) for s in self.subgraphs]
+        parts += [str(n) for n in self.nodes]
         parts += [str(e) for e in self.edges]
         parts += [
             str(r) for r in sorted(
@@ -132,19 +167,31 @@ class GraphPreviewGenerator:
     def __init__(self, title):
         self.graph = Graph(title, layout="dot")
 
-    def add_param(self, name, data_type, highlight=False):
+    def add_subgraph(self, label, **attrs):
+        attrs.setdefault("style", "rounded")
+        attrs.setdefault("color", "gray50")
+        return self.graph.add_subgraph(label, **attrs)
+
+    def add_param(self, name, data_type, highlight=False, subgraph=None):
         return self.graph.add_node(
             "%s\\n%s" % (name, data_type), prefix="param", shape="octagon",
-            style="filled",
+            style="filled", subgraph=subgraph,
             fillcolor="green" if highlight else "lightgrey")
 
-    def add_op(self, opType, **kwargs):
+    def add_op(self, opType, subgraph=None, **kwargs):
+        kwargs.setdefault("style", "rounded")
         return self.graph.add_node(
-            opType, prefix="op", shape="box", style="rounded", **kwargs)
+            opType, prefix="op", shape="box", subgraph=subgraph, **kwargs)
 
-    def add_arg(self, name, highlight=False):
+    def add_arg(self, name, highlight=False, subgraph=None, dead=False):
+        if dead:
+            # unreferenced relative to the fetch targets (walker
+            # live_report): keep it visible but visually inert
+            return self.graph.add_node(
+                name, prefix="arg", shape="ellipse", style="dashed",
+                color="gray60", fontcolor="gray60", subgraph=subgraph)
         return self.graph.add_node(
-            name, prefix="arg", shape="ellipse",
+            name, prefix="arg", shape="ellipse", subgraph=subgraph,
             style="filled" if highlight else "solid",
             fillcolor="yellow" if highlight else "white")
 

@@ -63,6 +63,7 @@ class Predictor:
                 persist[v.name] = jax.device_put(arr)
         self._state = persist
         platform = "cpu" if isinstance(self.place, core.CPUPlace) else "tpu"
+        self._verify(platform)
         step = build_step_fn(
             program, self.feed_names, self.fetch_names, is_test=True,
             platform=platform,
@@ -94,6 +95,37 @@ class Predictor:
                 if var.dtype is not None:
                     want = core.np_dtype(var.dtype)
             self._want_dtypes[n] = want
+
+    def _verify(self, platform):
+        """Static-analysis gate at construction, BEFORE the first engine
+        compile: a broken saved model (dangling param, un-computable
+        fetch) fails here with op-attributed diagnostics instead of deep
+        inside XLA. ``PADDLE_TPU_ANALYSIS=off|verify|full`` selects the
+        depth; analyzer crashes are swallowed (the gate must never break
+        a healthy model)."""
+        from ..analysis import analyzer as _analyzer
+
+        level = _analyzer.mode()
+        if level == "off":
+            return
+        t0 = time.monotonic()
+        try:
+            report = _analyzer.analyze(
+                self.program, feed_names=self.feed_names,
+                fetch_names=self.fetch_names,
+                state_names=set(self._state.keys()),
+                state_specs=self._state, platform=platform,
+                level=level, is_test=True)
+        except Exception as e:  # noqa: BLE001 — analyzer bug, not user's
+            obs.event("analysis_failed", source="predictor",
+                      error="%s: %s" % (type(e).__name__, e))
+            return
+        obs.observe("analysis.verify_seconds", time.monotonic() - t0)
+        if report.diagnostics:
+            obs.inc("analysis.findings", len(report.findings))
+            obs.event("analysis_report", source="predictor", count=False,
+                      level=level, summary=report.summary())
+        report.raise_if_errors()
 
     @classmethod
     def from_model(cls, dirname, model_filename=None, params_filename=None,

@@ -500,6 +500,38 @@ class GuardedExecutor:
             raise box["error"]
         return box["result"]
 
+    def _analyze_failure(self, program, feed, fetch_list):
+        """Full static analysis of the failed step's program; returns
+        extra fields for the retry event ({} when analysis is off or
+        anything goes wrong — diagnosis must never mask the original
+        error or block the retry)."""
+        try:
+            from ..analysis import analyzer as _analyzer
+
+            if _analyzer.mode() == "off":
+                return {}
+            from .framework import default_main_program
+
+            prog = program if program is not None \
+                else default_main_program()
+            prog = getattr(prog, "_program", prog)  # CompiledProgram
+            fetch_names = [f.name if hasattr(f, "name") else str(f)
+                           for f in (fetch_list or [])]
+            place = getattr(self._exe, "place", None)
+            report = _analyzer.analyze(
+                prog, feed_names=list(feed or {}),
+                fetch_names=fetch_names,
+                platform="cpu" if isinstance(place, core.CPUPlace)
+                else "tpu",
+                level="full")
+            extra = {"analysis": report.summary()}
+            finds = report.findings
+            if finds:
+                extra["analysis_findings"] = [str(d) for d in finds[:4]]
+            return extra
+        except Exception:  # noqa: BLE001 — best-effort diagnosis only
+            return {}
+
     def _amp_managed(self):
         opt = self.amp_optimizer
         return bool(opt is not None
@@ -546,8 +578,19 @@ class GuardedExecutor:
                 if attempt > self.max_retries:
                     raise
                 delay = self._backoff(attempt)
+                extra = {}
+                if attempt == 1:
+                    # first failure of this step: re-run the FULL static
+                    # analyzer and attach attributed diagnostics to the
+                    # retry event — a "transient" failure rooted in a
+                    # program hazard (donated buffer also fetched, host
+                    # sync inside a scan, ...) surfaces on the first
+                    # retry instead of after the budget burns out
+                    extra = self._analyze_failure(program, feed,
+                                                  fetch_list)
                 self._emit("retry", attempt=attempt, delay=delay,
-                           error="%s: %s" % (type(e).__name__, e))
+                           error="%s: %s" % (type(e).__name__, e),
+                           **extra)
                 time.sleep(delay)
 
         report = StepReport(fetches if fetches is not None else [])

@@ -49,6 +49,19 @@ Well-known serving metrics (PR 5, ``paddle_tpu.serving``):
   ``compile_done`` events (source ``predictor``) — absent entirely on
   a compile-cache warm start.
 
+Well-known analysis metrics (PR 6, ``paddle_tpu.analysis``):
+
+- ``analysis.verify_seconds`` histogram — cost of the static verify
+  gate on each first compile of a signature (executor + predictor);
+  ``analysis.findings`` counter — errors+warnings those gates reported.
+- ``analysis_report`` events (sources ``executor`` / ``predictor``)
+  carry the per-program finding summary; ``analysis_failed`` means the
+  analyzer itself crashed (the run proceeds — the gate never blocks on
+  its own bugs). ``GuardedExecutor`` retry events gain ``analysis`` /
+  ``analysis_findings`` fields from the post-failure full analysis.
+- ``scope_race`` events (source ``sanitizer``) — cross-thread Scope
+  write violations when ``PADDLE_TPU_SCOPE_SANITIZER=on``.
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

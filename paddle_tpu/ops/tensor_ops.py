@@ -50,7 +50,10 @@ def _reshape(ctx, ins, attrs):
         shape = list(attrs["shape"])
     # paddle: 0 means copy dim from input
     shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
-    return {"Out": [x.reshape(shape)], "XShape": [jnp.zeros((0,) + x.shape)]}
+    return {
+        "Out": [x.reshape(shape)],
+        "XShape": [jnp.zeros((0,) + x.shape, x.dtype)],
+    }
 
 
 @register_op("transpose2")
@@ -58,7 +61,7 @@ def _transpose(ctx, ins, attrs):
     x = ins["X"][0]
     return {
         "Out": [jnp.transpose(x, attrs["axis"])],
-        "XShape": [jnp.zeros((0,) + x.shape)],
+        "XShape": [jnp.zeros((0,) + x.shape, x.dtype)],
     }
 
 
@@ -71,7 +74,7 @@ def _squeeze(ctx, ins, attrs):
         out = jnp.squeeze(x, axis=axes) if axes else x
     else:
         out = jnp.squeeze(x)
-    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape)]}
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
 
 
 @register_op("unsqueeze2")
@@ -80,7 +83,7 @@ def _unsqueeze(ctx, ins, attrs):
     out = x
     for a in sorted(attrs["axes"]):
         out = jnp.expand_dims(out, a)
-    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape)]}
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, x.dtype)]}
 
 
 @register_op("flatten2")
@@ -92,7 +95,7 @@ def _flatten(ctx, ins, attrs):
         lead *= s
     return {
         "Out": [x.reshape((lead, -1))],
-        "XShape": [jnp.zeros((0,) + x.shape)],
+        "XShape": [jnp.zeros((0,) + x.shape, x.dtype)],
     }
 
 

@@ -30,6 +30,11 @@ def pytest_configure(config):
         "perf: performance-path tests (compile-cache warm starts, "
         "pipelined dispatch); `pytest -m perf` is the perf smoke lane "
         "bench_experiments/warm_start_lane.sh runs")
+    config.addinivalue_line(
+        "markers",
+        "analysis: static-analyzer tests (paddle_tpu.analysis: "
+        "verifier/shape checker/TPU-lint/scope sanitizer); `pytest -m "
+        "analysis` is the lane bench_experiments/analysis_lane.sh runs")
 
 
 @pytest.fixture(autouse=True)

@@ -42,8 +42,20 @@ def test_pipelined_losses_bit_identical_to_sync():
     exe2 = fluid.Executor(fluid.CPUPlace())
     s2 = Scope()
     exe2.run(fluid.default_startup_program(), scope=s2)
-    runner = exe2.run_pipelined(feeds=feeds, fetch_list=[loss], scope=s2)
-    piped = [np.asarray(out[0]) for out in runner]
+    # the pipelined loop dispatches from a background thread; run it
+    # under the armed scope sanitizer to prove the handoff is race-free
+    from paddle_tpu.analysis import sanitizer
+
+    sanitizer.arm()
+    sanitizer.reset()
+    try:
+        runner = exe2.run_pipelined(feeds=feeds, fetch_list=[loss],
+                                    scope=s2)
+        piped = [np.asarray(out[0]) for out in runner]
+    finally:
+        sanitizer.disarm()
+    assert sanitizer.violations() == []
+    sanitizer.reset()
 
     assert len(piped) == len(sync)
     for a, b in zip(sync, piped):

@@ -133,7 +133,11 @@ def test_from_model_uses_private_scope(tmp_path):
 
 def test_get_exec_thread_safe_single_compile(tmp_path):
     """N concurrent first callers of one signature -> exactly one
-    compile (the check-then-compile race is locked per signature)."""
+    compile (the check-then-compile race is locked per signature).
+    Runs under the armed scope sanitizer: the serving path must not
+    trip a single cross-thread scope-write violation."""
+    from paddle_tpu.analysis import sanitizer
+
     d = tmp_path / "m"
     _build_and_save(d)
     pred = Predictor.from_model(str(d))
@@ -147,12 +151,20 @@ def test_get_exec_thread_safe_single_compile(tmp_path):
         except Exception as e:  # noqa: BLE001
             errs.append(e)
 
-    threads = [threading.Thread(target=hit) for _ in range(8)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    assert not sanitizer.armed()  # off by default: zero hot-path cost
+    sanitizer.arm()
+    sanitizer.reset()
+    try:
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sanitizer.disarm()
     assert not errs
+    assert sanitizer.violations() == []
+    sanitizer.reset()
     assert pred.profile()["n_engines"] == 1
     assert len(obs.get_recorder().of("compile_start")) == 1
     for o in outs[1:]:
