@@ -905,6 +905,78 @@ def _measure_serving(n_clients=8, n_requests=160):
     }
 
 
+def _measure_serving_fleet(n_replicas=4, n_clients=8, n_requests=240):
+    """Serving-fleet lane (ISSUE 7): the same predictor behind a
+    health-aware ServingRouter over N per-device replicas, versus the
+    raw engines driven round-robin — the spread between the two is the
+    router's dispatch overhead, which must stay a thin slice (gated by
+    PADDLE_TPU_BENCH_SERVING=1)."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import framework, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 9
+    x = fluid.data(name="x", shape=[None, 32], dtype="float32")
+    h = fluid.layers.fc(x, size=64, act="relu")
+    out = fluid.layers.fc(h, size=8, act="softmax")
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_bench_fleet_")
+    fluid.io.save_inference_model(tmp, ["x"], [out], exe)
+    router = serving.local_fleet(
+        tmp, n_replicas=n_replicas, per_device=True,
+        buckets=[serving.BucketSpec(
+            {"x": (32,)}, batch_sizes=(1, 2, 4, 8, 16))],
+        name="fleet-bench", max_batch_size=16, max_wait_ms=1.0,
+        queue_capacity=256)
+    engines = [router._live[rid].engine for rid in sorted(router._live)]
+    rng = np.random.default_rng(0)
+    feeds = [rng.standard_normal((r, 32)).astype("float32")
+             for r in (1, 2, 3, 4)]
+    per_client = max(1, n_requests // n_clients)
+
+    def drive(predict):
+        def client(i):
+            for k in range(per_client):
+                predict(i, k, {"x": feeds[(i + k) % len(feeds)]})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return (n_clients * per_client) / (time.monotonic() - t0)
+
+    # same warmed engines, same load, two dispatch paths
+    direct_rps = drive(
+        lambda i, k, f: engines[(i + k) % len(engines)].predict(f))
+    router_rps = drive(lambda i, k, f: router.predict(f))
+    stats = router.stats()
+    router.stop(drain=True)
+    overhead = (100.0 * (direct_rps - router_rps) / direct_rps
+                if direct_rps else 0.0)
+    return {
+        "replicas": n_replicas,
+        "clients": n_clients,
+        "requests_per_path": n_clients * per_client,
+        "router_requests_per_sec": round(router_rps, 1),
+        "direct_requests_per_sec": round(direct_rps, 1),
+        "router_overhead_pct": round(overhead, 2),
+        "failovers": int(stats.get("failovers", 0)),
+        "replicas_live": int(stats.get("replicas_live", 0)),
+    }
+
+
 def _bank(st, variant, cfg, on_accel, backend, device_kind):
     peak_v = _peak_flops(device_kind)
     if peak_v:
@@ -1120,6 +1192,15 @@ def child_main(status_path):
             st.flush()
         except Exception as e:  # noqa: BLE001
             st.error("serving failed: %s: %s"
+                     % (type(e).__name__, str(e)[:300]))
+        # fleet lane (ISSUE 7): router over per-device replicas vs the
+        # bare engines — records the dispatch-overhead spread
+        st.stage("serving_fleet")
+        try:
+            st.data["detail"]["serving_fleet"] = _measure_serving_fleet()
+            st.flush()
+        except Exception as e:  # noqa: BLE001
+            st.error("serving_fleet failed: %s: %s"
                      % (type(e).__name__, str(e)[:300]))
 
     tel_out = os.environ.get("PADDLE_TPU_BENCH_TELEMETRY_OUT")

@@ -1,0 +1,189 @@
+#!/usr/bin/env bash
+# Chaos serving lane (ISSUE 7): the serving-fleet kill drill.
+#
+#   bash bench_experiments/chaos_serving_lane.sh
+#
+# Lane 1 runs the `chaos`-marked pytest slice (router failover under
+# fault injection, the in-suite SIGKILL twin of lane 2). Lane 2 is the
+# headline acceptance drill: a 4-replica fleet of real worker
+# processes (FileStore transport) behind a ServingRouter published
+# into the HTTP frontend, 8 concurrent mixed-shape clients, one
+# replica SIGKILLed at t~50% of the traffic window. The lane asserts
+# ZERO client-visible 5xx, every response bit-identical to a solo
+# Predictor.run, and post-kill throughput >= (N-1)/N of pre-kill.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PADDLE_TPU_TELEMETRY=on
+
+echo "== lane 1: chaos-marked fleet tests =="
+python -m pytest -q -p no:cacheprovider -m chaos tests/
+
+echo "== lane 2: N=4 process fleet, SIGKILL one replica mid-traffic =="
+python - <<'EOF'
+import json
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.fluid.inference import Predictor
+from paddle_tpu.parallel.elastic import ElasticConfig, FileStore
+from paddle_tpu.serving.router import ServingRouter, StoreReplica
+
+N_REPLICAS, N_CLIENTS = 4, 8
+TRAFFIC_S = 16.0          # measured traffic window
+SHAPES = (2, 3, 4, 5)     # mixed-shape rows; all bit-exact vs baseline
+
+work = tempfile.mkdtemp(prefix="paddle_tpu_chaos_serving_")
+model_dir = work + "/model"
+store_dir = work + "/store"
+
+fluid.default_startup_program().random_seed = 5
+x = fluid.data("x", [None, 16], dtype="float32")
+h = fluid.layers.fc(x, size=32, act="relu")
+out = fluid.layers.fc(h, size=4, act="softmax")
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+fluid.io.save_inference_model(
+    model_dir, ["x"], [out], exe,
+    main_program=fluid.default_main_program())
+baseline = Predictor.from_model(model_dir)
+
+buckets_json = '[{"feeds": {"x": [16]}, "batch_sizes": [1,2,4,8]}]'
+procs, logs = [], []
+for rid in range(N_REPLICAS):
+    log = open("%s/worker-%d.log" % (work, rid), "w")
+    logs.append(log)
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.router",
+         "--store", store_dir, "--rid", str(rid), "--name", "m",
+         "--model-dir", model_dir, "--buckets", buckets_json,
+         "--heartbeat-interval", "0.1"],
+        stdout=log, stderr=subprocess.STDOUT))
+
+store = FileStore(store_dir)
+cfg = ElasticConfig(heartbeat_interval=0.1, miss_threshold=5,
+                    startup_grace=240.0)
+router = ServingRouter(
+    [StoreReplica(r, store, name="m", config=cfg)
+     for r in range(N_REPLICAS)],
+    store=store, name="m", config=cfg, dirname=model_dir)
+
+# wait for every worker's first beacon (jax import + warmup per proc)
+deadline = time.monotonic() + 240
+while time.monotonic() < deadline:
+    if set(range(N_REPLICAS)) <= set(router.monitor.table()):
+        break
+    time.sleep(0.25)
+else:
+    raise SystemExit("FAIL: fleet never came up; see %s/worker-*.log"
+                     % work)
+print("fleet up: %d workers beating" % N_REPLICAS, flush=True)
+
+reg = serving.ModelRegistry()
+reg.publish("m", router, dirname=model_dir)
+srv = serving.ServingServer(reg).start()
+
+rng = np.random.default_rng(0)
+feeds = {r: rng.normal(size=(r, 16)).astype(np.float32) for r in SHAPES}
+refs = {r: baseline.run({"x": feeds[r]})[0] for r in SHAPES}
+for r in SHAPES:  # route warmers through every shape before measuring
+    outs = router.predict({"x": feeds[r]}, timeout=240)
+    assert np.array_equal(outs[0], refs[r]), "warmer drifted"
+
+records, errors = [], []
+rec_lock = threading.Lock()
+t_start = time.monotonic()
+t_end = t_start + TRAFFIC_S
+kill_state = {}
+
+
+def client(cid):
+    i = 0
+    while time.monotonic() < t_end:
+        rows = SHAPES[(cid + i) % len(SHAPES)]
+        i += 1
+        body = json.dumps({"feeds": {"x": feeds[rows].tolist()}}).encode()
+        req = urllib.request.Request(
+            srv.url + "/v1/models/m:predict", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                doc = json.load(resp)
+            o = doc["outputs"][0]
+            got = np.asarray(o["data"], dtype=o["dtype"]).reshape(o["shape"])
+            if not np.array_equal(got, refs[rows]):
+                with rec_lock:
+                    errors.append((cid, i, "NOT bit-identical"))
+            with rec_lock:
+                records.append(time.monotonic())
+        except urllib.error.HTTPError as e:
+            with rec_lock:
+                errors.append((cid, i, "HTTP %d" % e.code))
+        except Exception as e:  # noqa: BLE001
+            with rec_lock:
+                errors.append((cid, i, repr(e)))
+
+
+def killer():
+    time.sleep(TRAFFIC_S / 2.0)
+    kill_state["t"] = time.monotonic()
+    procs[0].send_signal(signal.SIGKILL)
+    print("SIGKILL -> replica 0 (pid %d) at t=%.1fs"
+          % (procs[0].pid, kill_state["t"] - t_start), flush=True)
+
+
+threads = [threading.Thread(target=client, args=(c,))
+           for c in range(N_CLIENTS)]
+threads.append(threading.Thread(target=killer))
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+
+stats = router.stats()
+live = router.replicas_live()
+gauge_live = obs.gauge("serving.replicas_live")  # before stop() zeroes it
+srv.stop(close_registry=False)
+router.stop()
+for p in procs[1:]:
+    p.terminate()
+for p in procs:
+    try:
+        p.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        p.kill()
+for log in logs:
+    log.close()
+
+assert not errors, "client-visible failures: %s" % errors[:5]
+assert live == [1, 2, 3], "dead replica not excised: live=%s" % live
+assert gauge_live == N_REPLICAS - 1, gauge_live
+
+t_kill = kill_state["t"]
+pre = [t for t in records if t_start + 1.0 <= t <= t_kill - 0.25]
+post = [t for t in records if t_kill + 2.0 <= t <= t_end - 0.25]
+pre_rps = len(pre) / (t_kill - 0.25 - (t_start + 1.0))
+post_rps = len(post) / (t_end - 0.25 - (t_kill + 2.0))
+floor = pre_rps * (N_REPLICAS - 1) / N_REPLICAS
+print("chaos serving OK: %d reqs, 0 errors, all bit-identical | "
+      "pre-kill %.1f req/s, post-kill %.1f req/s (floor %.1f) | "
+      "failovers=%d router_retry=%d live=%s"
+      % (len(records), pre_rps, post_rps, floor,
+         stats.get("failovers", 0), stats.get("router_retry", 0), live),
+      flush=True)
+assert post_rps >= floor, \
+    "throughput did not recover: %.1f < %.1f req/s" % (post_rps, floor)
+EOF
+
+echo "chaos serving lane: all green"

@@ -34,17 +34,27 @@ Fault spec grammar (clauses joined by ``;`` or ``,``)::
     clause   := site ":" trigger ":" action
     site     := "run" | "feed" | "save" | "fetch"
               | "collective" | "barrier" | "heartbeat"
+              | "dispatch" | "replica"
     trigger  := "every=" N | "at=" N      (N counts checks at that site,
                                            1-based)
-    action   := exception class name (builtins or "EOFException"), or
+    action   := exception class name (builtins or "EOFException"),
                 "nan" (site "fetch" only: corrupt the first fetched
-                float into NaN)
+                float into NaN), or "slow" (sleep
+                PADDLE_TPU_FAULT_SLOW_S seconds, default 0.25 — the
+                straggler/slow-replica drill)
 
 The fleet-level sites (see ``parallel/elastic.py``): ``collective``
 fires in the collective-op lowerings (``ops/collective_ops.py``) and
 the store-backed all-reduce, ``barrier`` in ``Fleet.barrier_worker`` /
 the elastic rendezvous paths, ``heartbeat`` in the beacon writer — so a
 "worker goes silent mid-run" drill is one env var away.
+
+The serving-fleet sites (see ``serving/router.py``): ``dispatch``
+fires in the router's per-attempt dispatch path, ``replica`` in each
+replica's admission path — replica kill is ``replica:at=N:RuntimeError``
+(the router fails over), replica slow is ``replica:every=N:slow`` (the
+straggler classifier demotes it), and partition is a ``heartbeat``
+fault on one replica's beater (beacons stop while the engine lives).
 
 With the env var unset and no injector installed, the hooks are inert
 (one dict lookup per site check).
@@ -163,6 +173,17 @@ def collective_check(what, site="collective"):
 # ---------------------------------------------------------------------------
 
 _NAN_ACTION = "nan"
+_SLOW_ACTION = "slow"
+_SLOW_S_ENV = "PADDLE_TPU_FAULT_SLOW_S"
+
+
+def _slow_seconds():
+    try:
+        return max(0.0, float(os.environ.get(_SLOW_S_ENV, 0.25)))
+    except (TypeError, ValueError):
+        return 0.25
+
+
 _CLAUSE_RE = re.compile(
     r"^(?P<site>[a-z_]+):(?P<mode>every|at)=(?P<n>\d+):(?P<action>\w+)$"
 )
@@ -202,7 +223,7 @@ def _resolve_exception(name):
         return exc
     raise FaultSpecError(
         "unknown fault action %r (want a builtin exception name, "
-        "'EOFException', or 'nan' for the fetch site)" % name
+        "'EOFException', 'slow', or 'nan' for the fetch site)" % name
     )
 
 
@@ -218,7 +239,8 @@ class FaultInjector:
     """
 
     SITES = frozenset({"run", "feed", "save", "fetch",
-                       "collective", "barrier", "heartbeat"})
+                       "collective", "barrier", "heartbeat",
+                       "dispatch", "replica"})
 
     _installed = None   # programmatic injector, wins over the env var
     _env_cached = None  # injector parsed from the env spec, counters live
@@ -253,6 +275,8 @@ class FaultInjector:
                     raise FaultSpecError(
                         "action 'nan' only applies to site 'fetch'")
                 exc = None
+            elif action == _SLOW_ACTION:
+                exc = None  # sleeps instead of raising (straggler drill)
             else:
                 exc = _resolve_exception(action)
             clause = _Clause(site, mode, n, action, exc)
@@ -291,12 +315,16 @@ class FaultInjector:
     # -- firing ----------------------------------------------------------
     def check(self, site):
         """Count a check at `site`; raise the first triggered exception
-        clause, or return True if a 'nan' clause fired."""
+        clause, or return True if a 'nan' clause fired. A triggered
+        'slow' clause sleeps PADDLE_TPU_FAULT_SLOW_S seconds in place —
+        the checked path stalls but survives."""
         nan_fired = False
         fire = None
         for clause in self._by_site.get(site, ()):
             if clause.poke():
-                if clause.exc is None:
+                if clause.action_name == _SLOW_ACTION:
+                    time.sleep(_slow_seconds())
+                elif clause.exc is None:
                     nan_fired = True
                 elif fire is None:
                     fire = clause

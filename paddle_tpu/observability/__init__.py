@@ -49,6 +49,19 @@ Well-known serving metrics (PR 5, ``paddle_tpu.serving``):
   ``compile_done`` events (source ``predictor``) — absent entirely on
   a compile-cache warm start.
 
+Well-known serving-fleet metrics (PR 7, ``serving.router``):
+
+- ``serving.replicas_live`` gauge — replicas currently taking traffic;
+  ``serving.rollout_state`` gauge — 0 idle / 1 rolling / 2 rolled-back.
+- ``serving.failovers`` / ``serving.router_retry`` /
+  ``serving.replica_dead`` counters — requests moved to a survivor,
+  all-shed backoff rounds, and replicas declared dead (each with a
+  flight-recorder event, source ``serving``).
+- ``serving.dispatch_seconds`` histogram — router pick-and-submit cost;
+  ``elastic.store_scan_cached`` / ``store_scan_full`` counters and the
+  ``elastic.store_scan_seconds`` histogram expose the FileStore
+  mtime-cache hit rate replica health polling rides on.
+
 Well-known analysis metrics (PR 6, ``paddle_tpu.analysis``):
 
 - ``analysis.verify_seconds`` histogram — cost of the static verify

@@ -153,6 +153,13 @@ class HeartbeatStore:
         """{key: payload} for every committed write in `namespace`."""
         raise NotImplementedError
 
+    def delete(self, namespace, key):
+        """Drop one committed write. Consumers that fully own a key
+        (the serving fleet's request/response mailboxes) garbage-
+        collect it so sustained traffic doesn't grow ``all()`` scans
+        without bound. Deleting a missing key is a no-op."""
+        raise NotImplementedError
+
 
 class InMemoryStore(HeartbeatStore):
     """Single-process fleets (threads as simulated workers) — and the
@@ -170,20 +177,46 @@ class InMemoryStore(HeartbeatStore):
         with self._lock:
             return {k: dict(v) for k, v in self._data[namespace].items()}
 
+    def delete(self, namespace, key):
+        with self._lock:
+            self._data[namespace].pop(str(key), None)
+
 
 class FileStore(HeartbeatStore):
     """Multi-process fleets on a shared filesystem: one JSON file per
     (namespace, key), committed by atomic tmp+rename so a reader never
-    observes a torn beacon. Namespaces become directories."""
+    observes a torn beacon. Namespaces become directories.
+
+    Reads are mtime-gated: ``all()`` caches the parsed namespace and
+    serves it back as long as the directory mtime is unchanged AND the
+    cached scan started comfortably after the last modification (the
+    slack absorbs coarse filesystem timestamp granularity — a write
+    landing in the same mtime tick as the scan can never validate the
+    cache). A 16-replica router polling heartbeats at 100ms then costs
+    one ``stat()`` per poll between beacons instead of 16 opens + JSON
+    parses. ``elastic.store_scan_cached`` / ``elastic.store_scan_full``
+    counters and the ``elastic.store_scan_seconds`` histogram expose
+    the hit rate and the per-scan cost."""
+
+    # a cached scan only validates once the directory has been quiet
+    # for this long: kernels stamp directory mtimes from a coarse clock
+    # (up to ~10ms per tick), so "same mtime" alone cannot prove "no
+    # write since the scan"
+    MTIME_SLACK_NS = 50_000_000
 
     def __init__(self, root):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        self._cache_lock = threading.Lock()
+        self._cache = {}   # dir -> (dir_mtime_ns, scan_wall_ns, parsed)
+        self._made = set()  # dirs already created (skip makedirs per op)
 
     def _dir(self, namespace):
         # namespaces may be hierarchical ("barrier/g0/shrink/3")
         d = os.path.join(self.root, *str(namespace).split("/"))
-        os.makedirs(d, exist_ok=True)
+        if d not in self._made:
+            os.makedirs(d, exist_ok=True)
+            self._made.add(d)
         return d
 
     def put(self, namespace, key, payload):
@@ -198,9 +231,21 @@ class FileStore(HeartbeatStore):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        # same-process readers must see this write on the next poll even
+        # if the directory mtime tick has not advanced
+        with self._cache_lock:
+            self._cache.pop(d, None)
 
-    def all(self, namespace):
+    def delete(self, namespace, key):
         d = self._dir(namespace)
+        try:
+            os.unlink(os.path.join(d, "%s.json" % key))
+        except OSError:
+            pass
+        with self._cache_lock:
+            self._cache.pop(d, None)
+
+    def _scan(self, d):
         out = {}
         for entry in os.listdir(d):
             if not entry.endswith(".json"):
@@ -210,6 +255,36 @@ class FileStore(HeartbeatStore):
                     out[entry[:-5]] = json.load(f)
             except (OSError, ValueError):
                 continue  # concurrent replace / torn write: skip
+        return out
+
+    def all(self, namespace):
+        d = self._dir(namespace)
+        t0 = time.monotonic()
+        try:
+            mtime = os.stat(d).st_mtime_ns
+        except OSError:
+            return {}
+        with self._cache_lock:
+            hit = self._cache.get(d)
+        if (hit is not None and hit[0] == mtime
+                and hit[1] > mtime + self.MTIME_SLACK_NS):
+            obs.inc("elastic.store_scan_cached")
+            obs.observe("elastic.store_scan_seconds",
+                        time.monotonic() - t0)
+            return {k: dict(v) for k, v in hit[2].items()}
+        scan_ns = time.time_ns()
+        out = self._scan(d)
+        try:
+            mtime_after = os.stat(d).st_mtime_ns
+        except OSError:
+            mtime_after = None
+        if mtime_after == mtime:
+            # nothing changed while we read: the parse is cacheable
+            with self._cache_lock:
+                self._cache[d] = (
+                    mtime, scan_ns, {k: dict(v) for k, v in out.items()})
+        obs.inc("elastic.store_scan_full")
+        obs.observe("elastic.store_scan_seconds", time.monotonic() - t0)
         return out
 
 
@@ -245,12 +320,24 @@ class HeartbeatMonitor:
         self._flagged_partition = set()
         self.generation = 0
 
+    # core beacon fields extras can never shadow
+    _CORE_FIELDS = frozenset(
+        {"worker", "step", "time", "latency", "state", "generation"})
+
     # -- publishing ------------------------------------------------------
-    def beat(self, step, latency=None, state="alive"):
+    def beat(self, step, latency=None, state="alive", extra=None):
+        """Publish this worker's beacon. `extra` merges additional
+        reporter fields (serving replicas ride it for queue depth /
+        model version) without touching the core health record — and
+        survives ``keepalive()`` re-stamps."""
         self._fault("heartbeat")
-        rec = {"worker": self.worker_index, "step": int(step),
-               "time": time.time(), "latency": latency, "state": state,
-               "generation": int(self.generation)}
+        rec = {}
+        if extra:
+            rec.update({k: v for k, v in dict(extra).items()
+                        if k not in self._CORE_FIELDS})
+        rec.update({"worker": self.worker_index, "step": int(step),
+                    "time": time.time(), "latency": latency,
+                    "state": state, "generation": int(self.generation)})
         self.store.put(self.NAMESPACE, self.worker_index, rec)
         self._last = rec
         return rec
@@ -260,7 +347,9 @@ class HeartbeatMonitor:
         as death to the peers)."""
         if self._last is not None:
             self.beat(self._last["step"], self._last.get("latency"),
-                      self._last.get("state", "alive"))
+                      self._last.get("state", "alive"),
+                      extra={k: v for k, v in self._last.items()
+                             if k not in self._CORE_FIELDS})
 
     def leave(self):
         """Clean departure — peers see 'left', not silence."""

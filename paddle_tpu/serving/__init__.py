@@ -9,12 +9,19 @@ cost scales with batches dispatched, not requests received.
 Layers::
 
     ModelRegistry          named models, isolated scopes, atomic hot reload
-      └─ ServingEngine     bounded queue + dispatch thread, dynamic
+      └─ ServingRouter     N replicas, heartbeat-driven health, least-
+                           loaded dispatch, shed-aware failover, warm
+                           standby autoscale, rolling version rollout
+        └─ ServingEngine   bounded queue + dispatch thread, dynamic
                            micro-batching, deadlines, load shedding
-           └─ Predictor    AOT executable per shape bucket, pre-warmed
+             └─ Predictor  AOT executable per shape bucket, pre-warmed
                            through fluid.compile_cache (restart == warm)
     ServingServer          stdlib HTTP/JSON frontend
                            (/v1/models/<name>:predict, /healthz, /metrics)
+
+A single-engine deployment stays exactly as before (``reg.load``); a
+fleet swaps in one line — ``reg.publish("m", router.local_fleet(dir,
+n_replicas=4))`` — because the router wears the engine's duck type.
 
 Quick start::
 
@@ -30,7 +37,11 @@ Well-known telemetry (``paddle_tpu.observability``):
 ``serving.queue_wait_seconds`` / ``batch_size`` / ``batch_rows`` /
 ``padding_waste`` / ``request_seconds`` histograms,
 ``serving.shed`` / ``serving.deadline_miss`` counters (each reject also
-lands in the flight recorder), ``serving.queue_depth.<model>`` gauges.
+lands in the flight recorder), ``serving.queue_depth.<model>`` gauges —
+plus the fleet layer: ``serving.replicas_live`` /
+``serving.rollout_state`` gauges, ``serving.failovers`` /
+``serving.router_retry`` / ``serving.replica_dead`` counters, and the
+``serving.dispatch_seconds`` histogram.
 """
 from .batcher import BucketSpec, round_up_pow2, tail_signature  # noqa: F401
 from .engine import (  # noqa: F401
@@ -38,9 +49,17 @@ from .engine import (  # noqa: F401
 )
 from .http import ServingHandler, ServingServer  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
+from .router import (  # noqa: F401
+    LocalReplica, NoReplicasError, ReplicaGoneError, ReplicaWorker,
+    RolloutError, ServingRouter, StoreReplica, local_fleet,
+    make_engine_factory,
+)
 
 __all__ = [
     "BucketSpec", "DeadlineExceededError", "EngineClosedError",
-    "ModelRegistry", "ServingEngine", "ServingHandler", "ServingServer",
-    "ShedError", "round_up_pow2", "tail_signature",
+    "LocalReplica", "ModelRegistry", "NoReplicasError", "ReplicaGoneError",
+    "ReplicaWorker", "RolloutError", "ServingEngine", "ServingHandler",
+    "ServingRouter", "ServingServer", "ShedError", "StoreReplica",
+    "local_fleet", "make_engine_factory", "round_up_pow2",
+    "tail_signature",
 ]

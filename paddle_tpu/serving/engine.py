@@ -45,7 +45,19 @@ __all__ = [
 
 
 class ShedError(RuntimeError):
-    """Fast-reject: the bounded request queue is full (load shedding)."""
+    """Fast-reject: the bounded request queue is full (load shedding).
+
+    Carries enough context for the HTTP frontend to answer usefully:
+    ``model`` / ``replica`` identify who shed, ``retry_after`` is the
+    engine's drain-rate-derived backoff hint in seconds (the 429
+    ``Retry-After`` header upstream)."""
+
+    def __init__(self, message="", model=None, replica=None,
+                 retry_after=None):
+        super().__init__(message)
+        self.model = model
+        self.replica = replica
+        self.retry_after = retry_after
 
 
 class DeadlineExceededError(RuntimeError):
@@ -68,9 +80,10 @@ class ServingEngine:
     def __init__(self, predictor, buckets=(), max_batch_size=8,
                  max_wait_ms=2.0, queue_capacity=64,
                  default_deadline_ms=None, request_timeout_s=60.0,
-                 name="default", auto_start=True):
+                 name="default", replica_id=None, auto_start=True):
         self._predictor = predictor
         self.name = str(name)
+        self.replica_id = replica_id
         self._max_batch_size = int(max_batch_size)
         self._max_wait_s = float(max_wait_ms) / 1000.0
         self._default_deadline_ms = default_deadline_ms
@@ -82,9 +95,19 @@ class ServingEngine:
         }
         self._stop_event = threading.Event()
         self._closed = False
+        # admission vs stop() is a race without this lock: a submitter
+        # that passed the closed check could land its queue.put AFTER a
+        # drain finished, silently stranding the request. Admission
+        # (closed check + put) and the stop-side closed flip are both
+        # atomic under _admit_lock, so every request either reaches the
+        # queue before the drain starts or gets EngineClosedError.
+        self._admit_lock = threading.Lock()
         self._thread = None
         self._stats_lock = threading.Lock()
         self._stats = collections.Counter()
+        # (t_done, n_requests) per dispatched group — the drain-rate
+        # window behind retry_after_hint()
+        self._rate = collections.deque(maxlen=64)
         if auto_start:
             self.start()
 
@@ -104,7 +127,8 @@ class ServingEngine:
         """Stop admitting work; with ``drain=True`` finish everything
         already queued first, else fail queued requests with
         :class:`EngineClosedError`. Idempotent."""
-        self._closed = True
+        with self._admit_lock:
+            self._closed = True
         alive = self._thread is not None and self._thread.is_alive()
         if drain and alive:
             t_end = time.monotonic() + float(timeout)
@@ -132,7 +156,7 @@ class ServingEngine:
         the coalesced batch). Raises :class:`ShedError` immediately when
         the queue is full and :class:`EngineClosedError` after
         ``stop()``."""
-        if self._closed:
+        if self._closed:  # cheap early reject; re-checked under the lock
             raise EngineClosedError(
                 "engine %r is draining/stopped" % self.name)
         prepared, _ = self._predictor._prepare(feeds)
@@ -159,14 +183,22 @@ class ServingEngine:
         req.future = Future()
         req.t_enqueue = time.monotonic()
         try:
-            self._q.put_nowait(req)
+            with self._admit_lock:
+                if self._closed:
+                    raise EngineClosedError(
+                        "engine %r is draining/stopped" % self.name)
+                self._q.put_nowait(req)
         except queue.Full:
             self._bump("shed")
             obs.event("shed", source="serving", model=self.name, rows=rows,
                       queue_capacity=self._q.maxsize)
             raise ShedError(
-                "serving queue full (%d) for model %r — request shed"
-                % (self._q.maxsize, self.name))
+                "serving queue full (%d) for model %r%s — request shed"
+                % (self._q.maxsize, self.name,
+                   "" if self.replica_id is None
+                   else " (replica %s)" % self.replica_id),
+                model=self.name, replica=self.replica_id,
+                retry_after=self.retry_after_hint())
         self._bump("requests")
         obs.set_gauge("serving.queue_depth.%s" % self.name, self._q.qsize())
         return req.future
@@ -294,6 +326,8 @@ class ServingEngine:
                       % (type(e).__name__, str(e)[:200]))
             for r in reqs:
                 r.future.set_exception(e)
+            with self._stats_lock:  # errors still drain the queue
+                self._rate.append((time.monotonic(), len(reqs)))
             return
         self._bump("batches")
         if len(reqs) > 1:
@@ -303,6 +337,8 @@ class ServingEngine:
         obs.observe("serving.batch_rows", rows)
         obs.observe("serving.padding_waste", (target - rows) / float(target))
         done = time.monotonic()
+        with self._stats_lock:
+            self._rate.append((done, len(reqs)))
         off = 0
         for r in reqs:
             # copy the slices: a view would pin the whole padded batch
@@ -330,6 +366,26 @@ class ServingEngine:
 
     def queue_depth(self):
         return self._q.qsize()
+
+    def drain_rate(self):
+        """Requests/sec the dispatch loop completed over its recent
+        window (None until the first batch lands, or after 30s idle)."""
+        now = time.monotonic()
+        with self._stats_lock:
+            pts = [(t, n) for t, n in self._rate if now - t < 30.0]
+        if not pts:
+            return None
+        span = max(1e-3, now - min(t for t, _ in pts))
+        return sum(n for _, n in pts) / span
+
+    def retry_after_hint(self):
+        """Seconds until the current queue likely drains at the
+        observed rate — what a shed client should wait before retrying
+        (the HTTP 429 ``Retry-After``). Clamped to [1, 60]."""
+        rate = self.drain_rate()
+        if not rate:
+            return 1.0
+        return min(60.0, max(1.0, (self.queue_depth() + 1) / rate))
 
     @property
     def predictor(self):

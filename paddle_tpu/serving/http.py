@@ -17,9 +17,12 @@ thread per model does the batching):
   (``render_prom()``): serving histograms with p50/p90/p99 quantiles,
   shed/deadline-miss counters, queue-depth gauges.
 
-Status mapping (the admission-control surface): 429 shed (queue full),
-504 deadline missed or wait timeout, 503 draining/stopped, 404 unknown
-model, 400 malformed request.
+Status mapping (the admission-control surface): 429 shed (queue full —
+the JSON body names the shedding model + replica and the response
+carries a ``Retry-After`` header derived from the engine's observed
+queue drain rate), 504 deadline missed or wait timeout, 503
+draining/stopped or a replica fleet with zero live replicas, 404
+unknown model, 400 malformed request.
 
 Standalone entry point::
 
@@ -47,13 +50,37 @@ class ServingHandler(BaseHTTPRequestHandler):
     def log_message(self, format, *args):  # noqa: A002 — stdlib signature
         pass  # request logging goes through the telemetry hub, not stderr
 
-    def _send_json(self, code, doc):
+    def _send_json(self, code, doc, headers=None):
         body = json.dumps(doc).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    @staticmethod
+    def _shed_doc(e, name, engine):
+        """429 body: who shed (model + replica), so a client/router tier
+        above can steer, not just back off."""
+        return {
+            "error": str(e),
+            "model": getattr(e, "model", None) or name,
+            "replica": getattr(e, "replica", None),
+            "retry_after_s": getattr(e, "retry_after", None),
+        }
+
+    @staticmethod
+    def _shed_headers(e, engine):
+        """Retry-After derived from the shedding engine's queue drain
+        rate (whole seconds, >= 1 per RFC 9110)."""
+        hint = getattr(e, "retry_after", None)
+        if hint is None:
+            hinter = getattr(engine, "retry_after_hint", None)
+            hint = hinter() if hinter is not None else None
+        seconds = max(1, int(-(-float(hint) // 1))) if hint else 1
+        return {"Retry-After": str(seconds)}
 
     def do_GET(self):  # noqa: N802 — stdlib handler name
         if self.path == "/healthz":
@@ -102,9 +129,11 @@ class ServingHandler(BaseHTTPRequestHandler):
         try:
             fut = engine.submit(feeds, deadline_ms=deadline_ms)
         except ShedError as e:
-            return self._send_json(429, {"error": str(e)})
+            return self._send_json(429, self._shed_doc(e, name, engine),
+                                   headers=self._shed_headers(e, engine))
         except EngineClosedError as e:
-            return self._send_json(503, {"error": str(e)})
+            return self._send_json(
+                503, {"error": str(e), "model": name})
         except (ValueError, KeyError) as e:
             return self._send_json(
                 400, {"error": "bad request: %s: %s"
@@ -114,13 +143,24 @@ class ServingHandler(BaseHTTPRequestHandler):
                 timeout_s if timeout_s is not None
                 else engine.request_timeout_s)
         except DeadlineExceededError as e:
-            return self._send_json(504, {"error": str(e)})
+            return self._send_json(504, {"error": str(e), "model": name})
+        except ShedError as e:
+            # the router retried across every replica and all of them
+            # shed — same backpressure contract as a direct shed
+            return self._send_json(429, self._shed_doc(e, name, engine),
+                                   headers=self._shed_headers(e, engine))
         except _FutureTimeout:
             return self._send_json(
-                504, {"error": "timed out waiting for model %r" % name})
+                504, {"error": "timed out waiting for model %r" % name,
+                      "model": name})
         except EngineClosedError as e:
-            return self._send_json(503, {"error": str(e)})
+            return self._send_json(503, {"error": str(e), "model": name})
         except Exception as e:  # noqa: BLE001 — model errors -> 500, not a dead conn
+            if type(e).__name__ == "NoReplicasError":
+                # fleet router with zero live replicas: unavailable,
+                # not an internal error (avoids importing router here)
+                return self._send_json(
+                    503, {"error": str(e), "model": name})
             return self._send_json(
                 500, {"error": "%s: %s" % (type(e).__name__, e)})
         self._send_json(200, {"outputs": [

@@ -48,11 +48,21 @@ class ModelRegistry:
 
         opts = dict(self._engine_defaults)
         opts.update(engine_opts)
+        # every failure below happens BEFORE the publish swap: a build
+        # or warmup error on the replacement leaves the currently-
+        # published version serving untouched (no version limbo)
         predictor = Predictor.from_model(
             str(dirname), **dict(predictor_opts or {}))
         engine = ServingEngine(
             predictor, buckets=buckets, name=str(name), **opts)
-        warm_report = engine.warmup() if warm else []
+        try:
+            warm_report = engine.warmup() if warm else []
+        except Exception:
+            # don't leak the stillborn engine's dispatch thread
+            engine.stop(drain=False, timeout=1.0)
+            obs.event("model_load_failed", source="serving",
+                      model=str(name), dirname=str(dirname))
+            raise
         with self._lock:
             old = self._models.get(name)
             version = (old["version"] + 1) if old else 1
@@ -76,6 +86,34 @@ class ModelRegistry:
             ).start()
         return engine
 
+    def publish(self, name, engine, dirname=None):
+        """Publish a pre-built engine-like object — anything with the
+        ServingEngine surface (``submit``/``predict``/``stats``/
+        ``queue_depth``/``stop``), notably a
+        :class:`~paddle_tpu.serving.router.ServingRouter` fronting N
+        replicas — under `name` with the same atomic-swap semantics as
+        :meth:`load`. The registry does not build, warm, or reload it;
+        lifecycle beyond the swap/drain belongs to the caller."""
+        with self._lock:
+            old = self._models.get(name)
+            version = (old["version"] + 1) if old else 1
+            self._models[name] = {
+                "engine": engine, "dirname": str(dirname or ""),
+                "version": version, "buckets": (), "warm": False,
+                "predictor_opts": {}, "engine_opts": {},
+                "published": True,
+            }
+        obs.event("model_publish", source="serving", model=str(name),
+                  version=version,
+                  engine_kind=type(engine).__name__)
+        if old is not None:
+            threading.Thread(
+                target=old["engine"].stop, kwargs={"drain": True},
+                daemon=True,
+                name="serving-drain-%s-v%d" % (name, old["version"]),
+            ).start()
+        return engine
+
     def reload(self, name, dirname=None):
         """Hot-reload `name` — from a new directory when given, else
         re-reading the one it was loaded from — with the same buckets
@@ -84,6 +122,11 @@ class ModelRegistry:
             cur = self._models.get(name)
         if cur is None:
             raise KeyError("no model %r loaded" % name)
+        if cur.get("published"):
+            raise ValueError(
+                "model %r was publish()ed, not load()ed — reload it "
+                "through its own surface (e.g. "
+                "ServingRouter.rolling_reload)" % name)
         return self.load(
             name, dirname if dirname is not None else cur["dirname"],
             buckets=cur["buckets"], warm=cur["warm"],

@@ -35,6 +35,11 @@ def pytest_configure(config):
         "analysis: static-analyzer tests (paddle_tpu.analysis: "
         "verifier/shape checker/TPU-lint/scope sanitizer); `pytest -m "
         "analysis` is the lane bench_experiments/analysis_lane.sh runs")
+    config.addinivalue_line(
+        "markers",
+        "chaos: serving-fleet kill/brownout drills (replica SIGKILL, "
+        "fault-site drills); `pytest -m chaos` is the lane "
+        "bench_experiments/chaos_serving_lane.sh runs")
 
 
 @pytest.fixture(autouse=True)

@@ -19,6 +19,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
 from paddle_tpu.fluid import resilience as R
 from paddle_tpu.parallel import checkpoint as ckpt
 from paddle_tpu.parallel import elastic as E
@@ -59,6 +60,10 @@ def test_inmemory_store_roundtrip_and_isolation():
     s.all("hb")["0"]["step"] = -1
     assert s.all("hb")["0"]["step"] == 1
     assert s.all("empty") == {}
+    # consumers GC their mailboxes; deleting a missing key is a no-op
+    s.delete("hb", 0)
+    s.delete("hb", "never-existed")
+    assert s.all("hb") == {"1": {"step": 2}}
 
 
 def test_file_store_roundtrip_torn_write_and_hierarchy(tmp_path):
@@ -77,6 +82,54 @@ def test_file_store_roundtrip_torn_write_and_hierarchy(tmp_path):
     # a second write wins atomically
     s.put("heartbeat", 3, {"step": 8, "state": "alive"})
     assert s.all("heartbeat")["3"]["step"] == 8
+    # delete GCs the beacon file (and a missing key is a no-op)
+    s.delete("heartbeat", 3)
+    s.delete("heartbeat", "never-existed")
+    assert "3" not in s.all("heartbeat")
+
+
+def test_file_store_mtime_cache_serves_repeats_without_rescanning(tmp_path):
+    # counter deltas use >=: other tests' leftover daemon beaters may
+    # poll their own FileStores and bump the same process-wide counters
+    s = E.FileStore(str(tmp_path / "store"))
+    s.put("hb", 0, {"step": 1})
+    s.put("hb", 1, {"step": 2})
+    # let the directory mtime tick age past the slack window so the
+    # first scan is allowed to validate its cache entry
+    time.sleep(s.MTIME_SLACK_NS / 1e9 + 0.05)
+    first = s.all("hb")
+    assert first == {"0": {"step": 1}, "1": {"step": 2}}
+    assert s._cache, "first quiet scan did not populate the cache"
+    cached_before = obs.counter("elastic.store_scan_cached")
+    second = s.all("hb")
+    third = s.all("hb")
+    assert obs.counter("elastic.store_scan_cached") >= cached_before + 2
+    # cached reads are equal to the fresh scan but independent copies
+    assert second == first and third == first
+    second["0"]["step"] = -99
+    assert s.all("hb")["0"]["step"] == 1
+
+
+def test_file_store_put_invalidates_mtime_cache(tmp_path):
+    s = E.FileStore(str(tmp_path / "store"))
+    s.put("hb", 0, {"step": 1})
+    time.sleep(s.MTIME_SLACK_NS / 1e9 + 0.05)
+    s.all("hb")
+    assert s._cache, "quiet scan did not populate the cache"
+    # a write drops the cache entry: the next read is a full scan that
+    # observes the new payload, even within the same mtime tick
+    s.put("hb", 0, {"step": 2})
+    assert not s._cache, "put() left a stale cache entry behind"
+    full_before = obs.counter("elastic.store_scan_full")
+    assert s.all("hb")["0"]["step"] == 2
+    assert obs.counter("elastic.store_scan_full") >= full_before + 1
+    # delete() invalidates the same way
+    time.sleep(s.MTIME_SLACK_NS / 1e9 + 0.05)
+    s.all("hb")
+    assert s._cache
+    s.delete("hb", 0)
+    assert not s._cache
+    assert s.all("hb") == {}
 
 
 # ---------------------------------------------------------------------------

@@ -294,6 +294,60 @@ def test_graceful_drain_and_closed_reject(tmp_path):
         f.result(timeout=1)
 
 
+def test_drain_vs_submit_race_never_strands_a_request(tmp_path):
+    """Regression (ISSUE 7 satellite): a submit that passed the cheap
+    closed check while ``stop(drain=True)`` ran concurrently used to
+    land its queue.put AFTER the drain finished — a silent drop (the
+    future never resolved). Admission and the stop-side closed flip are
+    now atomic under the admit lock, so the request either reaches the
+    queue before the drain starts (and gets served) or raises
+    EngineClosedError. This test pins the interleaving with a gated
+    queue: the submitter is paused INSIDE admission, stop() is issued,
+    and stop must block until the put completes."""
+    engine, _ = _mk_engine(tmp_path, auto_start=True, max_wait_ms=1.0)
+    entered, release = threading.Event(), threading.Event()
+    inner = engine._q
+
+    class GatedQueue:
+        def put_nowait(self, item):
+            entered.set()
+            assert release.wait(timeout=10), "gate never released"
+            return inner.put_nowait(item)
+
+        def __getattr__(self, name):
+            return getattr(inner, name)
+
+    engine._q = GatedQueue()
+    xv = np.ones((2, 6), np.float32)
+    result = {}
+
+    def submitter():
+        result["future"] = engine.submit({"x": xv})
+
+    t_submit = threading.Thread(target=submitter, daemon=True)
+    t_submit.start()
+    assert entered.wait(timeout=10)  # paused mid-admission, lock held
+
+    t_stop = threading.Thread(
+        target=engine.stop, kwargs={"drain": True}, daemon=True)
+    t_stop.start()
+    time.sleep(0.1)
+    # the fix under test: stop() must NOT have completed the drain
+    # while a submitter is inside admission
+    assert t_stop.is_alive(), \
+        "stop() finished around an in-progress submit"
+    release.set()
+    t_submit.join(timeout=10)
+    t_stop.join(timeout=10)
+    engine._q = inner
+    # the raced request was either served or failed loudly — never
+    # silently stranded
+    out, = result["future"].result(timeout=10)
+    assert out.shape == (2, 3)
+    with pytest.raises(EngineClosedError):
+        engine.submit({"x": xv})
+
+
 def test_warmup_covers_buckets_no_recompile_in_traffic(tmp_path):
     engine, pred = _mk_engine(tmp_path, max_wait_ms=1.0)
     report = engine.warmup()
@@ -396,6 +450,69 @@ def test_hot_reload_swaps_mid_traffic(tmp_path):
     reg.close()
 
 
+def test_reload_failure_leaves_current_version_serving(
+        tmp_path, monkeypatch):
+    """ISSUE 7 satellite: a reload whose replacement fails mid-build
+    (corrupt dir) or mid-warmup must leave v1 published and serving —
+    same engine object, same version, zero request errors, no limbo."""
+    from paddle_tpu.serving import registry as registry_mod
+
+    d1 = tmp_path / "v1"
+    _build_and_save(d1, seed=7)
+    reg = ModelRegistry(max_wait_ms=1.0)
+    reg.load("m", d1, buckets=[BucketSpec({"x": (6,)},
+                                          batch_sizes=(2, 4))])
+    v1_engine = reg.get("m")
+    xv = np.ones((2, 6), np.float32)
+    ref1 = v1_engine.predict({"x": xv})[0]
+
+    stop, errs = threading.Event(), []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                out = reg.get("m").predict({"x": xv})[0]
+                np.testing.assert_array_equal(out, ref1)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+
+    # failure 1: the replacement predictor cannot even build
+    with pytest.raises(Exception):
+        reg.reload("m", tmp_path / "no-such-dir")
+    assert reg.version("m") == 1 and reg.get("m") is v1_engine
+
+    # failure 2: the replacement builds but its warmup blows up
+    class BoomEngine(ServingEngine):
+        def warmup(self):
+            raise RuntimeError("seeded warmup failure")
+
+    monkeypatch.setattr(registry_mod, "ServingEngine", BoomEngine)
+    obs.reset()
+    with pytest.raises(RuntimeError, match="seeded warmup failure"):
+        reg.reload("m", d1)
+    assert obs.get_recorder().of("model_load_failed")
+    monkeypatch.undo()
+
+    # no version limbo: v1 still the published engine, still serving
+    assert reg.version("m") == 1
+    assert reg.get("m") is v1_engine and not v1_engine.closed
+    time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errs, errs[:3]
+    # and a clean reload still works afterwards
+    reg.reload("m", d1)
+    assert reg.version("m") == 2
+    reg.close()
+
+
 # ---------------------------------------------------------------------------
 # HTTP frontend
 # ---------------------------------------------------------------------------
@@ -435,6 +552,40 @@ def test_http_errors_and_health(tmp_path):
         assert e.code == 404
     else:
         raise AssertionError("GET /nothing-here returned %s" % status)
+    finally:
+        srv.stop(close_registry=True)
+
+
+def test_http_429_retry_after_and_error_body(tmp_path):
+    """ISSUE 7 satellite: a shed response carries a ``Retry-After``
+    header derived from the engine's observed queue drain rate, and the
+    JSON body names the shedding model (and replica, when the engine is
+    fleet-addressed)."""
+    d = tmp_path / "m"
+    _build_and_save(d)
+    reg = ModelRegistry()
+    engine = reg.load("tiny", d, warm=False, queue_capacity=1,
+                      auto_start=False)
+    srv = ServingServer(reg).start()
+    try:
+        engine.submit({"x": np.zeros((1, 6), np.float32)})  # queue full
+        # a known drain rate makes the hint deterministic:
+        # (depth 1 + 1) / 0.5 req/s = 4 s
+        engine.drain_rate = lambda: 0.5
+        req = urllib.request.Request(
+            srv.url + "/v1/models/tiny:predict",
+            data=json.dumps({"feeds": {"x": [[0.0] * 6]}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        e = ei.value
+        assert e.code == 429
+        assert e.headers["Retry-After"] == "4"
+        doc = json.load(e)
+        assert doc["model"] == "tiny"
+        assert "replica" in doc  # None for a solo engine, rid in a fleet
+        assert doc["retry_after_s"] == 4.0
+        assert "queue full" in doc["error"]
     finally:
         srv.stop(close_registry=True)
 
@@ -497,10 +648,13 @@ def test_http_acceptance_mixed_shape_clients(tmp_path):
         # shed half: a capacity-1, never-started second model -> 429s
         shed_engine = reg.load(
             "tiny", d, warm=False, queue_capacity=1, auto_start=False)
+        # server-side wait (timeout_s) must sit well under the client
+        # socket timeout or request 1's 504-vs-client-timeout race flips
+        # under load
         codes = [
             _post(srv.url + "/v1/models/tiny:predict",
                   {"feeds": {"x": [[0.0] * 6]},
-                   "timeout_s": 30})[0]
+                   "timeout_s": 5}, timeout=30)[0]
             for _ in range(3)
         ]
         # request 1 queues; 2 and 3 hit the full queue
