@@ -66,24 +66,14 @@ def _atomic_write_json(path, obj):
 # leave margin so OUR line is printed first.
 DEADLINE_S = float(os.environ.get("PADDLE_TPU_BENCH_DEADLINE_S", 1500))
 
-# bf16 peak FLOPs/s per chip by device_kind substring (public figures).
-_PEAK_FLOPS = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
-
 def _peak_flops(device_kind):
-    dk = (device_kind or "").lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in dk:
-            return peak
-    return None
+    """bf16 peak FLOPs/s by device_kind — single source of truth is the
+    analyzer's device table (analysis/costs.py shares it with the
+    roofline model). Child-side only: the import keeps the supervisor
+    free of paddle_tpu/jax."""
+    from paddle_tpu.analysis.costs import peak_flops
+
+    return peak_flops(device_kind)
 
 
 def _compose(status):
@@ -518,12 +508,11 @@ class _Status:
 
 
 def _flops_per_token_train(cfg, seq):
-    """Analytic matmul FLOPs per trained token (fwd + bwd ~= 3x fwd)."""
-    d, L, V = cfg.hidden, cfg.num_layers, cfg.vocab_size
-    per_layer = 12 * d * d          # qkv (3d^2) + proj (d^2) + mlp (8d^2)
-    attn = 4 * seq * d              # QK^T and AV rows for one token
-    fwd = 2 * (L * (per_layer + attn) + d * V)
-    return 3 * fwd
+    """Analytic matmul FLOPs per trained token — shared with the static
+    cost model (analysis/costs.py). Child-side only import."""
+    from paddle_tpu.analysis.costs import bert_train_flops_per_token
+
+    return bert_train_flops_per_token(cfg, seq)
 
 
 def _measure(tag, on_accel, use_flash, batch, seq, n_steps,
@@ -611,6 +600,28 @@ def _measure(tag, on_accel, use_flash, batch, seq, n_steps,
         "loss_first": round(loss0, 4),
         "loss_last": round(last, 4),
     }
+    # static roofline prediction next to the measurement: the
+    # predicted-vs-measured column continuously validates the analyzer's
+    # cost model against this lane (never sink the bench on a model bug)
+    try:
+        import jax as _jax
+
+        from paddle_tpu.analysis import costs as _costs
+
+        pred = _costs.predict_program(
+            fluid.default_main_program(), feed_specs=feed,
+            fetch_names=[vs["loss"].name],
+            device_kind=getattr(_jax.devices()[0], "device_kind", None))
+        if pred.get("predicted_step_seconds"):
+            variant["predicted_step_ms"] = round(
+                1000 * pred["predicted_step_seconds"], 2)
+        if pred.get("predicted_mfu") is not None:
+            variant["predicted_mfu"] = round(pred["predicted_mfu"], 4)
+        if pred.get("predicted_peak_hbm_bytes") is not None:
+            variant["predicted_peak_hbm_gb"] = round(
+                pred["predicted_peak_hbm_bytes"] / 1e9, 3)
+    except Exception as e:  # noqa: BLE001 — prediction is advisory
+        variant["predicted_error"] = "%s: %s" % (type(e).__name__, e)
     if compile_cache.enabled():
         hits = obs.counter("compile_cache.disk_hit") - cc_hit0
         variant["compile_cache"] = {
@@ -983,6 +994,11 @@ def _bank(st, variant, cfg, on_accel, backend, device_kind):
         variant["mfu"] = round(
             variant["tokens_per_sec"]
             * _flops_per_token_train(cfg, variant["seq_len"]) / peak_v, 4)
+        if variant.get("predicted_mfu") and variant["mfu"]:
+            # model error of the static roofline vs the measurement
+            variant["mfu_model_err_pct"] = round(
+                100.0 * (variant["predicted_mfu"] - variant["mfu"])
+                / variant["mfu"], 1)
     st.data["variants"].append(variant)
     tps = variant["tokens_per_sec"]
     best = st.data["best"]

@@ -1,0 +1,171 @@
+#!/usr/bin/env bash
+# Cost lane: the smoke for the static cost & memory analyzer (ISSUE 8).
+#
+#   bash bench_experiments/cost_lane.sh
+#
+# Lane 1 runs the cost/memory pytest slice. Lane 2 is the CLI smoke:
+# `--cost` must produce byte-stable JSON across runs, `--json-out` must
+# write the same document it printed, and a seeded oversized program
+# (HBM capacity pinned to 1 KB via PADDLE_TPU_HBM_BYTES) must exit 1
+# with a predicted-oom diagnostic. Lane 3 validates the roofline: the
+# machine constant is calibrated from a bert_tiny step at batch 4, the
+# model predicts batch 8 (the bench CPU lane's operating point), and
+# predicted MFU must land within MFU_TOL (default 0.25) of measured.
+# Lane 4 prices the gate itself: the analyzer rides every first
+# compile, so its share of a short training wall must stay under 2%.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PADDLE_TPU_TELEMETRY=on
+
+MFU_TOL="${MFU_TOL:-0.25}"
+
+echo "== lane 1: cost/memory pytest slice =="
+python -m pytest -q -p no:cacheprovider tests/test_cost_analysis.py
+
+echo "== lane 2: CLI --cost stable JSON + seeded predicted-OOM =="
+WORK_DIR="$(mktemp -d /tmp/paddle_tpu_cost_lane.XXXXXX)"
+trap 'rm -rf "$WORK_DIR"' EXIT
+
+python - "$WORK_DIR" <<'EOF'
+import sys
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+
+work = sys.argv[1]
+fluid.default_startup_program().random_seed = 11
+x = fluid.data("x", [None, 16], dtype="float32")
+h = fluid.layers.fc(x, size=32, act="relu")
+out = fluid.layers.fc(h, size=4, act="softmax")
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+fluid.io.save_inference_model(work + "/model", ["x"], [out], exe)
+EOF
+
+python -m paddle_tpu.analysis "$WORK_DIR/model" --cost --device v5e \
+    --json-out "$WORK_DIR/cost_a.json" > "$WORK_DIR/stdout_a.json"
+python -m paddle_tpu.analysis "$WORK_DIR/model" --cost --device v5e \
+    --json-out "$WORK_DIR/cost_b.json" > "$WORK_DIR/stdout_b.json"
+diff "$WORK_DIR/stdout_a.json" "$WORK_DIR/stdout_b.json" || {
+    echo "FAIL: --cost JSON not stable across runs"; exit 1; }
+diff "$WORK_DIR/stdout_a.json" "$WORK_DIR/cost_a.json" || {
+    echo "FAIL: --json-out file differs from stdout"; exit 1; }
+grep -q '"predicted_mfu"' "$WORK_DIR/cost_a.json" || {
+    echo "FAIL: no predicted_mfu in the cost section"; exit 1; }
+echo "--cost JSON stable; --json-out round-trips"
+
+set +e
+PADDLE_TPU_HBM_BYTES=1000 python -m paddle_tpu.analysis \
+    "$WORK_DIR/model" --cost > "$WORK_DIR/oom.json"
+RC=$?
+set -e
+if [ "$RC" -ne 1 ]; then
+    echo "FAIL: oversized program exited $RC, want 1"
+    cat "$WORK_DIR/oom.json"; exit 1
+fi
+grep -q "predicted-oom" "$WORK_DIR/oom.json" || {
+    echo "FAIL: no predicted-oom diagnostic"; cat "$WORK_DIR/oom.json"
+    exit 1; }
+echo "seeded oversized program: exit 1 with predicted-oom"
+
+echo "== lane 3: predicted vs measured MFU within ${MFU_TOL} =="
+MFU_TOL="$MFU_TOL" python - <<'EOF'
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.analysis import costs
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.models import bert
+
+TOL = float(os.environ.get("MFU_TOL", "0.25"))
+
+
+def measure(batch, seq, n_steps=25):
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 7
+    cfg = bert.bert_tiny(seq=seq)
+    vs = bert.build_bert_pretrain(cfg, seq)
+    fluid.optimizer.Adam(learning_rate=1e-4).minimize(vs["loss"])
+    prog = fluid.default_main_program()
+    ids, labels = bert.synthetic_batch(cfg, batch, seq)
+    feed = {"input_ids": ids, "mlm_labels": labels}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed=feed, fetch_list=[vs["loss"]])   # compile
+    exe.run(feed=feed, fetch_list=[vs["loss"]])   # settle donation
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            out = exe.run(feed=feed, fetch_list=[vs["loss"]],
+                          return_numpy=False)
+        _ = float(np.asarray(out[0]))
+        dt = (time.perf_counter() - t0) / n_steps
+        best = dt if best is None else min(best, dt)
+    return best, prog, feed, vs["loss"].name
+
+
+# calibration point: bert_tiny at batch 4 yields the machine's
+# EFFECTIVE throughput on this op mix (folds memory traffic and
+# fusion at the operating point into one constant)
+t_cal, prog, feed, loss = measure(4, 64)
+rep_cal = costs.analyze_cost(prog, feed_specs=feed, fetch_names=[loss])
+peak_eff = rep_cal.total_flops / t_cal
+os.environ[costs.PEAK_FLOPS_ENV] = repr(peak_eff)
+os.environ[costs.HBM_BW_ENV] = "1e18"  # folded into the effective peak
+print("calibrated effective peak: %.3g flops/s (batch-4 step %.4fs)"
+      % (peak_eff, t_cal))
+
+# target: the bench CPU lane's operating point (bert_tiny, batch 8)
+t_meas, prog, feed, loss = measure(8, 64)
+pred = costs.predict_program(prog, feed_specs=feed, fetch_names=[loss])
+mfu_meas = pred["total_flops"] / (t_meas * peak_eff)
+mfu_pred = pred["predicted_mfu"]
+rel = abs(mfu_pred - mfu_meas) / mfu_meas
+print("step: measured %.4fs predicted %.4fs" % (
+    t_meas, pred["predicted_step_seconds"]))
+print("MFU: measured %.3f predicted %.3f (rel err %.2f, tol %.2f)"
+      % (mfu_meas, mfu_pred, rel, TOL))
+assert rel <= TOL, "predicted MFU off by %.0f%% > %.0f%%" % (
+    100 * rel, 100 * TOL)
+EOF
+
+echo "== lane 4: analysis-gate overhead under 2% of training wall =="
+python - <<'EOF'
+import time
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+
+t0 = time.monotonic()
+x = fluid.data("x", [None, 16], dtype="float32")
+y = fluid.data("y", [None, 1], dtype="float32")
+h = fluid.layers.fc(x, size=32, act="relu")
+pred = fluid.layers.fc(h, size=1)
+loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+rng = np.random.RandomState(0)
+for _ in range(30):
+    exe.run(feed={"x": rng.rand(8, 16).astype(np.float32),
+                  "y": rng.rand(8, 1).astype(np.float32)},
+            fetch_list=[loss])
+wall = time.monotonic() - t0
+h = obs.histogram("analysis.verify_seconds")
+assert h["count"] >= 1, "the analysis gate never ran"
+share = h["sum"] / wall
+print("analysis gate: %d run(s), %.4fs of %.3fs wall (%.2f%%)"
+      % (h["count"], h["sum"], wall, 100.0 * share))
+assert share < 0.02, "analysis gate costs %.2f%% > 2%%" % (100.0 * share)
+EOF
+
+echo "cost lane OK"

@@ -16,6 +16,11 @@ Three passes over the symbolic Program IR plus one runtime guard:
   bodies, collectives without deadlines, shape-vocabulary blowups.
 - :mod:`.sanitizer` — opt-in cross-thread Scope mutation detector
   (``PADDLE_TPU_SCOPE_SANITIZER=on``).
+- :mod:`.costs` / :mod:`.memory` — the quantitative layer: per-op
+  FLOPs/bytes from the same lowering registry (traced with
+  ``jax.make_jaxpr``), a roofline step-time/MFU prediction against the
+  shared device table, and def-use liveness peak-HBM estimation that
+  gates compile and serving admission with a predicted-OOM error.
 
 Entry points: :func:`analyze` (all passes), :func:`verify` (structural
 only), the ``python -m paddle_tpu.analysis <model_dir>`` CLI, and the
@@ -29,8 +34,9 @@ costs nothing until a pass is actually used, and the stdlib-only
 __all__ = [
     "analyze", "verify", "mode", "ANALYSIS_ENV",
     "AnalysisReport", "Diagnostic", "ProgramVerifyError",
+    "analyze_cost", "CostReport", "device_profile",
     "analyzer", "verifier", "shapes", "tpu_lint", "walker",
-    "diagnostics", "sanitizer", "cli",
+    "diagnostics", "sanitizer", "cli", "costs", "memory",
 ]
 
 _LAZY_ATTRS = {
@@ -41,10 +47,13 @@ _LAZY_ATTRS = {
     "AnalysisReport": ("diagnostics", "AnalysisReport"),
     "Diagnostic": ("diagnostics", "Diagnostic"),
     "ProgramVerifyError": ("diagnostics", "ProgramVerifyError"),
+    "analyze_cost": ("costs", "analyze_cost"),
+    "CostReport": ("costs", "CostReport"),
+    "device_profile": ("costs", "device_profile"),
 }
 
 _SUBMODULES = ("analyzer", "verifier", "shapes", "tpu_lint", "walker",
-               "diagnostics", "sanitizer", "cli")
+               "diagnostics", "sanitizer", "cli", "costs", "memory")
 
 
 def __getattr__(name):

@@ -4,17 +4,26 @@ executor's verify-on-first-compile mode switch.
 ``PADDLE_TPU_ANALYSIS`` selects what gates a compile:
 
 - ``off``    — no analysis (bit-for-bit the pre-analyzer executor).
-- ``verify`` — (default) the structural verifier only: a pure-python
-  walk, microseconds even on big programs, catching everything that
-  would die at lowering time with attributed diagnostics instead.
-- ``full``   — verifier + abstract shape/dtype propagation + TPU-lint.
-  Costs one ``jax.eval_shape`` per op; meant for CI lanes, the CLI, and
-  first-failure triage (GuardedExecutor re-runs it on a failed
-  dispatch), not for every interactive run.
+- ``verify`` — (default) the structural verifier + the pure-python
+  liveness peak-HBM estimate (microseconds even on big programs),
+  catching everything that would die at lowering time — and programs
+  that provably cannot fit the device — with attributed diagnostics.
+- ``full``   — verifier + abstract shape/dtype propagation + the
+  roofline cost model (per-op FLOPs/bytes, predicted step seconds and
+  MFU) + TPU-lint. Costs one ``jax.eval_shape``/``make_jaxpr`` per op;
+  meant for CI lanes, the CLI, and first-failure triage
+  (GuardedExecutor re-runs it on a failed dispatch), not for every
+  interactive run.
+
+The predicted-OOM check compares the liveness peak against the device
+HBM capacity (table entry for the device kind, or
+``PADDLE_TPU_HBM_BYTES``); when it trips, the gate raises with an
+``error``-severity Diagnostic attributed to the op resident at the
+peak — BEFORE any ``compile_start`` event.
 """
 import os
 
-from .diagnostics import AnalysisReport
+from .diagnostics import ERROR, AnalysisReport
 from . import verifier
 
 __all__ = ["analyze", "mode", "ANALYSIS_ENV", "MODES"]
@@ -31,22 +40,28 @@ def mode(default="verify"):
 
 def analyze(program, feed_names=(), fetch_names=(), state_names=None,
             feed_specs=None, state_specs=None, platform="cpu",
-            level="full", is_test=False, default_dim=None):
+            level="full", is_test=False, default_dim=None,
+            device_kind=None, param_shards=1, act_shards=1):
     """Run the analyzer at ``level`` ("verify" | "full").
 
     Returns an :class:`AnalysisReport` merging every pass that ran.
     ``feed_specs``/``state_specs`` (name -> array-like or
     ShapeDtypeStruct) make the shape pass exact; omitted, shapes derive
-    from declared var metadata with -1 dims defaulted.
+    from declared var metadata with -1 dims defaulted. ``device_kind``
+    selects the roofline/capacity profile (env overrides always apply);
+    ``param_shards``/``act_shards`` divide parameter/activation
+    footprints for sharded meshes.
     """
     report = AnalysisReport()
     report.extend(verifier.verify(
         program, feed_names=feed_names, fetch_names=fetch_names,
         state_names=state_names))
+    env = None
+    cost = None
     if level == "full" and not report.errors:
         # shape propagation assumes structural well-formedness; on a
         # broken program the verifier errors are the actionable output
-        from . import shapes, tpu_lint
+        from . import costs, shapes, tpu_lint
 
         if feed_specs is None and feed_names:
             # derive specs for the caller's ACTUAL feed list — it may
@@ -60,8 +75,102 @@ def analyze(program, feed_names=(), fetch_names=(), state_names=None,
             program, feed_specs=feed_specs, state_specs=state_specs,
             is_test=is_test, platform=platform, default_dim=default_dim)
         report.extend(shape_report)
+        try:
+            cost = costs.analyze_cost(
+                program, env=env, feed_specs=feed_specs,
+                state_specs=state_specs, fetch_names=fetch_names,
+                state_names=state_names, is_test=is_test,
+                platform=platform, default_dim=default_dim,
+                device_kind=device_kind, param_shards=param_shards,
+                act_shards=act_shards)
+        except Exception as e:  # noqa: BLE001 — the cost model must
+            # never break a lint run; the structural passes stand alone
+            report.meta["cost_pass_error"] = "%s: %s" % (
+                type(e).__name__, e)
         report.extend(tpu_lint.lint(
             program, shape_env=env, feed_names=feed_names,
             fetch_names=fetch_names, state_names=state_names,
-            platform=platform))
+            platform=platform, cost=cost))
+    if not report.errors:
+        _quantify(report, program, cost=cost, feed_specs=feed_specs,
+                  state_specs=state_specs, fetch_names=fetch_names,
+                  state_names=state_names, default_dim=default_dim,
+                  device_kind=device_kind, param_shards=param_shards,
+                  act_shards=act_shards)
     return report
+
+
+def _fmt_bytes(n):
+    """Human-readable byte count at whichever scale is non-trivial."""
+    n = float(n)
+    for div, unit in ((1e9, "GB"), (1e6, "MB"), (1e3, "KB")):
+        if n >= div:
+            return "%.2f %s" % (n / div, unit)
+    return "%d B" % n
+
+
+def _quantify(report, program, cost=None, feed_specs=None,
+              state_specs=None, fetch_names=(), state_names=None,
+              default_dim=None, device_kind=None, param_shards=1,
+              act_shards=1):
+    """Fold the quantitative layer into ``report``: peak-HBM meta (and
+    the predicted-OOM error when it exceeds capacity) at every level;
+    roofline meta when a ``full``-level cost report is at hand. A crash
+    here must never break the gate — it degrades to meta."""
+    from . import costs, memory
+
+    try:
+        if cost is not None:
+            mem = cost.memory
+        else:
+            # cheap path (default gate): declared metadata + real
+            # feed/state shapes, no jax tracing. -1 dims resolve to the
+            # actual feed batch when the caller did not pin one.
+            dd = default_dim
+            if dd is None:
+                dims = [int(v.shape[0]) for v in (feed_specs or {}).values()
+                        if getattr(v, "shape", None)]
+                dd = max(dims) if dims else None
+            mem = memory.estimate(
+                program, feed_specs=feed_specs, state_specs=state_specs,
+                fetch_names=fetch_names, state_names=state_names,
+                default_dim=dd, param_shards=param_shards,
+                act_shards=act_shards)
+    except Exception as e:  # noqa: BLE001 — estimate bug, not user's
+        report.meta["memory_pass_error"] = "%s: %s" % (
+            type(e).__name__, e)
+        return
+    report.meta["predicted_peak_hbm_bytes"] = int(mem.peak_bytes)
+    if cost is not None:
+        report.meta["total_flops"] = round(cost.total_flops, 1)
+        report.meta["total_bytes"] = round(cost.total_bytes, 1)
+        if cost.predicted_step_seconds is not None:
+            report.meta["predicted_step_seconds"] = float(
+                "%.6g" % cost.predicted_step_seconds)
+        if cost.predicted_mfu is not None:
+            report.meta["predicted_mfu"] = round(cost.predicted_mfu, 4)
+    profile = costs.device_profile(device_kind)
+    cap = profile.hbm_bytes if profile is not None else None
+    if not cap:
+        return
+    report.meta["hbm_capacity_bytes"] = int(cap)
+    if mem.peak_bytes <= cap:
+        return
+    gb = program.global_block()
+    op = None
+    if mem.peak_op_index is not None and mem.peak_op_index < len(gb.ops):
+        op = gb.ops[mem.peak_op_index]
+    top = ", ".join(
+        "%s (%s)" % (n, _fmt_bytes(b)) for n, b in mem.top[:3])
+    report.add(
+        ERROR, "predicted-oom",
+        "predicted peak live-set %s exceeds device HBM %s "
+        "(%.0f%%): params %s + activations %s resident at op "
+        "%s '%s'%s — reduce the batch/sequence, shard params across a "
+        "mesh, or add recompute checkpoints"
+        % (_fmt_bytes(mem.peak_bytes), _fmt_bytes(cap),
+           100.0 * mem.peak_bytes / cap, _fmt_bytes(mem.param_bytes),
+           _fmt_bytes(mem.act_bytes_at_peak), mem.peak_op_index,
+           mem.peak_op_type,
+           ("; largest residents: " + top) if top else ""),
+        block_idx=0, op_index=mem.peak_op_index, op=op)

@@ -4,7 +4,10 @@ inference models (or raw Program JSON) without touching an executor.
 Exit codes: 0 clean, 1 findings (errors+warnings; tune with
 ``--fail-on``), 2 usage/load failure. Output is a stable JSON report
 (sorted keys, deterministically ordered diagnostics, no timestamps) so
-CI lanes can diff it; ``--text`` renders for humans.
+CI lanes can diff it; ``--text`` renders for humans; ``--json-out``
+additionally writes the JSON atomically to a file; ``--cost`` adds the
+cost-model section (per-op FLOPs/bytes, roofline step/MFU prediction,
+liveness peak-HBM vs the ``--device`` capacity).
 """
 import argparse
 import json
@@ -12,6 +15,14 @@ import os
 import sys
 
 __all__ = ["main"]
+
+_EPILOG = """\
+exit codes:
+  0   clean (or --fail-on never)
+  1   findings — errors and warnings per --fail-on (predicted-oom is
+      an error: the program's peak live-set exceeds the device HBM)
+  2   usage error / target failed to load
+"""
 
 
 def _load_target(path):
@@ -49,11 +60,35 @@ def _load_target(path):
     return program, feed_names, fetch_names, state_specs
 
 
+def _parse_mesh(spec):
+    """``"dp=8,mp=2"`` -> {"dp": 8, "mp": 2}."""
+    mesh = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        axis, _, size = part.partition("=")
+        if not size:
+            raise ValueError(
+                "bad --mesh entry %r (want axis=size)" % part)
+        mesh[axis.strip()] = int(size)
+    return mesh
+
+
+def _atomic_write(path, text):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Statically verify + shape-check + TPU-lint a saved "
-                    "inference model or Program JSON.")
+                    "inference model or Program JSON.",
+        epilog=_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("target",
                     help="save_inference_model dir, __model__ meta file, "
                          "or Program.to_json dump")
@@ -63,6 +98,23 @@ def main(argv=None):
     ap.add_argument("--level", choices=("verify", "full"), default="full")
     ap.add_argument("--batch", type=int, default=8,
                     help="placeholder for -1 feed dims (default: 8)")
+    ap.add_argument("--cost", action="store_true",
+                    help="add the cost-model section: per-op FLOPs/bytes, "
+                         "roofline-predicted step seconds and MFU, and "
+                         "the liveness peak-HBM estimate vs --device "
+                         "capacity (forces --level full)")
+    ap.add_argument("--device", default=None, metavar="KIND",
+                    help="device kind for the roofline/capacity model "
+                         "(e.g. v5e, v5p, v4); default: only the "
+                         "PADDLE_TPU_PEAK_FLOPS / PADDLE_TPU_HBM_BYTES / "
+                         "PADDLE_TPU_HBM_BW env overrides apply")
+    ap.add_argument("--mesh", default=None, metavar="AXES",
+                    help="mesh axes dividing footprints, e.g. "
+                         "'dp=8,mp=2' — dp/data/batch/sp axes divide "
+                         "activations, every other axis divides params")
+    ap.add_argument("--json-out", default=None, metavar="PATH",
+                    help="also write the JSON report to PATH atomically "
+                         "(tmp + rename); stdout is unchanged")
     ap.add_argument("--text", action="store_true",
                     help="human-readable report instead of JSON")
     ap.add_argument("--fail-on", choices=("findings", "error", "never"),
@@ -74,32 +126,73 @@ def main(argv=None):
     try:
         program, feed_names, fetch_names, state_specs = _load_target(
             args.target)
+        mesh = _parse_mesh(args.mesh)
     except Exception as e:  # noqa: BLE001 — CLI boundary
         print("error: cannot load %s: %s: %s"
               % (args.target, type(e).__name__, e), file=sys.stderr)
         return 2
 
     from .analyzer import analyze
+    from .memory import shard_divisors
+
+    level = "full" if args.cost else args.level
+    param_shards, act_shards = shard_divisors(mesh)
 
     # saved models are inference programs: analyze in test mode
     report = analyze(
         program, feed_names=feed_names, fetch_names=fetch_names,
         state_names=set(state_specs) if state_specs is not None else None,
-        state_specs=state_specs, platform=args.platform, level=args.level,
-        is_test=True, default_dim=args.batch)
+        state_specs=state_specs, platform=args.platform, level=level,
+        is_test=True, default_dim=args.batch, device_kind=args.device,
+        param_shards=param_shards, act_shards=act_shards)
 
     doc = {
         "target": args.target,
         "platform": args.platform,
-        "level": args.level,
+        "level": level,
         "report": report.to_dict(),
     }
+    if args.cost:
+        from .costs import analyze_cost
+
+        try:
+            cost = analyze_cost(
+                program, feed_names=feed_names, state_specs=state_specs,
+                fetch_names=fetch_names,
+                state_names=(set(state_specs)
+                             if state_specs is not None else None),
+                is_test=True, platform=args.platform,
+                default_dim=args.batch, device_kind=args.device,
+                param_shards=param_shards, act_shards=act_shards)
+            doc["cost"] = cost.to_dict()
+        except Exception as e:  # noqa: BLE001 — cost model must not
+            # take down the structural report
+            doc["cost"] = {"error": "%s: %s" % (type(e).__name__, e)}
+    rendered = json.dumps(doc, sort_keys=True, indent=2)
     if args.text:
         print("target: %s (platform %s, level %s)"
-              % (args.target, args.platform, args.level))
+              % (args.target, args.platform, level))
         print(str(report))
+        if args.cost and "error" not in doc["cost"]:
+            c = doc["cost"]
+            print("cost: %.3g flops, %.3g bytes moved, peak HBM %.3g MB"
+                  % (c["total_flops"], c["total_bytes"],
+                     c["memory"]["peak_bytes"] / 1e6))
+            if "predicted_step_seconds" in c:
+                print("roofline: %.3g s/step, MFU %.3g (%s-bound on %s)"
+                      % (c["predicted_step_seconds"],
+                         c.get("predicted_mfu", 0.0),
+                         c.get("bound", "?"),
+                         c.get("device", {}).get("name", "?")))
     else:
-        print(json.dumps(doc, sort_keys=True, indent=2))
+        print(rendered)
+    if args.json_out:
+        try:
+            _atomic_write(args.json_out, rendered + "\n")
+        except OSError as e:
+            print("error: cannot write %s: %s" % (args.json_out, e),
+                  file=sys.stderr)
+            return 2
 
     if args.fail_on == "never":
         return 0

@@ -1,0 +1,434 @@
+"""Static cost model: per-op FLOPs, bytes moved, and a roofline
+prediction of step time / MFU — computed BEFORE any XLA compile.
+
+Like :mod:`.shapes`, this pass reuses the op lowering registry as the
+single rule set: each op's lowering is traced with ``jax.make_jaxpr``
+over the abstract shape env, and FLOPs are counted primitive by
+primitive from the jaxpr (``dot_general``: 2·M·N·K,
+``conv_general_dilated``: 2·out·k·Cin/g, elementwise: one per output
+element, pure data movement: zero). Bytes per op are the op's input +
+output footprints — the HBM traffic an unfused op would move, i.e. the
+roofline's memory leg. The symbolic ``backward`` op is costed
+analytically as 2x its forward region (the classic fwd:bwd ratio; the
+vjp replay's duplicated forward is CSE'd by XLA, see lowering.run_ops).
+
+The device table below is the ONE shared peak-FLOPs/HBM table —
+``bench.py`` imports :func:`peak_flops` and
+:func:`bert_train_flops_per_token` from here so the bench and the
+analyzer can never drift. Env overrides (all optional) calibrate or
+pin a profile where no table entry matches (CPU smoke lanes, tests):
+
+- ``PADDLE_TPU_PEAK_FLOPS`` — peak FLOPs/s
+- ``PADDLE_TPU_HBM_BYTES``  — memory capacity in bytes
+- ``PADDLE_TPU_HBM_BW``     — memory bandwidth in bytes/s
+"""
+import os
+
+__all__ = [
+    "DeviceProfile", "DEVICE_TABLE", "device_profile", "peak_flops",
+    "bert_train_flops_per_token", "OpCost", "op_costs", "jaxpr_flops",
+    "CostReport", "analyze_cost", "predict_program",
+]
+
+PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
+HBM_BYTES_ENV = "PADDLE_TPU_HBM_BYTES"
+HBM_BW_ENV = "PADDLE_TPU_HBM_BW"
+
+
+class DeviceProfile:
+    """Roofline constants of one accelerator: bf16 peak FLOPs/s, HBM
+    capacity (bytes), HBM bandwidth (bytes/s). Any field may be None
+    (unknown) — consumers skip the corresponding check/prediction."""
+
+    __slots__ = ("name", "peak_flops", "hbm_bytes", "hbm_bw")
+
+    def __init__(self, name, peak_flops=None, hbm_bytes=None, hbm_bw=None):
+        self.name = name
+        self.peak_flops = peak_flops
+        self.hbm_bytes = hbm_bytes
+        self.hbm_bw = hbm_bw
+
+    def to_dict(self):
+        return {"name": self.name, "peak_flops": self.peak_flops,
+                "hbm_bytes": self.hbm_bytes, "hbm_bw": self.hbm_bw}
+
+    def __repr__(self):
+        return ("DeviceProfile(%r, peak_flops=%r, hbm_bytes=%r, "
+                "hbm_bw=%r)" % (self.name, self.peak_flops,
+                                self.hbm_bytes, self.hbm_bw))
+
+
+# Public per-chip figures, matched by device_kind substring in order
+# (first hit wins — "v5p" must precede "v5"). bf16 peak FLOPs/s, HBM
+# bytes, HBM bytes/s.
+DEVICE_TABLE = [
+    ("v6", DeviceProfile("v6e", 918e12, 32e9, 1640e9)),
+    ("v5p", DeviceProfile("v5p", 459e12, 95e9, 2765e9)),
+    ("v5e", DeviceProfile("v5e", 197e12, 16e9, 819e9)),
+    ("v5", DeviceProfile("v5e", 197e12, 16e9, 819e9)),
+    ("v4", DeviceProfile("v4", 275e12, 32e9, 1228e9)),
+    ("v3", DeviceProfile("v3", 123e12, 32e9, 900e9)),
+    ("v2", DeviceProfile("v2", 45e12, 16e9, 700e9)),
+]
+
+
+def _env_float(name):
+    v = os.environ.get(name)
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+def device_profile(device_kind=None):
+    """Resolve a :class:`DeviceProfile` for a jax ``device_kind`` string
+    (substring match against the table), then apply the env overrides.
+    Returns None when neither the table nor any override knows the
+    device — callers must treat that as "no prediction possible"."""
+    prof = None
+    dk = (device_kind or "").lower()
+    for key, p in DEVICE_TABLE:
+        if key in dk:
+            prof = DeviceProfile(p.name, p.peak_flops, p.hbm_bytes,
+                                 p.hbm_bw)
+            break
+    over = {
+        "peak_flops": _env_float(PEAK_FLOPS_ENV),
+        "hbm_bytes": _env_float(HBM_BYTES_ENV),
+        "hbm_bw": _env_float(HBM_BW_ENV),
+    }
+    if prof is None and not any(v is not None for v in over.values()):
+        return None
+    if prof is None:
+        prof = DeviceProfile(device_kind or "env")
+    for k, v in over.items():
+        if v is not None:
+            setattr(prof, k, v)
+    return prof
+
+
+def peak_flops(device_kind):
+    """bf16 peak FLOPs/s for a device_kind, or None (bench.py's
+    ``_peak_flops``, now table-backed here)."""
+    p = device_profile(device_kind)
+    return p.peak_flops if p is not None else None
+
+
+def bert_train_flops_per_token(cfg, seq):
+    """Analytic matmul FLOPs per trained token (fwd + bwd ~= 3x fwd) —
+    bench.py's ``_flops_per_token_train``, shared so the bench MFU and
+    the analyzer's roofline use one formula."""
+    d, L, V = cfg.hidden, cfg.num_layers, cfg.vocab_size
+    per_layer = 12 * d * d          # qkv (3d^2) + proj (d^2) + mlp (8d^2)
+    attn = 4 * seq * d              # QK^T and AV rows for one token
+    fwd = 2 * (L * (per_layer + attn) + d * V)
+    return 3 * fwd
+
+
+# -- per-primitive FLOP counting over a jaxpr -------------------------------
+
+# primitives that move/reshape data without arithmetic
+_ZERO_FLOP_PRIMS = frozenset({
+    "reshape", "broadcast_in_dim", "transpose", "convert_element_type",
+    "bitcast_convert_type", "slice", "dynamic_slice",
+    "dynamic_update_slice", "concatenate", "pad", "squeeze", "rev",
+    "iota", "copy", "device_put", "stop_gradient", "split",
+    "gather", "expand_dims", "real", "imag", "empty",
+})
+
+
+def _aval_size(aval):
+    n = 1
+    for d in getattr(aval, "shape", ()) or ():
+        n *= int(d)
+    return n
+
+
+def _sub_jaxprs(params):
+    subs = []
+    for v in params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for u in vs:
+            if hasattr(u, "jaxpr"):          # ClosedJaxpr
+                subs.append(u.jaxpr)
+            elif hasattr(u, "eqns"):         # Jaxpr
+                subs.append(u)
+    return subs
+
+
+def jaxpr_flops(jaxpr):
+    """Deterministic FLOP count of a jaxpr: exact for matmul/conv, one
+    per output element for everything arithmetic, zero for pure data
+    movement. ``scan`` bodies multiply by trip count; ``while`` bodies
+    count one trip (trip count is value-dependent); ``cond`` takes the
+    most expensive branch."""
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        subs = _sub_jaxprs(eqn.params)
+        if subs:
+            inner = [jaxpr_flops(s) for s in subs]
+            if prim == "scan":
+                total += float(eqn.params.get("length", 1)) * sum(inner)
+            elif prim == "cond":
+                total += max(inner)
+            else:  # pjit / while / remat / custom_* wrappers
+                total += sum(inner)
+            continue
+        total += _prim_flops(eqn, prim)
+    return total
+
+
+def _prim_flops(eqn, prim):
+    out_size = max((_aval_size(v.aval) for v in eqn.outvars), default=0)
+    if prim == "dot_general":
+        (lhs_c, _rhs_c), _ = eqn.params["dimension_numbers"]
+        lhs_shape = eqn.invars[0].aval.shape
+        k = 1
+        for d in lhs_c:
+            k *= int(lhs_shape[d])
+        return 2.0 * out_size * k
+    if prim == "conv_general_dilated":
+        dn = eqn.params["dimension_numbers"]
+        rhs = eqn.invars[1].aval
+        out_chan = int(rhs.shape[dn.rhs_spec[0]])
+        # per output element: 2 * (kernel spatial x in-chan-per-group)
+        return 2.0 * out_size * _aval_size(rhs) / max(out_chan, 1)
+    if prim in _ZERO_FLOP_PRIMS or prim.startswith("scatter"):
+        return 0.0
+    if prim.startswith("reduce") or prim.startswith("arg") \
+            or prim == "cumsum" or prim.startswith("cum"):
+        # one op per INPUT element: reductions shrink the output
+        return float(max((_aval_size(v.aval) for v in eqn.invars
+                          if hasattr(v, "aval")), default=out_size))
+    return float(out_size)
+
+
+# -- per-op costing over a Program ------------------------------------------
+
+class OpCost:
+    """FLOPs + bytes of one global-block op."""
+
+    __slots__ = ("op_index", "op_type", "flops", "bytes", "op")
+
+    def __init__(self, op_index, op_type, flops, bytes_, op=None):
+        self.op_index = op_index
+        self.op_type = op_type
+        self.flops = flops
+        self.bytes = bytes_
+        self.op = op
+
+    @property
+    def intensity(self):
+        """Arithmetic intensity (flops per HBM byte)."""
+        if not self.bytes:
+            return None
+        return self.flops / self.bytes
+
+    def to_dict(self):
+        d = {"op_index": self.op_index, "op_type": self.op_type,
+             "flops": round(self.flops, 1), "bytes": round(self.bytes, 1)}
+        if self.intensity is not None:
+            d["intensity"] = round(self.intensity, 3)
+        return d
+
+
+def op_costs(program, env, is_test=False, platform="cpu"):
+    """Per-op FLOPs/bytes for the global block by tracing each op's
+    lowering with ``jax.make_jaxpr`` over the abstract env from
+    :func:`.shapes.propagate`. Ops whose inputs never resolved (or
+    whose lowering cannot trace) are skipped. The ``backward`` op is
+    costed analytically: 2x the FLOPs/bytes of its forward region."""
+    import jax
+
+    from ..fluid import lowering
+    from ..ops.registry import LowerContext
+    from . import walker
+
+    gb = program.global_block()
+    var_lookup = lowering._make_var_lookup(gb)
+    rng = jax.random.PRNGKey(0)
+    out = []
+    fwd_flops = 0.0   # running non-backward totals (the backward region)
+    fwd_bytes = 0.0
+    for i, op in enumerate(gb.ops):
+        if op.type == "backward":
+            grads = op.output("Grads")
+            grad_bytes = sum(
+                _spec_nbytes(env[g]) for g in grads if g in env)
+            out.append(OpCost(i, op.type, 2.0 * fwd_flops,
+                              2.0 * fwd_bytes + grad_bytes, op=op))
+            continue
+        reads = walker._op_reads(program, op)
+        if any(n not in env for n in reads):
+            continue
+        sub_env = {n: env[n] for n in sorted(reads)}
+
+        def f(e, _op=op, _i=i):
+            ctx = LowerContext(rng=rng, is_test=is_test, program=program,
+                               platform=platform)
+            ctx.run_ops = lowering.run_ops
+            e = lowering.apply_op(_op, dict(e), ctx, var_lookup, op_tag=_i)
+            return {n: e[n] for ns in _op.outputs.values()
+                    for n in ns if n in e}
+
+        try:
+            closed = jax.make_jaxpr(f)(sub_env)
+        except Exception:  # noqa: BLE001 — shapes.propagate reports these
+            continue
+        flops = jaxpr_flops(closed.jaxpr)
+        nbytes = (sum(_spec_nbytes(env[n]) for n in reads)
+                  + sum(_spec_nbytes(env[n])
+                        for ns in op.outputs.values() for n in ns
+                        if n in env))
+        out.append(OpCost(i, op.type, flops, float(nbytes), op=op))
+        fwd_flops += flops
+        fwd_bytes += float(nbytes)
+    return out
+
+
+def _spec_nbytes(spec):
+    import numpy as np
+
+    n = 1
+    for d in getattr(spec, "shape", ()) or ():
+        n *= int(d)
+    return n * np.dtype(spec.dtype).itemsize
+
+
+# -- report -----------------------------------------------------------------
+
+class CostReport:
+    """Per-op and per-program FLOPs/bytes + roofline prediction against
+    one :class:`DeviceProfile`, plus the liveness peak-HBM estimate."""
+
+    def __init__(self, per_op, memory=None, profile=None):
+        self.per_op = list(per_op)
+        self.memory = memory            # analysis.memory.MemoryReport
+        self.profile = profile          # DeviceProfile or None
+        self.total_flops = float(sum(c.flops for c in self.per_op))
+        self.total_bytes = float(sum(c.bytes for c in self.per_op))
+
+    @property
+    def intensity(self):
+        if not self.total_bytes:
+            return None
+        return self.total_flops / self.total_bytes
+
+    @property
+    def predicted_step_seconds(self):
+        """Roofline: each op pays max(compute leg, memory leg); the
+        step is their sum (sequential dependency chain)."""
+        p = self.profile
+        if p is None or (not p.peak_flops and not p.hbm_bw):
+            return None
+        t = 0.0
+        for c in self.per_op:
+            legs = []
+            if p.peak_flops:
+                legs.append(c.flops / p.peak_flops)
+            if p.hbm_bw:
+                legs.append(c.bytes / p.hbm_bw)
+            t += max(legs)
+        return t
+
+    @property
+    def predicted_mfu(self):
+        p = self.profile
+        t = self.predicted_step_seconds
+        if not t or p is None or not p.peak_flops:
+            return None
+        return self.total_flops / (t * p.peak_flops)
+
+    @property
+    def bound(self):
+        """Whether the program as a whole is compute- or memory-bound
+        under the profile (None when unpredictable)."""
+        p = self.profile
+        if p is None or not p.peak_flops or not p.hbm_bw:
+            return None
+        return ("compute"
+                if self.total_flops / p.peak_flops
+                >= self.total_bytes / p.hbm_bw else "memory")
+
+    def hottest(self, k=5):
+        """Top-k ops by FLOPs, descending (stable: ties break on op
+        index)."""
+        return sorted(self.per_op,
+                      key=lambda c: (-c.flops, c.op_index))[:k]
+
+    def to_dict(self, top=16):
+        d = {
+            "n_ops_costed": len(self.per_op),
+            "total_flops": round(self.total_flops, 1),
+            "total_bytes": round(self.total_bytes, 1),
+        }
+        if self.intensity is not None:
+            d["intensity"] = round(self.intensity, 3)
+        if self.profile is not None:
+            d["device"] = self.profile.to_dict()
+        t = self.predicted_step_seconds
+        if t is not None:
+            d["predicted_step_seconds"] = float("%.6g" % t)
+        mfu = self.predicted_mfu
+        if mfu is not None:
+            d["predicted_mfu"] = round(mfu, 4)
+        if self.bound is not None:
+            d["bound"] = self.bound
+        if self.memory is not None:
+            d["memory"] = self.memory.to_dict()
+        d["hottest_ops"] = [c.to_dict() for c in self.hottest(top)]
+        return d
+
+
+def analyze_cost(program, env=None, feed_specs=None, state_specs=None,
+                 feed_names=None, fetch_names=(), state_names=None,
+                 is_test=False, platform="cpu", default_dim=None,
+                 device_kind=None, param_shards=1, act_shards=1):
+    """One-stop cost + memory analysis: propagate shapes (unless an
+    ``env`` is supplied), cost every op, run the liveness peak-HBM
+    estimate, and bind the device profile. Returns a
+    :class:`CostReport`."""
+    from . import memory, shapes
+
+    if env is None:
+        if feed_specs is None and feed_names:
+            feed_specs = shapes.feed_specs_from_program(
+                program, feed_names=list(feed_names),
+                default_dim=default_dim)
+        env, _ = shapes.propagate(
+            program, feed_specs=feed_specs, state_specs=state_specs,
+            is_test=is_test, platform=platform, default_dim=default_dim,
+            check_declared=False)
+    per_op = op_costs(program, env, is_test=is_test, platform=platform)
+    mem = memory.estimate(
+        program, env=env, feed_specs=feed_specs, state_specs=state_specs,
+        fetch_names=fetch_names, state_names=state_names,
+        default_dim=default_dim, param_shards=param_shards,
+        act_shards=act_shards)
+    return CostReport(per_op, memory=mem,
+                      profile=device_profile(device_kind))
+
+
+def predict_program(program, feed_specs=None, fetch_names=(),
+                    state_specs=None, device_kind=None, is_test=False,
+                    default_dim=None):
+    """Bench-friendly wrapper: :func:`analyze_cost` flattened to a plain
+    dict (``predicted_step_seconds``, ``predicted_mfu``, ``total_flops``,
+    ``total_bytes``, ``predicted_peak_hbm_bytes``)."""
+    rep = analyze_cost(
+        program, feed_specs=feed_specs, state_specs=state_specs,
+        fetch_names=fetch_names, is_test=is_test,
+        default_dim=default_dim, device_kind=device_kind)
+    out = {
+        "total_flops": rep.total_flops,
+        "total_bytes": rep.total_bytes,
+        "predicted_step_seconds": rep.predicted_step_seconds,
+        "predicted_mfu": rep.predicted_mfu,
+        "bound": rep.bound,
+    }
+    if rep.memory is not None:
+        out["predicted_peak_hbm_bytes"] = rep.memory.peak_bytes
+    return out

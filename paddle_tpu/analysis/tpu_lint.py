@@ -33,19 +33,38 @@ _COLLECTIVE_EXTRA = {"barrier", "ppermute", "all_to_all"}
 _BUCKETS_PER_AXIS = 8
 SHAPE_VOCAB_THRESHOLD = 2048
 
+# how many FLOPs-ranked ops the cost model promotes to "hottest" status
+HOT_K = 5
+
 
 def lint(program, shape_env=None, feed_names=(), fetch_names=(),
-         state_names=None, platform="tpu"):
+         state_names=None, platform="tpu", cost=None):
     """Lint a Program; returns an :class:`AnalysisReport`.
 
     ``shape_env``: inferred name -> spec from :mod:`.shapes` (falls back
     to declared var metadata when absent). ``state_names``: persistable
     names the executor will donate (``None`` = every persistable).
+    ``cost``: a :class:`.costs.CostReport` — when given, tiling findings
+    on the top-``HOT_K`` FLOPs-ranked ops are upgraded to
+    intensity-ranked ``hot-unpadded-*`` findings, and the ranking lands
+    in ``report.meta["hottest_ops"]``.
     """
     report = AnalysisReport(checks=["tpu_lint"])
     gb = program.global_block()
     on_tpu = platform == "tpu"
     shape_env = shape_env or {}
+
+    hot = {}
+    if cost is not None and cost.per_op:
+        total = cost.total_flops or 1.0
+        ranked = cost.hottest(HOT_K)
+        for rank, oc in enumerate(ranked, 1):
+            hot[oc.op_index] = (rank, oc)
+        report.meta["hottest_ops"] = [
+            dict(oc.to_dict(), rank=rank,
+                 flops_share=round(oc.flops / total, 4))
+            for rank, oc in ((r, ranked[r - 1])
+                             for r in range(1, len(ranked) + 1))]
 
     def shape_of(block, name):
         v = shape_env.get(name)
@@ -64,7 +83,11 @@ def lint(program, shape_env=None, feed_names=(), fetch_names=(),
     for block, i, op in walker.iter_ops(program):
         # -- lane padding ---------------------------------------------------
         if op.type in _MATMUL_OPS or op.type in _CONV_OPS:
-            _lint_tiling(block, i, op, shape_of, report)
+            hot_rank = hot.get(i) if block.idx == 0 else None
+            _lint_tiling(block, i, op, shape_of, report,
+                         hot_rank=hot_rank,
+                         total_flops=(cost.total_flops
+                                      if cost is not None else None))
         # -- host sync inside scan regions ----------------------------------
         if op.type in _HOST_SYNC_OPS and block.idx != 0:
             owner = owners.get(block.idx)
@@ -133,8 +156,12 @@ def lint(program, shape_env=None, feed_names=(), fetch_names=(),
     return report
 
 
-def _lint_tiling(block, i, op, shape_of, report):
-    """Flag MXU operand dims off the (8, 128) tile grid."""
+def _lint_tiling(block, i, op, shape_of, report, hot_rank=None,
+                 total_flops=None):
+    """Flag MXU operand dims off the (8, 128) tile grid. With a cost
+    ranking, a finding on a top-K op carries its FLOPs rank, share, and
+    arithmetic intensity — the padding fix with the largest payoff
+    first."""
     checked = []
     if op.type in _MATMUL_OPS:
         for slot in ("X", "Y"):
@@ -157,14 +184,26 @@ def _lint_tiling(block, i, op, shape_of, report):
                      - (sub * lane)
                      / (_round_up(sub, SUBLANE) * _round_up(lane, LANE)))
             bad.append((n, shape, waste))
+    check = ("unpadded-matmul" if op.type in _MATMUL_OPS
+             else "unpadded-conv")
+    prefix = ""
+    if hot_rank is not None:
+        rank, oc = hot_rank
+        check = "hot-" + check
+        share = (oc.flops / total_flops) if total_flops else 0.0
+        inten = oc.intensity
+        prefix = (
+            "rank #%d hottest op (%.0f%% of program FLOPs%s): "
+            % (rank, 100.0 * share,
+               ", intensity %.1f flops/byte" % inten
+               if inten is not None else ""))
     for n, shape, waste in bad:
         report.add(
-            PERF, "unpadded-matmul" if op.type in _MATMUL_OPS
-            else "unpadded-conv",
-            "operand '%s' of '%s' has minor dims %s not aligned to the "
-            "8x128 tile grid — XLA pads with ~%d%% dead lanes; pad the "
-            "layer width (or fold small dims) to multiples of 128/8"
-            % (n, op.type, tuple(shape[-2:]), round(100 * waste)),
+            PERF, check,
+            "%soperand '%s' of '%s' has minor dims %s not aligned to "
+            "the 8x128 tile grid — XLA pads with ~%d%% dead lanes; pad "
+            "the layer width (or fold small dims) to multiples of 128/8"
+            % (prefix, n, op.type, tuple(shape[-2:]), round(100 * waste)),
             block_idx=block.idx, op_index=i, op=op, var=n)
 
 

@@ -264,12 +264,15 @@ class OptimizerWithMixedPrecision:
             ]
         return params_grads
 
-    def apply_gradients(self, params_grads):
-        return self._optimizer.apply_gradients(params_grads)
+    def apply_gradients(self, params_grads, grad_clip=None):
+        return self._optimizer.apply_gradients(
+            params_grads, grad_clip=grad_clip
+        )
 
-    def apply_optimize(self, loss, startup_program, params_grads):
+    def apply_optimize(self, loss, startup_program, params_grads,
+                       grad_clip=None):
         return self._optimizer.apply_optimize(
-            loss, startup_program, params_grads
+            loss, startup_program, params_grads, grad_clip=grad_clip
         )
 
     def minimize(self, loss, startup_program=None, parameter_list=None,

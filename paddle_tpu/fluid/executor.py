@@ -30,6 +30,27 @@ from ..analysis import sanitizer as _sanitizer
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 
 
+def _device_kind():
+    """The jax device kind the analysis gate prices against (None when
+    devices are unavailable — the cost model then relies on the
+    PADDLE_TPU_PEAK_FLOPS/HBM_BYTES/HBM_BW env overrides only)."""
+    try:
+        return getattr(jax.devices()[0], "device_kind", None)
+    except Exception:  # noqa: BLE001 — no backend is not a gate failure
+        return None
+
+
+def _publish_analysis_gauges(report):
+    """Mirror the analyzer's quantitative meta into the telemetry hub
+    (documented in observability.__init__: analysis.predicted_*)."""
+    peak = report.meta.get("predicted_peak_hbm_bytes")
+    if peak is not None:
+        obs.set_gauge("analysis.predicted_peak_hbm", peak)
+    mfu = report.meta.get("predicted_mfu")
+    if mfu is not None:
+        obs.set_gauge("analysis.predicted_mfu", mfu)
+
+
 class _TensorView:
     """Compat shim for `scope.find_var(name).get_tensor()` usage."""
 
@@ -605,12 +626,14 @@ class Executor:
                 program, feed_names=list(feed_arrays.keys()),
                 fetch_names=fetch_names, state_names=set(state.keys()),
                 feed_specs=feed_arrays, state_specs=state,
-                platform=platform, level=level)
+                platform=platform, level=level,
+                device_kind=_device_kind())
         except Exception as e:  # noqa: BLE001 — analyzer bug, not user's
             obs.event("analysis_failed", source="executor",
                       error="%s: %s" % (type(e).__name__, e))
             return
         obs.observe("analysis.verify_seconds", time.monotonic() - t0)
+        _publish_analysis_gauges(report)
         if report.diagnostics:
             obs.inc("analysis.findings", len(report.findings))
             obs.event("analysis_report", source="executor", count=False,

@@ -30,7 +30,8 @@ import time
 import numpy as np
 
 from . import compile_cache, core
-from .executor import Executor, Scope, global_scope
+from .executor import (Executor, Scope, global_scope, _device_kind,
+                       _publish_analysis_gauges)
 from .lowering import build_step_fn
 from .. import observability as obs
 
@@ -115,12 +116,13 @@ class Predictor:
                 fetch_names=self.fetch_names,
                 state_names=set(self._state.keys()),
                 state_specs=self._state, platform=platform,
-                level=level, is_test=True)
+                level=level, is_test=True, device_kind=_device_kind())
         except Exception as e:  # noqa: BLE001 — analyzer bug, not user's
             obs.event("analysis_failed", source="predictor",
                       error="%s: %s" % (type(e).__name__, e))
             return
         obs.observe("analysis.verify_seconds", time.monotonic() - t0)
+        _publish_analysis_gauges(report)
         if report.diagnostics:
             obs.inc("analysis.findings", len(report.findings))
             obs.event("analysis_report", source="predictor", count=False,

@@ -138,13 +138,27 @@ class Optimizer:
         self._finish_update(block, parameters_and_grads)
         return optimize_ops
 
-    def apply_gradients(self, params_grads):
+    def apply_gradients(self, params_grads, grad_clip=None):
+        # The reference only honors grad_clip in dygraph mode (TODO at
+        # ref optimizer.py:3774 for static) — here the static path
+        # honors it too, by emitting clip ops over the grad vars under
+        # the current program guard. Direct apply_gradients callers get
+        # the same clipping minimize() routes through here.
         params_grads = sorted(params_grads, key=lambda x: x[0].name)
         params_grads, table_param_and_grad, table_optimize_op = (
             params_grads,
             None,
             None,
         )
+        if grad_clip is not None:
+            from .dygraph_grad_clip import GradClipBase
+
+            if not isinstance(grad_clip, GradClipBase):
+                raise TypeError(
+                    "grad_clip must be a dygraph_grad_clip.GradClipBase "
+                    "instance, got %r" % (grad_clip,)
+                )
+            params_grads = grad_clip(params_grads)
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(
             params_grads, self.regularization
@@ -152,10 +166,11 @@ class Optimizer:
         optimize_ops = self._create_optimization_pass(params_grads)
         return optimize_ops
 
-    def apply_optimize(self, loss, startup_program, params_grads):
+    def apply_optimize(self, loss, startup_program, params_grads,
+                       grad_clip=None):
         prog = loss.block.program
         with program_guard(prog, startup_program):
-            return self.apply_gradients(params_grads)
+            return self.apply_gradients(params_grads, grad_clip=grad_clip)
 
     def minimize(
         self,
@@ -177,21 +192,8 @@ class Optimizer:
             parameter_list=parameter_list,
             no_grad_set=no_grad_set,
         )
-        if grad_clip is not None:
-            # The reference only honors grad_clip in dygraph mode (TODO at
-            # ref optimizer.py:3774 for static) — we apply it in both modes
-            # by emitting clip ops over the freshly appended grad vars.
-            from .dygraph_grad_clip import GradClipBase
-
-            if not isinstance(grad_clip, GradClipBase):
-                raise TypeError(
-                    "grad_clip must be a dygraph_grad_clip.GradClipBase "
-                    "instance, got %r" % (grad_clip,)
-                )
-            with program_guard(loss.block.program, startup_program):
-                params_grads = grad_clip(params_grads)
         optimize_ops = self.apply_optimize(
-            loss, startup_program, params_grads
+            loss, startup_program, params_grads, grad_clip=grad_clip
         )
         return optimize_ops, params_grads
 
@@ -1025,12 +1027,15 @@ class RecomputeOptimizer(Optimizer):
             loss, parameter_list, no_grad_set, checkpoints=self._checkpoints
         )
 
-    def apply_gradients(self, params_grads):
-        return self._optimizer.apply_gradients(params_grads)
+    def apply_gradients(self, params_grads, grad_clip=None):
+        return self._optimizer.apply_gradients(
+            params_grads, grad_clip=grad_clip
+        )
 
-    def apply_optimize(self, loss, startup_program, params_grads):
+    def apply_optimize(self, loss, startup_program, params_grads,
+                       grad_clip=None):
         return self._optimizer.apply_optimize(
-            loss, startup_program, params_grads
+            loss, startup_program, params_grads, grad_clip=grad_clip
         )
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
@@ -1038,17 +1043,9 @@ class RecomputeOptimizer(Optimizer):
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
-        if grad_clip is not None:
-            from .dygraph_grad_clip import GradClipBase
-
-            if not isinstance(grad_clip, GradClipBase):
-                raise TypeError(
-                    "grad_clip must be a dygraph_grad_clip.GradClipBase "
-                    "instance, got %r" % (grad_clip,)
-                )
-            with program_guard(loss.block.program, startup_program):
-                params_grads = grad_clip(params_grads)
-        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        optimize_ops = self.apply_optimize(
+            loss, startup_program, params_grads, grad_clip=grad_clip
+        )
         return optimize_ops, params_grads
 
     def __getattr__(self, item):

@@ -75,6 +75,19 @@ Well-known analysis metrics (PR 6, ``paddle_tpu.analysis``):
 - ``scope_race`` events (source ``sanitizer``) — cross-thread Scope
   write violations when ``PADDLE_TPU_SCOPE_SANITIZER=on``.
 
+Well-known cost-model metrics (PR 8, ``analysis.costs`` / ``.memory``):
+
+- ``analysis.predicted_peak_hbm`` gauge — the liveness estimate of the
+  peak live-set (bytes) for the last program the executor/predictor
+  gate admitted; ``analysis.predicted_mfu`` gauge — the roofline MFU
+  prediction (set at ``PADDLE_TPU_ANALYSIS=full``, when the cost pass
+  runs). A predicted-OOM program raises before ``compile_start``, so
+  these gauges always describe a program that was allowed to compile.
+- ``serving.predicted_peak_hbm.<model>`` gauge — worst bucket-ladder
+  peak the admission check priced at ``ServingEngine.warmup()``;
+  ``bucket_rejected`` events (source ``serving``) record ladders that
+  exceeded the HBM budget (the warmup raises before any compile).
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

@@ -404,11 +404,17 @@ class HeartbeatMonitor:
                           threshold=cfg.dead_after)
         return dead
 
-    def stragglers(self, members=None):
+    def stragglers(self, members=None, step_lag=True):
         """Alive peers whose step lag exceeds ``straggler_lag`` or whose
         reported per-step latency exceeds ``straggler_factor`` x the
         fleet median. Emits ``straggler`` on the transition in and
-        ``straggler_recovered`` on the way out."""
+        ``straggler_recovered`` on the way out.
+
+        ``step_lag=False`` disables the lag trigger: serving fleets
+        beat a per-process tick counter whose ZERO is each replica's
+        start time, so a replica built after a slow sibling warmup is
+        offset forever — only the latency signal means anything there
+        (training fleets step in lockstep, so lag stays on)."""
         cfg = self.config
         table = self.table()
         members = (set(range(self.world_size)) if members is None
@@ -432,7 +438,7 @@ class HeartbeatMonitor:
             lat = rec.get("latency")
             slow = (median is not None and lat is not None and median > 0
                     and lat > cfg.straggler_factor * median)
-            if lag > cfg.straggler_lag or slow:
+            if (step_lag and lag > cfg.straggler_lag) or slow:
                 flagged.add(w)
                 if w not in self._flagged_straggler:
                     self._flagged_straggler.add(w)

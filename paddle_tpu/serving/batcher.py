@@ -81,6 +81,24 @@ class BucketSpec:
             for n in self.shapes
         }
 
+    @property
+    def max_batch_size(self):
+        """The ladder's worst (largest) batch — what HBM admission
+        prices."""
+        return self.batch_sizes[-1]
+
+    def feed_specs(self, batch_size):
+        """Abstract (shape, dtype) specs of :meth:`feeds_for` without
+        allocating the arrays — capacity planning uses these."""
+        import jax
+
+        return {
+            n: jax.ShapeDtypeStruct(
+                (int(batch_size),) + self.shapes[n],
+                np.dtype(self.dtypes[n]))
+            for n in self.shapes
+        }
+
     def __repr__(self):
         return "BucketSpec(shapes=%r, dtypes=%r, batch_sizes=%r)" % (
             self.shapes, self.dtypes, self.batch_sizes)

@@ -211,11 +211,69 @@ class ServingEngine:
             timeout if timeout is not None else self.request_timeout_s)
 
     # -- warmup ----------------------------------------------------------
-    def warmup(self):
+    def check_hbm_budget(self, budget_bytes=None):
+        """Predict each bucket ladder's worst-bucket peak HBM with the
+        static liveness analyzer and reject ladders that cannot fit.
+
+        ``budget_bytes=None`` resolves the device capacity from the
+        analyzer's device table (or ``PADDLE_TPU_HBM_BYTES``); when no
+        capacity is known the check is a no-op. Raises
+        :class:`~paddle_tpu.analysis.ProgramVerifyError` listing every
+        over-budget ladder — BEFORE any warmup compile touches XLA."""
+        from ..analysis import costs as _costs, memory as _memory
+        from ..analysis.diagnostics import ProgramVerifyError
+        from ..fluid.executor import _device_kind
+
+        if budget_bytes is None:
+            profile = _costs.device_profile(_device_kind())
+            budget_bytes = profile.hbm_bytes if profile else None
+        if not budget_bytes:
+            return []
+        pred = self._predictor
+        results = []
+        worst = 0
+        for spec in self._bucket_specs:
+            b = spec.max_batch_size
+            est = _memory.estimate(
+                pred.program, feed_specs=spec.feed_specs(b),
+                state_specs=pred._state,
+                fetch_names=pred.fetch_names,
+                state_names=set(pred._state), default_dim=b)
+            worst = max(worst, est.peak_bytes)
+            results.append((spec, b, est))
+        obs.set_gauge(
+            "serving.predicted_peak_hbm.%s" % self.name, worst)
+        over = [(spec, b, est) for spec, b, est in results
+                if est.peak_bytes > budget_bytes]
+        if not over:
+            return results
+        lines = [
+            "bucket %s at batch %d: predicted peak %.2f MB "
+            "(params %.2f MB + activations %.2f MB at op %s '%s')"
+            % (spec.signature(), b, est.peak_bytes / 1e6,
+               est.param_bytes / 1e6, est.act_bytes_at_peak / 1e6,
+               est.peak_op_index, est.peak_op_type)
+            for spec, b, est in over]
+        obs.event("bucket_rejected", source="serving", model=self.name,
+                  rejected=len(over), budget_bytes=int(budget_bytes))
+        raise ProgramVerifyError(
+            "predicted-oom: %d of %d bucket ladder(s) exceed the HBM "
+            "budget (%.2f MB) — trim the worst batch sizes or shard the "
+            "model:\n%s"
+            % (len(over), len(results), budget_bytes / 1e6,
+               "\n".join(lines)))
+
+    def warmup(self, check_hbm=True):
         """Pre-build one executable per declared (bucket, batch size)
         through the predictor's compile-cache disk tier. On a restarted
         server every entry resolves from disk — ``source == "disk"``,
-        zero ``compile_start`` events. Returns the per-entry report."""
+        zero ``compile_start`` events. Returns the per-entry report.
+
+        ``check_hbm=True`` first runs :meth:`check_hbm_budget`: a
+        ladder whose worst bucket cannot fit the device raises before
+        any compile is attempted."""
+        if check_hbm:
+            self.check_hbm_budget()
         report = []
         for spec in self._bucket_specs:
             for b in spec.batch_sizes:

@@ -32,7 +32,8 @@ Replica flavors:
   treats as "replay on the next replica".
 
 Dispatch is least-loaded with shed-aware failover: candidates are the
-live replicas ordered by (straggler?, queue depth, rid); a replica that
+live replicas ordered by (straggler?, queue depth), depth ties rotated
+round-robin so an idle fleet still spreads load; a replica that
 sheds (:class:`~.engine.ShedError`) or is draining just moves the
 request to the next candidate, and when EVERY replica sheds the router
 backs off exponentially and retries inside the request's deadline
@@ -614,6 +615,7 @@ class ServingRouter:
         self._dead = {}
         self._scaled_up = []      # rids activated by pressure (LIFO)
         self._stragglers = set()
+        self._rr = 0              # round-robin cursor for depth ties
         self._pressure = collections.deque()
         self._closed = False
         self._inflight = set()
@@ -712,11 +714,20 @@ class ServingRouter:
 
     def _candidates(self, tried):
         """Live replicas this request has not tried, least-loaded
-        first; flagged stragglers sort behind healthy peers."""
+        first; depth ties rotate round-robin so an idle fleet spreads
+        even a strictly serial stream instead of funnelling every
+        request at the lowest rid; flagged stragglers sort behind
+        healthy peers."""
         with self._lock:
-            pool = [(r.rid in self._stragglers, r.queue_depth(), r.rid, r)
-                    for r in self._live.values() if r.rid not in tried]
-        return [r for *_, r in sorted(pool, key=lambda t: t[:3])]
+            reps = [r for r in self._live.values() if r.rid not in tried]
+            if reps:
+                k = self._rr % len(reps)
+                self._rr += 1
+                reps = reps[k:] + reps[:k]
+            pool = [(r.rid in self._stragglers, r.queue_depth(), r)
+                    for r in reps]
+        pool.sort(key=lambda t: t[:2])  # stable: ties keep rotation
+        return [r for *_, r in pool]
 
     def _dispatch(self, state):
         try:
@@ -854,8 +865,11 @@ class ServingRouter:
                 self._mark_dead(rid)
             with self._lock:
                 members = set(self._live)
+            # step_lag=False: replica beats count from each process's
+            # start, not a shared training step — lag is meaningless
+            # here and would pin late-built replicas behind forever
             self._stragglers = (
-                self.monitor.stragglers(members=members)
+                self.monitor.stragglers(members=members, step_lag=False)
                 if len(members) >= 2 else set())
         obs.set_gauge("serving.queue_depth.%s" % self.name,
                       self.queue_depth())

@@ -75,8 +75,8 @@ def test_fleet_bit_identity_and_balance(model_dir):
         assert stats["requests"] == 16
         assert stats["router_requests"] == 16
         assert stats["replicas_live"] == 2
-        # both replicas actually served work (least-loaded spreads a
-        # serial stream because depth ties break by rid only briefly)
+        # both replicas actually served work (depth ties rotate
+        # round-robin, so even a strictly serial stream spreads)
         per = [r.stats()["requests"] for r in router._live.values()]
         assert sum(per) == 16 and all(n > 0 for n in per), per
     finally:
