@@ -988,6 +988,213 @@ def _measure_serving_fleet(n_replicas=4, n_clients=8, n_requests=240):
     }
 
 
+def _measure_decode_serving(n_clients=8, requests_per_client=3,
+                            max_new=16):
+    """Decode-serving lane (ISSUE 9): a tiny trained GPT behind the
+    continuous-batching DecodeEngine and the HTTP chunked ``:generate``
+    endpoint, >= 8 concurrent mixed-length clients. Reports aggregate
+    tokens/s and per-token + TTFT latency p50/p99, the peak
+    slot-utilization gauge, the spread vs the full-batch-barrier
+    baseline (same programs, admission only when every slot is free), a
+    per-length bit-identity check against solo build_gpt_generate, and
+    the warm-restart compile count (gated by PADDLE_TPU_BENCH_DECODE=1)."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.models import gpt
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 9
+    cfg = gpt.gpt_tiny(vocab=97, max_len=64)
+    vs = gpt.build_gpt_lm(cfg, 16)
+    fluid.optimizer.Adam(5e-3).minimize(vs["loss"])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    ids, labels = gpt.synthetic_lm_batch(cfg, 16, 16)
+    for _ in range(10):
+        exe.run(feed={"gpt_ids": ids, "gpt_labels": labels},
+                fetch_list=[vs["loss"]])
+
+    lens_cycle = (3, 6, 10, 14)
+    rng = np.random.default_rng(0)
+    prompts = {n: rng.integers(1, cfg.vocab, n).astype("int64")
+               for n in lens_cycle}
+
+    def make_engine(barrier=False):
+        # deterministic program names per build: an engine constructed
+        # after a process restart fingerprints identically, so the
+        # compile-cache disk tier makes its warmup zero-compile
+        unique_name.switch()
+        return serving.DecodeEngine(
+            cfg, fluid.global_scope(), slots=4, cache_len=48,
+            prompt_buckets=(8, 16), queue_capacity=256,
+            name="decode-bench", barrier=barrier)
+
+    eng = make_engine()
+    eng.warmup()
+    reg = serving.ModelRegistry()
+    reg.publish("gpt", eng)
+    srv = serving.ServingServer(reg).start()
+
+    # sample the live-slot gauge while the load runs (its end-state is
+    # always 0.0 once everything retires)
+    util_peak = [0.0]
+    sampling = threading.Event()
+
+    def sampler():
+        while not sampling.is_set():
+            g = obs.gauge("serving.decode.slot_utilization.decode-bench")
+            if g is not None:
+                util_peak[0] = max(util_peak[0], g)
+            time.sleep(0.002)
+
+    ttfts, gaps, errors = [], [], []
+    lock = threading.Lock()
+    streamed = {}
+
+    def client(cid):
+        for k in range(requests_per_client):
+            plen = lens_cycle[(cid + k) % len(lens_cycle)]
+            body = _json.dumps({
+                "prompt": prompts[plen].tolist(),
+                "max_new_tokens": max_new}).encode()
+            req = urllib.request.Request(
+                srv.url + "/v1/models/gpt:generate", data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.monotonic()
+            try:
+                toks, times = [], []
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    for line in resp:
+                        doc = _json.loads(line)
+                        if "token" in doc:
+                            toks.append(doc["token"])
+                            times.append(time.monotonic())
+                        elif doc.get("done") and doc.get(
+                                "finish_reason") != "length":
+                            errors.append((cid, k, doc))
+                with lock:
+                    ttfts.append(times[0] - t0)
+                    gaps.extend(b - a for a, b in zip(times, times[1:]))
+                    streamed.setdefault(plen, toks)
+            except Exception as e:  # noqa: BLE001 — bank it, keep driving
+                errors.append((cid, k, repr(e)))
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    sampling.set()
+    sampler_t.join(timeout=2)
+    srv.stop(close_registry=False)
+    if errors:
+        raise RuntimeError("decode clients failed: %r" % errors[:3])
+
+    # bit-identity: every streamed sequence must match a SOLO
+    # build_gpt_generate greedy run of its prompt, token for token
+    for plen, toks in sorted(streamed.items()):
+        g_prog, g_st = fluid.Program(), fluid.Program()
+        with fluid.program_guard(g_prog, g_st):
+            gen = gpt.build_gpt_generate(cfg, plen, max_new, mode="greedy")
+        want = np.asarray(exe.run(
+            g_prog, feed={"gpt_prompt": prompts[plen].reshape(1, -1)},
+            fetch_list=[gen["ids"]])[0])[0, plen - 1:]
+        if list(want) != toks:
+            raise RuntimeError(
+                "decode stream diverged from solo generate at prompt "
+                "len %d" % plen)
+
+    def pct(sorted_vals, q):
+        if not sorted_vals:
+            return None
+        i = min(len(sorted_vals) - 1, int(len(sorted_vals) * q))
+        return round(1000 * sorted_vals[i], 3)
+
+    ttfts.sort()
+    gaps.sort()
+    n_requests = n_clients * requests_per_client
+    stats = eng.stats()
+
+    # ablation: identical programs, but admission only when EVERY slot
+    # is free — the classic full-batch generation schedule
+    def drive_direct(engine):
+        # prime first-dispatch costs (write-jit trace, executable
+        # first-run) out of the timed window so the two schedules
+        # compare scheduling, not warmup order
+        for plen in lens_cycle:
+            engine.generate(prompts[plen], max_new=2, timeout=120)
+        done = []
+
+        def d_client(cid):
+            for k in range(requests_per_client):
+                plen = lens_cycle[(cid + k) % len(lens_cycle)]
+                out = engine.generate(prompts[plen], max_new=max_new,
+                                      timeout=120)
+                with lock:
+                    done.append(len(out))
+
+        ths = [threading.Thread(target=d_client, args=(c,))
+               for c in range(n_clients)]
+        w0 = time.monotonic()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return sum(done) / (time.monotonic() - w0)
+
+    continuous_tps = drive_direct(eng)
+    eng.stop(drain=True)
+    barrier_eng = make_engine(barrier=True)
+    barrier_eng.warmup(check_hbm=False)
+    barrier_tps = drive_direct(barrier_eng)
+    barrier_eng.stop(drain=True)
+
+    # warm restart: a rebuilt engine resolves every program through the
+    # compile cache — with the disk tier on, zero XLA compiles
+    restart = make_engine()
+    warm2 = restart.warmup(check_hbm=False)
+    restart.stop(drain=True)
+    sources = {}
+    for r in warm2:
+        sources[r["source"]] = sources.get(r["source"], 0) + 1
+    reg.close()
+
+    return {
+        "clients": n_clients,
+        "requests": n_requests,
+        "tokens_total": stats["tokens"],
+        "tokens_per_sec": round(n_requests * max_new / wall, 1),
+        "ttft_ms_p50": pct(ttfts, 0.50),
+        "ttft_ms_p99": pct(ttfts, 0.99),
+        "per_token_ms_p50": pct(gaps, 0.50),
+        "per_token_ms_p99": pct(gaps, 0.99),
+        "slot_utilization_peak": round(util_peak[0], 3),
+        "prefills": stats["prefills"],
+        "steps": stats["steps"],
+        "continuous_tokens_per_sec": round(continuous_tps, 1),
+        "barrier_tokens_per_sec": round(barrier_tps, 1),
+        "continuous_vs_barrier_speedup": round(
+            continuous_tps / barrier_tps, 3) if barrier_tps else None,
+        "bit_identical_to_solo_generate": True,
+        "warm_restart_sources": sources,
+    }
+
+
 def _bank(st, variant, cfg, on_accel, backend, device_kind):
     peak_v = _peak_flops(device_kind)
     if peak_v:
@@ -1217,6 +1424,17 @@ def child_main(status_path):
             st.flush()
         except Exception as e:  # noqa: BLE001
             st.error("serving_fleet failed: %s: %s"
+                     % (type(e).__name__, str(e)[:300]))
+
+    if os.environ.get("PADDLE_TPU_BENCH_DECODE"):
+        # decode lane (ISSUE 9): continuous-batching KV-cache decode
+        # behind the HTTP :generate stream, vs the full-batch barrier
+        st.stage("decode_serving")
+        try:
+            st.data["detail"]["decode_serving"] = _measure_decode_serving()
+            st.flush()
+        except Exception as e:  # noqa: BLE001
+            st.error("decode_serving failed: %s: %s"
                      % (type(e).__name__, str(e)[:300]))
 
     tel_out = os.environ.get("PADDLE_TPU_BENCH_TELEMETRY_OUT")

@@ -148,12 +148,19 @@ def _ceil_div(a, b):
 
 def estimate(program, env=None, feed_specs=None, state_specs=None,
              fetch_names=(), state_names=None, default_dim=None,
-             param_shards=1, act_shards=1, sizes=None):
+             param_shards=1, act_shards=1, sizes=None,
+             resident_names=()):
     """Run the liveness walk; returns a :class:`MemoryReport`.
 
     ``state_names=None`` treats every persistable as state (executor
     semantics). ``param_shards``/``act_shards`` divide parameter and
-    activation footprints (see :func:`shard_divisors`)."""
+    activation footprints (see :func:`shard_divisors`).
+    ``resident_names`` pins names live across the WHOLE program
+    regardless of their def/use span — e.g. the persistent per-slot KV
+    buffer pair a decode engine round-trips device-to-device every
+    step: def-use liveness would let the fed copy die at its last
+    reader, but the serving process holds both the fed and the fetched
+    buffer for the region's entire lifetime."""
     gb = program.global_block()
     if sizes is None:
         sizes = sizes_from(program, env=env, feed_specs=feed_specs,
@@ -165,6 +172,7 @@ def estimate(program, env=None, feed_specs=None, state_specs=None,
         state_names = set(state_names)
     fetch_names = set(fetch_names or ())
     feed_names = set(feed_specs or ())
+    resident_names = set(resident_names or ())
 
     param_bytes = sum(
         _ceil_div(sizes[n], param_shards)
@@ -198,7 +206,7 @@ def estimate(program, env=None, feed_specs=None, state_specs=None,
 
     transient = {}
     seen_unsized = set(unsized)
-    for n in set(first_def) | set(last_use) | feed_names:
+    for n in set(first_def) | set(last_use) | feed_names | resident_names:
         if n in state_names:
             continue
         if n not in sizes:
@@ -210,6 +218,8 @@ def estimate(program, env=None, feed_specs=None, state_specs=None,
         end = last_use.get(n, start)
         if n in fetch_names:
             end = n_ops - 1
+        if n in resident_names:
+            start, end = 0, n_ops - 1
         end = max(end, start)
         transient[n] = (start, end, _ceil_div(sizes[n], act_shards))
 

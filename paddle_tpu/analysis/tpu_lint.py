@@ -10,7 +10,7 @@ from ..fluid import core
 from . import walker
 from .diagnostics import INFO, PERF, WARNING, AnalysisReport
 
-__all__ = ["lint"]
+__all__ = ["lint", "lint_decode_ladder"]
 
 # MXU is 128x128, VPU lanes are 8x128; a float32 tile is (8, 128)
 # (see the pallas guide) — XLA pads unaligned dims with dead lanes.
@@ -250,3 +250,43 @@ def _lint_shape_vocab(gb, feed_names, report):
                (", " + ", ".join(detail)) if detail else "",
                estimate),
             block_idx=0)
+
+
+def lint_decode_ladder(prompt_buckets, slot_counts=(1,), cache_lens=(),
+                       threshold=None):
+    """Lint a decode engine's AOT program ladder BEFORE it compiles.
+
+    A DecodeEngine compiles one prefill program per (prompt bucket,
+    cache_len) and one step program per (slot count, cache_len); an
+    over-wide ladder (per-token prompt buckets, a cache_len per client)
+    quietly re-creates the unbounded-shape-vocab hazard the feed lint
+    catches for dynamic axes — but here every rung is *declared*, so
+    the feed shapes all look static. Warns against the same
+    ``SHAPE_VOCAB_THRESHOLD`` budget; also flags non-pow2 prompt
+    buckets (each odd rung is a whole extra executable a pow2 ladder
+    would have covered)."""
+    report = AnalysisReport(checks=["decode_ladder"])
+    prompt_buckets = sorted({int(b) for b in (prompt_buckets or ())})
+    slot_counts = sorted({int(s) for s in (slot_counts or (1,))})
+    cache_lens = sorted({int(c) for c in (cache_lens or (1,))})
+    threshold = SHAPE_VOCAB_THRESHOLD if threshold is None else threshold
+    programs = len(cache_lens) * (len(prompt_buckets) + len(slot_counts))
+    report.meta["decode_ladder_programs"] = programs
+    if programs > threshold:
+        report.add(
+            WARNING, "unbounded-shape-vocab",
+            "decode ladder compiles %d AOT programs (%d prompt buckets "
+            "+ %d slot counts over %d cache lengths) — over the %d "
+            "shape-vocabulary budget; thin the prompt-bucket ladder "
+            "(pow2 rungs) and pin one (slots, cache_len) per engine"
+            % (programs, len(prompt_buckets), len(slot_counts),
+               len(cache_lens), threshold),
+            block_idx=0)
+    odd = [b for b in prompt_buckets
+           if b & (b - 1) and b != max(prompt_buckets or [0])]
+    if odd:
+        report.add(
+            INFO, "decode-ladder-rungs",
+            "non-pow2 prompt buckets %s: each is an extra executable a "
+            "pow2 ladder would already cover" % (odd,), block_idx=0)
+    return report

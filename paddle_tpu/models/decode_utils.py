@@ -55,15 +55,19 @@ def step_masks(pos, tmax):
     return write3, keep3, self_mask
 
 
-def update_cache(cache, new_t, write3=None, keep3=None, pos=None):
+def update_cache(cache, new_t, write3=None, keep3=None, pos=None,
+                 per_row=False):
     """Write the (B, 1, H) step value into the (B, T, H) cache.
 
-    With ``pos`` (the (B, 1) decode position, uniform across the batch
-    as in every incremental decoder here) this is an O(B·H)
-    dynamic-update-slice write. Without it, the one-hot masked rewrite
-    (``write3``/``keep3`` from :func:`step_masks`) re-reads and
-    re-writes the whole cache — kept for callers with per-row
-    positions."""
+    With ``pos`` (the (B, 1) decode position) this is an O(B·H)
+    dynamic-update-slice write: uniform across the batch by default
+    (every row advances one token per scan step, as in the full-batch
+    decoders here), or an independent position per row with
+    ``per_row=True`` (slotted continuous-batching decode, where a
+    freshly prefilled slot sits at its prompt length while neighbours
+    are deep into generation). Without ``pos``, the one-hot masked
+    rewrite (``write3``/``keep3`` from :func:`step_masks`) re-reads and
+    re-writes the whole cache — kept for callers with neither."""
     if pos is not None:
         from paddle_tpu.fluid.layer_helper import LayerHelper
 
@@ -74,6 +78,7 @@ def update_cache(cache, new_t, write3=None, keep3=None, pos=None):
             type="decode_cache_write",
             inputs={"Cache": [cache], "Value": [new_t], "Pos": [pos]},
             outputs={"Out": [out]},
+            attrs={"per_row": bool(per_row)},
         )
         return out
     if write3 is None or keep3 is None:

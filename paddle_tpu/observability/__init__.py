@@ -88,6 +88,22 @@ Well-known cost-model metrics (PR 8, ``analysis.costs`` / ``.memory``):
   ``bucket_rejected`` events (source ``serving``) record ladders that
   exceeded the HBM budget (the warmup raises before any compile).
 
+Well-known decode-serving metrics (PR 9, ``serving.decode``):
+
+- ``serving.decode.slot_utilization.<engine>`` gauge — live slots /
+  total slots after each dispatch iteration (continuous batching keeps
+  this near 1.0 under load); ``serving.decode.cache_occupancy.<engine>``
+  gauge — filled KV rows / (slots × cache_len).
+- ``serving.decode.prefill_seconds`` / ``step_seconds`` /
+  ``ttft_seconds`` / ``request_seconds`` histograms — the two-program
+  loop's dispatch costs plus time-to-first-token and whole-request
+  latency.
+- ``serving.decode.tokens`` / ``requests`` / ``prefills`` / ``steps``
+  / ``retired`` / ``shed`` / ``deadline_miss`` / ``cancelled``
+  counters — every lifecycle edge ``stats()`` reports, mirrored into
+  the hub; rejects and client disconnects also land in the flight
+  recorder with ``engine="decode"``.
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

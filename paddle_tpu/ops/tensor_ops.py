@@ -450,6 +450,20 @@ def _decode_cache_write(ctx, ins, attrs):
     (ref: paddle/fluid/operators/math/beam_search.cc writes rows in
     place rather than rebuilding the tensor)."""
     cache, val, pos = ins["Cache"][0], ins["Value"][0], ins["Pos"][0]
+    if attrs.get("per_row"):
+        # continuous-batching slot semantics: every row is its OWN
+        # sequence at its own position (freed slots restart at 0 while
+        # neighbours keep decoding), so the write index varies per row.
+        # vmap the row write — still O(B·H), no one-hot rewrite.
+        import jax as _jax
+
+        starts = pos.reshape(-1).astype(jnp.int32)
+
+        def _row(c, v, s):
+            return lax.dynamic_update_slice(
+                c, v.astype(c.dtype), (s, jnp.int32(0)))
+
+        return single(_jax.vmap(_row)(cache, val, starts))
     start = pos.reshape(-1)[0].astype(jnp.int32)
     zero = jnp.int32(0)
     return single(lax.dynamic_update_slice(

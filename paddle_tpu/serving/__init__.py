@@ -23,6 +23,16 @@ A single-engine deployment stays exactly as before (``reg.load``); a
 fleet swaps in one line — ``reg.publish("m", router.local_fleet(dir,
 n_replicas=4))`` — because the router wears the engine's duck type.
 
+Autoregressive decode gets its own engine:
+:class:`~paddle_tpu.serving.decode.DecodeEngine` holds a persistent
+slotted KV cache and runs a two-program loop (bucketed prefill + one
+step program for every live slot), retiring finished sequences and
+prefilling queued requests into freed slots *between* steps —
+continuous batching, no full-batch barrier. It publishes like any
+engine (``reg.publish("gpt", DecodeEngine(cfg, scope))``) and streams
+per-token over ``POST /v1/models/<name>:generate`` (chunked
+transfer-encoding).
+
 Quick start::
 
     from paddle_tpu import serving
@@ -44,6 +54,9 @@ plus the fleet layer: ``serving.replicas_live`` /
 ``serving.dispatch_seconds`` histogram.
 """
 from .batcher import BucketSpec, round_up_pow2, tail_signature  # noqa: F401
+from .decode import (  # noqa: F401
+    DecodeEngine, DecodeStream, default_prompt_buckets,
+)
 from .engine import (  # noqa: F401
     DeadlineExceededError, EngineClosedError, ServingEngine, ShedError,
 )
@@ -56,10 +69,10 @@ from .router import (  # noqa: F401
 )
 
 __all__ = [
-    "BucketSpec", "DeadlineExceededError", "EngineClosedError",
-    "LocalReplica", "ModelRegistry", "NoReplicasError", "ReplicaGoneError",
-    "ReplicaWorker", "RolloutError", "ServingEngine", "ServingHandler",
-    "ServingRouter", "ServingServer", "ShedError", "StoreReplica",
-    "local_fleet", "make_engine_factory", "round_up_pow2",
-    "tail_signature",
+    "BucketSpec", "DeadlineExceededError", "DecodeEngine", "DecodeStream",
+    "EngineClosedError", "LocalReplica", "ModelRegistry", "NoReplicasError",
+    "ReplicaGoneError", "ReplicaWorker", "RolloutError", "ServingEngine",
+    "ServingHandler", "ServingRouter", "ServingServer", "ShedError",
+    "StoreReplica", "default_prompt_buckets", "local_fleet",
+    "make_engine_factory", "round_up_pow2", "tail_signature",
 ]

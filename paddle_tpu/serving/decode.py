@@ -1,0 +1,754 @@
+"""DecodeEngine: slotted KV-cache decode with continuous batching.
+
+The micro-batching :class:`~paddle_tpu.serving.engine.ServingEngine`
+coalesces fixed-shape ``predict`` calls; the millions-of-users workload
+is autoregressive *decode*, where a full-batch ``lax.scan`` generator
+(:func:`~paddle_tpu.models.gpt.build_gpt_generate`) makes every request
+wait for the slowest sequence in its batch and admits nothing
+mid-generation. This engine removes the full-batch barrier:
+
+- **Slotted KV cache** — ONE pre-allocated device buffer pair
+  ``(slots, layers, cache_len, heads*dh)`` holds every live sequence's
+  keys/values. A slot is a sequence's home for its whole generation;
+  retiring frees the slot the same step.
+- **Two programs, both AOT** — a *prefill* program per declared prompt
+  bucket (parallel pass over the right-padded prompt writes a slot's
+  cache and emits the first token) and ONE *step* program (one token
+  for ALL slots per iteration, per-slot positions). Both resolve
+  through the PR-4 compile-cache disk tier at :meth:`warmup`, so a
+  restarted server never compiles and steady-state decode never sees
+  XLA.
+- **Continuous batching** — a single dispatch thread interleaves the
+  two: finished sequences (EOS or max-new) retire in-flight and queued
+  requests are prefilled into freed slots between steps; the other
+  slots never stall on a barrier. Per-request tokens are bit-identical
+  to a solo ``build_gpt_generate`` run (row independence + per-slot
+  masks), which the tests assert token-for-token.
+- **Streaming** — ``submit()`` returns a :class:`DecodeStream` whose
+  ``tokens()`` generator yields each token as the step loop produces
+  it; ``serving.http`` exposes it as a chunked-transfer ``:generate``
+  endpoint. Cancelling a stream (client disconnect) frees its slot at
+  the next loop iteration.
+
+Admission control mirrors the serving engine: full queue fast-rejects
+with :class:`~paddle_tpu.serving.engine.ShedError` (HTTP 429 +
+Retry-After from the observed retire rate), a queued request whose
+deadline expires is shed BEFORE its prefill with
+:class:`~paddle_tpu.serving.engine.DeadlineExceededError` (504), and
+:meth:`check_hbm_budget` prices the KV buffer pair + params + step
+peak with the static liveness analyzer before any warmup compile.
+
+Telemetry: ``serving.decode.slot_utilization`` /
+``serving.decode.cache_occupancy`` gauges,
+``serving.decode.prefill_seconds`` / ``step_seconds`` /
+``ttft_seconds`` / ``request_seconds`` histograms, and
+``serving.decode.tokens`` / ``requests`` / ``retired`` / ``shed`` /
+``deadline_miss`` / ``cancelled`` counters.
+
+``barrier=True`` is the ablation mode benches compare against: slots
+are only refilled once EVERY slot has retired — the classic full-batch
+generation schedule, identical programs, no in-flight admission.
+"""
+import collections
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .. import observability as obs
+from .engine import DeadlineExceededError, EngineClosedError, ShedError
+
+__all__ = ["DecodeEngine", "DecodeStream", "default_prompt_buckets"]
+
+
+def default_prompt_buckets(cache_len, smallest=8):
+    """Pow2 prompt-length ladder up to ``cache_len`` (always at least
+    one bucket)."""
+    buckets = []
+    b = min(int(smallest), int(cache_len))
+    while b < cache_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(cache_len))
+    return tuple(sorted(set(buckets)))
+
+
+class DecodeStream:
+    """Streaming handle for one generation request.
+
+    The dispatch thread feeds it; the caller either iterates
+    :meth:`tokens` (per-token streaming — what the HTTP chunked
+    endpoint does) or blocks on :meth:`result` for the full list.
+    ``finish_reason`` is ``"eos"`` / ``"length"`` / ``"cancelled"`` /
+    ``"error"`` once done. :meth:`cancel` (idempotent, thread-safe)
+    frees the request's slot at the dispatch loop's next iteration —
+    or drops it from the queue if it never reached a slot."""
+
+    def __init__(self, prompt_len, max_new, stall_timeout_s=60.0):
+        self.prompt_len = int(prompt_len)
+        self.max_new = int(max_new)
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.finish_reason = None
+        self.t_submit = time.monotonic()
+        self._q = queue.Queue()
+        self._tokens = []
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+        self._error = None
+
+    # -- caller surface --------------------------------------------------
+    @property
+    def cancelled(self):
+        return self._cancelled.is_set()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def cancel(self):
+        """Stop generating for this request (client went away)."""
+        self._cancelled.set()
+
+    def tokens(self, timeout=None):
+        """Generator yielding token ids as the engine produces them.
+        ``timeout`` bounds the wait for EACH token (default: the
+        engine's request timeout); a stalled engine raises
+        ``TimeoutError``, a failed request raises its error."""
+        wait = self.stall_timeout_s if timeout is None else float(timeout)
+        while True:
+            try:
+                kind, val = self._q.get(timeout=wait)
+            except queue.Empty:
+                raise TimeoutError(
+                    "no token for %.1fs (generated %d so far)"
+                    % (wait, len(self._tokens)))
+            if kind == "tok":
+                yield val
+            elif kind == "err":
+                raise val
+            else:  # done
+                return
+
+    def result(self, timeout=None):
+        """Block until generation finishes; returns the full token
+        list (raises the request's error if it failed)."""
+        wait = self.stall_timeout_s if timeout is None else timeout
+        if not self._done.wait(wait):
+            raise TimeoutError(
+                "generation not done after %.1fs" % float(wait))
+        if self._error is not None:
+            raise self._error
+        return list(self._tokens)
+
+    def so_far(self):
+        """Tokens generated so far (snapshot, no wait)."""
+        return list(self._tokens)
+
+    # -- engine surface --------------------------------------------------
+    def _emit(self, tok):
+        self._tokens.append(tok)
+        self._q.put(("tok", tok))
+
+    def _finish(self, reason):
+        self.finish_reason = reason
+        self._done.set()
+        self._q.put(("done", reason))
+
+    def _fail(self, exc):
+        self._error = exc
+        self.finish_reason = "error"
+        self._done.set()
+        self._q.put(("err", exc))
+
+
+class _Request:
+    __slots__ = ("prompt", "plen", "bucket", "max_new", "eos_id",
+                 "deadline", "handle")
+
+
+class _Slot:
+    __slots__ = ("handle", "remaining", "eos_id", "t_prefill")
+
+    def __init__(self, handle, remaining, eos_id):
+        self.handle = handle
+        self.remaining = remaining
+        self.eos_id = eos_id
+        self.t_prefill = time.monotonic()
+
+
+class DecodeEngine:
+    """Continuous-batching decode engine over a prefill/step program
+    pair (GPT-family by default; any builder pair with the same feed/
+    fetch contract plugs in via ``build_prefill``/``build_step``).
+
+    ::
+
+        eng = DecodeEngine(cfg, scope=trained_scope, slots=8,
+                           cache_len=128, eos_id=2, name="gpt")
+        eng.warmup()
+        for tok in eng.submit(prompt_ids, max_new=64).tokens():
+            ...
+
+    ``scope`` is any name->array mapping holding the trained params
+    (a ``fluid.Scope``, ``global_scope()`` after training, or a plain
+    dict); :meth:`from_dir` loads a ``save_persistables`` /
+    ``save_inference_model`` directory. Params are device_put ONCE and
+    shared by every program (prefill buckets + step), not duplicated
+    per predictor."""
+
+    engine_kind = "decode"
+
+    def __init__(self, cfg, scope, slots=4, cache_len=64,
+                 prompt_buckets=None, eos_id=None, queue_capacity=64,
+                 default_max_new=32, default_deadline_ms=None,
+                 request_timeout_s=60.0, name="default",
+                 barrier=False, auto_start=True,
+                 build_prefill=None, build_step=None):
+        import jax
+
+        import paddle_tpu.fluid as fluid
+        from ..fluid.inference import Predictor
+
+        if build_prefill is None or build_step is None:
+            from ..models.gpt import build_gpt_decode_step, build_gpt_prefill
+
+            build_prefill = build_prefill or build_gpt_prefill
+            build_step = build_step or build_gpt_decode_step
+        self._jax = jax
+        self.cfg = cfg
+        self.name = str(name)
+        self.slots = int(slots)
+        self.cache_len = int(cache_len)
+        self.eos_id = eos_id
+        self.default_max_new = int(default_max_new)
+        self._default_deadline_ms = default_deadline_ms
+        self.request_timeout_s = float(request_timeout_s)
+        self.barrier = bool(barrier)
+        if prompt_buckets is None:
+            prompt_buckets = default_prompt_buckets(self.cache_len)
+        self.prompt_buckets = tuple(sorted({int(b) for b in prompt_buckets}))
+        if not self.prompt_buckets or self.prompt_buckets[0] < 1:
+            raise ValueError("prompt_buckets must be positive ints")
+        if self.prompt_buckets[-1] > self.cache_len:
+            raise ValueError(
+                "largest prompt bucket (%d) exceeds cache_len (%d)"
+                % (self.prompt_buckets[-1], self.cache_len))
+
+        # -- build the program pair (never touching the caller's
+        # default_main_program) and share ONE device param set ---------
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            step_vars = build_step(cfg, self.cache_len)
+            step_prog = fluid.default_main_program()
+        prefill = {}
+        for b in self.prompt_buckets:
+            with fluid.program_guard(fluid.Program(), fluid.Program()):
+                pv = build_prefill(cfg, b, self.cache_len)
+                prefill[b] = (fluid.default_main_program(), pv)
+        persist = {}
+        for prog in [step_prog] + [p for p, _ in prefill.values()]:
+            for v in prog.list_vars():
+                if not getattr(v, "persistable", False):
+                    continue
+                if v.name in persist:
+                    continue
+                if v.name not in scope:
+                    raise KeyError(
+                        "param %r required by the decode programs is "
+                        "missing from the given scope — train the model "
+                        "or load its persistables first" % v.name)
+                # snapshot through the host: device_put on a committed
+                # jax array is a no-op, and sharing the training
+                # executor's buffers would let its donating step
+                # invalidate them under this engine mid-serve
+                persist[v.name] = jax.device_put(np.asarray(scope[v.name]))
+        self._params = persist
+        self._step_vars = step_vars
+        self._step_pred = Predictor(
+            step_prog, step_vars["feed_names"], step_vars["fetch_vars"],
+            scope=persist)
+        self._prefill_preds = {}
+        self._prefill_vars = {}
+        for b, (prog, pv) in prefill.items():
+            self._prefill_preds[b] = Predictor(
+                prog, pv["feed_names"], pv["fetch_vars"], scope=persist)
+            self._prefill_vars[b] = pv
+
+        # -- the persistent slot buffer pair + host-side slot state ----
+        shape = (self.slots, cfg.num_layers, self.cache_len, cfg.hidden)
+        self._k = jax.device_put(np.zeros(shape, np.float32))
+        self._v = jax.device_put(np.zeros(shape, np.float32))
+        self._tok = np.zeros((self.slots, 1), np.int64)
+        self._pos = np.zeros((self.slots, 1), np.int64)
+        self._slots = [None] * self.slots
+        # slot writes trace once (slot index is a traced scalar); the
+        # old buffer is donated so the pair never triples up in HBM
+        self._write = jax.jit(
+            lambda buf, val, slot: jax.lax.dynamic_update_slice(
+                buf, val, (slot, 0, 0, 0)),
+            donate_argnums=(0,))
+
+        self._q = queue.Queue(maxsize=int(queue_capacity))
+        self._stop_event = threading.Event()
+        self._abort = False
+        self._closed = False
+        self._admit_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._stats = collections.Counter()
+        self._rate = collections.deque(maxlen=64)  # (t_done, 1) retires
+        self._thread = None
+        if auto_start:
+            self.start()
+
+    # -- construction helpers -------------------------------------------
+    @classmethod
+    def from_dir(cls, cfg, dirname, filename=None, **kw):
+        """Build from a ``save_persistables`` / ``save_params`` /
+        ``save_inference_model`` directory (the ``.npz`` payload those
+        writers produce)."""
+        import os
+
+        candidates = ([filename] if filename else
+                      ["__persistables__.npz", "__params__.npz",
+                       "__vars__.npz"])
+        for fn in candidates:
+            path = os.path.join(str(dirname), fn)
+            if os.path.exists(path):
+                data = np.load(path, allow_pickle=False)
+                return cls(cfg, {n: data[n] for n in data.files}, **kw)
+        raise FileNotFoundError(
+            "no params payload (%s) under %r" % (", ".join(candidates),
+                                                 dirname))
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._closed:
+            raise EngineClosedError("engine %r is closed" % self.name)
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="decode-dispatch-%s" % self.name)
+            self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        """Stop admitting work. ``drain=True`` finishes every live slot
+        and queued request first; ``drain=False`` fails them with
+        :class:`EngineClosedError`. Idempotent."""
+        with self._admit_lock:
+            self._closed = True
+        if not drain:
+            self._abort = True
+        self._stop_event.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=max(0.1, float(timeout)))
+        while True:  # no thread (or it died): fail leftovers loudly
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            req.handle._fail(EngineClosedError(
+                "engine %r stopped before prefill" % self.name))
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._slots[i] = None
+                s.handle._fail(EngineClosedError(
+                    "engine %r stopped mid-generation" % self.name))
+        obs.event("engine_stop", source="serving", count=False,
+                  model=self.name, engine="decode", drained=bool(drain))
+
+    # -- admission -------------------------------------------------------
+    def _bucket_for(self, plen):
+        for b in self.prompt_buckets:
+            if b >= plen:
+                return b
+        return None
+
+    def submit(self, prompt, max_new=None, eos_id=None, deadline_ms=None):
+        """Enqueue one generation request; returns a
+        :class:`DecodeStream`. Raises :class:`ShedError` when the queue
+        is full, :class:`EngineClosedError` after ``stop()``, and
+        ``ValueError`` for prompts that cannot fit the ladder."""
+        if self._closed:
+            raise EngineClosedError(
+                "engine %r is draining/stopped" % self.name)
+        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
+        plen = int(prompt.shape[0])
+        if plen < 1:
+            raise ValueError("empty prompt")
+        if prompt.min() < 0 or prompt.max() >= self.cfg.vocab:
+            raise ValueError(
+                "prompt token out of range [0, %d)" % self.cfg.vocab)
+        bucket = self._bucket_for(plen)
+        if bucket is None:
+            raise ValueError(
+                "prompt length %d exceeds the largest prompt bucket "
+                "(%d) — raise cache_len/prompt_buckets"
+                % (plen, self.prompt_buckets[-1]))
+        max_new = self.default_max_new if max_new is None else int(max_new)
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        if plen + max_new - 1 > self.cache_len:
+            raise ValueError(
+                "prompt_len %d + max_new %d - 1 exceeds cache_len %d"
+                % (plen, max_new, self.cache_len))
+        req = _Request()
+        req.prompt = prompt
+        req.plen = plen
+        req.bucket = bucket
+        req.max_new = max_new
+        req.eos_id = self.eos_id if eos_id is None else eos_id
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        req.deadline = (time.monotonic() + float(deadline_ms) / 1000.0
+                        if deadline_ms is not None else None)
+        req.handle = DecodeStream(
+            plen, max_new, stall_timeout_s=self.request_timeout_s)
+        try:
+            with self._admit_lock:
+                if self._closed:
+                    raise EngineClosedError(
+                        "engine %r is draining/stopped" % self.name)
+                self._q.put_nowait(req)
+        except queue.Full:
+            self._bump("shed")
+            obs.event("shed", source="serving", model=self.name,
+                      engine="decode", prompt_len=plen,
+                      queue_capacity=self._q.maxsize)
+            raise ShedError(
+                "decode queue full (%d) for model %r — request shed"
+                % (self._q.maxsize, self.name),
+                model=self.name, retry_after=self.retry_after_hint())
+        self._bump("requests")
+        obs.set_gauge("serving.queue_depth.%s" % self.name,
+                      self._q.qsize())
+        return req.handle
+
+    def generate(self, prompt, max_new=None, eos_id=None,
+                 deadline_ms=None, timeout=None):
+        """Synchronous submit + wait; returns the full token list."""
+        h = self.submit(prompt, max_new=max_new, eos_id=eos_id,
+                        deadline_ms=deadline_ms)
+        return h.result(
+            timeout if timeout is not None else self.request_timeout_s)
+
+    # -- admission checks before warmup ----------------------------------
+    def check_hbm_budget(self, budget_bytes=None):
+        """Price params + the persistent KV buffer pair + the step
+        program's transient peak with the static liveness analyzer,
+        BEFORE any warmup compile. The cache feeds/fetches are passed
+        as ``resident_names`` so the analyzer holds them live across
+        the whole decode region instead of letting them die like
+        ordinary activations. ``budget_bytes=None`` resolves the device
+        capacity from the analyzer's device table; unknown capacity is
+        a no-op. Raises ``ProgramVerifyError`` when the engine cannot
+        fit."""
+        from ..analysis import costs as _costs, memory as _memory
+        from ..analysis.diagnostics import ProgramVerifyError
+        from ..fluid.executor import _device_kind
+
+        if budget_bytes is None:
+            profile = _costs.device_profile(_device_kind())
+            budget_bytes = profile.hbm_bytes if profile else None
+        if not budget_bytes:
+            return None
+        jax = self._jax
+        pred = self._step_pred
+        sv = self._step_vars
+        cache_names = [sv["k_in"].name, sv["v_in"].name,
+                       sv["k"].name, sv["v"].name]
+        feed_specs = {
+            sv["tok"].name: jax.ShapeDtypeStruct(
+                (self.slots, 1), np.int64),
+            sv["pos"].name: jax.ShapeDtypeStruct(
+                (self.slots, 1), np.int64),
+            sv["k_in"].name: jax.ShapeDtypeStruct(
+                tuple(self._k.shape), np.float32),
+            sv["v_in"].name: jax.ShapeDtypeStruct(
+                tuple(self._v.shape), np.float32),
+        }
+        est = _memory.estimate(
+            pred.program, feed_specs=feed_specs,
+            state_specs=pred._state, fetch_names=pred.fetch_names,
+            state_names=set(pred._state), default_dim=self.slots,
+            resident_names=cache_names)
+        obs.set_gauge(
+            "serving.predicted_peak_hbm.%s" % self.name, est.peak_bytes)
+        if est.peak_bytes > budget_bytes:
+            obs.event("bucket_rejected", source="serving",
+                      model=self.name, engine="decode",
+                      budget_bytes=int(budget_bytes))
+            raise ProgramVerifyError(
+                "predicted-oom: decode engine %r needs %.2f MB "
+                "(params %.2f MB + resident KV pair + step peak at op "
+                "%s '%s') but the HBM budget is %.2f MB — shrink "
+                "slots/cache_len or shard the model"
+                % (self.name, est.peak_bytes / 1e6,
+                   est.param_bytes / 1e6, est.peak_op_index,
+                   est.peak_op_type, budget_bytes / 1e6))
+        return est
+
+    def check_ladder(self):
+        """Lint the (slots, cache_len, prompt-buckets) ladder's
+        compiled-program count against the shape-vocabulary budget;
+        returns the findings (also recorded as events)."""
+        from ..analysis import tpu_lint
+
+        report = tpu_lint.lint_decode_ladder(
+            self.prompt_buckets, slot_counts=(self.slots,),
+            cache_lens=(self.cache_len,))
+        for d in report.findings:
+            obs.event("decode_ladder_lint", source="serving",
+                      model=self.name, message=d.message[:200])
+        return report.findings
+
+    def warmup(self, check_hbm=True):
+        """Pre-build the step program and every prompt-bucket prefill
+        through the compile-cache disk tier (zero ``compile_start`` on
+        a restarted server). Returns the per-program report."""
+        if check_hbm:
+            self.check_hbm_budget()
+        self.check_ladder()
+        report = []
+        source = self._step_pred.warm({
+            "gpt_step_tok": self._tok, "gpt_step_pos": self._pos,
+            "gpt_step_k": np.zeros(self._k.shape, np.float32),
+            "gpt_step_v": np.zeros(self._v.shape, np.float32)})
+        report.append({"program": "step", "slots": self.slots,
+                       "cache_len": self.cache_len, "source": source})
+        for b in self.prompt_buckets:
+            source = self._prefill_preds[b].warm({
+                "gpt_prefill_ids": np.zeros((1, b), np.int64),
+                "gpt_prefill_len": np.ones((1, 1), np.int64)})
+            report.append({"program": "prefill", "bucket": b,
+                           "source": source})
+        obs.event(
+            "warmup", source="serving", count=False, model=self.name,
+            engine="decode", engines=len(report),
+            compiled=sum(1 for r in report if r["source"] == "compile"),
+            disk_warm=sum(1 for r in report if r["source"] == "disk"))
+        return report
+
+    # -- dispatch loop ---------------------------------------------------
+    def _loop(self):
+        while True:
+            self._sweep_cancelled()
+            self._admit()
+            live = sum(1 for s in self._slots if s is not None)
+            if self._abort:
+                self._fail_all()
+                return
+            if live == 0:
+                if self._stop_event.is_set() and self._q.empty():
+                    return
+                time.sleep(0.002)
+                continue
+            self._step()
+
+    def _fail_all(self):
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            req.handle._fail(EngineClosedError(
+                "engine %r stopped before prefill" % self.name))
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._retire(i, "error", error=EngineClosedError(
+                    "engine %r stopped mid-generation" % self.name))
+
+    def _sweep_cancelled(self):
+        for i, s in enumerate(self._slots):
+            if s is not None and s.handle.cancelled:
+                self._retire(i, "cancelled")
+
+    def _admit(self):
+        """Prefill queued requests into free slots. In ``barrier`` mode
+        (the full-batch baseline) admission waits until EVERY slot has
+        retired."""
+        if self.barrier and any(s is not None for s in self._slots):
+            return
+        for i in range(self.slots):
+            if self._slots[i] is not None:
+                continue
+            req = None
+            while req is None:
+                try:
+                    req = self._q.get_nowait()
+                except queue.Empty:
+                    obs.set_gauge(
+                        "serving.queue_depth.%s" % self.name,
+                        self._q.qsize())
+                    return
+                if req.handle.cancelled:
+                    req.handle._finish("cancelled")
+                    self._bump("cancelled")
+                    req = None
+                    continue
+                now = time.monotonic()
+                if req.deadline is not None and now > req.deadline:
+                    # shed BEFORE prefill: no chip time for an answer
+                    # nobody is waiting for
+                    self._bump("deadline_miss")
+                    waited_ms = round(
+                        1000 * (now - req.handle.t_submit), 3)
+                    obs.event("deadline_miss", source="serving",
+                              model=self.name, engine="decode",
+                              waited_ms=waited_ms)
+                    req.handle._fail(DeadlineExceededError(
+                        "deadline expired after %s ms in decode queue "
+                        "(model %r)" % (waited_ms, self.name)))
+                    req = None
+            self._prefill(i, req)
+        obs.set_gauge("serving.queue_depth.%s" % self.name,
+                      self._q.qsize())
+
+    def _prefill(self, slot, req):
+        t0 = time.monotonic()
+        ids = np.zeros((1, req.bucket), np.int64)
+        ids[0, :req.plen] = req.prompt
+        plen = np.asarray([[req.plen]], np.int64)
+        try:
+            nxt, k1, v1 = self._prefill_preds[req.bucket].run(
+                {"gpt_prefill_ids": ids, "gpt_prefill_len": plen},
+                return_numpy=False)
+        except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+            self._bump("prefill_errors")
+            obs.event("prefill_error", source="serving", model=self.name,
+                      error="%s: %s" % (type(e).__name__, str(e)[:200]))
+            req.handle._fail(e)
+            return
+        slot_i = np.int32(slot)
+        self._k = self._write(self._k, k1, slot_i)
+        self._v = self._write(self._v, v1, slot_i)
+        self._tok[slot, 0] = tok = int(np.asarray(nxt)[0, 0])
+        self._pos[slot, 0] = req.plen
+        self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id)
+        now = time.monotonic()
+        obs.observe("serving.decode.prefill_seconds", now - t0)
+        obs.observe("serving.decode.ttft_seconds",
+                    now - req.handle.t_submit)
+        self._bump("prefills")
+        self._emit(slot, tok)
+        self._gauges()
+
+    def _emit(self, slot, tok):
+        """Deliver one generated token to a slot's stream; retires the
+        slot the SAME step when the sequence finishes (EOS or length)."""
+        s = self._slots[slot]
+        s.handle._emit(tok)
+        s.remaining -= 1
+        self._bump("tokens")
+        obs.inc("serving.decode.tokens")
+        if s.eos_id is not None and tok == s.eos_id:
+            self._retire(slot, "eos")
+        elif s.remaining <= 0:
+            self._retire(slot, "length")
+
+    def _retire(self, slot, reason, error=None):
+        s = self._slots[slot]
+        self._slots[slot] = None
+        self._tok[slot, 0] = 0
+        self._pos[slot, 0] = 0
+        if error is not None:
+            s.handle._fail(error)
+        else:
+            s.handle._finish(reason)
+        self._bump("retired")
+        if reason == "cancelled":
+            self._bump("cancelled")
+        now = time.monotonic()
+        obs.observe("serving.decode.request_seconds",
+                    now - s.handle.t_submit)
+        with self._stats_lock:
+            self._rate.append((now, 1))
+        obs.event("slot_retired", source="serving", count=False,
+                  model=self.name, slot=slot, reason=reason,
+                  tokens=len(s.handle._tokens))
+
+    def _step(self):
+        t0 = time.monotonic()
+        try:
+            nxt, self._k, self._v = self._step_pred.run(
+                {"gpt_step_tok": self._tok, "gpt_step_pos": self._pos,
+                 "gpt_step_k": self._k, "gpt_step_v": self._v},
+                return_numpy=False)
+        except Exception as e:  # noqa: BLE001 — fail the slots, not the loop
+            self._bump("step_errors")
+            obs.event("step_error", source="serving", model=self.name,
+                      error="%s: %s" % (type(e).__name__, str(e)[:200]))
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    self._retire(i, "error", error=e)
+            return
+        obs.observe("serving.decode.step_seconds",
+                    time.monotonic() - t0)
+        self._bump("steps")
+        nxt_np = np.asarray(nxt)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tok = int(nxt_np[i, 0])
+            self._pos[i, 0] += 1
+            self._tok[i, 0] = tok
+            self._emit(i, tok)
+        self._gauges()
+
+    def _gauges(self):
+        live = sum(1 for s in self._slots if s is not None)
+        obs.set_gauge("serving.decode.slot_utilization.%s" % self.name,
+                      live / float(self.slots))
+        occupancy = float(self._pos.sum()) / (self.slots * self.cache_len)
+        obs.set_gauge("serving.decode.cache_occupancy.%s" % self.name,
+                      occupancy)
+
+    # -- introspection ---------------------------------------------------
+    def _bump(self, key, n=1):
+        with self._stats_lock:
+            self._stats[key] += n
+        # mirror every lifecycle counter into the hub so /metrics sees
+        # the same numbers stats() reports ("tokens" incs at its own
+        # site to keep the hot emit path one call)
+        if key != "tokens":
+            obs.inc("serving.decode.%s" % key, n)
+
+    def stats(self):
+        """Local lifetime counters: requests/tokens/prefills/steps/
+        retired/shed/deadline_miss/cancelled/prefill_errors/
+        step_errors."""
+        with self._stats_lock:
+            out = dict(self._stats)
+        for k in ("requests", "tokens", "prefills", "steps", "retired",
+                  "shed", "deadline_miss", "cancelled",
+                  "prefill_errors", "step_errors"):
+            out.setdefault(k, 0)
+        out["live_slots"] = sum(1 for s in self._slots if s is not None)
+        out["slots"] = self.slots
+        return out
+
+    def queue_depth(self):
+        return self._q.qsize()
+
+    def drain_rate(self):
+        """Requests/sec retired over the recent window (None until the
+        first retire, or after 30s idle)."""
+        now = time.monotonic()
+        with self._stats_lock:
+            pts = [(t, n) for t, n in self._rate if now - t < 30.0]
+        if not pts:
+            return None
+        span = max(1e-3, now - min(t for t, _ in pts))
+        return sum(n for _, n in pts) / span
+
+    def retry_after_hint(self):
+        """Seconds until the queue likely drains at the observed retire
+        rate (the HTTP 429 ``Retry-After``). Clamped to [1, 60]."""
+        rate = self.drain_rate()
+        if not rate:
+            return 1.0
+        return min(60.0, max(1.0, (self.queue_depth() + 1) / rate))
+
+    @property
+    def closed(self):
+        return self._closed

@@ -11,6 +11,19 @@ thread per model does the batching):
   Feed dtypes default to the model's declared var dtypes (ints arriving
   as JSON numbers coerce to the program's int32/int64), so a plain
   nested-list payload round-trips bit-exact for float32 models.
+- ``POST /v1/models/<name>:generate`` — decode engines only
+  (:class:`~paddle_tpu.serving.decode.DecodeEngine` published into the
+  registry). Body ``{"prompt": [ids], "max_new_tokens": 32?,
+  "eos_id": 2?, "deadline_ms": 50?, "timeout_s": 10?, "stream": true?}``.
+  With ``stream`` (the default) the reply is **chunked
+  transfer-encoding** (HTTP/1.1), one JSON line per token flushed as
+  the engine's step loop produces it — ``{"token": 7, "index": 0}`` —
+  closed by a ``{"done": true, "finish_reason": ..., "tokens": [...]}``
+  line. The response headers are only sent once the FIRST token (or
+  failure) is known, so queue-time errors still map to real statuses;
+  a client disconnect mid-stream cancels the request and frees its
+  engine slot at the next dispatch iteration. ``"stream": false``
+  returns one aggregate JSON document.
 - ``GET /healthz`` — ``{"status": "ok", "models": {...}}`` with
   per-model version, queue depth, and lifetime counters.
 - ``GET /metrics`` — the telemetry hub's Prometheus text
@@ -42,10 +55,14 @@ from .engine import DeadlineExceededError, EngineClosedError, ShedError
 __all__ = ["ServingHandler", "ServingServer", "main"]
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
+_GENERATE_RE = re.compile(r"^/v1/models/([^/:]+):generate$")
 
 
 class ServingHandler(BaseHTTPRequestHandler):
     server_version = "paddle-tpu-serving/0.1"
+    # chunked transfer-encoding (the :generate stream) needs HTTP/1.1;
+    # every other response carries Content-Length so keep-alive is safe
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, format, *args):  # noqa: A002 — stdlib signature
         pass  # request logging goes through the telemetry hub, not stderr
@@ -98,12 +115,125 @@ class ServingHandler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": "not found: %s" % self.path})
 
+    # -- decode streaming (:generate) -----------------------------------
+    def _chunk(self, doc):
+        """One chunked-transfer frame holding a JSON line, flushed so
+        the client sees each token as the step loop emits it."""
+        data = (json.dumps(doc) + "\n").encode("utf-8")
+        self.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
+    def _generate_errdoc(self, exc, name, engine):
+        """(status, doc, headers) for a pre-stream generate failure."""
+        if isinstance(exc, ShedError):
+            return (429, self._shed_doc(exc, name, engine),
+                    self._shed_headers(exc, engine))
+        if isinstance(exc, DeadlineExceededError):
+            return 504, {"error": str(exc), "model": name}, None
+        if isinstance(exc, EngineClosedError):
+            return 503, {"error": str(exc), "model": name}, None
+        if isinstance(exc, (TimeoutError, _FutureTimeout)):
+            return (504, {"error": "timed out waiting for model %r"
+                          % name, "model": name}, None)
+        return (500, {"error": "%s: %s" % (type(exc).__name__, exc),
+                      "model": name}, None)
+
+    def _do_generate(self, name, engine):
+        if getattr(engine, "engine_kind", None) != "decode":
+            return self._send_json(
+                400, {"error": "model %r is not a decode engine — use "
+                               ":predict" % name})
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = body["prompt"]
+            kw = {"max_new": body.get("max_new_tokens"),
+                  "eos_id": body.get("eos_id"),
+                  "deadline_ms": body.get("deadline_ms")}
+            timeout_s = body.get("timeout_s")
+            stream = bool(body.get("stream", True))
+        except (ValueError, KeyError, TypeError) as e:
+            return self._send_json(
+                400, {"error": "bad request: %s: %s"
+                               % (type(e).__name__, e)})
+        try:
+            handle = engine.submit(prompt, **kw)
+        except (ValueError, TypeError) as e:
+            return self._send_json(
+                400, {"error": "bad request: %s: %s"
+                               % (type(e).__name__, e)})
+        except Exception as e:  # noqa: BLE001 — admission errors -> statuses
+            return self._send_json(*self._generate_errdoc(e, name, engine))
+
+        if not stream:
+            try:
+                toks = handle.result(timeout_s)
+            except Exception as e:  # noqa: BLE001
+                return self._send_json(
+                    *self._generate_errdoc(e, name, engine))
+            return self._send_json(200, {
+                "tokens": toks, "n_tokens": len(toks),
+                "finish_reason": handle.finish_reason, "model": name})
+
+        # hold the headers until the first token (or failure) exists:
+        # a request shed/expired in the queue must answer 429/504, not
+        # a 200 that dies mid-stream
+        gen = handle.tokens(timeout=timeout_s)
+        try:
+            first = next(gen, None)
+        except Exception as e:  # noqa: BLE001
+            handle.cancel()
+            return self._send_json(*self._generate_errdoc(e, name, engine))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            try:
+                if first is not None:
+                    self._chunk({"token": first, "index": 0})
+                    for i, tok in enumerate(gen, start=1):
+                        self._chunk({"token": tok, "index": i})
+                toks = handle.so_far()
+                self._chunk({"done": True,
+                             "finish_reason": handle.finish_reason,
+                             "tokens": toks, "n_tokens": len(toks)})
+            except (BrokenPipeError, ConnectionResetError):
+                # client went away: free the slot at the next dispatch
+                # iteration instead of decoding to nobody
+                handle.cancel()
+                obs.event("client_disconnect", source="serving",
+                          model=name, streamed=len(handle.so_far()))
+                self.close_connection = True
+                return
+            except Exception as e:  # noqa: BLE001 — mid-stream engine error
+                self._chunk({"error": "%s: %s" % (type(e).__name__, e),
+                             "done": True, "finish_reason": "error"})
+                return
+        finally:
+            if not handle.done:
+                handle.cancel()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True
+
     def do_POST(self):  # noqa: N802 — stdlib handler name
+        g = _GENERATE_RE.match(self.path)
+        if g:
+            name = g.group(1)
+            engine = self.server.registry.get(name)
+            if engine is None:
+                return self._send_json(
+                    404, {"error": "unknown model %r" % name})
+            return self._do_generate(name, engine)
         m = _PREDICT_RE.match(self.path)
         if not m:
             return self._send_json(
                 404, {"error": "not found: %s (expected "
-                               "/v1/models/<name>:predict)" % self.path})
+                               "/v1/models/<name>:predict or :generate)"
+                               % self.path})
         name = m.group(1)
         engine = self.server.registry.get(name)
         if engine is None:

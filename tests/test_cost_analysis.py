@@ -158,6 +158,45 @@ def test_memory_intermediates_die_after_last_use():
     assert any(n == x.name or n == a.name or n == b.name for n in names)
 
 
+def test_memory_resident_names_pin_kv_buffers():
+    # a decode engine round-trips its KV buffer device-to-device every
+    # step: resident_names must hold the fed copy live across the WHOLE
+    # program even though def-use liveness would let it die at its only
+    # reader (first op)
+    x = fluid.data(name="x", shape=[64, 64], dtype="float32")
+    a = fluid.layers.relu(x)
+    b = fluid.layers.relu(a)
+    c = fluid.layers.reduce_sum(b)
+    prog = fluid.default_main_program()
+    each = 64 * 64 * 4
+    plain = memory.estimate(prog, fetch_names=[c.name], default_dim=64)
+    pinned = memory.estimate(prog, fetch_names=[c.name], default_dim=64,
+                             resident_names=[x.name])
+    assert plain.peak_bytes < 3 * each
+    assert pinned.peak_bytes >= 3 * each
+    assert pinned.peak_bytes > plain.peak_bytes
+
+
+def test_lint_decode_ladder_budget():
+    from paddle_tpu.analysis import tpu_lint
+
+    # a sane engine ladder is clean
+    ok = tpu_lint.lint_decode_ladder((8, 16, 32), slot_counts=(8,),
+                                     cache_lens=(64,))
+    assert ok.findings == []
+    assert ok.meta["decode_ladder_programs"] == 4
+    # a per-token "ladder" re-creates the unbounded-shape-vocab hazard
+    # with every rung declared static
+    bad = tpu_lint.lint_decode_ladder(
+        range(1, 3001), slot_counts=(8,), cache_lens=(4096,))
+    assert len(bad.findings) == 1
+    assert bad.findings[0].check == "unbounded-shape-vocab"
+    # non-pow2 rungs are flagged info (advice), never a finding
+    odd = tpu_lint.lint_decode_ladder((8, 24, 32))
+    assert odd.findings == []
+    assert any(d.check == "decode-ladder-rungs" for d in odd.diagnostics)
+
+
 def test_memory_backward_residuals_and_persistables():
     x, loss = _fc_chain(widths=(32, 64, 1))
     fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)

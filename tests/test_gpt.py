@@ -114,6 +114,66 @@ def test_gpt_generate_inference_model_roundtrip(tmp_path):
     np.testing.assert_array_equal(got, want)
 
 
+def test_gpt_prefill_step_bit_identical_to_generate():
+    """The factored two-program decode path (ISSUE 9): bucketed prefill
+    writes a slot's cache + first token, the per-slot step program
+    decodes the rest — and the tokens must be BIT-identical to the
+    single-scan build_gpt_generate greedy output on the same prompt,
+    with the batch dim acting as a slot dim (mixed prompt lengths at
+    mixed per-row positions in one batch)."""
+    cfg, _, _, exe, _, _ = _train_tiny(steps=30)
+    cache_len, bucket = 24, 8
+
+    pf_prog, pf_st = fluid.Program(), fluid.Program()
+    with fluid.program_guard(pf_prog, pf_st):
+        pf = gpt.build_gpt_prefill(cfg, bucket, cache_len)
+    st_prog, st_st = fluid.Program(), fluid.Program()
+    with fluid.program_guard(st_prog, st_st):
+        st = gpt.build_gpt_decode_step(cfg, cache_len)
+
+    rng = np.random.default_rng(17)
+    lens = [3, 6, 8]  # mixed lengths sharing one slot batch
+    prompts = [rng.integers(1, cfg.vocab, n).astype("int64")
+               for n in lens]
+    n_new = 7
+    ids = np.zeros((len(lens), bucket), "int64")
+    for i, p in enumerate(prompts):
+        ids[i, :lens[i]] = p
+    plen = np.asarray(lens, "int64").reshape(-1, 1)
+    tok, k, v = map(np.asarray, exe.run(
+        pf_prog, feed={"gpt_prefill_ids": ids, "gpt_prefill_len": plen},
+        fetch_list=[pf["next"], pf["k"], pf["v"]]))
+    assert k.shape == (len(lens), cfg.num_layers, cache_len, cfg.hidden)
+    toks, pos = [tok], plen.copy()
+    for _ in range(n_new - 1):
+        tok, k, v = map(np.asarray, exe.run(
+            st_prog, feed={"gpt_step_tok": tok, "gpt_step_pos": pos,
+                           "gpt_step_k": k, "gpt_step_v": v},
+            fetch_list=[st["next"], st["k"], st["v"]]))
+        toks.append(tok)
+        pos = pos + 1
+    got = np.concatenate(toks, axis=1)
+
+    for i, (p, n) in enumerate(zip(prompts, lens)):
+        g_prog, g_st = fluid.Program(), fluid.Program()
+        with fluid.program_guard(g_prog, g_st):
+            gen = gpt.build_gpt_generate(cfg, n, n_new, mode="greedy")
+        want = np.asarray(exe.run(
+            g_prog, feed={"gpt_prompt": p.reshape(1, -1)},
+            fetch_list=[gen["ids"]])[0])
+        np.testing.assert_array_equal(got[i], want[0, n - 1:])
+
+
+def test_gpt_prefill_rejects_bad_lengths():
+    cfg = gpt.gpt_tiny(vocab=50, max_len=16)
+    with pytest.raises(ValueError, match="prompt_len"):
+        gpt.build_gpt_prefill(cfg, 12, 8)
+    with pytest.raises(ValueError, match="max_len"):
+        gpt.build_gpt_prefill(cfg, 8, 32)
+    with pytest.raises(ValueError, match="max_len"):
+        gpt.build_gpt_decode_step(cfg, 32)
+
+
 def test_gpt_trains_sharded_dp_tp():
     """GPT under GSPMD dp x tp via DistributedProgram + tp_rules: loss
     decreases and matches the unsharded run (sharding is a layout)."""
