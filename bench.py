@@ -1195,6 +1195,104 @@ def _measure_decode_serving(n_clients=8, requests_per_client=3,
     }
 
 
+def _measure_comms(steps=10, batch=64, hidden=256, n_layers=3):
+    """Gradient-communication lane (ISSUE 10): the same dp training step
+    three ways — GSPMD fp32 baseline, explicit bucketed comms fp32, and
+    block-scaled int8 with error feedback — recording loss parity, the
+    deterministic wire accounting (compression/overlap ratios, bytes),
+    and measured step seconds (gated by PADDLE_TPU_BENCH_COMMS=1)."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import observability as obs
+    from paddle_tpu.fluid import executor as executor_mod
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.parallel import fleet as fleet_mod
+    from paddle_tpu.parallel.fleet import DistributedStrategy
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs >= 2 devices for a dp group"}
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((batch, hidden)).astype("float32")
+    y = (x @ rng.standard_normal((hidden, 1)) / hidden).astype("float32")
+
+    def run_variant(mutate):
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        executor_mod._scope_stack[:] = [executor_mod.Scope()]
+        obs.reset()
+        fluid.default_startup_program().random_seed = 17
+        fluid.default_main_program().random_seed = 17
+        xv = fluid.data("bx", shape=[None, hidden], dtype="float32")
+        yv = fluid.data("by", shape=[None, 1], dtype="float32")
+        h = xv
+        for _ in range(n_layers):
+            h = fluid.layers.fc(h, hidden, act="tanh")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, yv))
+        strategy = DistributedStrategy()
+        mutate(strategy)
+        fl = fleet_mod.Fleet().init()
+        opt = fl.distributed_optimizer(
+            fluid.optimizer.SGD(0.05), strategy=strategy)
+        opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        feed = {"bx": x, "by": y}
+        losses = []
+        out = exe.run(fl.main_program, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(out[0])))  # compile step
+        t0 = time.time()
+        for _ in range(steps - 1):
+            out = exe.run(fl.main_program, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(out[0])))
+        det = {
+            "losses": [round(v, 6) for v in losses],
+            "step_seconds": round(
+                (time.time() - t0) / max(steps - 1, 1), 6),
+        }
+        for key in ("comm.compression_ratio", "comm.overlap_ratio"):
+            v = obs.gauge(key)
+            if v is not None:
+                det[key.split(".", 1)[1]] = round(float(v), 4)
+        for key in ("comm.bytes_sent", "comm.bytes_saved"):
+            v = obs.counter(key)
+            if v:
+                det[key.split(".", 1)[1]] = int(v)
+        return det
+
+    def comms(s, quantize):
+        s.grad_sync_mode = "comms"
+        s.grad_quantize = quantize
+        # small target so the tiny model still splits into several
+        # buckets and the overlap accounting is exercised
+        s.grad_bucket_bytes = 256 << 10
+
+    prev_tel = os.environ.get("PADDLE_TPU_TELEMETRY")
+    os.environ["PADDLE_TPU_TELEMETRY"] = "on"
+    try:
+        out = {
+            "n_devices": len(jax.devices()),
+            "gspmd_fp32": run_variant(lambda s: None),
+            "comms_fp32": run_variant(lambda s: comms(s, False)),
+            "comms_int8": run_variant(lambda s: comms(s, True)),
+        }
+    finally:
+        if prev_tel is None:
+            os.environ.pop("PADDLE_TPU_TELEMETRY", None)
+        else:
+            os.environ["PADDLE_TPU_TELEMETRY"] = prev_tel
+    out["loss_gap_int8_vs_fp32"] = round(
+        abs(out["comms_int8"]["losses"][-1]
+            - out["gspmd_fp32"]["losses"][-1]), 6)
+    return out
+
+
 def _bank(st, variant, cfg, on_accel, backend, device_kind):
     peak_v = _peak_flops(device_kind)
     if peak_v:
@@ -1435,6 +1533,17 @@ def child_main(status_path):
             st.flush()
         except Exception as e:  # noqa: BLE001
             st.error("decode_serving failed: %s: %s"
+                     % (type(e).__name__, str(e)[:300]))
+
+    if os.environ.get("PADDLE_TPU_BENCH_COMMS"):
+        # comms lane (ISSUE 10): explicit bucketed/quantized dp gradient
+        # sync vs the GSPMD fp32 baseline — loss parity + wire accounting
+        st.stage("comms")
+        try:
+            st.data["detail"]["comms"] = _measure_comms()
+            st.flush()
+        except Exception as e:  # noqa: BLE001
+            st.error("comms failed: %s: %s"
                      % (type(e).__name__, str(e)[:300]))
 
     tel_out = os.environ.get("PADDLE_TPU_BENCH_TELEMETRY_OUT")

@@ -1,0 +1,143 @@
+#!/usr/bin/env bash
+# Comms lane: the smoke for the gradient-communication subsystem
+# (ISSUE 10, parallel/comms).
+#
+#   bash bench_experiments/comms_lane.sh
+#
+# Lane 1 runs the comms pytest slice (quantization bounds, error
+# feedback, bucket determinism, allreduce parity, fault drills). Lane 2
+# is the dp=8 dryrun through Fleet: the quantized bucketed sync must
+# report comm.compression_ratio >= 3.5, keep the final loss within
+# tolerance of the fp32 GSPMD baseline, report comm.overlap_ratio > 0
+# against a bit-identical non-overlapped reference run, and (with the
+# ICI bandwidth pinned) observe the predicted comm.allreduce_seconds.
+# Lane 3 checks the CLI surfaces the interconnect leg: `--cost --mesh
+# dp=8` must emit predicted allreduce seconds + scaling efficiency.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+export PADDLE_TPU_TELEMETRY=on
+export PADDLE_TPU_ICI_BW=1e9
+
+LOSS_TOL="${LOSS_TOL:-5e-3}"
+
+echo "== lane 1: comms pytest slice =="
+python -m pytest -q -p no:cacheprovider tests/test_comms.py
+
+echo "== lane 2: dp=8 dryrun — compression / parity / overlap =="
+LOSS_TOL="$LOSS_TOL" python - <<'EOF'
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.parallel import fleet as fleet_mod
+from paddle_tpu.parallel.fleet import DistributedStrategy
+
+TOL = float(os.environ.get("LOSS_TOL", "5e-3"))
+
+
+def run(mutate, steps=8):
+    from paddle_tpu.fluid import executor as executor_mod
+    from paddle_tpu.fluid import framework, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    executor_mod._scope_stack[:] = [executor_mod.Scope()]
+    obs.reset()
+    fluid.default_startup_program().random_seed = 11
+    fluid.default_main_program().random_seed = 11
+    x = fluid.data("cx", shape=[None, 16], dtype="float32")
+    y = fluid.data("cy", shape=[None, 1], dtype="float32")
+    h = fluid.layers.fc(x, 64, act="tanh")
+    h = fluid.layers.fc(h, 64, act="tanh")
+    p = fluid.layers.fc(h, 1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+    s = DistributedStrategy()
+    mutate(s)
+    fl = fleet_mod.Fleet().init()
+    opt = fl.distributed_optimizer(fluid.optimizer.SGD(0.1), strategy=s)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(0)
+    xa = rng.standard_normal((32, 16)).astype("float32")
+    ya = (xa @ rng.standard_normal((16, 1)) / 16).astype("float32")
+    losses = []
+    for _ in range(steps):
+        out = exe.run(fl.main_program, feed={"cx": xa, "cy": ya},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(out[0])))
+    return losses
+
+
+def comms(s, overlap=True):
+    s.grad_sync_mode = "comms"
+    s.grad_quantize = True
+    s.grad_bucket_bytes = 8 << 10   # several buckets on this model
+    s.grad_overlap = overlap
+
+
+plain = run(lambda s: None)
+quant = run(comms)
+ratio = obs.gauge("comm.compression_ratio")
+overlap = obs.gauge("comm.overlap_ratio")
+sent = obs.counter("comm.bytes_sent")
+hist = obs.histogram("comm.allreduce_seconds")
+gap = abs(quant[-1] - plain[-1])
+print("fp32 baseline:", ["%.5f" % v for v in plain])
+print("quantized    :", ["%.5f" % v for v in quant])
+print("compression_ratio=%.4f overlap_ratio=%.4f bytes_sent=%d"
+      % (ratio, overlap, sent))
+print("loss gap %.6f (tol %g); allreduce_seconds count=%s"
+      % (gap, TOL, hist and hist["count"]))
+assert ratio >= 3.5, "compression %.3f < 3.5" % ratio
+assert overlap > 0.0, "no overlap opportunity reported"
+assert sent > 0
+assert gap < TOL, "quantized run diverged: gap %.5f" % gap
+assert hist and hist["count"] >= 1, "predicted comm leg never observed"
+
+nolap = run(lambda s: comms(s, overlap=False))
+assert obs.gauge("comm.overlap_ratio") == 0.0
+assert nolap == quant, "non-overlapped reference is not bit-identical"
+print("overlap vs non-overlap: bit-identical over %d steps" % len(quant))
+EOF
+
+echo "== lane 3: CLI --cost --mesh dp=8 surfaces the comm leg =="
+WORK_DIR="$(mktemp -d /tmp/paddle_tpu_comms_lane.XXXXXX)"
+trap 'rm -rf "$WORK_DIR"' EXIT
+
+python - "$WORK_DIR" <<'EOF'
+import json
+import sys
+
+import paddle_tpu.fluid as fluid
+
+work = sys.argv[1]
+fluid.default_startup_program().random_seed = 11
+x = fluid.data("x", shape=[None, 16], dtype="float32")
+y = fluid.data("y", shape=[None, 1], dtype="float32")
+h = fluid.layers.fc(x, 64, act="relu")
+p = fluid.layers.fc(h, 1)
+loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+with open(work + "/train.json", "w") as f:
+    f.write(fluid.default_main_program().to_json())
+EOF
+
+python -m paddle_tpu.analysis "$WORK_DIR/train.json" --cost \
+    --device v5e --mesh dp=8 --batch 8 --fail-on never \
+    > "$WORK_DIR/cost.json"
+grep -q '"comm"' "$WORK_DIR/cost.json" || {
+    echo "FAIL: no comm section in --cost --mesh dp=8"; exit 1; }
+grep -q '"predicted_allreduce_seconds"' "$WORK_DIR/cost.json" || {
+    echo "FAIL: no predicted_allreduce_seconds"; exit 1; }
+grep -q '"scaling_efficiency"' "$WORK_DIR/cost.json" || {
+    echo "FAIL: no scaling_efficiency"; exit 1; }
+echo "--cost --mesh dp=8 reports the interconnect leg"
+
+echo "comms lane OK"

@@ -102,7 +102,10 @@ def main(argv=None):
                     help="add the cost-model section: per-op FLOPs/bytes, "
                          "roofline-predicted step seconds and MFU, and "
                          "the liveness peak-HBM estimate vs --device "
-                         "capacity (forces --level full)")
+                         "capacity (forces --level full); with --mesh "
+                         "dp=N also the predicted gradient-allreduce "
+                         "seconds (ICI bandwidth from --device or "
+                         "PADDLE_TPU_ICI_BW) and dp scaling efficiency")
     ap.add_argument("--device", default=None, metavar="KIND",
                     help="device kind for the roofline/capacity model "
                          "(e.g. v5e, v5p, v4); default: only the "
@@ -155,6 +158,12 @@ def main(argv=None):
     if args.cost:
         from .costs import analyze_cost
 
+        # gradient sync rides the batch-sharding axes; sp/seq shard the
+        # sequence and keep full gradients, so they don't widen the group
+        dp_shards = 1
+        for axis, size in mesh.items():
+            if str(axis).lower() in ("dp", "data", "batch"):
+                dp_shards *= int(size)
         try:
             cost = analyze_cost(
                 program, feed_names=feed_names, state_specs=state_specs,
@@ -163,7 +172,8 @@ def main(argv=None):
                              if state_specs is not None else None),
                 is_test=True, platform=args.platform,
                 default_dim=args.batch, device_kind=args.device,
-                param_shards=param_shards, act_shards=act_shards)
+                param_shards=param_shards, act_shards=act_shards,
+                dp_shards=dp_shards)
             doc["cost"] = cost.to_dict()
         except Exception as e:  # noqa: BLE001 — cost model must not
             # take down the structural report
@@ -184,6 +194,17 @@ def main(argv=None):
                          c.get("predicted_mfu", 0.0),
                          c.get("bound", "?"),
                          c.get("device", {}).get("name", "?")))
+            if "comm" in c:
+                cc = c["comm"]
+                line = ("comm: dp=%d, %.3g grad bytes"
+                        % (cc["dp_shards"], cc["grad_bytes"]))
+                if "predicted_allreduce_seconds" in cc:
+                    line += (", allreduce %.3g s"
+                             % cc["predicted_allreduce_seconds"])
+                if "scaling_efficiency" in cc:
+                    line += (", scaling efficiency %.3g"
+                             % cc["scaling_efficiency"])
+                print(line)
     else:
         print(rendered)
     if args.json_out:

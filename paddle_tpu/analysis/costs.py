@@ -21,6 +21,9 @@ pin a profile where no table entry matches (CPU smoke lanes, tests):
 - ``PADDLE_TPU_PEAK_FLOPS`` — peak FLOPs/s
 - ``PADDLE_TPU_HBM_BYTES``  — memory capacity in bytes
 - ``PADDLE_TPU_HBM_BW``     — memory bandwidth in bytes/s
+- ``PADDLE_TPU_ICI_BW``     — per-chip interconnect bandwidth in
+  bytes/s (the gradient-allreduce leg; see
+  :func:`ring_allreduce_seconds`)
 """
 import os
 
@@ -28,47 +31,55 @@ __all__ = [
     "DeviceProfile", "DEVICE_TABLE", "device_profile", "peak_flops",
     "bert_train_flops_per_token", "OpCost", "op_costs", "jaxpr_flops",
     "CostReport", "analyze_cost", "predict_program",
+    "ring_allreduce_seconds", "dp_grad_bytes", "ICI_BW_ENV",
 ]
 
 PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
 HBM_BYTES_ENV = "PADDLE_TPU_HBM_BYTES"
 HBM_BW_ENV = "PADDLE_TPU_HBM_BW"
+ICI_BW_ENV = "PADDLE_TPU_ICI_BW"
 
 
 class DeviceProfile:
     """Roofline constants of one accelerator: bf16 peak FLOPs/s, HBM
-    capacity (bytes), HBM bandwidth (bytes/s). Any field may be None
+    capacity (bytes), HBM bandwidth (bytes/s), and per-chip ICI
+    (inter-chip interconnect) bandwidth (bytes/s — all links combined,
+    the figure a ring allreduce rides). Any field may be None
     (unknown) — consumers skip the corresponding check/prediction."""
 
-    __slots__ = ("name", "peak_flops", "hbm_bytes", "hbm_bw")
+    __slots__ = ("name", "peak_flops", "hbm_bytes", "hbm_bw", "ici_bw")
 
-    def __init__(self, name, peak_flops=None, hbm_bytes=None, hbm_bw=None):
+    def __init__(self, name, peak_flops=None, hbm_bytes=None, hbm_bw=None,
+                 ici_bw=None):
         self.name = name
         self.peak_flops = peak_flops
         self.hbm_bytes = hbm_bytes
         self.hbm_bw = hbm_bw
+        self.ici_bw = ici_bw
 
     def to_dict(self):
         return {"name": self.name, "peak_flops": self.peak_flops,
-                "hbm_bytes": self.hbm_bytes, "hbm_bw": self.hbm_bw}
+                "hbm_bytes": self.hbm_bytes, "hbm_bw": self.hbm_bw,
+                "ici_bw": self.ici_bw}
 
     def __repr__(self):
         return ("DeviceProfile(%r, peak_flops=%r, hbm_bytes=%r, "
-                "hbm_bw=%r)" % (self.name, self.peak_flops,
-                                self.hbm_bytes, self.hbm_bw))
+                "hbm_bw=%r, ici_bw=%r)"
+                % (self.name, self.peak_flops, self.hbm_bytes,
+                   self.hbm_bw, self.ici_bw))
 
 
 # Public per-chip figures, matched by device_kind substring in order
 # (first hit wins — "v5p" must precede "v5"). bf16 peak FLOPs/s, HBM
-# bytes, HBM bytes/s.
+# bytes, HBM bytes/s, ICI bytes/s (all links per chip).
 DEVICE_TABLE = [
-    ("v6", DeviceProfile("v6e", 918e12, 32e9, 1640e9)),
-    ("v5p", DeviceProfile("v5p", 459e12, 95e9, 2765e9)),
-    ("v5e", DeviceProfile("v5e", 197e12, 16e9, 819e9)),
-    ("v5", DeviceProfile("v5e", 197e12, 16e9, 819e9)),
-    ("v4", DeviceProfile("v4", 275e12, 32e9, 1228e9)),
-    ("v3", DeviceProfile("v3", 123e12, 32e9, 900e9)),
-    ("v2", DeviceProfile("v2", 45e12, 16e9, 700e9)),
+    ("v6", DeviceProfile("v6e", 918e12, 32e9, 1640e9, 448e9)),
+    ("v5p", DeviceProfile("v5p", 459e12, 95e9, 2765e9, 600e9)),
+    ("v5e", DeviceProfile("v5e", 197e12, 16e9, 819e9, 200e9)),
+    ("v5", DeviceProfile("v5e", 197e12, 16e9, 819e9, 200e9)),
+    ("v4", DeviceProfile("v4", 275e12, 32e9, 1228e9, 300e9)),
+    ("v3", DeviceProfile("v3", 123e12, 32e9, 900e9, 82e9)),
+    ("v2", DeviceProfile("v2", 45e12, 16e9, 700e9, 62e9)),
 ]
 
 
@@ -92,12 +103,13 @@ def device_profile(device_kind=None):
     for key, p in DEVICE_TABLE:
         if key in dk:
             prof = DeviceProfile(p.name, p.peak_flops, p.hbm_bytes,
-                                 p.hbm_bw)
+                                 p.hbm_bw, p.ici_bw)
             break
     over = {
         "peak_flops": _env_float(PEAK_FLOPS_ENV),
         "hbm_bytes": _env_float(HBM_BYTES_ENV),
         "hbm_bw": _env_float(HBM_BW_ENV),
+        "ici_bw": _env_float(ICI_BW_ENV),
     }
     if prof is None and not any(v is not None for v in over.values()):
         return None
@@ -125,6 +137,49 @@ def bert_train_flops_per_token(cfg, seq):
     attn = 4 * seq * d              # QK^T and AV rows for one token
     fwd = 2 * (L * (per_layer + attn) + d * V)
     return 3 * fwd
+
+
+def ring_allreduce_seconds(n_bytes, n_shards, ici_bw):
+    """Bandwidth term of one (ring or two-shot) allreduce of
+    ``n_bytes`` over ``n_shards`` chips at ``ici_bw`` bytes/s per chip:
+    every chip sends and receives ``2 (n-1)/n * n_bytes`` concurrently,
+    so the wall time is that divided by the per-chip bandwidth. 0.0
+    when there is nothing to reduce across (n < 2) or the bandwidth is
+    unknown."""
+    n = max(1, int(n_shards))
+    if n < 2 or not ici_bw:
+        return 0.0
+    return 2.0 * (n - 1) / n * float(n_bytes) / float(ici_bw)
+
+
+def dp_grad_bytes(program, env=None):
+    """fp32 bytes one data-parallel step must allreduce: the backward
+    op's gradient footprint when the program trains, else the
+    trainable-parameter footprint (inference dumps of a training model
+    — what an equivalent training step would sync). Deterministic, so
+    the comm prediction below and parallel/comms' live wire accounting
+    agree on what counts."""
+    import numpy as np
+
+    gb = program.global_block()
+    total = 0.0
+    for op in gb.ops:
+        if op.type != "backward":
+            continue
+        for g in op.output("Grads"):
+            if env is not None and g in env:
+                total += _spec_nbytes(env[g])
+    if total:
+        return total
+    for p in gb.all_parameters():
+        if not getattr(p, "trainable", True):
+            continue
+        shape = tuple(getattr(p, "shape", ()) or ())
+        if not shape or not all(isinstance(d, int) and d > 0
+                                for d in shape):
+            continue
+        total += float(np.prod(shape)) * 4.0
+    return total
 
 
 # -- per-primitive FLOP counting over a jaxpr -------------------------------
@@ -302,12 +357,19 @@ def _spec_nbytes(spec):
 
 class CostReport:
     """Per-op and per-program FLOPs/bytes + roofline prediction against
-    one :class:`DeviceProfile`, plus the liveness peak-HBM estimate."""
+    one :class:`DeviceProfile`, plus the liveness peak-HBM estimate and
+    (when ``dp_shards > 1``) the interconnect leg: predicted gradient
+    allreduce seconds and data-parallel scaling efficiency."""
 
-    def __init__(self, per_op, memory=None, profile=None):
+    def __init__(self, per_op, memory=None, profile=None, dp_shards=1,
+                 grad_bytes=0.0, comm_overlap_ratio=0.0):
         self.per_op = list(per_op)
         self.memory = memory            # analysis.memory.MemoryReport
         self.profile = profile          # DeviceProfile or None
+        self.dp_shards = max(1, int(dp_shards))
+        self.grad_bytes = float(grad_bytes)
+        self.comm_overlap_ratio = min(1.0, max(0.0,
+                                               float(comm_overlap_ratio)))
         self.total_flops = float(sum(c.flops for c in self.per_op))
         self.total_bytes = float(sum(c.bytes for c in self.per_op))
 
@@ -353,6 +415,30 @@ class CostReport:
                 if self.total_flops / p.peak_flops
                 >= self.total_bytes / p.hbm_bw else "memory")
 
+    @property
+    def predicted_comm_seconds(self):
+        """Gradient-allreduce wall seconds per step over the profile's
+        ICI bandwidth. None when there is no dp group, no gradient
+        footprint, or the bandwidth is unknown."""
+        p = self.profile
+        bw = p.ici_bw if p is not None else None
+        if self.dp_shards < 2 or not self.grad_bytes or not bw:
+            return None
+        return ring_allreduce_seconds(self.grad_bytes, self.dp_shards, bw)
+
+    @property
+    def scaling_efficiency(self):
+        """Predicted dp scaling efficiency: compute time over compute
+        plus the EXPOSED comm leg (comm scaled by what bucketed overlap
+        cannot hide). 1.0 means free scaling; None when either leg is
+        unpredictable."""
+        t = self.predicted_step_seconds
+        c = self.predicted_comm_seconds
+        if not t or c is None:
+            return None
+        exposed = c * (1.0 - self.comm_overlap_ratio)
+        return t / (t + exposed)
+
     def hottest(self, k=5):
         """Top-k ops by FLOPs, descending (stable: ties break on op
         index)."""
@@ -379,6 +465,19 @@ class CostReport:
             d["bound"] = self.bound
         if self.memory is not None:
             d["memory"] = self.memory.to_dict()
+        if self.dp_shards > 1 and self.grad_bytes:
+            comm = {
+                "dp_shards": self.dp_shards,
+                "grad_bytes": round(self.grad_bytes, 1),
+                "overlap_ratio": round(self.comm_overlap_ratio, 4),
+            }
+            c = self.predicted_comm_seconds
+            if c is not None:
+                comm["predicted_allreduce_seconds"] = float("%.6g" % c)
+            eff = self.scaling_efficiency
+            if eff is not None:
+                comm["scaling_efficiency"] = round(eff, 4)
+            d["comm"] = comm
         d["hottest_ops"] = [c.to_dict() for c in self.hottest(top)]
         return d
 
@@ -386,11 +485,16 @@ class CostReport:
 def analyze_cost(program, env=None, feed_specs=None, state_specs=None,
                  feed_names=None, fetch_names=(), state_names=None,
                  is_test=False, platform="cpu", default_dim=None,
-                 device_kind=None, param_shards=1, act_shards=1):
+                 device_kind=None, param_shards=1, act_shards=1,
+                 dp_shards=1, comm_overlap_ratio=0.0):
     """One-stop cost + memory analysis: propagate shapes (unless an
     ``env`` is supplied), cost every op, run the liveness peak-HBM
-    estimate, and bind the device profile. Returns a
-    :class:`CostReport`."""
+    estimate, and bind the device profile. With ``dp_shards > 1`` the
+    report also carries the interconnect leg (gradient bytes, predicted
+    allreduce seconds against the profile's ICI bandwidth, and dp
+    scaling efficiency; ``comm_overlap_ratio`` is the fraction the
+    bucketed backward-overlap scheduler hides — see
+    parallel/comms/bucketing.py). Returns a :class:`CostReport`."""
     from . import memory, shapes
 
     if env is None:
@@ -408,8 +512,11 @@ def analyze_cost(program, env=None, feed_specs=None, state_specs=None,
         fetch_names=fetch_names, state_names=state_names,
         default_dim=default_dim, param_shards=param_shards,
         act_shards=act_shards)
+    grad_bytes = dp_grad_bytes(program, env) if int(dp_shards) > 1 else 0.0
     return CostReport(per_op, memory=mem,
-                      profile=device_profile(device_kind))
+                      profile=device_profile(device_kind),
+                      dp_shards=dp_shards, grad_bytes=grad_bytes,
+                      comm_overlap_ratio=comm_overlap_ratio)
 
 
 def predict_program(program, feed_specs=None, fetch_names=(),

@@ -36,6 +36,11 @@ SHAPE_VOCAB_THRESHOLD = 2048
 # how many FLOPs-ranked ops the cost model promotes to "hottest" status
 HOT_K = 5
 
+# fp32 allreduce payload past which block-scaled quantization pays off:
+# below this, per-collective latency dominates and the ~3.9x wire cut
+# saves nothing worth the extra quantize/dequantize
+QUANTIZABLE_ALLREDUCE_BYTES = 1 << 16
+
 
 def lint(program, shape_env=None, feed_names=(), fetch_names=(),
          state_names=None, platform="tpu", cost=None):
@@ -135,6 +140,9 @@ def lint(program, shape_env=None, feed_names=(), fetch_names=(),
                          "the donation at all"),
                 block_idx=0, var=n)
 
+    # -- quantizable fp32 allreduces ----------------------------------------
+    _lint_quantizable_allreduce(collectives, shape_of, shape_env, report)
+
     # -- collectives without a deadline -------------------------------------
     if collectives:
         from ..fluid.resilience import deadline_remaining
@@ -205,6 +213,50 @@ def _lint_tiling(block, i, op, shape_of, report, hot_rank=None,
             "the layer width (or fold small dims) to multiples of 128/8"
             % (prefix, n, op.type, tuple(shape[-2:]), round(100 * waste)),
             block_idx=block.idx, op_index=i, op=op, var=n)
+
+
+def _lint_quantizable_allreduce(collectives, shape_of, shape_env, report):
+    """Flag full-precision sum-allreduces of large fp32 tensors: the
+    block-scaled quantized lowering (``c_allreduce_quant``, or
+    ``DistributedStrategy.grad_quantize`` for the gradient path) moves
+    ~3.9x fewer wire bytes at block 256 with error feedback absorbing
+    the rounding. Small payloads are latency-bound and stay exact."""
+    import numpy as np
+
+    for block, i, op in collectives:
+        if op.type != "c_allreduce_sum":
+            continue
+        for n in op.input("X"):
+            shape = shape_of(block, n)
+            if not shape or any(d is None or d < 0 for d in shape):
+                continue
+            spec = shape_env.get(n)
+            if spec is not None:
+                if np.dtype(spec.dtype) != np.float32:
+                    continue
+            else:
+                blk, declared = block, None
+                while blk is not None:
+                    if n in blk.vars:
+                        declared = blk.vars[n].dtype
+                        break
+                    blk = blk.parent_block
+                if declared != core.VarType.FP32:
+                    continue
+            nbytes = 4
+            for d in shape:
+                nbytes *= int(d)
+            if nbytes < QUANTIZABLE_ALLREDUCE_BYTES:
+                continue
+            report.add(
+                PERF, "quantizable-allreduce",
+                "'c_allreduce_sum' of '%s' moves %d fp32 bytes per "
+                "participant at full precision — block-scaled int8 "
+                "('c_allreduce_quant', or DistributedStrategy."
+                "grad_quantize for gradients) cuts the wire ~3.9x at "
+                "block 256, with error feedback absorbing the rounding"
+                % (n, nbytes),
+                block_idx=block.idx, op_index=i, op=op, var=n)
 
 
 def _round_up(x, m):

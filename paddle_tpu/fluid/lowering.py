@@ -262,6 +262,16 @@ def run_ops(block, op_list, env, ctx):
             seed = jnp.ones_like(loss_val)
         (grads,) = vjp_fn(seed)
         grad_names = bw_op.output("Grads")
+        # gradient-communication hook (parallel/comms): a dp grad-sync
+        # program installs a callable that allreduces (optionally
+        # quantized/bucketed) the raw grads HERE — between the backward
+        # op and the optimizer ops that consume them — so XLA sees the
+        # collectives interleaved with the remaining backward/update
+        # compute and can overlap them.
+        gc = getattr(ctx, "grad_comm", None)
+        if gc is not None and block.idx == 0:
+            synced = gc(dict(zip(grad_names, grads)))
+            grads = [synced.get(n, g) for n, g in zip(grad_names, grads)]
         for n, g in zip(grad_names, grads):
             env[n] = g
             cached_grads[n] = g
@@ -343,12 +353,18 @@ def persistable_names(program):
 
 
 def build_step_fn(program, feed_names, fetch_names, is_test=False,
-                  extra_env=None, mesh_axes=None, platform=None, mesh=None):
+                  extra_env=None, mesh_axes=None, platform=None, mesh=None,
+                  grad_comm=None):
     """Return a pure function step(state, feeds, rng) -> (fetches, new_state).
 
     ``state`` / ``feeds`` are dicts name->array. ``new_state`` contains every
     persistable var that has a value after the run (parameters, optimizer
     accumulators, batch-norm stats, step counters, ...).
+
+    ``grad_comm``: optional callable ``{grad_name: array} -> {grad_name:
+    array}`` applied to the global block's backward-op gradients before
+    the optimizer ops consume them (the gradient-communication hook;
+    see :mod:`paddle_tpu.parallel.comms`).
     """
     block = program.global_block()
     op_list = list(block.ops)
@@ -358,6 +374,7 @@ def build_step_fn(program, feed_names, fetch_names, is_test=False,
         ctx = LowerContext(rng=rng, is_test=is_test, program=program,
                            mesh_axes=mesh_axes, platform=platform,
                            mesh=mesh)
+        ctx.grad_comm = grad_comm
         ctx.run_ops = run_ops  # control-flow ops recurse through this
         # names the recompute pass must keep live across jax.checkpoint
         # segment boundaries even if no later op consumes them

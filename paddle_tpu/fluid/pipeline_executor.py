@@ -467,12 +467,12 @@ def _build_pipeline_fn(program, region, spans, ring_names, record_names,
             # other mesh axis (dp/tp/...) stays auto — GSPMD keeps the
             # feeds' dp sharding and the params' tp sharding inside the
             # stage bodies and inserts those collectives itself
-            recs = jax.shard_map(
-                local, mesh=mesh,
+            from ..parallel.sharding import shard_map_manual
+            recs = shard_map_manual(
+                local, mesh,
                 in_specs=(P(), P(), P()),
                 out_specs=P(),
-                axis_names=frozenset({"pp"}),
-                check_vma=False,
+                manual_axes={"pp"},
             )(params, nontarget_state, feeds_mb)
             loss_mb = recs[loss_name]
             loss = jnp.mean(loss_mb.astype(jnp.float32))

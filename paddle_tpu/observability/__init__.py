@@ -104,6 +104,25 @@ Well-known decode-serving metrics (PR 9, ``serving.decode``):
   the hub; rejects and client disconnects also land in the flight
   recorder with ``engine="decode"``.
 
+Well-known gradient-communication metrics (PR 10, ``parallel/comms``):
+
+- ``comm.bytes_sent`` counter — wire bytes one gradient sync moved
+  across the dp group (per step, deterministic from the bucket plan);
+  ``comm.bytes_saved`` counter — bytes the quantized path avoided vs
+  the fp32 ring over the same padded payload.
+- ``comm.compression_ratio`` gauge — fp32 bytes / actual wire bytes of
+  the last sync (1.0 on the exact path, ~3.9 at block 256);
+  ``comm.overlap_ratio`` gauge — fraction of comm bytes with
+  backward-overlap opportunity (0.0 with one bucket or overlap off).
+- ``comm.allreduce_seconds`` histogram — the COST-MODEL-predicted comm
+  leg per step (wire bytes over the profile's ICI bandwidth,
+  ``PADDLE_TPU_ICI_BW`` overridable), not a measurement: inside one
+  fused jitted step the per-collective time is not separable host-side.
+  Absent when no device profile knows the bandwidth.
+- ``collective.dispatch.grad_sync`` counter — each bucketed sync
+  dispatch through the FleetGuard collective gate, alongside the
+  existing per-op ``collective.dispatch.<op>`` counters.
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

@@ -18,6 +18,14 @@ from jax import lax
 from .registry import register_op, single
 
 
+def axis_size(ax):
+    """Static mapped-axis size; ``lax.axis_size`` is newer than some
+    supported jax builds (psum of the literal 1 folds statically)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(ax)
+    return lax.psum(1, ax)
+
+
 def _axis(ctx, attrs):
     """Resolve the mesh axis for a collective ring id; None = no axis bound
     (single-device execution)."""
@@ -25,7 +33,7 @@ def _axis(ctx, attrs):
     return ctx.mesh_axes.get(ring) or ctx.mesh_axes.get("collective")
 
 
-def _guard(what, site="collective"):
+def collective_guard(what, site="collective"):
     """Host-side health gate, hit at trace/dispatch time — before the
     collective is handed to XLA. Counts a fault-spec check at `site`
     and enforces the thread's armed collective deadline (elastic
@@ -34,13 +42,21 @@ def _guard(what, site="collective"):
     World-size-1 paths are guarded too: the entry point is the unit of
     accounting, not the payload — the telemetry dispatch counters below
     count lowerings the same way. Imported lazily — ops must stay
-    importable before the fluid package finishes initialising."""
+    importable before the fluid package finishes initialising.
+
+    Public: parallel/comms routes every quantized/bucketed gradient
+    sync launch through here too, so FleetGuard deadlines and fault
+    drills cover the new lowerings exactly like the c_* op lowerings.
+    """
     from .. import observability as obs
     from ..fluid.resilience import collective_check
 
     obs.inc("collective.dispatch")
     obs.inc("collective.dispatch.%s" % what)
     collective_check(what, site=site)
+
+
+_guard = collective_guard
 
 
 def _allreduce(name, reducer):
@@ -125,7 +141,7 @@ def _c_split(ctx, ins, attrs):
     if ax is None:
         return single(x)
     idx = lax.axis_index(ax)
-    n = lax.axis_size(ax)
+    n = axis_size(ax)
     per = x.shape[0] // n
     return single(lax.dynamic_slice_in_dim(x, idx * per, per, axis=0))
 
@@ -178,7 +194,7 @@ def _ppermute(ctx, ins, attrs):
     ax = _axis(ctx, attrs)
     if ax is None:
         return single(x)
-    n = lax.axis_size(ax)
+    n = axis_size(ax)
     shift = attrs.get("shift", 1)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return single(lax.ppermute(x, axis_name=ax, perm=perm))
@@ -197,3 +213,34 @@ def _all_to_all(ctx, ins, attrs):
         lax.all_to_all(x, ax, split_axis=split_axis, concat_axis=concat_axis,
                        tiled=True)
     )
+
+
+@register_op("c_allreduce_quant")
+def _c_allreduce_quant(ctx, ins, attrs):
+    """Block-scaled quantized mean-allreduce (parallel/comms): quantize
+    -> reduce-scatter -> dequant-accumulate -> all-gather. Explicit-op
+    twin of the grad-sync path for shard_map programs that script their
+    own collectives. attrs: ``block_size`` (default 256), ``wire_dtype``
+    ('int8' | 'fp8_e4m3'), ``op`` ('mean' default | 'sum'). Inputs that
+    can't block-quantize (non-float, scalar) fall back to the exact
+    reduce, like pmean_int8."""
+    x = ins["X"][0]
+    _guard("c_allreduce_quant")
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return single(x)
+    from ..parallel.comms import allreduce as _ar
+    from ..parallel.comms import quantize as _qz
+
+    mean = attrs.get("op", "mean") != "sum"
+    block = int(attrs.get("block_size", _qz.DEFAULT_BLOCK))
+    wire = attrs.get("wire_dtype", "int8")
+    n = _ar.axis_size(ax)
+    if not jnp.issubdtype(x.dtype, jnp.floating) or x.ndim == 0:
+        red = lax.psum(x, ax)
+        return single(red / n if mean else red)
+    flat, orig = _qz.pad_flat(x.astype(jnp.float32).reshape(-1),
+                              n * block)
+    reduced, _ = _ar.quantized_allreduce_flat(flat, ax, block, wire,
+                                              mean=mean)
+    return single(reduced[:orig].reshape(x.shape).astype(x.dtype))

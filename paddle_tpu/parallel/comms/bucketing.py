@@ -1,0 +1,233 @@
+"""Deterministic gradient bucketing + backward-overlap scheduling.
+
+The Big Send-off observation (PAPERS.md): one allreduce over ALL
+gradients cannot start until the LAST gradient of the backward pass
+exists, so the whole comm leg is exposed. Splitting the gradients into
+size-targeted buckets in **reverse-backward order** (the order grads
+are produced: last forward layer first) gives XLA's latency-hiding
+scheduler one collective per bucket, each of which only depends on its
+own bucket's grads — so bucket 0's allreduce runs while the backward
+pass is still producing bucket 1's inputs. Too-small buckets pay
+per-collective latency; too-large buckets serialize — hence the
+size-targeted greedy plan.
+
+Everything here is host-side deterministic planning plus one
+trace-time entry point:
+
+- :func:`plan_buckets` — pure function of (ordered name/size list,
+  target bytes): same plan every call, every process, every restart.
+  Determinism matters because bucket layout defines the residual state
+  shapes checkpointed with the model.
+- :func:`sync_bucketed` — called inside shard_map during tracing;
+  packs each bucket flat, applies error feedback, runs the (quantized
+  or exact) allreduce per bucket, and unpacks. With ``overlap=False``
+  every gradient is fenced behind ``lax.optimization_barrier`` before
+  the first collective — the bit-reference ablation: identical values,
+  zero scheduling freedom.
+
+``overlap_ratio`` is reported deterministically from the plan: the
+last bucket's allreduce can never overlap backward compute (nothing is
+left to overlap with), so ``1 - last_bucket_bytes / total_bytes`` is
+the fraction of comm bytes with overlap *opportunity*. 0.0 with a
+single bucket or with overlap disabled.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from . import quantize as qz
+from .allreduce import (axis_size, exact_allreduce_flat,
+                        quantized_allreduce_flat)
+
+__all__ = ["Bucket", "BucketPlan", "plan_buckets", "bucket_padded_len",
+           "pack_bucket", "unpack_bucket", "sync_bucketed",
+           "residual_name"]
+
+
+class Bucket:
+    """One size-targeted group of gradients, reduced together.
+
+    ``names``/``shapes``/``sizes`` are parallel lists in
+    reverse-backward order; ``offsets[i]`` is where tensor i starts in
+    the bucket-flat vector; ``n_elements`` the unpadded flat length.
+    """
+
+    __slots__ = ("index", "names", "shapes", "sizes", "offsets",
+                 "n_elements")
+
+    def __init__(self, index, names, shapes, sizes):
+        self.index = int(index)
+        self.names = list(names)
+        self.shapes = [tuple(s) for s in shapes]
+        self.sizes = [int(s) for s in sizes]
+        offs, off = [], 0
+        for s in self.sizes:
+            offs.append(off)
+            off += s
+        self.offsets = offs
+        self.n_elements = off
+
+    def to_dict(self):
+        return {"index": self.index, "names": list(self.names),
+                "n_elements": self.n_elements}
+
+    def __repr__(self):
+        return ("Bucket(%d, %d tensors, %d elements)"
+                % (self.index, len(self.names), self.n_elements))
+
+
+class BucketPlan:
+    """The full schedule: buckets in launch order (reverse-backward)."""
+
+    __slots__ = ("buckets", "target_bytes", "itemsize")
+
+    def __init__(self, buckets, target_bytes, itemsize=4):
+        self.buckets = list(buckets)
+        self.target_bytes = int(target_bytes)
+        self.itemsize = int(itemsize)
+
+    @property
+    def total_elements(self):
+        return sum(b.n_elements for b in self.buckets)
+
+    def overlap_ratio(self, overlap=True):
+        """Fraction of comm bytes with overlap opportunity: everything
+        except the last-launched bucket (which waits on the final
+        grads) can hide behind remaining backward compute. 0.0 when
+        overlap is disabled or there is nothing to hide behind."""
+        if not overlap or len(self.buckets) < 2:
+            return 0.0
+        total = self.total_elements
+        if not total:
+            return 0.0
+        return 1.0 - self.buckets[-1].n_elements / float(total)
+
+    def to_dict(self):
+        return {"target_bytes": self.target_bytes,
+                "n_buckets": len(self.buckets),
+                "buckets": [b.to_dict() for b in self.buckets]}
+
+    def __repr__(self):
+        return ("BucketPlan(%d buckets, %d elements, target=%dB)"
+                % (len(self.buckets), self.total_elements,
+                   self.target_bytes))
+
+
+def plan_buckets(named_sizes, target_bytes, itemsize=4):
+    """Greedy size-targeted bucketing of ``[(name, shape), ...]``
+    given in FORWARD parameter order; buckets come out in
+    reverse-backward launch order. A bucket closes once it reaches
+    ``target_bytes`` (fp32 accounting — the wire format doesn't change
+    which grads belong together). Oversized single tensors get their
+    own bucket. Pure and deterministic."""
+    if target_bytes < 1:
+        raise ValueError("target_bytes must be >= 1, got %d"
+                         % target_bytes)
+    items = list(reversed(list(named_sizes)))
+    buckets, cur = [], []
+    cur_bytes = 0
+    for name, shape in items:
+        size = 1
+        for d in shape:
+            size *= int(d)
+        cur.append((name, tuple(shape), size))
+        cur_bytes += size * itemsize
+        if cur_bytes >= target_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    return BucketPlan(
+        [Bucket(i, [n for n, _, _ in b], [s for _, s, _ in b],
+                [z for _, _, z in b])
+         for i, b in enumerate(buckets)],
+        target_bytes, itemsize)
+
+
+def bucket_padded_len(bucket, axis_size, block_size):
+    """Flat length a bucket's wire vector is padded to: the quantized
+    two-shot needs len divisible by ``axis_size * block_size`` so the
+    reduce-scatter chunks split on block boundaries."""
+    return qz.round_up(max(bucket.n_elements, 1),
+                       int(axis_size) * int(block_size))
+
+
+def pack_bucket(bucket, grads, padded_len):
+    """Concatenate a bucket's gradients (fp32, flattened, in bucket
+    order) and zero-pad to ``padded_len``."""
+    parts = [grads[n].astype(jnp.float32).reshape(-1)
+             for n in bucket.names]
+    flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    if padded_len > bucket.n_elements:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((padded_len - bucket.n_elements,),
+                             jnp.float32)])
+    return flat
+
+
+def unpack_bucket(bucket, flat, grads):
+    """Split a reduced bucket-flat vector back into named tensors with
+    the original shapes/dtypes."""
+    out = {}
+    for name, shape, size, off in zip(bucket.names, bucket.shapes,
+                                      bucket.sizes, bucket.offsets):
+        out[name] = flat[off:off + size].reshape(shape).astype(
+            grads[name].dtype)
+    return out
+
+
+def residual_name(bucket):
+    """Scope name of a bucket's error-feedback residual state."""
+    return "comm_ef_residual_%d" % bucket.index
+
+
+def sync_bucketed(grads, axis_name, cfg, plan, residuals=None):
+    """Allreduce every gradient, one collective per bucket, inside
+    shard_map. Returns ``(synced_grads, new_residuals)``.
+
+    ``residuals`` maps :func:`residual_name` -> padded flat residual
+    (required when ``cfg.error_feedback`` and ``cfg.quantized``);
+    ``new_residuals`` has the same keys with next step's values (empty
+    dict when EF is off — callers thread it through scope state).
+
+    With ``cfg.overlap=False`` the packed bucket flats are fenced
+    through one ``lax.optimization_barrier`` before any collective
+    launches — XLA then cannot start bucket 0's allreduce until every
+    gradient (all buckets' inputs) exists. Values are bit-identical to
+    the overlapped schedule; only instruction-scheduling freedom
+    differs, which is exactly what a bit-reference ablation needs.
+    """
+    axis_size_mult = cfg.block_size if cfg.quantized else 1
+    packed = []
+    for bucket in plan.buckets:
+        padded = qz.round_up(max(bucket.n_elements, 1),
+                             _axis_pad(axis_name) * axis_size_mult)
+        packed.append((bucket, padded,
+                       pack_bucket(bucket, grads, padded)))
+    if not cfg.overlap and packed:
+        fenced = lax.optimization_barrier(
+            tuple(flat for _, _, flat in packed))
+        packed = [(b, p, f) for (b, p, _), f in zip(packed, fenced)]
+    synced, new_residuals = {}, {}
+    for bucket, padded, flat in packed:
+        use_ef = cfg.quantized and cfg.error_feedback
+        if use_ef:
+            res = residuals[residual_name(bucket)]
+            send = qz.error_feedback_apply(flat, res)
+        else:
+            send = flat
+        if cfg.quantized:
+            reduced, local_decoded = quantized_allreduce_flat(
+                send, axis_name, cfg.block_size, cfg.wire_dtype,
+                mean=True)
+        else:
+            reduced, local_decoded = exact_allreduce_flat(
+                send, axis_name, mean=True)
+        if use_ef:
+            new_residuals[residual_name(bucket)] = (
+                qz.error_feedback_update(send, local_decoded))
+        synced.update(unpack_bucket(bucket, reduced, grads))
+    return synced, new_residuals
+
+
+def _axis_pad(axis_name):
+    return axis_size(axis_name)

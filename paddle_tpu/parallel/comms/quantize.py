@@ -1,0 +1,130 @@
+"""Block-scaled gradient quantization with error feedback.
+
+EQuARX-style encoding (PAPERS.md: "EQuARX: Efficient Quantized
+AllReduce in XLA", arxiv 2506.17615): a flat fp32 vector is split into
+fixed-size blocks, each block carries its OWN symmetric scale
+``s_b = max|block| / levels``, and payloads ship as int8 (or fp8 where
+the jax build has ``float8_e4m3fn``). Per-block scales bound the
+rounding error by ``max|block| / (2 * levels)`` per element — a small
+block next to a large one is not drowned in the large block's scale,
+which is the whole advantage over one tensor-wide scale
+(:func:`..comms.allreduce.pmean_int8` keeps the legacy tensor-wide
+variant for LocalSGD's delta sync).
+
+Error feedback (DGC/EF-SGD lineage; ref fluid.optimizer
+DGCMomentumOptimizer keeps the same residual-accumulation idea): the
+compression error of step t is re-injected at step t+1 instead of
+lost, so the quantization noise telescopes instead of accumulating —
+``send_t = encode(g_t + e_t)``, ``e_{t+1} = (g_t + e_t) -
+decode(send_t)``. The helpers here are pure functions; the residual
+arrays ride the training scope as per-shard state
+(:mod:`.grad_sync`).
+
+Everything operates on FLAT vectors — bucketing.py owns the
+pack/unpack between named gradient tensors and bucket-flat layout.
+"""
+import jax.numpy as jnp
+
+__all__ = [
+    "DEFAULT_BLOCK", "WIRE_DTYPES", "wire_info", "round_up", "pad_flat",
+    "quantize_blocks", "dequantize_blocks", "error_feedback_apply",
+    "error_feedback_update", "wire_bytes", "compression_ratio",
+]
+
+DEFAULT_BLOCK = 256
+
+# wire format name -> (itemsize bytes, max representable magnitude)
+WIRE_DTYPES = {
+    "int8": (1, 127.0),
+    "fp8_e4m3": (1, 448.0),
+}
+
+
+def wire_info(wire_dtype):
+    """(jnp dtype, itemsize, levels) for a wire format name. fp8 is
+    "ready" in the encode/decode math but gated on the jax build
+    actually shipping ``float8_e4m3fn``."""
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            "unknown wire dtype %r (known: %s)"
+            % (wire_dtype, sorted(WIRE_DTYPES)))
+    itemsize, levels = WIRE_DTYPES[wire_dtype]
+    if wire_dtype == "int8":
+        return jnp.int8, itemsize, levels
+    dt = getattr(jnp, "float8_e4m3fn", None)
+    if dt is None:
+        raise ValueError(
+            "wire dtype 'fp8_e4m3' needs a jax build with "
+            "jnp.float8_e4m3fn; use 'int8'")
+    return dt, itemsize, levels
+
+
+def round_up(n, m):
+    return ((int(n) + m - 1) // m) * m
+
+
+def pad_flat(flat, multiple):
+    """Zero-pad a flat vector to a length multiple; returns (padded,
+    original_length). Zero pad rows quantize exactly (their block scale
+    floors at tiny), so padding never perturbs real elements."""
+    n = flat.shape[0]
+    target = round_up(n, multiple)
+    if target == n:
+        return flat, n
+    return jnp.concatenate(
+        [flat, jnp.zeros((target - n,), flat.dtype)]), n
+
+
+def quantize_blocks(flat, block_size=DEFAULT_BLOCK, wire_dtype="int8"):
+    """Encode a flat fp32 vector (length % block_size == 0) into
+    ``(payload, scales)``: payload has the wire dtype and the input's
+    length, scales is fp32 with one entry per block."""
+    dt, _, levels = wire_info(wire_dtype)
+    blocks = flat.astype(jnp.float32).reshape(-1, block_size)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    # all-zero blocks: keep the scale finite; they decode to exact 0
+    scales = jnp.maximum(amax, 1e-30) / levels
+    scaled = blocks / scales[:, None]
+    if dt == jnp.int8:
+        payload = jnp.clip(jnp.round(scaled), -levels, levels).astype(dt)
+    else:
+        payload = jnp.clip(scaled, -levels, levels).astype(dt)
+    return payload.reshape(flat.shape), scales
+
+
+def dequantize_blocks(payload, scales, block_size=DEFAULT_BLOCK):
+    """Decode ``(payload, scales)`` back to flat fp32."""
+    blocks = payload.astype(jnp.float32).reshape(-1, block_size)
+    return (blocks * scales[:, None]).reshape(payload.shape)
+
+
+def error_feedback_apply(flat, residual):
+    """Compensated send value: this step's gradient plus the carried
+    compression error of previous steps."""
+    return flat + residual
+
+
+def error_feedback_update(compensated, decoded):
+    """Next step's residual: what the wire format could not represent
+    of the compensated value this step."""
+    return compensated - decoded
+
+
+# -- deterministic wire-byte accounting (host side) -------------------------
+
+def wire_bytes(n_elements, block_size=DEFAULT_BLOCK, wire_dtype="int8"):
+    """Bytes one transmission of a quantized length-n vector puts on
+    the wire: payload + per-block fp32 scales. ``n_elements`` must
+    already be block-padded (see :func:`round_up`)."""
+    itemsize = WIRE_DTYPES[wire_dtype][0]
+    n_blocks = (int(n_elements) + block_size - 1) // block_size
+    return int(n_elements) * itemsize + n_blocks * 4
+
+
+def compression_ratio(n_elements, block_size=DEFAULT_BLOCK,
+                      wire_dtype="int8", full_itemsize=4):
+    """fp32-payload bytes over quantized-payload bytes for one
+    transmission — ``4 / (1 + 4/block)`` for int8: 3.94x at block 256,
+    crossing the 3.5x bar at any block >= 32."""
+    return (float(n_elements) * full_itemsize
+            / wire_bytes(n_elements, block_size, wire_dtype))

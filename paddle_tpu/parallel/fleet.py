@@ -53,8 +53,21 @@ class DistributedStrategy:
         self.use_local_sgd = False
         self.local_sgd_k_steps = 1
         # beyond-reference (EQuARX-inspired): int8-quantized payload for
-        # the k-step param averaging; see parallel/quantized_collectives
+        # the k-step param averaging; see parallel/comms
         self.local_sgd_quantized_sync = False
+        # explicit gradient-communication subsystem (parallel/comms):
+        # "gspmd" (default) leaves the per-gradient fp32 all-reduce to
+        # the XLA partitioner; "comms" runs GradSyncProgram — bucketed
+        # allreduces in reverse-backward order (overlap with backward
+        # compute), optionally block-scaled quantized with error
+        # feedback. Pure-dp only.
+        self.grad_sync_mode = "gspmd"
+        self.grad_quantize = False
+        self.grad_quantize_block = 256
+        self.grad_wire_dtype = "int8"
+        self.grad_error_feedback = True
+        self.grad_bucket_bytes = 4 << 20
+        self.grad_overlap = True
         self.use_dgc = False
         # parity only: XLA fuses collectives itself (its all-reduce
         # combiner), so this flag is honored by construction
@@ -274,10 +287,52 @@ class Fleet:
                     "which would silently override the tp/sp sharding "
                     "rules — run LocalSGD pure-dp"
                 )
+            if s.grad_sync_mode == "comms":
+                raise NotImplementedError(
+                    "grad_sync_mode='comms' with use_local_sgd: LocalSGD "
+                    "averages PARAMETERS every k steps, the comms "
+                    "subsystem allreduces GRADIENTS every step — the "
+                    "two sync disciplines exclude each other (LocalSGD's "
+                    "quantized payload is local_sgd_quantized_sync)"
+                )
             self._distributed_program = LocalSGDProgram(
                 program, self._mesh, k_steps=s.local_sgd_k_steps,
                 quantized_sync=s.local_sgd_quantized_sync,
                 param_rules=rules,
+            )
+        elif s.grad_sync_mode == "comms":
+            from .comms import CommConfig, GradSyncProgram
+
+            if tp > 1 or sp > 1:
+                raise NotImplementedError(
+                    "grad_sync_mode='comms' with tensor/sequence "
+                    "parallelism: GradSync stacks whole per-dp-shard "
+                    "param copies, which would silently override the "
+                    "tp/sp sharding rules — run it pure-dp"
+                )
+            if s.sharding_degree > 1:
+                raise NotImplementedError(
+                    "grad_sync_mode='comms' with sharding_degree>1: "
+                    "ZeRO shards optimizer state over dp, GradSync "
+                    "keeps stacked per-dp-shard state — pick one"
+                )
+            self._distributed_program = GradSyncProgram(
+                program, self._mesh,
+                comm_config=CommConfig(
+                    quantized=s.grad_quantize,
+                    block_size=s.grad_quantize_block,
+                    wire_dtype=s.grad_wire_dtype,
+                    error_feedback=s.grad_error_feedback,
+                    bucket_bytes=s.grad_bucket_bytes,
+                    overlap=s.grad_overlap,
+                ),
+                param_rules=rules,
+            )
+        elif s.grad_sync_mode not in ("gspmd", None):
+            raise NotImplementedError(
+                "grad_sync_mode=%r: 'gspmd' (XLA-partitioner "
+                "collectives) or 'comms' (parallel/comms explicit "
+                "bucketed/quantized gradient sync)" % (s.grad_sync_mode,)
             )
         else:
             self._distributed_program = DistributedProgram(

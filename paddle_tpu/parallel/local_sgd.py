@@ -12,34 +12,23 @@ between averaging points, trading ICI traffic for staleness.
 
 TPU-native realization: the reference rewrites the program with snapshot
 vars + c_allreduce ops over NCCL rings. Here the ONE lowered step runs
-under ``shard_map`` over the 'dp' mesh axis: per-shard parameter and
-optimizer-state copies ride a stacked leading dp dimension in the scope
-(sharded P('dp')), the per-shard RNG folds in the shard index, and the
-averaging step is a ``lax.cond``-gated ``lax.pmean`` on ICI — no
-snapshot buffers needed (the average is computed directly), and
-non-averaging steps issue NO parameter collectives, which is the entire
-point of LocalSGD.
+under ``shard_map`` over the 'dp' mesh axis (the shared
+:class:`..sharding.StackedDpProgram` stage: per-shard parameter and
+optimizer-state copies ride a stacked leading dp dimension in the
+scope), the per-shard RNG folds in the shard index, and the averaging
+step is a ``lax.cond``-gated ``lax.pmean`` on ICI — no snapshot buffers
+needed (the average is computed directly), and non-averaging steps
+issue NO parameter collectives, which is the entire point of LocalSGD.
 """
-import numpy as np
-
 import jax
-import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 
-from ..fluid import core
-from ..fluid.framework import Variable
-from ..fluid.lowering import build_step_fn
-from .sharding import DistributedProgram
+from .sharding import StackedDpProgram, shard_map  # noqa: F401  (re-export)
 
 __all__ = ["LocalSGDProgram"]
 
 
-class LocalSGDProgram(DistributedProgram):
+class LocalSGDProgram(StackedDpProgram):
     """Runnable through the ordinary Executor like DistributedProgram.
 
     Scope layout: trainable params and optimizer accumulators are stored
@@ -47,15 +36,11 @@ class LocalSGDProgram(DistributedProgram):
     :meth:`consolidate_scope` before saving persistables.
     """
 
+    _mode_name = "LocalSGD"
+
     def __init__(self, program, mesh, k_steps=1, quantized_sync=False,
                  **kw):
         super().__init__(program, mesh, **kw)
-        if "dp" not in mesh.shape or mesh.shape["dp"] <= 1:
-            raise ValueError(
-                "LocalSGD requires a dp mesh axis of size > 1 "
-                "(got mesh %s); with one worker there is nothing to "
-                "average — use the plain collective mode" % (mesh.shape,)
-            )
         self._k = max(1, int(k_steps))
         # beyond-reference (EQuARX-inspired): int8-quantize the k-step
         # averaging payload — ~4x fewer bytes on ICI/DCN. The payload is
@@ -65,29 +50,6 @@ class LocalSGDProgram(DistributedProgram):
         # the largest weight. Off by default: exact modes stay bit-exact
         # with plain dp.
         self._quantized_sync = bool(quantized_sync)
-        block = program.global_block()
-        self._avg_names = {
-            v.name for v in block.all_parameters()
-            if getattr(v, "trainable", True)
-        }
-        opt_state = {
-            v.name for v in block.vars.values()
-            if getattr(v, "belong_to_optimizer", False)
-        }
-        # per-shard (divergent) state: params + accumulators + EVERY
-        # persistable var some op writes (BN moving stats, AMP loss-scale
-        # counters, lr counters, ...). Each shard computes these from its
-        # own sub-batch, so pretending they are replicated would silently
-        # keep one shard's value; stacking them is always correct (vars
-        # that update identically just carry identical copies). Only
-        # params are averaged — the reference averages only params;
-        # everything else stays worker-local.
-        written = {n for op in block.ops for n in op.output_arg_names}
-        step_state = {
-            v.name for v in block.vars.values()
-            if getattr(v, "persistable", False) and v.name in written
-        }
-        self._local_names = self._avg_names | opt_state | step_state
         if self._quantized_sync:
             # per-shard anchors (last-synced param values) live in the
             # scope like any other stacked local state; NOT program
@@ -96,354 +58,78 @@ class LocalSGDProgram(DistributedProgram):
                 n: n + "@LSGD_ANCHOR" for n in self._avg_names
             }
             self._local_names |= set(self._anchor_names.values())
-        self._step_i = 0
 
-    # -- state staging ----------------------------------------------------
-    def _stack_state(self, state):
-        """Scope values -> stacked-local / replicated device arrays."""
-        ndp = self._mesh.shape["dp"]
-        out = {}
-        for k, v in state.items():
-            arr = v if hasattr(v, "sharding") else np.asarray(v)
-            if k in self._local_names:
-                if hasattr(v, "sharding") and self._is_stacked_sharding(
-                        v.sharding):
-                    # already stacked on device from the previous step:
-                    # (dp, *orig) with the LEADING dim as the dp axis —
-                    # keep it there (no host round-trip, donation works)
-                    out[k] = v
-                    continue
-                np_arr = np.asarray(arr)
-                if np_arr.ndim >= 1 and np_arr.shape[0] == ndp and \
-                        self._already_stacked(k, np_arr):
-                    stacked = np_arr          # host copy, already stacked
-                else:
-                    stacked = np.broadcast_to(
-                        np_arr, (ndp,) + np_arr.shape)
-                    self._mark_stacked(k, stacked)
-                out[k] = jax.device_put(stacked, NamedSharding(
-                    self._mesh,
-                    P("dp", *([None] * (stacked.ndim - 1)))))
-            else:
-                sh = NamedSharding(self._mesh, P())
-                out[k] = (v if hasattr(v, "sharding")
-                          and v.sharding == sh
-                          else jax.device_put(np.asarray(arr), sh))
-        return out
+    # -- StackedDpProgram hooks -------------------------------------------
+    def _seed_extra_state(self, raw_state, scope):
+        if not self._quantized_sync:
+            return
+        # anchors (last-synced params) ride the scope; first run seeds
+        # them from the current params
+        for pn, an in self._anchor_names.items():
+            existing = scope.find_value(an)
+            raw_state[an] = existing if existing is not None \
+                else raw_state[pn]
 
-    def _is_stacked_sharding(self, sh):
-        """dp on the leading dim, nothing else — robust to jax's
-        trailing-None normalization (P('dp',) vs P('dp', None))."""
-        spec = getattr(sh, "spec", None)
-        mesh = getattr(sh, "mesh", None)
-        if spec is None or mesh is None:
-            return False
-        try:
-            if dict(mesh.shape) != dict(self._mesh.shape):
-                return False
-        except Exception:  # noqa: BLE001
-            return False
-        entries = tuple(spec)
-        return (len(entries) >= 1 and entries[0] == "dp"
-                and all(e is None for e in entries[1:]))
+    def _make_per_shard(self, base_step):
+        local = self._local_names
+        avg_names = self._avg_names
+        k_steps = self._k
+        quantized = self._quantized_sync
+        anchor_of = dict(getattr(self, "_anchor_names", {}))
+        if quantized:
+            from .comms.allreduce import pmean_int8
 
-    def _already_stacked(self, name, arr):
-        return self._stacked_shapes.get(name) == arr.shape
+        def per_shard(st, fd, rng, step_i):
+            st = {n: (v[0] if n in local else v)
+                  for n, v in st.items()}
+            # anchors are scope-state, not program vars: keep them
+            # out of the program step
+            anchors = {n: st.pop(anchor_of[n])
+                       for n in anchor_of} if quantized else {}
+            # independent per-shard randomness (dropout etc.)
+            rng = jax.random.fold_in(rng, lax.axis_index("dp"))
+            fetches, new_st = base_step(st, fd, rng)
+            do_avg = (step_i % k_steps) == 0
 
-    def _mark_stacked(self, name, arr):
-        if not hasattr(self, "_stacked_shapes"):
-            self._stacked_shapes = {}
-        self._stacked_shapes[name] = arr.shape
-
-    def _collapse(self, name, arr):
-        """Collapse a stacked (ndp, ...) value to program-var shape:
-        floats mean over the dp axis, ints take shard 0. Device values
-        stay ON DEVICE (eager jnp ops; XLA reduces over the sharded
-        leading axis) — serialization pulls only what it writes, so a
-        checkpoint-during-training save is O(bytes written), not an
-        O(params x ndp) host round-trip of the whole scope."""
-        if isinstance(arr, np.ndarray):        # already host: stay host
-            if np.issubdtype(arr.dtype, np.floating):
-                return arr.mean(axis=0)
-            return arr[0]
-        if np.issubdtype(np.dtype(arr.dtype), np.floating):
-            return jnp.mean(arr, axis=0)
-        return arr[0]
-
-    def _stacked_here(self, name, v):
-        return (name in self._local_names
-                and getattr(self, "_stacked_shapes", {}).get(name)
-                is not None
-                and self._stacked_shapes[name]
-                == tuple(getattr(v, "shape", ()) or ()))
-
-    def consolidated_scope(self, scope):
-        """A COPY of ``scope`` with stacked per-shard state collapsed to
-        program-var shapes (floats: cross-shard mean; ints: shard 0) —
-        for serialization. The LIVE scope is untouched: an off-schedule
-        save must not act as a parameter sync or average away the
-        worker-local optimizer moments. Device values stay on device
-        (no host materialization); non-collapsed device values are
-        device-COPIED, never aliased — the live buffer may be donated
-        to the next jitted step, and a snapshot held across that step
-        must not dereference a deleted buffer."""
-        from ..fluid.executor import Scope
-
-        snap = Scope()
-        for name, v in list(scope.items()):
-            if self._stacked_here(name, v):
-                snap.set(name, self._collapse(name, v))
-            elif isinstance(v, jax.Array):
-                snap.set(name, jnp.copy(v))
-            else:
-                snap.set(name, v)
-        return snap
-
-    def consolidate_scope(self, scope):
-        """IN-PLACE collapse (end of training / before handing the
-        scope to non-LocalSGD consumers). For checkpoint-during-training
-        use :meth:`consolidated_scope` — it leaves training state
-        alone."""
-        for name in self._local_names:
-            v = scope.find_value(name)
-            if v is None:
-                continue
-            if not self._stacked_here(name, v):
-                continue
-            scope.update(name, self._collapse(name, v))
-            self._stacked_shapes.pop(name, None)
-
-    # -- elastic shrink ---------------------------------------------------
-    def shrink_dp(self, scope, surviving_shards, new_mesh=None):
-        """Shrink-to-survivors (parallel/elastic.py): drop the dead
-        workers' rows from every stacked per-shard value in `scope`,
-        rebuild on a mesh over the surviving devices, and invalidate the
-        jit cache so the next step re-traces on the smaller dp axis.
-        The k-step ``lax.pmean`` averaging then reduces over the NEW
-        axis size — the gradient/param-averaging denominator is
-        rescaled from the old world to the survivor count, instead of
-        silently averaging ghosts. Returns the new mesh.
-
-        Rare-event path: stacked state round-trips through the host
-        (the old mesh's device set no longer exists, so device-to-device
-        resharding has no target layout to reuse).
-        """
-        old_ndp = self._mesh.shape["dp"]
-        keep = sorted(set(surviving_shards))
-        bad = [i for i in keep if not 0 <= i < old_ndp]
-        if bad:
-            raise ValueError(
-                "surviving shard positions %s out of range for dp=%d"
-                % (bad, old_ndp))
-        if len(keep) < 2:
-            raise ValueError(
-                "LocalSGD needs >= 2 surviving shards (got %d of %d); "
-                "with one worker left, consolidate the scope and fall "
-                "back to single-worker training" % (len(keep), old_ndp))
-        if new_mesh is None:
-            from .mesh import shrink_mesh
-
-            new_mesh = shrink_mesh(self._mesh, survivors=keep)
-        if new_mesh.shape.get("dp") != len(keep):
-            raise ValueError(
-                "new mesh dp axis is %s but %d shards survive"
-                % (new_mesh.shape.get("dp"), len(keep)))
-        for name, shape in list(getattr(self, "_stacked_shapes",
-                                        {}).items()):
-            v = scope.find_value(name)
-            if v is None or tuple(getattr(v, "shape", ())) != shape:
-                continue
-            sliced = np.ascontiguousarray(np.asarray(v)[keep])
-            scope.update(name, sliced)
-            self._stacked_shapes[name] = sliced.shape
-        self._mesh = new_mesh
-        self._cache.clear()
-        return new_mesh
-
-    # -- executor hook ----------------------------------------------------
-    def _executor_run(self, executor, feed, fetch_list, scope,
-                      return_numpy):
-        from ..fluid.executor import global_scope
-
-        if not hasattr(self, "_stacked_shapes"):
-            self._stacked_shapes = {}
-        program = self._program
-        mesh = self._mesh
-        ndp = mesh.shape["dp"]
-        scope = scope if scope is not None else global_scope()
-        feed = feed or {}
-        fetch_names = [
-            f.name if isinstance(f, Variable) else f
-            for f in (fetch_list or [])
-        ]
-        block = program.global_block()
-
-        feed_arrays, feed_specs = {}, {}
-        for name, value in feed.items():
-            value = getattr(value, "_ndarray", value)
-            arr = np.asarray(value)
-            if block.has_var(name) and block.var(name).dtype is not None:
-                want = core.np_dtype(block.var(name).dtype)
-                if arr.dtype != want:
-                    arr = arr.astype(want)
-            # same contract as DistributedProgram.feed_sharding:
-            # explicit feed_specs win (P() opts a feed out of batch
-            # splitting), then the feed_axis heuristic
-            if name in self._feed_specs:
-                spec = self._feed_specs[name]
-                entries = tuple(spec)
-                # P() (replicate) or P('dp') / P('dp', None, ...)
-                # (batch-split) only: 'dp' anywhere but the leading dim
-                # would slice features, not examples
-                if not (all(a is None for a in entries)
-                        or (entries[:1] == ("dp",)
-                            and all(a is None for a in entries[1:]))):
-                    raise NotImplementedError(
-                        "LocalSGD feeds shard over 'dp' on the LEADING "
-                        "(batch) dim only; feed %r asked for %s"
-                        % (name, spec))
-            elif (self._feed_axis and arr.ndim
-                    and arr.shape[0] % ndp == 0):
-                spec = P("dp")
-            else:
-                spec = P()
-            feed_specs[name] = spec
-            feed_arrays[name] = jax.device_put(
-                arr, NamedSharding(mesh, spec))
-        raw_state = executor._gather_state(program, scope)
-        if self._quantized_sync:
-            # anchors (last-synced params) ride the scope; first run
-            # seeds them from the current params
-            for pn, an in self._anchor_names.items():
-                existing = scope.find_value(an)
-                raw_state[an] = existing if existing is not None \
-                    else raw_state[pn]
-        state = self._stack_state(raw_state)
-        state_specs = {
-            k: (P("dp", *([None] * (np.ndim(v) - 1)))
-                if k in self._local_names else P())
-            for k, v in state.items()
-        }
-
-        sig = (
-            id(program), program._version,
-            tuple(sorted((k, v.shape, str(v.dtype))
-                         for k, v in feed_arrays.items())),
-            tuple(fetch_names),
-            tuple(sorted((k, v.shape, str(v.dtype))
-                         for k, v in state.items())),
-        )
-        entry = self._cache.get(sig)
-        if entry is None:
-            base_step = build_step_fn(
-                program, list(feed_arrays), fetch_names,
-                mesh_axes={a: a for a in mesh.axis_names},
-                mesh=mesh,
-            )
-            local = self._local_names
-            avg_names = self._avg_names
-            k_steps = self._k
-            quantized = self._quantized_sync
-            anchor_of = dict(getattr(self, "_anchor_names", {}))
+            names = [n for n in sorted(avg_names) if n in new_st]
+            vals = [new_st[n] for n in names]
             if quantized:
-                from .quantized_collectives import pmean_int8
+                anchs = [anchors[n] for n in names]
 
-            def per_shard(st, fd, rng, step_i):
-                st = {n: (v[0] if n in local else v)
-                      for n, v in st.items()}
-                # anchors are scope-state, not program vars: keep them
-                # out of the program step
-                anchors = {n: st.pop(anchor_of[n])
-                           for n in anchor_of} if quantized else {}
-                # independent per-shard randomness (dropout etc.)
-                rng = jax.random.fold_in(rng, lax.axis_index("dp"))
-                fetches, new_st = base_step(st, fd, rng)
-                do_avg = (step_i % k_steps) == 0
+                def averaged(args):
+                    vs, ans = args
+                    # int8 payload = DELTA since the last sync;
+                    # the anchor re-syncs to the averaged result
+                    new_vs = [
+                        a + pmean_int8(v - a, "dp")
+                        for v, a in zip(vs, ans)
+                    ]
+                    return new_vs, list(new_vs)
 
-                names = [n for n in sorted(avg_names) if n in new_st]
-                vals = [new_st[n] for n in names]
-                if quantized:
-                    anchs = [anchors[n] for n in names]
-
-                    def averaged(args):
-                        vs, ans = args
-                        # int8 payload = DELTA since the last sync;
-                        # the anchor re-syncs to the averaged result
-                        new_vs = [
-                            a + pmean_int8(v - a, "dp")
-                            for v, a in zip(vs, ans)
-                        ]
-                        return new_vs, list(new_vs)
-
-                    vals, anchs = lax.cond(
-                        do_avg, averaged, lambda args: args,
-                        (vals, anchs))
-                    for n, a in zip(names, anchs):
-                        new_st[anchor_of[n]] = a
-                    # state structure must round-trip exactly: anchors
-                    # whose param wasn't in new_st pass through
-                    for n, a in anchors.items():
-                        new_st.setdefault(anchor_of[n], a)
-                else:
-                    def averaged(vs):
-                        return [lax.pmean(v, "dp") for v in vs]
-
-                    # non-averaging steps issue NO param collectives —
-                    # both cond branches trace, but only the taken one
-                    # runs, and the predicate is shard-uniform (step_i
-                    # is replicated)
-                    vals = lax.cond(do_avg, averaged, lambda vs: vs,
-                                    vals)
-                for n, v in zip(names, vals):
-                    new_st[n] = v
-                new_st = {n: (v[None] if n in local else v)
-                          for n, v in new_st.items()}
-                fetches = [f[None] for f in fetches]
-                return fetches, new_st
-
-            smap_kw = dict(
-                mesh=mesh,
-                in_specs=(state_specs, feed_specs, P(), P()),
-                out_specs=([P("dp")] * len(fetch_names), state_specs),
-            )
-            try:  # replication checking: check_vma (new) / check_rep (old)
-                stepper = shard_map(per_shard, check_vma=False, **smap_kw)
-            except TypeError:
-                stepper = shard_map(per_shard, check_rep=False, **smap_kw)
-            entry = jax.jit(stepper, donate_argnums=(0,))
-            self._cache[sig] = entry
-
-        self._step_i += 1
-        rng = jax.device_put(executor._next_rng(program),
-                             NamedSharding(mesh, P()))
-        step_i = jax.device_put(jnp.asarray(self._step_i, jnp.int32),
-                                NamedSharding(mesh, P()))
-        fetches, new_state = entry(state, feed_arrays, rng, step_i)
-        for k, v in new_state.items():
-            scope.update(k, v)
-            if k in self._local_names:
-                self._stacked_shapes[k] = tuple(v.shape)
-
-        out = []
-        for name, v in zip(fetch_names, fetches):
-            # v is (ndp, *per_shard_shape)
-            var = block.vars.get(name)
-            vshape = getattr(var, "shape", None)
-            batchy = bool(vshape) and len(vshape) and (
-                vshape[0] in (None, -1)
-                # static batch dims count too: a declared leading dim
-                # equal to ndp * per-shard is a sharded batch, and
-                # averaging unrelated examples would be silent garbage
-                or (isinstance(vshape[0], int) and len(v.shape) >= 2
-                    and vshape[0] == v.shape[0] * v.shape[1])
-            )
-            if batchy:
-                # per-shard batch outputs concatenate back to the
-                # global batch
-                v = jnp.reshape(v, (-1,) + tuple(v.shape[2:]))
-            elif jnp.issubdtype(v.dtype, jnp.floating):
-                v = jnp.mean(v, axis=0)     # e.g. per-shard losses
+                vals, anchs = lax.cond(
+                    do_avg, averaged, lambda args: args,
+                    (vals, anchs))
+                for n, a in zip(names, anchs):
+                    new_st[anchor_of[n]] = a
+                # state structure must round-trip exactly: anchors
+                # whose param wasn't in new_st pass through
+                for n, a in anchors.items():
+                    new_st.setdefault(anchor_of[n], a)
             else:
-                v = v[0]
-            out.append(np.asarray(v) if return_numpy else v)
-        return out
+                def averaged(vs):
+                    return [lax.pmean(v, "dp") for v in vs]
+
+                # non-averaging steps issue NO param collectives —
+                # both cond branches trace, but only the taken one
+                # runs, and the predicate is shard-uniform (step_i
+                # is replicated)
+                vals = lax.cond(do_avg, averaged, lambda vs: vs,
+                                vals)
+            for n, v in zip(names, vals):
+                new_st[n] = v
+            new_st = {n: (v[None] if n in local else v)
+                      for n, v in new_st.items()}
+            fetches = [f[None] for f in fetches]
+            return fetches, new_st
+
+        return per_shard

@@ -10,10 +10,28 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["build_mesh", "shrink_mesh", "get_default_mesh",
-           "set_default_mesh", "P", "NamedSharding", "Mesh"]
+__all__ = ["build_mesh", "shrink_mesh", "dp_size", "require_dp_axis",
+           "get_default_mesh", "set_default_mesh", "P", "NamedSharding",
+           "Mesh"]
 
 _default_mesh = None
+
+
+def dp_size(mesh):
+    """Size of the mesh's data-parallel axis (1 when there is none)."""
+    return mesh.shape.get("dp", 1) if mesh is not None else 1
+
+
+def require_dp_axis(mesh, who="this mode"):
+    """Validate and return the dp axis size; raises the standard
+    "dp mesh axis" error for modes that only make sense with >1 data
+    shard (LocalSGD, explicit gradient sync)."""
+    n = dp_size(mesh)
+    if n <= 1:
+        raise ValueError(
+            "%s requires a dp mesh axis of size > 1 (got mesh %s)"
+            % (who, dict(mesh.shape) if mesh is not None else None))
+    return n
 
 
 def build_mesh(axes=None, devices=None):

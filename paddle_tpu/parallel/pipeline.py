@@ -14,6 +14,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .comms.allreduce import axis_size
+from .sharding import shard_map_manual
+
 __all__ = ["gpipe", "gpipe_sharded", "gpipe_composed"]
 
 
@@ -25,7 +28,7 @@ def gpipe(stage_fn, stage_params, x_microbatches, axis_name):
     x_microbatches: (M, ...) microbatches, identical on every device
     Returns (M, ...) outputs valid on the LAST stage device.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = x_microbatches.shape[0]
     ticks = m + n - 1
@@ -82,15 +85,11 @@ def _gpipe_global(stage_fn, stacked_params, x, mesh, axis,
         jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
         P(),
     )
+    fn = shard_map_manual(local, mesh, in_specs, P(),
+                          manual_axes=manual_axes)
     if manual_axes is None:
-        fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
-                           out_specs=P(), check_vma=False)
         outs = fn(stacked_params, xm)
     else:
-        fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
-                           out_specs=P(),
-                           axis_names=frozenset(manual_axes),
-                           check_vma=False)
         # partially-manual shard_map only traces under jit (eager
         # tracing rejects auto-axis out_specs); inside an outer jitted
         # train step this inner jit simply inlines

@@ -1,40 +1,12 @@
-"""Quantized cross-shard collectives (EQuARX-inspired, PAPERS.md:
-"Efficient Quantized AllReduce in XLA", arxiv 2506.17615).
+"""Compatibility shim — the quantized collectives grew into the
+:mod:`.comms` subsystem (parallel/comms/): block-scaled quantization
+with error feedback, the two-shot quantized allreduce, bucketed
+backward-overlap scheduling, and ``GradSyncProgram``.
 
-On a pod, LocalSGD's k-step parameter averaging is an ICI/DCN
-all-reduce whose payload is the full parameter set; int8-quantizing the
-payload cuts the bytes on the wire ~4x at the cost of a bounded
-rounding error. The TPU-native shape of the trick:
-
-1. shared symmetric scale per tensor: ``s = pmax(max|x|) / 127``
-   (one scalar all-reduce — every shard must use the SAME scale or the
-   sum is meaningless);
-2. quantize, sum as int32 over the axis (int8 payload on the wire —
-   XLA keeps the narrow type for the collective), dequantize, divide.
-
-Error bound: |pmean_int8(x) - pmean(x)| <= s/2 = pmax|x| / 254 per
-element. Opt-in (LocalSGDProgram(quantized_sync=True)): exact modes
-stay bit-exact with plain dp.
+``pmean_int8`` (the tensor-wide-scale single-shot mean LocalSGD's
+delta sync uses) lives on in :mod:`.comms.allreduce` with identical
+semantics; import it from either place.
 """
-import jax.numpy as jnp
-from jax import lax
+from .comms.allreduce import pmean_int8  # noqa: F401
 
 __all__ = ["pmean_int8"]
-
-
-def pmean_int8(x, axis_name):
-    """Mean of ``x`` over ``axis_name`` with an int8-quantized payload.
-
-    Inside shard_map/pmap. Non-float inputs and scalars fall back to
-    the exact pmean — quantizing a handful of elements saves nothing.
-    """
-    if not jnp.issubdtype(x.dtype, jnp.floating) or x.ndim == 0:
-        return lax.pmean(x, axis_name)
-    n = lax.axis_size(axis_name)
-    amax = lax.pmax(jnp.max(jnp.abs(x)).astype(jnp.float32), axis_name)
-    # all-zero tensors: keep the scale finite; the result is exactly 0
-    scale = jnp.maximum(amax, 1e-30) / 127.0
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
-                 -127, 127).astype(jnp.int8)
-    total = lax.psum(q.astype(jnp.int32), axis_name)
-    return (total.astype(jnp.float32) * (scale / n)).astype(x.dtype)

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .comms.allreduce import axis_size
+
 __all__ = ["ring_attention", "ring_attention_sharded", "full_attention"]
 
 
@@ -52,7 +54,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     d = q.shape[-1]
     t_local = q.shape[1]
     scale = scale or (d ** -0.5)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
