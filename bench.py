@@ -1293,6 +1293,125 @@ def _measure_comms(steps=10, batch=64, hidden=256, n_layers=3):
     return out
 
 
+def _measure_planner(steps=8, batch=16, seq=64):
+    """Auto-tuned lane (ISSUE 11): run the auto-parallelism planner's
+    search on the bench BERT-tiny pretrain step for the actual device
+    count, then run its top fleet-runnable pick end-to-end against the
+    dp-gspmd baseline, banking the ranked table and the chosen config
+    (gated by PADDLE_TPU_BENCH_PLAN=1)."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.analysis.cli import _bench_bert_program
+    from paddle_tpu.analysis.costs import device_profile
+    from paddle_tpu.fluid import executor as executor_mod
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import fleet as fleet_mod
+    from paddle_tpu.parallel.fleet import DistributedStrategy
+    from paddle_tpu.planner import plan_search
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"skipped": "needs >= 2 devices to plan over"}
+    device_kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    on_accel = jax.default_backend() not in ("cpu",)
+    profile = device_profile(device_kind) or device_profile("v5e")
+
+    # -- search -----------------------------------------------------------
+    prog, feed_names, fetch_names = _bench_bert_program(batch=batch,
+                                                        seq=seq)
+    result = plan_search(
+        prog, n_dev, profile=profile, feed_names=feed_names,
+        fetch_names=fetch_names, default_dim=batch,
+        # bf16 AMP is a TPU lever; the CPU lane measures what it runs
+        amp_choices=(False, True) if on_accel else (False,))
+    out = {
+        "n_devices": n_dev,
+        "device_profile": profile.name if profile else None,
+        "n_candidates": (len(result.ranked) + len(result.rejected)
+                         + len(result.unpriced)),
+        "n_rejected": len(result.rejected),
+        "ranked": [
+            {"plan": p.plan.name,
+             "predicted_step_seconds": p.predicted_step_seconds,
+             "fleet_runnable": p.plan.fleet_runnable()}
+            for p in result.ranked[:5]],
+    }
+
+    # -- run a config end-to-end -----------------------------------------
+    def run_config(strategy):
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        executor_mod._scope_stack[:] = [executor_mod.Scope()]
+        fluid.default_startup_program().random_seed = 17
+        fluid.default_main_program().random_seed = 17
+        cfg = bert.bert_tiny(seq=seq)
+        vs = bert.build_bert_pretrain(cfg, seq)
+        if strategy.tensor_parallel_degree > 1:
+            strategy.tensor_parallel_rules = bert.tp_rules()
+        fl = fleet_mod.Fleet().init()
+        opt = fl.distributed_optimizer(
+            fluid.optimizer.Adam(learning_rate=1e-4), strategy=strategy)
+        opt.minimize(vs["loss"])
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        ids, labels = bert.synthetic_batch(cfg, batch, seq)
+        feed = {"input_ids": ids, "mlm_labels": labels}
+        losses = []
+        res = exe.run(fl.main_program, feed=feed,
+                      fetch_list=[vs["loss"]])
+        losses.append(float(np.asarray(res[0])))  # compile step
+        t0 = time.time()
+        for _ in range(steps - 1):
+            res = exe.run(fl.main_program, feed=feed,
+                          fetch_list=[vs["loss"]])
+            losses.append(float(np.asarray(res[0])))
+        return {
+            "losses": [round(v, 6) for v in losses],
+            "step_seconds": round(
+                (time.time() - t0) / max(steps - 1, 1), 6),
+        }
+
+    out["baseline"] = run_config(DistributedStrategy())
+    out["baseline"]["plan"] = "dp%d (gspmd baseline)" % n_dev
+
+    # walk the ranking until a plan runs; record anything that failed
+    fallbacks = []
+    chosen = None
+    for priced in result.ranked:
+        if not priced.plan.fleet_runnable():
+            continue
+        try:
+            strategy = DistributedStrategy.from_plan(priced.plan)
+            measured = run_config(strategy)
+            chosen = priced
+            out["auto"] = measured
+            break
+        except Exception as e:  # noqa: BLE001 — fall to the next plan
+            fallbacks.append({"plan": priced.plan.name,
+                              "error": "%s: %s"
+                              % (type(e).__name__, str(e)[:160])})
+    if fallbacks:
+        out["fallbacks"] = fallbacks
+    if chosen is None:
+        out["error"] = "no fleet-runnable plan survived"
+        return out
+    out["chosen"] = chosen.plan.to_dict()
+    out["chosen_predicted_step_seconds"] = chosen.predicted_step_seconds
+    base_s = out["baseline"]["step_seconds"]
+    auto_s = out["auto"]["step_seconds"]
+    if auto_s:
+        out["speedup_vs_baseline"] = round(base_s / auto_s, 4)
+    out["loss_gap_auto_vs_baseline"] = round(
+        abs(out["auto"]["losses"][-1]
+            - out["baseline"]["losses"][-1]), 6)
+    return out
+
+
 def _bank(st, variant, cfg, on_accel, backend, device_kind):
     peak_v = _peak_flops(device_kind)
     if peak_v:
@@ -1544,6 +1663,18 @@ def child_main(status_path):
             st.flush()
         except Exception as e:  # noqa: BLE001
             st.error("comms failed: %s: %s"
+                     % (type(e).__name__, str(e)[:300]))
+
+    if os.environ.get("PADDLE_TPU_BENCH_PLAN"):
+        # auto-tuned lane (ISSUE 11): the planner searches mesh x
+        # strategy x comms for this machine's device count and its top
+        # fleet-runnable pick runs end-to-end vs the dp-gspmd baseline
+        st.stage("planner")
+        try:
+            st.data["detail"]["planner"] = _measure_planner()
+            st.flush()
+        except Exception as e:  # noqa: BLE001
+            st.error("planner failed: %s: %s"
                      % (type(e).__name__, str(e)[:300]))
 
     tel_out = os.environ.get("PADDLE_TPU_BENCH_TELEMETRY_OUT")

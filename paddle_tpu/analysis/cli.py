@@ -18,11 +18,42 @@ __all__ = ["main"]
 
 _EPILOG = """\
 exit codes:
-  0   clean (or --fail-on never)
+  0   clean (or --fail-on never); with --plan: a ranked plan exists
   1   findings — errors and warnings per --fail-on (predicted-oom is
-      an error: the program's peak live-set exceeds the device HBM)
-  2   usage error / target failed to load
+      an error: the program's peak live-set exceeds the device HBM);
+      with --plan: every candidate was rejected (nothing fits)
+  2   usage error / target failed to load / malformed --mesh
+
+plan mode:
+  --plan --devices N searches mesh factorizations of N (dp/tp/pp) x
+  DistributedStrategy settings (gspmd vs explicit comms, int8
+  quantized allreduce, bucketed overlap, ZeRO-1, AMP), prices each
+  against the --device profile (compute roofline + pipeline bubble +
+  ICI/DCN comm legs), drops predicted-OOM candidates with
+  op-attributed diagnostics, and ranks the rest by predicted step
+  seconds. TARGET may be omitted: the bench BERT pretrain program is
+  built in-process. --json-out writes a plan document that
+  DistributedStrategy.from_plan and bench.py's auto-tuned lane apply
+  directly; with --mesh the given composition is also priced against
+  the winner (suboptimal-parallel-plan finding at >=1.25x).
 """
+
+
+def _bench_bert_program(batch=8, seq=64):
+    """The default --plan target: the bench BERT-tiny pretrain step
+    (same construction as bench.py's CPU lane), built in-process so
+    ``--plan --devices N`` needs no saved model."""
+    from .. import fluid
+    from ..fluid import framework
+    from ..models import bert
+
+    prog = framework.Program()
+    startup = framework.Program()
+    with framework.program_guard(prog, startup):
+        cfg = bert.bert_tiny(seq=seq)
+        vs = bert.build_bert_pretrain(cfg, seq)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(vs["loss"])
+    return prog, ["input_ids", "mlm_labels"], [vs["loss"].name]
 
 
 def _load_target(path):
@@ -61,17 +92,34 @@ def _load_target(path):
 
 
 def _parse_mesh(spec):
-    """``"dp=8,mp=2"`` -> {"dp": 8, "mp": 2}."""
+    """``"dp=8,tp=2"`` -> {"dp": 8, "tp": 2}. Any axis name is legal
+    (dp/data/batch/sp/seq shard activations; tp/mp/pp/ep shard params —
+    see memory.shard_divisors). Raises ValueError with an actionable
+    message on malformed entries; the CLI maps that to exit 2."""
     mesh = {}
     for part in (spec or "").split(","):
         part = part.strip()
         if not part:
             continue
         axis, _, size = part.partition("=")
-        if not size:
+        axis = axis.strip()
+        if not axis or not size:
             raise ValueError(
-                "bad --mesh entry %r (want axis=size)" % part)
-        mesh[axis.strip()] = int(size)
+                "bad --mesh entry %r (want axis=size, e.g. "
+                "'dp=8,tp=2,pp=2')" % part)
+        try:
+            n = int(size)
+        except ValueError:
+            raise ValueError(
+                "bad --mesh entry %r: size %r is not an integer"
+                % (part, size.strip()))
+        if n < 1:
+            raise ValueError(
+                "bad --mesh entry %r: axis size must be >= 1" % part)
+        if axis in mesh:
+            raise ValueError(
+                "bad --mesh: axis %r given twice" % axis)
+        mesh[axis] = n
     return mesh
 
 
@@ -82,6 +130,85 @@ def _atomic_write(path, text):
     os.replace(tmp, path)
 
 
+def _run_plan(args, mesh):
+    """--plan mode: search mesh x strategy x comms and emit the ranked
+    plan document. Exit 0 when a plan exists, 1 when every candidate
+    was rejected, 2 on usage/load errors."""
+    if not args.devices or args.devices < 1:
+        print("error: --plan requires --devices N (a positive device "
+              "count to lay the mesh over)", file=sys.stderr)
+        return 2
+    is_test = False
+    state_specs = None
+    if args.target is not None:
+        try:
+            program, feed_names, fetch_names, state_specs = _load_target(
+                args.target)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print("error: cannot load %s: %s: %s"
+                  % (args.target, type(e).__name__, e), file=sys.stderr)
+            return 2
+        is_test = True  # saved models are inference programs
+        target_desc = args.target
+    else:
+        program, feed_names, fetch_names = _bench_bert_program(
+            batch=args.batch)
+        target_desc = "bench-bert-tiny (built in-process)"
+
+    from ..planner import plan_search
+    from .costs import device_profile
+
+    # a search needs SOME roofline to rank against; with no --device
+    # the v5e table row fills whatever the PADDLE_TPU_* env overrides
+    # (applied on top, as always) leave unset
+    device_defaulted = "v5e" if args.device is None else None
+    profile = device_profile(args.device or "v5e")
+
+    amp_choices = {"auto": (False, True), "on": (True,),
+                   "off": (False,)}[args.amp]
+    result = plan_search(
+        program, args.devices, profile=profile,
+        feed_names=feed_names, fetch_names=fetch_names,
+        state_specs=state_specs,
+        state_names=(set(state_specs) if state_specs is not None
+                     else None),
+        is_test=is_test, default_dim=args.batch,
+        microbatches=args.microbatches, amp_choices=amp_choices)
+    doc = {
+        "target": target_desc,
+        "devices": args.devices,
+        "plan": result.to_dict(top=args.top),
+    }
+    if device_defaulted:
+        doc["device_defaulted"] = device_defaulted
+    if mesh:
+        from .tpu_lint import lint_parallel_plan
+
+        rep = lint_parallel_plan(
+            program, mesh, n_devices=args.devices,
+            microbatches=args.microbatches, level="full",
+            search_result=result)
+        doc["mesh_check"] = rep.to_dict()
+    rendered = json.dumps(doc, sort_keys=True, indent=2)
+    if args.text:
+        print("target: %s" % target_desc)
+        print(result.render_text(top=args.top))
+        if mesh and doc.get("mesh_check", {}).get("diagnostics"):
+            for d in doc["mesh_check"]["diagnostics"]:
+                print("%s [%s] %s"
+                      % (d["severity"], d["check"], d["message"]))
+    else:
+        print(rendered)
+    if args.json_out:
+        try:
+            _atomic_write(args.json_out, rendered + "\n")
+        except OSError as e:
+            print("error: cannot write %s: %s" % (args.json_out, e),
+                  file=sys.stderr)
+            return 2
+    return 0 if result.ranked else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
@@ -89,9 +216,10 @@ def main(argv=None):
                     "inference model or Program JSON.",
         epilog=_EPILOG,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("target",
+    ap.add_argument("target", nargs="?", default=None,
                     help="save_inference_model dir, __model__ meta file, "
-                         "or Program.to_json dump")
+                         "or Program.to_json dump; optional with --plan "
+                         "(defaults to the bench BERT pretrain program)")
     ap.add_argument("--platform", choices=("tpu", "cpu"), default="tpu",
                     help="lint target platform (default: tpu — the "
                          "deployment target)")
@@ -113,8 +241,28 @@ def main(argv=None):
                          "PADDLE_TPU_HBM_BW env overrides apply")
     ap.add_argument("--mesh", default=None, metavar="AXES",
                     help="mesh axes dividing footprints, e.g. "
-                         "'dp=8,mp=2' — dp/data/batch/sp axes divide "
-                         "activations, every other axis divides params")
+                         "'dp=8,tp=2' or 'dp=2,pp=2,ep=2' — "
+                         "dp/data/batch/sp axes divide activations, "
+                         "every other axis (tp/mp/pp/ep) divides "
+                         "params; with --plan, this composition is "
+                         "priced against the search winner")
+    ap.add_argument("--plan", action="store_true",
+                    help="auto-parallelism planner: search mesh x "
+                         "strategy x comms for --devices chips and "
+                         "emit the ranked plan table (see epilog)")
+    ap.add_argument("--devices", type=int, default=None, metavar="N",
+                    help="device count the plan search targets "
+                         "(required with --plan)")
+    ap.add_argument("--microbatches", type=int, default=8, metavar="M",
+                    help="pipeline microbatches pp plans amortize "
+                         "their (pp-1)/M bubble over (default: 8)")
+    ap.add_argument("--top", type=int, default=8, metavar="K",
+                    help="ranked plans to include in the report "
+                         "(default: 8)")
+    ap.add_argument("--amp", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="AMP leg of the plan search: auto tries both "
+                         "(default); on/off pins it")
     ap.add_argument("--json-out", default=None, metavar="PATH",
                     help="also write the JSON report to PATH atomically "
                          "(tmp + rename); stdout is unchanged")
@@ -126,10 +274,24 @@ def main(argv=None):
                          "(default: findings = errors+warnings)")
     args = ap.parse_args(argv)
 
+    # malformed --mesh is a usage error with its own message — not a
+    # "cannot load target" traceback
+    try:
+        mesh = _parse_mesh(args.mesh)
+    except ValueError as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 2
+
+    if args.plan:
+        return _run_plan(args, mesh)
+
+    if args.target is None:
+        print("error: TARGET is required without --plan",
+              file=sys.stderr)
+        return 2
     try:
         program, feed_names, fetch_names, state_specs = _load_target(
             args.target)
-        mesh = _parse_mesh(args.mesh)
     except Exception as e:  # noqa: BLE001 — CLI boundary
         print("error: cannot load %s: %s: %s"
               % (args.target, type(e).__name__, e), file=sys.stderr)

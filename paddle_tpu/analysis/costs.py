@@ -24,6 +24,10 @@ pin a profile where no table entry matches (CPU smoke lanes, tests):
 - ``PADDLE_TPU_ICI_BW``     — per-chip interconnect bandwidth in
   bytes/s (the gradient-allreduce leg; see
   :func:`ring_allreduce_seconds`)
+- ``PADDLE_TPU_DCN_BW``     — per-chip CROSS-SLICE bandwidth in
+  bytes/s (the data-center network leg a multi-slice allreduce rides)
+- ``PADDLE_TPU_SLICE_CHIPS`` — chips one ICI slice can reach; groups
+  wider than this pay the DCN wire (see :func:`allreduce_bandwidth`)
 """
 import os
 
@@ -31,55 +35,81 @@ __all__ = [
     "DeviceProfile", "DEVICE_TABLE", "device_profile", "peak_flops",
     "bert_train_flops_per_token", "OpCost", "op_costs", "jaxpr_flops",
     "CostReport", "analyze_cost", "predict_program",
-    "ring_allreduce_seconds", "dp_grad_bytes", "ICI_BW_ENV",
+    "ring_allreduce_seconds", "allreduce_bandwidth",
+    "pipeline_bubble_fraction", "dp_grad_bytes", "ICI_BW_ENV",
+    "DCN_BW_ENV", "SLICE_CHIPS_ENV",
 ]
 
 PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
 HBM_BYTES_ENV = "PADDLE_TPU_HBM_BYTES"
 HBM_BW_ENV = "PADDLE_TPU_HBM_BW"
 ICI_BW_ENV = "PADDLE_TPU_ICI_BW"
+DCN_BW_ENV = "PADDLE_TPU_DCN_BW"
+SLICE_CHIPS_ENV = "PADDLE_TPU_SLICE_CHIPS"
 
 
 class DeviceProfile:
     """Roofline constants of one accelerator: bf16 peak FLOPs/s, HBM
-    capacity (bytes), HBM bandwidth (bytes/s), and per-chip ICI
+    capacity (bytes), HBM bandwidth (bytes/s), per-chip ICI
     (inter-chip interconnect) bandwidth (bytes/s — all links combined,
-    the figure a ring allreduce rides). Any field may be None
-    (unknown) — consumers skip the corresponding check/prediction."""
+    the figure a ring allreduce rides), per-chip DCN bandwidth
+    (bytes/s — what a collective pays once it crosses a slice
+    boundary), and the chip count one ICI slice tops out at. Any field
+    may be None (unknown) — consumers skip the corresponding
+    check/prediction."""
 
-    __slots__ = ("name", "peak_flops", "hbm_bytes", "hbm_bw", "ici_bw")
+    __slots__ = ("name", "peak_flops", "hbm_bytes", "hbm_bw", "ici_bw",
+                 "dcn_bw", "slice_chips")
 
     def __init__(self, name, peak_flops=None, hbm_bytes=None, hbm_bw=None,
-                 ici_bw=None):
+                 ici_bw=None, dcn_bw=None, slice_chips=None):
         self.name = name
         self.peak_flops = peak_flops
         self.hbm_bytes = hbm_bytes
         self.hbm_bw = hbm_bw
         self.ici_bw = ici_bw
+        self.dcn_bw = dcn_bw
+        self.slice_chips = slice_chips
+
+    def copy(self):
+        return DeviceProfile(self.name, self.peak_flops, self.hbm_bytes,
+                             self.hbm_bw, self.ici_bw, self.dcn_bw,
+                             self.slice_chips)
 
     def to_dict(self):
         return {"name": self.name, "peak_flops": self.peak_flops,
                 "hbm_bytes": self.hbm_bytes, "hbm_bw": self.hbm_bw,
-                "ici_bw": self.ici_bw}
+                "ici_bw": self.ici_bw, "dcn_bw": self.dcn_bw,
+                "slice_chips": self.slice_chips}
 
     def __repr__(self):
         return ("DeviceProfile(%r, peak_flops=%r, hbm_bytes=%r, "
-                "hbm_bw=%r, ici_bw=%r)"
+                "hbm_bw=%r, ici_bw=%r, dcn_bw=%r, slice_chips=%r)"
                 % (self.name, self.peak_flops, self.hbm_bytes,
-                   self.hbm_bw, self.ici_bw))
+                   self.hbm_bw, self.ici_bw, self.dcn_bw,
+                   self.slice_chips))
 
 
-# Public per-chip figures, matched by device_kind substring in order
-# (first hit wins — "v5p" must precede "v5"). bf16 peak FLOPs/s, HBM
-# bytes, HBM bytes/s, ICI bytes/s (all links per chip).
+# Public per-chip figures, matched by device_kind substring — the
+# LONGEST matching key wins ("v5p" beats "v5" regardless of row order,
+# so adding rows can never shadow existing ones). bf16 peak FLOPs/s,
+# HBM bytes, HBM bytes/s, ICI bytes/s (all links per chip), DCN
+# bytes/s per chip, max chips per ICI slice.
 DEVICE_TABLE = [
-    ("v6", DeviceProfile("v6e", 918e12, 32e9, 1640e9, 448e9)),
-    ("v5p", DeviceProfile("v5p", 459e12, 95e9, 2765e9, 600e9)),
-    ("v5e", DeviceProfile("v5e", 197e12, 16e9, 819e9, 200e9)),
-    ("v5", DeviceProfile("v5e", 197e12, 16e9, 819e9, 200e9)),
-    ("v4", DeviceProfile("v4", 275e12, 32e9, 1228e9, 300e9)),
-    ("v3", DeviceProfile("v3", 123e12, 32e9, 900e9, 82e9)),
-    ("v2", DeviceProfile("v2", 45e12, 16e9, 700e9, 62e9)),
+    ("v6", DeviceProfile("v6e", 918e12, 32e9, 1640e9, 448e9,
+                         25e9, 256)),
+    ("v5p", DeviceProfile("v5p", 459e12, 95e9, 2765e9, 600e9,
+                          25e9, 8960)),
+    ("v5e", DeviceProfile("v5e", 197e12, 16e9, 819e9, 200e9,
+                          12.5e9, 256)),
+    ("v5", DeviceProfile("v5e", 197e12, 16e9, 819e9, 200e9,
+                         12.5e9, 256)),
+    ("v4", DeviceProfile("v4", 275e12, 32e9, 1228e9, 300e9,
+                         12.5e9, 4096)),
+    ("v3", DeviceProfile("v3", 123e12, 32e9, 900e9, 82e9,
+                         6.25e9, 1024)),
+    ("v2", DeviceProfile("v2", 45e12, 16e9, 700e9, 62e9,
+                         6.25e9, 512)),
 ]
 
 
@@ -95,21 +125,25 @@ def _env_float(name):
 
 def device_profile(device_kind=None):
     """Resolve a :class:`DeviceProfile` for a jax ``device_kind`` string
-    (substring match against the table), then apply the env overrides.
-    Returns None when neither the table nor any override knows the
-    device — callers must treat that as "no prediction possible"."""
+    (substring match against the table; when several keys match, the
+    LONGEST — most specific — wins, so the result is independent of
+    table row order), then apply the env overrides. Returns None when
+    neither the table nor any override knows the device — callers must
+    treat that as "no prediction possible"."""
     prof = None
     dk = (device_kind or "").lower()
+    best_key = None
     for key, p in DEVICE_TABLE:
-        if key in dk:
-            prof = DeviceProfile(p.name, p.peak_flops, p.hbm_bytes,
-                                 p.hbm_bw, p.ici_bw)
-            break
+        if key in dk and (best_key is None or len(key) > len(best_key)):
+            best_key = key
+            prof = p.copy()
     over = {
         "peak_flops": _env_float(PEAK_FLOPS_ENV),
         "hbm_bytes": _env_float(HBM_BYTES_ENV),
         "hbm_bw": _env_float(HBM_BW_ENV),
         "ici_bw": _env_float(ICI_BW_ENV),
+        "dcn_bw": _env_float(DCN_BW_ENV),
+        "slice_chips": _env_float(SLICE_CHIPS_ENV),
     }
     if prof is None and not any(v is not None for v in over.values()):
         return None
@@ -150,6 +184,30 @@ def ring_allreduce_seconds(n_bytes, n_shards, ici_bw):
     if n < 2 or not ici_bw:
         return 0.0
     return 2.0 * (n - 1) / n * float(n_bytes) / float(ici_bw)
+
+
+def allreduce_bandwidth(profile, group_size):
+    """(bytes/s, wire) the allreduce over ``group_size`` chips rides:
+    the ICI figure while the group fits one slice, the per-chip DCN
+    figure once it spills over ``profile.slice_chips``. Falls back to
+    ICI when the DCN figure is unknown (single-slice optimism is better
+    than no prediction)."""
+    if profile is None:
+        return None, "ici"
+    n = max(1, int(group_size))
+    cap = profile.slice_chips
+    if cap and n > int(cap) and profile.dcn_bw:
+        return profile.dcn_bw, "dcn"
+    return profile.ici_bw, "ici"
+
+
+def pipeline_bubble_fraction(pp, microbatches):
+    """GPipe fill/drain overhead as a fraction of useful compute:
+    (pp-1)/microbatches. 0.0 for a single stage; with one microbatch a
+    pp-stage schedule is fully serial (fraction pp-1)."""
+    pp = max(1, int(pp))
+    m = max(1, int(microbatches or 1))
+    return float(pp - 1) / float(m)
 
 
 def dp_grad_bytes(program, env=None):
@@ -416,12 +474,20 @@ class CostReport:
                 >= self.total_bytes / p.hbm_bw else "memory")
 
     @property
+    def comm_wire(self):
+        """Which wire the gradient allreduce rides: "ici" while the dp
+        group fits one slice, "dcn" once it spills past the profile's
+        slice_chips."""
+        _, wire = allreduce_bandwidth(self.profile, self.dp_shards)
+        return wire
+
+    @property
     def predicted_comm_seconds(self):
         """Gradient-allreduce wall seconds per step over the profile's
-        ICI bandwidth. None when there is no dp group, no gradient
+        interconnect — ICI while the dp group fits one slice, DCN when
+        it crosses slices. None when there is no dp group, no gradient
         footprint, or the bandwidth is unknown."""
-        p = self.profile
-        bw = p.ici_bw if p is not None else None
+        bw, _ = allreduce_bandwidth(self.profile, self.dp_shards)
         if self.dp_shards < 2 or not self.grad_bytes or not bw:
             return None
         return ring_allreduce_seconds(self.grad_bytes, self.dp_shards, bw)
@@ -470,6 +536,7 @@ class CostReport:
                 "dp_shards": self.dp_shards,
                 "grad_bytes": round(self.grad_bytes, 1),
                 "overlap_ratio": round(self.comm_overlap_ratio, 4),
+                "wire": self.comm_wire,
             }
             c = self.predicted_comm_seconds
             if c is not None:

@@ -10,7 +10,8 @@ from ..fluid import core
 from . import walker
 from .diagnostics import INFO, PERF, WARNING, AnalysisReport
 
-__all__ = ["lint", "lint_decode_ladder"]
+__all__ = ["lint", "lint_decode_ladder", "lint_parallel_plan",
+           "SUBOPTIMAL_PLAN_SLOWDOWN"]
 
 # MXU is 128x128, VPU lanes are 8x128; a float32 tile is (8, 128)
 # (see the pallas guide) — XLA pads unaligned dims with dead lanes.
@@ -40,6 +41,10 @@ HOT_K = 5
 # below this, per-collective latency dominates and the ~3.9x wire cut
 # saves nothing worth the extra quantize/dequantize
 QUANTIZABLE_ALLREDUCE_BYTES = 1 << 16
+
+# a gated composition priced this much slower than the best
+# same-device-count plan draws the suboptimal-parallel-plan finding
+SUBOPTIMAL_PLAN_SLOWDOWN = 1.25
 
 
 def lint(program, shape_env=None, feed_names=(), fetch_names=(),
@@ -302,6 +307,74 @@ def _lint_shape_vocab(gb, feed_names, report):
                (", " + ", ".join(detail)) if detail else "",
                estimate),
             block_idx=0)
+
+
+def lint_parallel_plan(program, mesh, strategy=None, n_devices=None,
+                       device_kind=None, profile=None, level="full",
+                       microbatches=1, amp=None, feed_names=None,
+                       feed_specs=None, state_specs=None, fetch_names=(),
+                       state_names=None, is_test=False, default_dim=None,
+                       search_result=None):
+    """Price the composition a program is gated under (``mesh`` +
+    optionally its ``DistributedStrategy``) against the planner's best
+    same-device-count plan; emits a ``suboptimal-parallel-plan`` PERF
+    finding naming the better plan when the gated one is priced
+    >= ``SUBOPTIMAL_PLAN_SLOWDOWN`` slower. Off below ``full`` level —
+    the search runs one shape-propagation + a few hundred pricings, far
+    too heavy for the µs verify gate. A planner failure degrades to
+    report meta, never an exception."""
+    report = AnalysisReport(checks=["parallel_plan"])
+    if level != "full":
+        return report
+    mesh = dict(mesh or {})
+    if n_devices is None:
+        n_devices = 1
+        for s in mesh.values():
+            n_devices *= int(s)
+    if n_devices < 2:
+        return report
+    try:
+        from ..planner import plan_search, price_composition
+        from .costs import device_profile
+
+        if profile is None:
+            profile = device_profile(device_kind)
+        result = search_result
+        if result is None:
+            result = plan_search(
+                program, n_devices, profile=profile,
+                feed_names=feed_names, feed_specs=feed_specs,
+                state_specs=state_specs, fetch_names=fetch_names,
+                state_names=state_names, is_test=is_test,
+                default_dim=default_dim,
+                microbatches=max(microbatches, 8))
+        else:
+            profile = result.profile
+        current = price_composition(
+            program, mesh, strategy=strategy, profile=profile,
+            microbatches=microbatches, amp=amp, base=result.base)
+        best = result.best
+        cur_s = current.predicted_step_seconds
+        if best is None or cur_s is None:
+            return report
+        best_s = best.predicted_step_seconds
+        report.meta["parallel_plan"] = {
+            "current": current.to_dict(), "best": best.to_dict()}
+        if best_s and cur_s >= SUBOPTIMAL_PLAN_SLOWDOWN * best_s:
+            report.add(
+                PERF, "suboptimal-parallel-plan",
+                "this composition (%s) is priced %.3g s/step — %.1fx "
+                "the best same-device-count plan '%s' at %.3g s/step; "
+                "run `python -m paddle_tpu.analysis --plan --devices "
+                "%d` for the ranked table and apply the winner via "
+                "DistributedStrategy.from_plan"
+                % (current.plan.name, cur_s, cur_s / best_s,
+                   best.plan.name, best_s, n_devices),
+                block_idx=0)
+    except Exception as e:  # noqa: BLE001 — advisory pass only
+        report.meta["parallel_plan_error"] = "%s: %s" % (
+            type(e).__name__, e)
+    return report
 
 
 def lint_decode_ladder(prompt_buckets, slot_counts=(1,), cache_lens=(),

@@ -83,6 +83,47 @@ class DistributedStrategy:
         self.recompute = False
         self.recompute_checkpoints = []
 
+    @classmethod
+    def from_plan(cls, plan):
+        """Build a strategy from a planner plan — a
+        :class:`paddle_tpu.planner.ParallelPlan`, the dict its
+        ``to_dict`` emits, or a whole ``--json-out`` plan document
+        (the ``best.plan`` entry is used). Raises NotImplementedError
+        for plans the collective build cannot run (pp/ep meshes route
+        through PipelineOptimizer / the MoE path)."""
+        d = plan
+        if hasattr(d, "to_dict"):
+            d = d.to_dict()
+        if not isinstance(d, dict):
+            raise TypeError(
+                "from_plan wants a ParallelPlan or its dict, got %r"
+                % type(plan).__name__)
+        # accept the full search document too
+        if "plan" in d and isinstance(d["plan"], dict):
+            d = d["plan"]
+        if "best" in d and isinstance(d["best"], dict):
+            d = d["best"].get("plan", d["best"])
+        mesh = d.get("mesh") or {}
+        bad = [a for a in mesh if a not in ("dp", "tp", "sp")]
+        if bad:
+            raise NotImplementedError(
+                "plan %r uses mesh axes %s the fleet collective build "
+                "does not run (pp -> fluid.optimizer.PipelineOptimizer, "
+                "ep -> the MoE path); pick the search's best "
+                "fleet-runnable plan instead"
+                % (d.get("name", "?"), sorted(bad)))
+        s = cls()
+        s.tensor_parallel_degree = int(mesh.get("tp", 1))
+        s.sequence_parallel_degree = int(mesh.get("sp", 1))
+        s.grad_sync_mode = d.get("grad_sync_mode", "gspmd")
+        s.grad_quantize = bool(d.get("grad_quantize", False))
+        s.grad_quantize_block = int(d.get("grad_quantize_block", 256))
+        s.grad_bucket_bytes = int(d.get("grad_bucket_bytes", 4 << 20))
+        s.grad_overlap = bool(d.get("grad_overlap", True))
+        s.sharding_degree = int(d.get("sharding_degree", 1))
+        s.amp = bool(d.get("amp", False))
+        return s
+
 
 class RoleMakerBase:
     def __init__(self):

@@ -11,8 +11,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["build_mesh", "shrink_mesh", "dp_size", "require_dp_axis",
-           "get_default_mesh", "set_default_mesh", "P", "NamedSharding",
-           "Mesh"]
+           "factorizations", "get_default_mesh", "set_default_mesh",
+           "P", "NamedSharding", "Mesh"]
 
 _default_mesh = None
 
@@ -32,6 +32,37 @@ def require_dp_axis(mesh, who="this mode"):
             "%s requires a dp mesh axis of size > 1 (got mesh %s)"
             % (who, dict(mesh.shape) if mesh is not None else None))
     return n
+
+
+def factorizations(n_devices, axes=("dp", "tp", "pp")):
+    """Every way to lay ``n_devices`` out over the named ``axes``:
+    ordered tuples of sizes (one per axis, >= 1) whose product is the
+    device count, emitted as ``{axis: size}`` dicts with size-1 axes
+    dropped. Deterministic order (sizes enumerated ascending per axis,
+    first axis outermost) so planner candidate lists are byte-stable
+    across processes."""
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError("n_devices must be >= 1, got %d" % n)
+    axes = tuple(axes)
+    out = []
+
+    def rec(rest, i, acc):
+        if i == len(axes) - 1:
+            out.append(acc + [rest])
+            return
+        d = 1
+        while d <= rest:
+            if rest % d == 0:
+                rec(rest // d, i + 1, acc + [d])
+            d += 1
+
+    if len(axes) == 1:
+        out.append([n])
+    else:
+        rec(n, 0, [])
+    return [{a: s for a, s in zip(axes, sizes) if s > 1} or
+            {axes[0]: 1} for sizes in out]
 
 
 def build_mesh(axes=None, devices=None):

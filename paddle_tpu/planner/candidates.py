@@ -1,0 +1,88 @@
+"""Candidate enumeration: mesh factorizations x strategy variants.
+
+The mesh leg comes from :func:`parallel.mesh.factorizations` over
+(dp, tp, pp); the strategy leg mirrors exactly what ``Fleet._build``
+accepts so every emitted plan is constructible:
+
+- "gspmd" gradient sync composes with any mesh;
+- ZeRO-1 (``sharding_degree``) needs a dp axis > 1 and gspmd sync;
+- the explicit comms subsystem (bucketed fp32 / int8 block-scaled with
+  backward overlap) is pure-dp only;
+- AMP toggles independently of everything else.
+
+Model-shape constraints prune meshes that cannot be realized: tp must
+divide some dimension of every 2D+ trainable parameter (a column/row
+shard must land on whole tiles), and pp cannot exceed the number of
+sliceable layers.
+"""
+from .plan import ParallelPlan
+
+__all__ = ["enumerate_plans", "tp_compatible", "MAX_TP", "MAX_PP"]
+
+# search bounds: tp/pp beyond these never win on the model sizes this
+# framework targets and only bloat the candidate table
+MAX_TP = 16
+MAX_PP = 8
+
+
+def tp_compatible(tp, param_shapes):
+    """tp is realizable when every >=2D parameter has at least one
+    dimension divisible by tp (there is a whole-tile axis to shard)."""
+    if tp <= 1:
+        return True
+    for shape in param_shapes or ():
+        dims = [int(d) for d in shape if isinstance(d, int) and d > 0]
+        if len(dims) < 2:
+            continue
+        if not any(d % tp == 0 for d in dims):
+            return False
+    return True
+
+
+def enumerate_plans(n_devices, param_shapes=(), n_layers=None,
+                    microbatches=8, amp_choices=(False, True),
+                    max_tp=MAX_TP, max_pp=MAX_PP,
+                    grad_bucket_bytes=4 << 20, grad_quantize_block=256):
+    """All candidate :class:`ParallelPlan`s for ``n_devices``.
+
+    ``param_shapes``: trainable-parameter shapes for the tp divisibility
+    check. ``n_layers``: pipeline-sliceable layer count (pp <= this).
+    ``microbatches``: the schedule depth pp plans amortize their bubble
+    over. Deterministic emission order."""
+    from ..parallel.mesh import factorizations
+
+    plans = []
+    seen = set()
+    for mesh in factorizations(n_devices, axes=("dp", "tp", "pp")):
+        tp = mesh.get("tp", 1)
+        pp = mesh.get("pp", 1)
+        dp = mesh.get("dp", 1)
+        if tp > max_tp or not tp_compatible(tp, param_shapes):
+            continue
+        if pp > max_pp or (n_layers is not None and pp > max(1, n_layers)):
+            continue
+        mb = microbatches if pp > 1 else 1
+        variants = [dict(grad_sync_mode="gspmd")]
+        if dp > 1 and pp == 1:
+            variants.append(dict(grad_sync_mode="gspmd",
+                                 sharding_degree=dp))
+        if dp > 1 and tp == 1 and pp == 1:
+            # explicit comms sync is pure-dp (Fleet._build refuses the
+            # tp/sp composition); fp32-bucketed and int8-quantized legs
+            variants.append(dict(grad_sync_mode="comms",
+                                 grad_quantize=False,
+                                 grad_overlap=True))
+            variants.append(dict(grad_sync_mode="comms",
+                                 grad_quantize=True,
+                                 grad_overlap=True))
+        for var in variants:
+            for amp in amp_choices:
+                plan = ParallelPlan(
+                    mesh=mesh, microbatches=mb, amp=amp,
+                    grad_bucket_bytes=grad_bucket_bytes,
+                    grad_quantize_block=grad_quantize_block, **var)
+                if plan.name in seen:
+                    continue
+                seen.add(plan.name)
+                plans.append(plan)
+    return plans

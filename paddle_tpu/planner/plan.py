@@ -1,0 +1,157 @@
+"""The plan record: one candidate parallel configuration.
+
+A :class:`ParallelPlan` is the unit the planner enumerates, prices,
+ranks, and emits — a mesh shape plus the `DistributedStrategy` knobs
+that matter for step time (gradient-sync mode, quantization, bucketed
+overlap, ZeRO optimizer-state sharding, AMP) and the microbatch count
+a pipeline schedule amortizes its bubble over. Everything is plain
+ints/bools/strs so ``to_dict`` round-trips through JSON byte-stably
+(fixed key order, no floats, no process-local ids) — the fingerprint
+discipline the compile cache already follows.
+"""
+
+__all__ = ["ParallelPlan", "MESH_AXIS_ORDER"]
+
+# canonical axis emission order — mesh dicts serialize in this order so
+# two processes producing the same plan produce the same bytes
+MESH_AXIS_ORDER = ("dp", "tp", "sp", "pp", "ep")
+
+
+class ParallelPlan:
+    """One (mesh x strategy) candidate.
+
+    ``mesh`` maps axis name -> size (size-1 axes omitted); the product
+    must equal the device count the plan targets. ``grad_sync_mode``
+    mirrors ``DistributedStrategy``: "gspmd" leaves gradient allreduce
+    to the XLA partitioner, "comms" runs the explicit bucketed
+    (optionally int8 block-scaled) sync with backward overlap.
+    """
+
+    __slots__ = ("mesh", "microbatches", "grad_sync_mode",
+                 "grad_quantize", "grad_quantize_block",
+                 "grad_bucket_bytes", "grad_overlap", "sharding_degree",
+                 "amp")
+
+    def __init__(self, mesh, microbatches=1, grad_sync_mode="gspmd",
+                 grad_quantize=False, grad_quantize_block=256,
+                 grad_bucket_bytes=4 << 20, grad_overlap=True,
+                 sharding_degree=1, amp=False):
+        self.mesh = {str(a): int(s) for a, s in (mesh or {}).items()
+                     if int(s) > 1}
+        if not self.mesh:
+            self.mesh = {"dp": 1}
+        self.microbatches = max(1, int(microbatches))
+        self.grad_sync_mode = str(grad_sync_mode)
+        self.grad_quantize = bool(grad_quantize)
+        self.grad_quantize_block = int(grad_quantize_block)
+        self.grad_bucket_bytes = int(grad_bucket_bytes)
+        self.grad_overlap = bool(grad_overlap)
+        self.sharding_degree = max(1, int(sharding_degree))
+        self.amp = bool(amp)
+
+    # -- axis accessors ---------------------------------------------------
+    def axis(self, name):
+        return int(self.mesh.get(name, 1))
+
+    @property
+    def dp(self):
+        return self.axis("dp")
+
+    @property
+    def tp(self):
+        return self.axis("tp")
+
+    @property
+    def pp(self):
+        return self.axis("pp")
+
+    @property
+    def n_devices(self):
+        n = 1
+        for s in self.mesh.values():
+            n *= int(s)
+        return n
+
+    @property
+    def model_shards(self):
+        """Shards each gradient/parameter is split across (every
+        non-batch axis); the dp allreduce payload divides by this."""
+        n = 1
+        for a, s in self.mesh.items():
+            if a.lower() not in ("dp", "data", "batch", "sp", "seq"):
+                n *= int(s)
+        return n
+
+    # -- identity ---------------------------------------------------------
+    def _mesh_items(self):
+        """Mesh items in canonical order (unknown axes last, sorted)."""
+        known = [(a, self.mesh[a]) for a in MESH_AXIS_ORDER
+                 if a in self.mesh]
+        extra = sorted((a, s) for a, s in self.mesh.items()
+                       if a not in MESH_AXIS_ORDER)
+        return known + extra
+
+    @property
+    def name(self):
+        """Stable human tag: ``dp4_tp2+zero+int8+amp``."""
+        parts = ["%s%d" % (a, s) for a, s in self._mesh_items()]
+        tag = "_".join(parts)
+        if self.pp > 1:
+            tag += "_mb%d" % self.microbatches
+        if self.sharding_degree > 1:
+            tag += "+zero"
+        if self.grad_sync_mode == "comms":
+            tag += "+int8" if self.grad_quantize else "+comms"
+            if self.grad_overlap:
+                tag += "+ov"
+        if self.amp:
+            tag += "+amp"
+        return tag
+
+    def sort_key(self):
+        """Deterministic total-order tie-break for equal predictions."""
+        return self.name
+
+    def fleet_runnable(self):
+        """Whether ``Fleet._build`` accepts this plan today: the
+        collective build handles dp/tp/sp meshes; pp routes through
+        PipelineOptimizer and ep through the MoE path, so plans using
+        them are emitted for capacity planning but not auto-applied."""
+        return all(a in ("dp", "tp", "sp") for a in self.mesh)
+
+    def to_dict(self):
+        """JSON-stable dict (insertion order is the canonical order)."""
+        d = {"mesh": dict(self._mesh_items()),
+             "microbatches": self.microbatches,
+             "grad_sync_mode": self.grad_sync_mode,
+             "grad_quantize": self.grad_quantize,
+             "grad_quantize_block": self.grad_quantize_block,
+             "grad_bucket_bytes": self.grad_bucket_bytes,
+             "grad_overlap": self.grad_overlap,
+             "sharding_degree": self.sharding_degree,
+             "amp": self.amp,
+             "name": self.name,
+             "fleet_runnable": self.fleet_runnable()}
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(mesh=d.get("mesh") or {},
+                   microbatches=d.get("microbatches", 1),
+                   grad_sync_mode=d.get("grad_sync_mode", "gspmd"),
+                   grad_quantize=d.get("grad_quantize", False),
+                   grad_quantize_block=d.get("grad_quantize_block", 256),
+                   grad_bucket_bytes=d.get("grad_bucket_bytes", 4 << 20),
+                   grad_overlap=d.get("grad_overlap", True),
+                   sharding_degree=d.get("sharding_degree", 1),
+                   amp=d.get("amp", False))
+
+    def __repr__(self):
+        return "ParallelPlan(%s)" % self.name
+
+    def __eq__(self, other):
+        return (isinstance(other, ParallelPlan)
+                and self.to_dict() == other.to_dict())
+
+    def __hash__(self):
+        return hash(self.name)

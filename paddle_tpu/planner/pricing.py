@@ -1,0 +1,330 @@
+"""Plan pricing: predicted step seconds + peak HBM for one candidate.
+
+The analyzed program is treated as the GLOBAL batch of work — every
+candidate is priced on "seconds to complete the same global step", so
+predictions are comparable across meshes (a dp=8 plan runs 1/8 of the
+batch per chip, a tp=8 plan runs 1/8 of every matmul; both divide the
+single-chip roofline by 8 and differ in what they pay the wire).
+
+Legs, all from the existing passes:
+
+- compute: per-op roofline (``costs.op_costs``) summed, divided by the
+  chip count, inflated by the GPipe bubble fraction
+  ``(pp-1)/microbatches`` (``costs.pipeline_bubble_fraction``);
+- dp gradient allreduce: ``costs.ring_allreduce_seconds`` over the
+  fp32 (or int8 block-scaled — ``comms.quantize.compression_ratio``)
+  payload, on ICI while the job fits one slice and on DCN past
+  ``DeviceProfile.slice_chips`` (``costs.allreduce_bandwidth``),
+  discounted by the bucketed backward-overlap ratio
+  (``comms.bucketing.plan_buckets``);
+- tp activation allreduce: per-layer output traffic over the tp group
+  (Megatron-style — per-chip volume roughly constant in tp);
+- pp boundary sends: stage-boundary activations, point-to-point;
+- memory: ``memory.estimate`` under ``shard_divisors`` of the plan's
+  mesh, with ZeRO-1 deducting the dp-sharded optimizer-state slice and
+  AMP halving activation residency. Over-budget plans carry an
+  op-attributed rejection instead of a rank.
+
+AMP constants are deliberately coarse (bf16 matmul speedup, halved
+activation traffic) — the planner needs ORDERING fidelity, not
+absolute accuracy; the dryrun-zoo test asserts exactly that.
+"""
+from ..analysis import costs as costs_mod
+from ..analysis import memory as memory_mod
+
+__all__ = ["ProgramBase", "build_base", "price_plan", "PricedPlan",
+           "AMP_COMPUTE_SPEEDUP", "AMP_BYTES_FACTOR",
+           "AMP_ACT_MEM_FACTOR", "TP_BWD_COMM_MULT",
+           "GSPMD_OVERLAP_RATIO"]
+
+# bf16 matmul throughput over fp32 (MXU runs both, fp32 at half rate
+# conservatively) and the HBM-traffic cut from half-width activations
+AMP_COMPUTE_SPEEDUP = 1.5
+AMP_BYTES_FACTOR = 0.6
+# AMP halves activation residency at the liveness peak (params stay
+# fp32 master copies)
+AMP_ACT_MEM_FACTOR = 0.5
+# tp comm volume: one output allreduce forward + two backward
+TP_BWD_COMM_MULT = 3.0
+# the XLA partitioner schedules collectives itself; we price its
+# overlap conservatively at zero so the explicit comms subsystem's
+# measured bucketed overlap is an honest advantage, not a wash
+GSPMD_OVERLAP_RATIO = 0.0
+
+
+class ProgramBase:
+    """One-time program analysis every candidate shares: the per-op
+    cost table, gradient footprint, trainable-parameter layout, and a
+    memoized ``memory.estimate`` per shard layout."""
+
+    def __init__(self, program, env, per_op, grad_bytes, param_shapes,
+                 state_total_bytes, feed_specs=None, state_specs=None,
+                 fetch_names=(), state_names=None, default_dim=None):
+        self.program = program
+        self.env = env
+        self.per_op = list(per_op)
+        self.grad_bytes = float(grad_bytes)
+        self.param_shapes = list(param_shapes)  # [(name, shape)] fwd order
+        self.state_total_bytes = float(state_total_bytes)
+        self.feed_specs = feed_specs
+        self.state_specs = state_specs
+        self.fetch_names = fetch_names
+        self.state_names = state_names
+        self.default_dim = default_dim
+        self.total_flops = float(sum(c.flops for c in self.per_op))
+        self.total_bytes = float(sum(c.bytes for c in self.per_op))
+        # forward MXU-ish output traffic (tp allreduce / pp boundary leg)
+        self.mxu_out_bytes = 0.0
+        self.n_heavy_ops = 0
+        for c in self.per_op:
+            if c.op_type == "backward" or not c.flops or c.op is None:
+                continue
+            out_b = sum(
+                costs_mod._spec_nbytes(env[n])
+                for ns in c.op.outputs.values() for n in ns if n in env)
+            if c.flops >= 2.0 * max(out_b, 1.0):
+                # contraction-like (matmul/conv): the ops tp shards
+                self.mxu_out_bytes += out_b
+                self.n_heavy_ops += 1
+        self._mem_cache = {}
+        self._roofline_cache = {}
+
+    def roofline_seconds(self, profile, amp=False):
+        """Single-chip roofline step seconds under ``profile`` with the
+        AMP adjustment applied per op (memoized per (profile id, amp))."""
+        if profile is None or (not profile.peak_flops
+                               and not profile.hbm_bw):
+            return None
+        key = (id(profile), bool(amp))
+        if key in self._roofline_cache:
+            return self._roofline_cache[key]
+        fl_div = (profile.peak_flops or 0.0) * (
+            AMP_COMPUTE_SPEEDUP if amp else 1.0)
+        by_fac = AMP_BYTES_FACTOR if amp else 1.0
+        t = 0.0
+        for c in self.per_op:
+            legs = []
+            if fl_div:
+                legs.append(c.flops / fl_div)
+            if profile.hbm_bw:
+                legs.append(c.bytes * by_fac / profile.hbm_bw)
+            t += max(legs)
+        self._roofline_cache[key] = t
+        return t
+
+    def memory_report(self, param_shards, act_shards):
+        key = (int(param_shards), int(act_shards))
+        if key not in self._mem_cache:
+            self._mem_cache[key] = memory_mod.estimate(
+                self.program, env=self.env, feed_specs=self.feed_specs,
+                state_specs=self.state_specs,
+                fetch_names=self.fetch_names,
+                state_names=self.state_names,
+                default_dim=self.default_dim,
+                param_shards=key[0], act_shards=key[1])
+        return self._mem_cache[key]
+
+
+def build_base(program, feed_names=None, feed_specs=None,
+               state_specs=None, fetch_names=(), state_names=None,
+               is_test=False, platform="cpu", default_dim=None):
+    """Analyze ``program`` once (shape propagation + per-op costing +
+    gradient/parameter footprints) into a :class:`ProgramBase`."""
+    from ..analysis import shapes
+
+    if feed_specs is None and feed_names:
+        feed_specs = shapes.feed_specs_from_program(
+            program, feed_names=list(feed_names), default_dim=default_dim)
+    env, _ = shapes.propagate(
+        program, feed_specs=feed_specs, state_specs=state_specs,
+        is_test=is_test, platform=platform, default_dim=default_dim,
+        check_declared=False)
+    per_op = costs_mod.op_costs(program, env, is_test=is_test,
+                                platform=platform)
+    grad_bytes = costs_mod.dp_grad_bytes(program, env)
+    gb = program.global_block()
+    param_shapes = []
+    for p in gb.all_parameters():
+        if not getattr(p, "trainable", True):
+            continue
+        shape = tuple(getattr(p, "shape", ()) or ())
+        if shape and all(isinstance(d, int) and d > 0 for d in shape):
+            param_shapes.append((p.name, shape))
+    sizes = memory_mod.sizes_from(program, env=env, feed_specs=feed_specs,
+                                  state_specs=state_specs,
+                                  default_dim=default_dim)
+    if state_names is None:
+        persist = {n for n, v in gb.vars.items() if v.persistable}
+    else:
+        persist = set(state_names)
+    state_total = float(sum(sizes[n] for n in persist if n in sizes))
+    return ProgramBase(program, env, per_op, grad_bytes, param_shapes,
+                       state_total, feed_specs=feed_specs,
+                       state_specs=state_specs, fetch_names=fetch_names,
+                       state_names=state_names, default_dim=default_dim)
+
+
+class PricedPlan:
+    """One candidate with its predicted legs; ``rejected`` is None for
+    rankable plans or an op-attributed diagnostic dict for plans the
+    HBM budget excludes."""
+
+    __slots__ = ("plan", "predicted_step_seconds", "compute_seconds",
+                 "bubble_fraction", "dp_comm_seconds",
+                 "exposed_comm_seconds", "comm_wire", "overlap_ratio",
+                 "tp_comm_seconds", "pp_comm_seconds", "predicted_mfu",
+                 "scaling_efficiency", "peak_hbm_bytes", "hbm_budget",
+                 "rejected")
+
+    def __init__(self, plan, **kw):
+        self.plan = plan
+        for k in self.__slots__[1:]:
+            setattr(self, k, kw.get(k))
+
+    def to_dict(self):
+        def f6(x):
+            return None if x is None else float("%.6g" % x)
+
+        d = {"plan": self.plan.to_dict(),
+             "predicted_step_seconds": f6(self.predicted_step_seconds),
+             "compute_seconds": f6(self.compute_seconds),
+             "bubble_fraction": f6(self.bubble_fraction),
+             "dp_comm_seconds": f6(self.dp_comm_seconds),
+             "exposed_comm_seconds": f6(self.exposed_comm_seconds),
+             "comm_wire": self.comm_wire,
+             "overlap_ratio": f6(self.overlap_ratio),
+             "tp_comm_seconds": f6(self.tp_comm_seconds),
+             "pp_comm_seconds": f6(self.pp_comm_seconds),
+             "predicted_mfu": f6(self.predicted_mfu),
+             "scaling_efficiency": f6(self.scaling_efficiency),
+             "peak_hbm_bytes": (None if self.peak_hbm_bytes is None
+                                else int(self.peak_hbm_bytes)),
+             "hbm_budget": (None if self.hbm_budget is None
+                            else int(self.hbm_budget))}
+        if self.rejected is not None:
+            d["rejected"] = self.rejected
+        return d
+
+
+def price_plan(base, plan, profile, hbm_budget=None):
+    """Price one :class:`ParallelPlan` against a ``DeviceProfile``;
+    returns a :class:`PricedPlan` (rejected when over the HBM budget)."""
+    n_dev = plan.n_devices
+    dp = plan.dp
+    tp = plan.tp
+    pp = plan.pp
+
+    # -- compute leg ------------------------------------------------------
+    single = base.roofline_seconds(profile, amp=plan.amp)
+    compute_s = None
+    bubble = costs_mod.pipeline_bubble_fraction(pp, plan.microbatches)
+    if single is not None:
+        compute_s = single / float(n_dev) * (1.0 + bubble)
+
+    # -- dp gradient allreduce -------------------------------------------
+    dp_comm_s = exposed_s = None
+    overlap_ratio = 0.0
+    bw, wire = costs_mod.allreduce_bandwidth(profile, n_dev)
+    if dp > 1 and base.grad_bytes and bw:
+        grad_elems = base.grad_bytes / 4.0 / float(plan.model_shards)
+        payload = grad_elems * 4.0
+        if plan.grad_sync_mode == "comms" and plan.grad_quantize:
+            from ..parallel.comms.quantize import (compression_ratio,
+                                                   round_up)
+
+            padded = round_up(max(int(grad_elems), 1),
+                              plan.grad_quantize_block)
+            payload = padded * 4.0 / compression_ratio(
+                padded, plan.grad_quantize_block)
+        dp_comm_s = costs_mod.ring_allreduce_seconds(payload, dp, bw)
+        if plan.grad_sync_mode == "comms" and plan.grad_overlap:
+            from ..parallel.comms.bucketing import plan_buckets
+
+            shard = max(1, plan.model_shards)
+            named = [(n, (max(1, int(_numel(s)) // shard),))
+                     for n, s in base.param_shapes]
+            if named:
+                overlap_ratio = plan_buckets(
+                    named, plan.grad_bucket_bytes).overlap_ratio(True)
+        else:
+            overlap_ratio = GSPMD_OVERLAP_RATIO
+        exposed_s = dp_comm_s * (1.0 - overlap_ratio)
+    elif dp > 1 and base.grad_bytes:
+        wire = None  # no bandwidth figure: comm leg unpredictable
+
+    # -- tp activation allreduce -----------------------------------------
+    tp_comm_s = None
+    if tp > 1 and profile is not None and profile.ici_bw:
+        act_bytes = base.mxu_out_bytes * TP_BWD_COMM_MULT / float(max(dp, 1))
+        tp_comm_s = costs_mod.ring_allreduce_seconds(
+            act_bytes, tp, profile.ici_bw)
+
+    # -- pp boundary point-to-point --------------------------------------
+    pp_comm_s = None
+    if pp > 1 and profile is not None and profile.ici_bw:
+        boundary = (base.mxu_out_bytes / float(max(base.n_heavy_ops, 1))
+                    / float(max(dp, 1)))
+        pp_comm_s = 2.0 * (pp - 1) * boundary / profile.ici_bw
+
+    total = None
+    if compute_s is not None:
+        total = compute_s
+        for leg in (exposed_s, tp_comm_s, pp_comm_s):
+            if leg:
+                total += leg
+
+    mfu = eff = None
+    if total and profile is not None and profile.peak_flops:
+        mfu = (base.total_flops / float(n_dev)) / (
+            total * profile.peak_flops)
+    if total and compute_s:
+        eff = compute_s / total
+
+    # -- memory gate ------------------------------------------------------
+    param_shards, act_shards = memory_mod.shard_divisors(plan.mesh)
+    mem = base.memory_report(param_shards, act_shards)
+    peak = float(mem.peak_bytes)
+    if plan.sharding_degree > 1 and dp > 1:
+        opt_state = max(0.0, base.state_total_bytes - base.grad_bytes)
+        sharded_opt = opt_state / float(max(param_shards, 1))
+        peak -= sharded_opt * (1.0 - 1.0 / float(dp))
+    if plan.amp:
+        peak -= mem.act_bytes_at_peak * (1.0 - AMP_ACT_MEM_FACTOR)
+    peak = max(peak, 0.0)
+    budget = hbm_budget
+    if budget is None and profile is not None:
+        budget = profile.hbm_bytes
+    rejected = None
+    if budget and peak > budget:
+        rejected = {
+            "reason": "predicted-oom",
+            "peak_bytes": int(peak),
+            "hbm_bytes": int(budget),
+            "peak_op_index": mem.peak_op_index,
+            "peak_op_type": mem.peak_op_type,
+            "top_residents": [
+                {"name": n, "bytes": int(b)} for n, b in mem.top[:3]],
+        }
+    return PricedPlan(
+        plan,
+        predicted_step_seconds=total,
+        compute_seconds=compute_s,
+        bubble_fraction=bubble,
+        dp_comm_seconds=dp_comm_s,
+        exposed_comm_seconds=exposed_s,
+        comm_wire=(wire if dp > 1 and dp_comm_s is not None else None),
+        overlap_ratio=overlap_ratio,
+        tp_comm_seconds=tp_comm_s,
+        pp_comm_seconds=pp_comm_s,
+        predicted_mfu=mfu,
+        scaling_efficiency=eff,
+        peak_hbm_bytes=peak,
+        hbm_budget=budget,
+        rejected=rejected)
+
+
+def _numel(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n

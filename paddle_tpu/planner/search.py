@@ -1,0 +1,166 @@
+"""Plan search: enumerate -> price -> gate on HBM -> rank.
+
+``plan_search`` is the subsystem's front door: hand it a Program (or
+let the CLI build the bench BERT pretrain target), a device count, and
+a :class:`DeviceProfile`, and it returns a :class:`PlanSearchResult`
+whose ``ranked`` list is ordered by predicted step seconds (ties break
+on the plan's stable name so two processes always emit identical
+JSON) and whose ``rejected`` list carries op-attributed predicted-OOM
+diagnostics for every candidate the HBM budget excluded.
+"""
+from .candidates import enumerate_plans
+from .plan import ParallelPlan
+from .pricing import build_base, price_plan
+
+__all__ = ["PlanSearchResult", "plan_search", "price_composition"]
+
+
+class PlanSearchResult:
+    """Ranked candidates + exclusions for one (program, devices,
+    profile) search."""
+
+    def __init__(self, n_devices, profile, ranked, rejected,
+                 unpriced, base):
+        self.n_devices = int(n_devices)
+        self.profile = profile
+        self.ranked = list(ranked)      # PricedPlan, best first
+        self.rejected = list(rejected)  # PricedPlan with .rejected set
+        self.unpriced = list(unpriced)  # PricedPlan with no prediction
+        self.base = base
+
+    @property
+    def best(self):
+        return self.ranked[0] if self.ranked else None
+
+    def best_runnable(self):
+        """Best plan ``Fleet._build`` accepts today (dp/tp/sp mesh)."""
+        for pp in self.ranked:
+            if pp.plan.fleet_runnable():
+                return pp
+        return None
+
+    def to_dict(self, top=None):
+        ranked = self.ranked if top is None else self.ranked[:top]
+        d = {
+            "n_devices": self.n_devices,
+            "device": (self.profile.to_dict()
+                       if self.profile is not None else None),
+            "n_candidates": (len(self.ranked) + len(self.rejected)
+                             + len(self.unpriced)),
+            "n_rejected": len(self.rejected),
+            "n_unpriced": len(self.unpriced),
+            "ranked": [p.to_dict() for p in ranked],
+            "rejected": [p.to_dict() for p in self.rejected],
+        }
+        if self.best is not None:
+            d["best"] = self.best.to_dict()
+        return d
+
+    def render_text(self, top=10):
+        """Human table: rank, plan, predicted legs."""
+        lines = ["plan search: %d candidates over %d devices "
+                 "(%d OOM-rejected, %d unpriced)"
+                 % (len(self.ranked) + len(self.rejected)
+                    + len(self.unpriced),
+                    self.n_devices, len(self.rejected),
+                    len(self.unpriced))]
+        if self.profile is not None:
+            lines.append("device: %s" % self.profile.name)
+        hdr = ("  %-4s %-28s %12s %10s %10s %8s"
+               % ("rank", "plan", "step_s", "compute_s", "comm_s",
+                  "peak_GB"))
+        lines.append(hdr)
+        for i, p in enumerate(self.ranked[:top], 1):
+            comm = sum(x for x in (p.exposed_comm_seconds,
+                                   p.tp_comm_seconds,
+                                   p.pp_comm_seconds) if x)
+            lines.append(
+                "  %-4d %-28s %12.4g %10.4g %10.4g %8.2f"
+                % (i, p.plan.name, p.predicted_step_seconds or 0.0,
+                   p.compute_seconds or 0.0, comm,
+                   (p.peak_hbm_bytes or 0) / 1e9))
+        for p in self.rejected[:max(0, top - len(self.ranked))]:
+            rej = p.rejected or {}
+            lines.append(
+                "  OOM  %-28s peak %.2f GB > %.2f GB at op %s '%s'"
+                % (p.plan.name, rej.get("peak_bytes", 0) / 1e9,
+                   rej.get("hbm_bytes", 0) / 1e9,
+                   rej.get("peak_op_index"), rej.get("peak_op_type")))
+        return "\n".join(lines)
+
+
+def plan_search(program, n_devices, device_kind=None, profile=None,
+                feed_names=None, feed_specs=None, state_specs=None,
+                fetch_names=(), state_names=None, is_test=False,
+                platform="cpu", default_dim=None, microbatches=8,
+                amp_choices=(False, True), hbm_budget=None,
+                max_tp=None, max_pp=None, base=None):
+    """Search mesh x strategy x comms for ``program`` on ``n_devices``
+    chips of ``device_kind`` (or an explicit ``profile``). Returns a
+    :class:`PlanSearchResult`."""
+    from ..analysis.costs import device_profile
+    from . import candidates as cand_mod
+
+    if profile is None:
+        profile = device_profile(device_kind)
+    if base is None:
+        base = build_base(
+            program, feed_names=feed_names, feed_specs=feed_specs,
+            state_specs=state_specs, fetch_names=fetch_names,
+            state_names=state_names, is_test=is_test, platform=platform,
+            default_dim=default_dim)
+    n_layers = max(1, base.n_heavy_ops // 2)
+    plans = enumerate_plans(
+        n_devices,
+        param_shapes=[s for _, s in base.param_shapes],
+        n_layers=n_layers, microbatches=microbatches,
+        amp_choices=amp_choices,
+        max_tp=max_tp if max_tp is not None else cand_mod.MAX_TP,
+        max_pp=max_pp if max_pp is not None else cand_mod.MAX_PP)
+    ranked, rejected, unpriced = [], [], []
+    for plan in plans:
+        priced = price_plan(base, plan, profile, hbm_budget=hbm_budget)
+        if priced.rejected is not None:
+            rejected.append(priced)
+        elif priced.predicted_step_seconds is None:
+            unpriced.append(priced)
+        else:
+            ranked.append(priced)
+    ranked.sort(key=lambda p: (p.predicted_step_seconds,
+                               p.plan.sort_key()))
+    rejected.sort(key=lambda p: p.plan.sort_key())
+    unpriced.sort(key=lambda p: p.plan.sort_key())
+    return PlanSearchResult(n_devices, profile, ranked, rejected,
+                            unpriced, base)
+
+
+def price_composition(program, mesh, strategy=None, device_kind=None,
+                      profile=None, microbatches=1, amp=None,
+                      base=None, **base_kw):
+    """Price ONE composition — a mesh dict plus (optionally) the
+    ``DistributedStrategy`` gating it — without running the search.
+    Used by the dryrun-zoo validation test and the
+    ``suboptimal-parallel-plan`` lint."""
+    from ..analysis.costs import device_profile
+
+    if profile is None:
+        profile = device_profile(device_kind)
+    if base is None:
+        base = build_base(program, **base_kw)
+    kw = {}
+    if strategy is not None:
+        kw = dict(
+            grad_sync_mode=getattr(strategy, "grad_sync_mode", "gspmd"),
+            grad_quantize=getattr(strategy, "grad_quantize", False),
+            grad_quantize_block=getattr(strategy, "grad_quantize_block",
+                                        256),
+            grad_bucket_bytes=getattr(strategy, "grad_bucket_bytes",
+                                      4 << 20),
+            grad_overlap=getattr(strategy, "grad_overlap", True),
+            sharding_degree=getattr(strategy, "sharding_degree", 1),
+        )
+        if amp is None:
+            amp = getattr(strategy, "amp", False)
+    plan = ParallelPlan(mesh=mesh, microbatches=microbatches,
+                        amp=bool(amp), **kw)
+    return price_plan(base, plan, profile)

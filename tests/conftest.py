@@ -40,6 +40,12 @@ def pytest_configure(config):
         "chaos: serving-fleet kill/brownout drills (replica SIGKILL, "
         "fault-site drills); `pytest -m chaos` is the lane "
         "bench_experiments/chaos_serving_lane.sh runs")
+    config.addinivalue_line(
+        "markers",
+        "planner: auto-parallelism planner tests (paddle_tpu.planner "
+        "search/pricing/CLI); `pytest -m planner` is the slice "
+        "bench_experiments/planner_lane.sh runs under the jax "
+        "version matrix")
 
 
 @pytest.fixture(autouse=True)

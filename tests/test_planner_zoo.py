@@ -1,0 +1,214 @@
+"""Planner validation on the 8-device dryrun zoo: predicted step-time
+ORDERING must match measured ordering (rank correlation, not absolute
+error — the cost model prices a TPU roofline, the measurement runs on
+8 virtual CPU devices, but both track the same work).
+
+Each composition mirrors a phase of ``__graft_entry__.dryrun_multichip``:
+BERT-tiny pretrain on dp4 x tp2, GPT-tiny causal LM on dp4 x tp2, the
+Wide&Deep vocab-sharded CTR model on dp4 x mp2, and the small-fc ZeRO-1
+fleet program on dp8. The models span ~3 orders of magnitude of per-step
+work, so ordering is robust to CPU timing noise; we still take the min
+of several steady-state steps and allow one adjacent swap (Spearman
+rho >= 0.6) plus exact top-1 (slowest composition) agreement.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.analysis.costs import DeviceProfile
+from paddle_tpu.planner import price_composition
+
+pytestmark = [pytest.mark.planner, pytest.mark.slow]
+
+# a CPU-ish roofline: absolute numbers are irrelevant (both columns are
+# only compared by rank); ici_bw is set high so the virtual-device
+# "interconnect" (memcpy) doesn't dominate the prediction either
+CPU_PROFILE = DeviceProfile("cpu-zoo", peak_flops=5e9, hbm_bw=20e9,
+                            ici_bw=1e12)
+
+WARMUP_STEPS = 2
+TIMED_STEPS = 3
+
+
+def _measure(run_step):
+    for _ in range(WARMUP_STEPS):
+        run_step()
+    best = None
+    for _ in range(TIMED_STEPS):
+        t0 = time.perf_counter()
+        run_step()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _spearman(xs, ys):
+    def ranks(vs):
+        order = sorted(range(len(vs)), key=lambda i: vs[i])
+        r = [0] * len(vs)
+        for rank, i in enumerate(order):
+            r[i] = rank
+        return r
+
+    rx, ry = ranks(xs), ranks(ys)
+    n = len(xs)
+    d2 = sum((a - b) ** 2 for a, b in zip(rx, ry))
+    return 1.0 - 6.0 * d2 / (n * (n * n - 1))
+
+
+def _price(mesh, feed_names, strategy=None):
+    priced = price_composition(
+        fluid.default_main_program(), mesh, strategy=strategy,
+        profile=CPU_PROFILE, feed_names=feed_names, default_dim=16)
+    assert priced.rejected is None
+    return priced.predicted_step_seconds
+
+
+def _zoo_bert():
+    """dryrun phase 1: BERT-tiny pretrain, dp=4 x tp=2."""
+    import jax
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel.mesh import build_mesh
+    from paddle_tpu.parallel.sharding import (DistributedProgram,
+                                              ShardingRule)
+
+    seq, batch = 64, 16
+    cfg = bert.bert_tiny(seq=seq)
+    vs = bert.build_bert_pretrain(cfg, seq)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(vs["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = build_mesh({"dp": 4, "tp": 2}, devices=jax.devices()[:8])
+    dist = DistributedProgram(
+        fluid.default_main_program(), mesh,
+        param_rules=[ShardingRule(p, s) for p, s in bert.tp_rules()],
+        feed_axis="dp")
+    ids, labels = bert.synthetic_batch(cfg, batch, seq)
+    feed = {"input_ids": ids, "mlm_labels": labels}
+
+    def step():
+        exe.run(dist, feed=feed, fetch_list=[vs["loss"]])
+
+    return step, {"dp": 4, "tp": 2}, ["input_ids", "mlm_labels"], None
+
+
+def _zoo_gpt():
+    """dryrun phase 2.95: GPT-tiny causal LM, dp=4 x tp=2."""
+    import jax
+    from paddle_tpu.models import gpt
+    from paddle_tpu.parallel.mesh import build_mesh
+    from paddle_tpu.parallel.sharding import (DistributedProgram,
+                                              ShardingRule)
+
+    cfg = gpt.gpt_tiny(vocab=96, max_len=32)
+    vs = gpt.build_gpt_lm(cfg, 16)
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(vs["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = build_mesh({"dp": 4, "tp": 2}, devices=jax.devices()[:8])
+    dist = DistributedProgram(
+        fluid.default_main_program(), mesh,
+        param_rules=[ShardingRule(p, s) for p, s in gpt.tp_rules()],
+        feed_axis="dp")
+    ids, labels = gpt.synthetic_lm_batch(cfg, 16, 16)
+    feed = {"gpt_ids": ids, "gpt_labels": labels}
+
+    def step():
+        exe.run(dist, feed=feed, fetch_list=[vs["loss"]])
+
+    return step, {"dp": 4, "tp": 2}, ["gpt_ids", "gpt_labels"], None
+
+
+def _zoo_wide_deep():
+    """dryrun phase 2.7: Wide&Deep vocab-sharded embedding, dp=4 x mp=2."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.models import wide_deep
+    from paddle_tpu.parallel.mesh import build_mesh
+    from paddle_tpu.parallel.sharding import (DistributedProgram,
+                                              ShardingRule)
+
+    vs = wide_deep.build_wide_deep(
+        num_sparse_fields=6, sparse_vocab=1024, emb_dim=8,
+        num_dense=4, hidden=[16, 16])
+    fluid.optimizer.Adam(5e-3).minimize(vs["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = build_mesh({"dp": 4, "mp": 2}, devices=jax.devices()[:8])
+    dist = DistributedProgram(
+        fluid.default_main_program(), mesh,
+        param_rules=[ShardingRule(r"ctr_emb", P("mp", None)),
+                     ShardingRule(r"ctr_wide_emb", P("mp", None))],
+        feed_axis="dp")
+    dense, sparse, label = wide_deep.synthetic_ctr_batch(
+        16, num_sparse_fields=6, sparse_vocab=1024, num_dense=4)
+    feed = {"dense": dense, "sparse": sparse, "ctr_label": label}
+
+    def step():
+        exe.run(dist, feed=feed, fetch_list=[vs["loss"]])
+
+    return (step, {"dp": 4, "mp": 2},
+            ["dense", "sparse", "ctr_label"], None)
+
+
+def _zoo_fc_zero():
+    """dryrun phase 2.5: small-fc ZeRO-1 fleet program, dp=8."""
+    from paddle_tpu.parallel import fleet as fleet_mod
+
+    x = fluid.data("zoo_x", [None, 64], dtype="float32")
+    y = fluid.data("zoo_y", [None, 1], dtype="float32")
+    h = fluid.layers.fc(x, size=64, act="relu")
+    p = fluid.layers.fc(h, size=1)
+    loss = fluid.layers.reduce_mean(fluid.layers.square_error_cost(p, y))
+    strategy = fleet_mod.DistributedStrategy()
+    strategy.sharding_degree = 2
+    fl = fleet_mod.Fleet().init()
+    fl.distributed_optimizer(
+        fluid.optimizer.Adam(learning_rate=5e-3), strategy).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.default_rng(11)
+    feed = {"zoo_x": rng.normal(size=(16, 64)).astype(np.float32),
+            "zoo_y": rng.normal(size=(16, 1)).astype(np.float32)}
+    prog = fl.main_program
+
+    def step():
+        exe.run(prog, feed=feed, fetch_list=[loss])
+
+    return step, {"dp": 8}, ["zoo_x", "zoo_y"], strategy
+
+
+ZOO = [("bert_dp4_tp2", _zoo_bert),
+       ("gpt_dp4_tp2", _zoo_gpt),
+       ("widedeep_dp4_mp2", _zoo_wide_deep),
+       ("fc_zero_dp8", _zoo_fc_zero)]
+
+
+def test_predicted_ordering_matches_measured(_fresh_programs):
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid import executor as executor_mod
+
+    names, measured, predicted = [], [], []
+    for name, build in ZOO:
+        # each composition gets the dryrun's fresh-programs treatment
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        executor_mod._scope_stack[:] = [executor_mod.Scope()]
+        framework.default_startup_program().random_seed = 7
+        step, mesh, feed_names, strategy = build()
+        pred = _price(mesh, feed_names, strategy=strategy)
+        meas = _measure(step)
+        names.append(name)
+        predicted.append(pred)
+        measured.append(meas)
+
+    pairs = sorted(zip(names, measured, predicted), key=lambda t: t[1])
+    detail = ", ".join("%s meas=%.4gs pred=%.4gs" % t for t in pairs)
+    rho = _spearman(measured, predicted)
+    assert rho >= 0.6, "rank correlation %.2f too low: %s" % (rho, detail)
+    # the heavyweight composition must be identified exactly
+    assert (max(zip(measured, names))[1]
+            == max(zip(predicted, names))[1]), detail
