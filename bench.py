@@ -1195,6 +1195,224 @@ def _measure_decode_serving(n_clients=8, requests_per_client=3,
     }
 
 
+def _measure_disagg_serving(latency_clients=6, long_clients=2,
+                            requests_per_client=3, max_new=16):
+    """Disaggregated-serving lane (ISSUE 12): the same mixed-tenant
+    load against a colocated DecodeEngine (prefill and step share one
+    dispatch loop) and a 2-prefill + 2-decode disagg fleet over the
+    int8 KV wire — recording the latency tenant's per-token p50/p99
+    for both (the disagg legs must hold that tenant's per-token SLO
+    with long bulk prompts in the mix AND through a replica kill),
+    aggregate tokens/s, the int8-resident slot economics at an equal
+    HBM budget, and a mid-run decode-replica kill that every live
+    stream must survive via re-prefill migration with zero failures
+    (gated by PADDLE_TPU_BENCH_DISAGG=1)."""
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving.decode import kv_slot_bytes
+    from paddle_tpu.serving.disagg import (
+        TenantSpec, TenantTable, disagg_fleet, handoff_compression,
+    )
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 9
+    cfg = gpt.gpt_tiny(vocab=97, max_len=128)
+    vs = gpt.build_gpt_lm(cfg, 16)
+    fluid.optimizer.Adam(5e-3).minimize(vs["loss"])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    ids, labels = gpt.synthetic_lm_batch(cfg, 16, 16)
+    for _ in range(10):
+        exe.run(feed={"gpt_ids": ids, "gpt_labels": labels},
+                fetch_list=[vs["loss"]])
+
+    cache_len, buckets = 96, (8, 96)
+    long_len, long_new = 90, 6   # 90 + 6 - 1 <= 96: bucket-96 prefills
+    latency_lens = (3, 5, 6)
+    rng = np.random.default_rng(0)
+    prompts = {n: rng.integers(1, cfg.vocab, n).astype("int64")
+               for n in latency_lens + (long_len,)}
+
+    def drive(submit, chaos=None, expect_tokens=1):
+        """Run the mixed-tenant load against one `submit` callable;
+        `chaos` (if given) fires once ~50% of the expected tokens have
+        streamed. Returns (per-tenant inter-token gaps, errors, wall,
+        tokens)."""
+        gaps = {"latency": [], "bulk": []}
+        errors, lock = [], threading.Lock()
+        done_tokens = [0]
+
+        def client(tenant, plen, n_new, rounds):
+            for _ in range(rounds):
+                try:
+                    h = submit(prompts[plen], n_new, tenant)
+                    times = [time.monotonic()]
+                    n = 0
+                    for _tok in h.tokens(timeout=180):
+                        times.append(time.monotonic())
+                        n += 1
+                    if n != n_new:
+                        raise RuntimeError(
+                            "stream delivered %d/%d tokens" % (n, n_new))
+                    with lock:
+                        gaps[tenant].extend(
+                            b - a for a, b in zip(times[1:], times[2:]))
+                        done_tokens[0] += n
+                except Exception as e:  # noqa: BLE001 — bank it, keep driving
+                    errors.append((tenant, plen, repr(e)))
+
+        threads = [threading.Thread(
+            target=client,
+            args=("latency", latency_lens[c % len(latency_lens)],
+                  max_new, requests_per_client))
+            for c in range(latency_clients)]
+        threads += [threading.Thread(
+            target=client, args=("bulk", long_len, long_new,
+                                 requests_per_client))
+            for _ in range(long_clients)]
+        stop_watch = threading.Event()
+
+        def watcher():
+            while not stop_watch.wait(0.01):
+                if done_tokens[0] >= expect_tokens // 2:
+                    chaos()
+                    return
+
+        w = (threading.Thread(target=watcher, daemon=True)
+             if chaos else None)
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        if w:
+            w.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        stop_watch.set()
+        if w:
+            w.join(timeout=1)
+        return gaps, errors, wall, done_tokens[0]
+
+    expect = (latency_clients * requests_per_client * max_new
+              + long_clients * requests_per_client * long_new)
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        if not vals:
+            return None
+        return round(
+            1000 * vals[min(len(vals) - 1, int(len(vals) * q))], 3)
+
+    # -- leg 1: colocated baseline (one engine, prefill stalls steps) --
+    unique_name.switch()
+    base = serving.DecodeEngine(
+        cfg, fluid.global_scope(), slots=4, cache_len=cache_len,
+        prompt_buckets=buckets, queue_capacity=256, name="disagg-base")
+    base.warmup(check_hbm=False)
+    base_gaps, base_errors, base_wall, base_tokens = drive(
+        lambda p, n, t: base.submit(p, max_new=n, tenant=t),
+        expect_tokens=expect)
+    base.stop(drain=True)
+    if base_errors:
+        raise RuntimeError(
+            "colocated baseline failed: %r" % base_errors[:3])
+
+    # -- leg 2: the disagg fleet, steady state ------------------------
+    unique_name.switch()
+    tenants = TenantTable(specs=[
+        TenantSpec("latency", priority="interactive",
+                   per_token_slo_ms=250.0),
+        TenantSpec("bulk", priority="batch")])
+    router = disagg_fleet(
+        cfg, fluid.global_scope(), n_prefill=2, n_decode=2, slots=2,
+        cache_len=cache_len, prompt_buckets=buckets, kv_dtype="fp32",
+        wire_dtype="int8", tenants=tenants, name="disagg-bench",
+        queue_capacity=256, request_timeout_s=180.0)
+    router.warmup(check_hbm=False)
+    # clean mixed-tenant drive first: the latency numbers must not mix
+    # steady-state inter-token gaps with migration stalls from the kill
+    dis_gaps, dis_errors, dis_wall, dis_tokens = drive(
+        lambda p, n, t: router.submit(p, max_new=n, tenant=t),
+        expect_tokens=expect)
+    if dis_errors:
+        raise RuntimeError("disagg clean leg failed: %r" % dis_errors[:3])
+
+    # -- leg 3: same fleet, mid-run decode-replica kill ----------------
+    # a long-lived canary guarantees the kill catches a live stream
+    canary = router.submit(prompts[5], max_new=80, tenant="latency")
+    deadline = time.monotonic() + 60
+    while len(canary.so_far()) < 3 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    with router._lock:
+        victim = next(r for r, s in router._sessions.items()
+                      if canary in s)
+    killed = []
+
+    def chaos():
+        router.kill_replica(victim)
+        killed.append(victim)
+
+    chaos_gaps, dis_errors, _chaos_wall, _chaos_tokens = drive(
+        lambda p, n, t: router.submit(p, max_new=n, tenant=t),
+        chaos=chaos, expect_tokens=expect)
+    canary_toks = canary.result(180.0)
+    if not killed:
+        chaos()  # load outran the watcher; still record a clean kill
+    st = router.stats()
+    router.stop(drain=True, timeout=30.0)
+    if dis_errors:
+        raise RuntimeError("disagg fleet failed: %r" % dis_errors[:3])
+    if len(canary_toks) != 80:
+        raise RuntimeError(
+            "canary stream lost tokens across the kill: %d/80"
+            % len(canary_toks))
+    if st["failed_streams"]:
+        raise RuntimeError(
+            "%d streams failed through the chaos leg"
+            % st["failed_streams"])
+
+    # -- slot economics: int8 residency at an equal HBM budget ---------
+    fp32_slot = kv_slot_bytes(cfg, cache_len, "fp32")
+    int8_slot = kv_slot_bytes(cfg, cache_len, "int8")
+    budget = 4 * fp32_slot
+
+    return {
+        "clients": latency_clients + long_clients,
+        "long_prompt_len": long_len,
+        "baseline_tokens_per_sec": round(base_tokens / base_wall, 1),
+        "disagg_tokens_per_sec": round(dis_tokens / dis_wall, 1),
+        "baseline_latency_per_token_ms_p99": pct(
+            base_gaps["latency"], 0.99),
+        "disagg_latency_per_token_ms_p99": pct(
+            dis_gaps["latency"], 0.99),
+        "baseline_latency_per_token_ms_p50": pct(
+            base_gaps["latency"], 0.50),
+        "disagg_latency_per_token_ms_p50": pct(
+            dis_gaps["latency"], 0.50),
+        "chaos_latency_per_token_ms_p99": pct(
+            chaos_gaps["latency"], 0.99),
+        "killed_decode_replica": killed[0] if killed else None,
+        "migrations": int(st["migrations"]),
+        "failed_streams": int(st["failed_streams"]),
+        "replica_dead": int(st["replica_dead"]),
+        "handoff_compression_int8": round(
+            handoff_compression(cfg.num_layers, cache_len, cfg.hidden,
+                                "int8"), 3),
+        "slot_bytes_fp32": fp32_slot,
+        "slot_bytes_int8": int8_slot,
+        "slots_at_equal_budget_fp32": int(budget // fp32_slot),
+        "slots_at_equal_budget_int8": int(budget // int8_slot),
+    }
+
+
 def _measure_comms(steps=10, batch=64, hidden=256, n_layers=3):
     """Gradient-communication lane (ISSUE 10): the same dp training step
     three ways — GSPMD fp32 baseline, explicit bucketed comms fp32, and
@@ -1652,6 +1870,19 @@ def child_main(status_path):
             st.flush()
         except Exception as e:  # noqa: BLE001
             st.error("decode_serving failed: %s: %s"
+                     % (type(e).__name__, str(e)[:300]))
+
+    if os.environ.get("PADDLE_TPU_BENCH_DISAGG"):
+        # disagg lane (ISSUE 12): prefill/decode phase split vs the
+        # colocated engine under mixed tenants, with a mid-run decode-
+        # replica kill every live stream must survive via migration
+        st.stage("disagg_serving")
+        try:
+            st.data["detail"]["disagg_serving"] = (
+                _measure_disagg_serving())
+            st.flush()
+        except Exception as e:  # noqa: BLE001
+            st.error("disagg_serving failed: %s: %s"
                      % (type(e).__name__, str(e)[:300]))
 
     if os.environ.get("PADDLE_TPU_BENCH_COMMS"):

@@ -123,6 +123,29 @@ Well-known gradient-communication metrics (PR 10, ``parallel/comms``):
   dispatch through the FleetGuard collective gate, alongside the
   existing per-op ``collective.dispatch.<op>`` counters.
 
+Well-known disaggregated-serving metrics (PR 12, ``serving.disagg``):
+
+- ``serving.disagg.prefill_live`` / ``decode_live`` gauges — replicas
+  of each phase taking traffic; ``serving.disagg.decode_sessions.<rid>``
+  gauge — live sessions pinned to each decode replica (the session-
+  affinity placement signal).
+- ``serving.disagg.sessions`` / ``migrations`` / ``failed_streams`` /
+  ``replica_dead`` / ``handoffs`` counters — session lifecycle:
+  ``migrations`` counts re-prefill recoveries off dead decode
+  replicas, and chaos drills assert ``failed_streams`` stays 0.
+- ``serving.disagg.prefill_ttft_seconds`` histogram — queue wait +
+  prefill on the prefill fleet (the TTFT SLO leg);
+  ``serving.disagg.per_token_seconds`` (and ``.<tenant>``) histograms
+  — inter-token gaps on the decode leg (the per-token-p99 SLO leg);
+  ``serving.disagg.slo_miss_ttft`` / ``slo_miss_per_token`` counters
+  score them against each tenant's targets.
+- ``serving.disagg.tenant_live.<tenant>`` gauge and
+  ``serving.disagg.tenant_sessions`` / ``tenant_shed`` counters — the
+  per-tenant quota accounting behind 429s;
+  ``serving.disagg.adopt_seconds`` histogram and
+  ``serving.disagg.handoff_bytes.<engine>`` gauge price the KV handoff
+  itself (int8 block-scaled wire ≈ 3.9x smaller than fp32).
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

@@ -22,6 +22,12 @@ arrays ride the training scope as per-shard state
 
 Everything operates on FLAT vectors — bucketing.py owns the
 pack/unpack between named gradient tensors and bucket-flat layout.
+
+Second consumer (PR 12): :mod:`paddle_tpu.serving.disagg.kv_wire`
+rides the same block-scaled encoding for the prefill->decode KV
+handoff (one block per (layer, row) of the cache, no error feedback —
+a handoff is one-shot, not a telescoping stream), so the wire format
+and its error bound stay defined in exactly one place.
 """
 import jax.numpy as jnp
 

@@ -33,6 +33,16 @@ engine (``reg.publish("gpt", DecodeEngine(cfg, scope))``) and streams
 per-token over ``POST /v1/models/<name>:generate`` (chunked
 transfer-encoding).
 
+Decode scales past one engine by **disaggregating the phases**
+(:mod:`~paddle_tpu.serving.disagg`): prefill replicas turn prompts
+into serialized int8 block-scaled KV handoffs, step-only decode
+replicas (optionally int8-*resident*, ~4x slots/chip) adopt them, and
+:func:`~paddle_tpu.serving.disagg.disagg_fleet` fronts the fleet with
+a :class:`~paddle_tpu.serving.disagg.DisaggRouter` — session-affine,
+migrates sessions off dead replicas via re-prefill, and gates
+admission with per-tenant priorities/quotas/SLOs
+(:class:`~paddle_tpu.serving.disagg.TenantTable`).
+
 Quick start::
 
     from paddle_tpu import serving
@@ -67,12 +77,19 @@ from .router import (  # noqa: F401
     RolloutError, ServingRouter, StoreReplica, local_fleet,
     make_engine_factory,
 )
+from .disagg import (  # noqa: F401  (after .decode/.router: it layers on them)
+    DisaggReplica, DisaggRouter, DisaggStream, KVHandoff, PrefillEngine,
+    PrefillTicket, TenantSpec, TenantTable, disagg_fleet,
+)
 
 __all__ = [
     "BucketSpec", "DeadlineExceededError", "DecodeEngine", "DecodeStream",
-    "EngineClosedError", "LocalReplica", "ModelRegistry", "NoReplicasError",
+    "DisaggReplica", "DisaggRouter", "DisaggStream",
+    "EngineClosedError", "KVHandoff", "LocalReplica", "ModelRegistry",
+    "NoReplicasError", "PrefillEngine", "PrefillTicket",
     "ReplicaGoneError", "ReplicaWorker", "RolloutError", "ServingEngine",
     "ServingHandler", "ServingRouter", "ServingServer", "ShedError",
-    "StoreReplica", "default_prompt_buckets", "local_fleet",
-    "make_engine_factory", "round_up_pow2", "tail_signature",
+    "StoreReplica", "TenantSpec", "TenantTable", "default_prompt_buckets",
+    "disagg_fleet", "local_fleet", "make_engine_factory",
+    "round_up_pow2", "tail_signature",
 ]

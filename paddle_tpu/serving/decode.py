@@ -48,6 +48,16 @@ Telemetry: ``serving.decode.slot_utilization`` /
 ``barrier=True`` is the ablation mode benches compare against: slots
 are only refilled once EVERY slot has retired — the classic full-batch
 generation schedule, identical programs, no in-flight admission.
+
+Disaggregation hooks (PR 12, ``serving.disagg``): ``kv_dtype="int8"``
+keeps the slot cache **resident in int8** with per-(slot, layer, row)
+fp32 scales — ~4x the decode slots at equal HBM, priced honestly by
+:meth:`check_hbm_budget` — swapping in the dequantize-in-program step
+(:func:`~paddle_tpu.models.gpt.build_gpt_decode_step_q`);
+``role="decode"`` builds NO prefill programs (a pure step replica) and
+:meth:`submit_prefilled` adopts a serialized
+:class:`~paddle_tpu.serving.disagg.kv_wire.KVHandoff` from a prefill
+replica straight into a slot.
 """
 import collections
 import queue
@@ -59,7 +69,23 @@ import numpy as np
 from .. import observability as obs
 from .engine import DeadlineExceededError, EngineClosedError, ShedError
 
-__all__ = ["DecodeEngine", "DecodeStream", "default_prompt_buckets"]
+__all__ = ["DecodeEngine", "DecodeStream", "default_prompt_buckets",
+           "kv_slot_bytes"]
+
+
+def kv_slot_bytes(cfg, cache_len, kv_dtype="fp32"):
+    """HBM bytes ONE decode slot's KV cache pair occupies — the slot
+    economics `disagg` trades on: int8 residency pays 1 byte/element
+    plus one fp32 scale per (layer, row) instead of 4 bytes/element,
+    so slots-per-budget multiplies by ~4 (3.9x at hidden 32+)."""
+    if kv_dtype not in ("fp32", "int8"):
+        raise ValueError("kv_dtype must be 'fp32' or 'int8', got %r"
+                         % (kv_dtype,))
+    n = int(cfg.num_layers) * int(cache_len) * int(cfg.hidden)
+    if kv_dtype == "int8":
+        rows = int(cfg.num_layers) * int(cache_len)
+        return 2 * (n + rows * 4)
+    return 2 * n * 4
 
 
 def default_prompt_buckets(cache_len, smallest=8):
@@ -164,7 +190,7 @@ class DecodeStream:
 
 class _Request:
     __slots__ = ("prompt", "plen", "bucket", "max_new", "eos_id",
-                 "deadline", "handle")
+                 "deadline", "handle", "handoff", "tenant", "priority")
 
 
 class _Slot:
@@ -204,22 +230,35 @@ class DecodeEngine:
                  default_max_new=32, default_deadline_ms=None,
                  request_timeout_s=60.0, name="default",
                  barrier=False, auto_start=True,
-                 build_prefill=None, build_step=None):
+                 build_prefill=None, build_step=None,
+                 kv_dtype="fp32", role="colocated"):
         import jax
 
         import paddle_tpu.fluid as fluid
         from ..fluid.inference import Predictor
 
+        if kv_dtype not in ("fp32", "int8"):
+            raise ValueError("kv_dtype must be 'fp32' or 'int8', got %r"
+                             % (kv_dtype,))
+        if role not in ("colocated", "decode"):
+            raise ValueError("role must be 'colocated' or 'decode', "
+                             "got %r" % (role,))
         if build_prefill is None or build_step is None:
-            from ..models.gpt import build_gpt_decode_step, build_gpt_prefill
+            from ..models.gpt import (build_gpt_decode_step,
+                                      build_gpt_decode_step_q,
+                                      build_gpt_prefill)
 
             build_prefill = build_prefill or build_gpt_prefill
-            build_step = build_step or build_gpt_decode_step
+            build_step = build_step or (
+                build_gpt_decode_step_q if kv_dtype == "int8"
+                else build_gpt_decode_step)
         self._jax = jax
         self.cfg = cfg
         self.name = str(name)
         self.slots = int(slots)
         self.cache_len = int(cache_len)
+        self.kv_dtype = str(kv_dtype)
+        self.role = str(role)
         self.eos_id = eos_id
         self.default_max_new = int(default_max_new)
         self._default_deadline_ms = default_deadline_ms
@@ -241,10 +280,11 @@ class DecodeEngine:
             step_vars = build_step(cfg, self.cache_len)
             step_prog = fluid.default_main_program()
         prefill = {}
-        for b in self.prompt_buckets:
-            with fluid.program_guard(fluid.Program(), fluid.Program()):
-                pv = build_prefill(cfg, b, self.cache_len)
-                prefill[b] = (fluid.default_main_program(), pv)
+        if self.role != "decode":  # a pure decode replica never prefills
+            for b in self.prompt_buckets:
+                with fluid.program_guard(fluid.Program(), fluid.Program()):
+                    pv = build_prefill(cfg, b, self.cache_len)
+                    prefill[b] = (fluid.default_main_program(), pv)
         persist = {}
         for prog in [step_prog] + [p for p, _ in prefill.values()]:
             for v in prog.list_vars():
@@ -276,8 +316,15 @@ class DecodeEngine:
 
         # -- the persistent slot buffer pair + host-side slot state ----
         shape = (self.slots, cfg.num_layers, self.cache_len, cfg.hidden)
-        self._k = jax.device_put(np.zeros(shape, np.float32))
-        self._v = jax.device_put(np.zeros(shape, np.float32))
+        self._cache_np_dtype = (np.int8 if self.kv_dtype == "int8"
+                                else np.float32)
+        self._k = jax.device_put(np.zeros(shape, self._cache_np_dtype))
+        self._v = jax.device_put(np.zeros(shape, self._cache_np_dtype))
+        self._kscale = self._vscale = None
+        if self.kv_dtype == "int8":
+            sshape = shape[:-1] + (1,)
+            self._kscale = jax.device_put(np.zeros(sshape, np.float32))
+            self._vscale = jax.device_put(np.zeros(sshape, np.float32))
         self._tok = np.zeros((self.slots, 1), np.int64)
         self._pos = np.zeros((self.slots, 1), np.int64)
         self._slots = [None] * self.slots
@@ -364,14 +411,22 @@ class DecodeEngine:
                 return b
         return None
 
-    def submit(self, prompt, max_new=None, eos_id=None, deadline_ms=None):
+    def submit(self, prompt, max_new=None, eos_id=None, deadline_ms=None,
+               tenant=None, priority=None):
         """Enqueue one generation request; returns a
         :class:`DecodeStream`. Raises :class:`ShedError` when the queue
         is full, :class:`EngineClosedError` after ``stop()``, and
-        ``ValueError`` for prompts that cannot fit the ladder."""
+        ``ValueError`` for prompts that cannot fit the ladder.
+        ``tenant``/``priority`` are carried for observability — the
+        disagg router schedules on them; a lone engine records them."""
         if self._closed:
             raise EngineClosedError(
                 "engine %r is draining/stopped" % self.name)
+        if self.role == "decode":
+            raise RuntimeError(
+                "engine %r is a decode-role (step-only) replica: it "
+                "builds no prefill programs — hand it a prefilled KV "
+                "cache via submit_prefilled()" % self.name)
         prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
         plen = int(prompt.shape[0])
         if plen < 1:
@@ -398,12 +453,17 @@ class DecodeEngine:
         req.bucket = bucket
         req.max_new = max_new
         req.eos_id = self.eos_id if eos_id is None else eos_id
+        req.handoff = None
+        req.tenant = tenant
+        req.priority = priority
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms
         req.deadline = (time.monotonic() + float(deadline_ms) / 1000.0
                         if deadline_ms is not None else None)
         req.handle = DecodeStream(
             plen, max_new, stall_timeout_s=self.request_timeout_s)
+        req.handle.tenant = tenant
+        req.handle.priority = priority
         try:
             with self._admit_lock:
                 if self._closed:
@@ -432,6 +492,72 @@ class DecodeEngine:
         return h.result(
             timeout if timeout is not None else self.request_timeout_s)
 
+    def submit_prefilled(self, handoff, max_new=None, eos_id=None,
+                         deadline_ms=None, tenant=None, priority=None):
+        """Enqueue a generation whose prefill already happened on
+        another replica: ``handoff`` is a
+        :class:`~paddle_tpu.serving.disagg.kv_wire.KVHandoff` whose KV
+        pair is adopted into a free slot (no prefill program runs here
+        — works on ``role="decode"`` replicas). The stream's first
+        token is the handoff's ``next_token``; ``max_new`` counts it,
+        matching :meth:`submit` semantics, so a handoff at ``plen``
+        with ``max_new`` N delivers N tokens total."""
+        if self._closed:
+            raise EngineClosedError(
+                "engine %r is draining/stopped" % self.name)
+        expect = (self.cfg.num_layers, self.cache_len, self.cfg.hidden)
+        if tuple(handoff.shape) != expect:
+            raise ValueError(
+                "handoff cache shape %r does not match this engine's "
+                "geometry %r" % (tuple(handoff.shape), expect))
+        plen = int(handoff.plen)
+        if plen < 1 or plen > self.cache_len:
+            raise ValueError("handoff plen %d outside [1, cache_len=%d]"
+                             % (plen, self.cache_len))
+        max_new = self.default_max_new if max_new is None else int(max_new)
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        if plen + max_new - 1 > self.cache_len:
+            raise ValueError(
+                "handoff plen %d + max_new %d - 1 exceeds cache_len %d"
+                % (plen, max_new, self.cache_len))
+        req = _Request()
+        req.prompt = np.asarray(handoff.prompt, np.int64).reshape(-1)
+        req.plen = plen
+        req.bucket = None
+        req.max_new = max_new
+        req.eos_id = self.eos_id if eos_id is None else eos_id
+        req.handoff = handoff
+        req.tenant = tenant
+        req.priority = priority
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        req.deadline = (time.monotonic() + float(deadline_ms) / 1000.0
+                        if deadline_ms is not None else None)
+        req.handle = DecodeStream(
+            plen, max_new, stall_timeout_s=self.request_timeout_s)
+        req.handle.tenant = tenant
+        req.handle.priority = priority
+        try:
+            with self._admit_lock:
+                if self._closed:
+                    raise EngineClosedError(
+                        "engine %r is draining/stopped" % self.name)
+                self._q.put_nowait(req)
+        except queue.Full:
+            self._bump("shed")
+            obs.event("shed", source="serving", model=self.name,
+                      engine="decode", prompt_len=plen, handoff=True,
+                      queue_capacity=self._q.maxsize)
+            raise ShedError(
+                "decode queue full (%d) for model %r — handoff shed"
+                % (self._q.maxsize, self.name),
+                model=self.name, retry_after=self.retry_after_hint())
+        self._bump("requests")
+        obs.set_gauge("serving.queue_depth.%s" % self.name,
+                      self._q.qsize())
+        return req.handle
+
     # -- admission checks before warmup ----------------------------------
     def check_hbm_budget(self, budget_bytes=None):
         """Price params + the persistent KV buffer pair + the step
@@ -457,16 +583,26 @@ class DecodeEngine:
         sv = self._step_vars
         cache_names = [sv["k_in"].name, sv["v_in"].name,
                        sv["k"].name, sv["v"].name]
+        # the cache feed dtype drives the byte pricing: int8 residency
+        # costs 1 byte/element where fp32 cost 4, plus the per-row fp32
+        # scale planes — exactly the slot multiplier disagg banks on
         feed_specs = {
             sv["tok"].name: jax.ShapeDtypeStruct(
                 (self.slots, 1), np.int64),
             sv["pos"].name: jax.ShapeDtypeStruct(
                 (self.slots, 1), np.int64),
             sv["k_in"].name: jax.ShapeDtypeStruct(
-                tuple(self._k.shape), np.float32),
+                tuple(self._k.shape), self._cache_np_dtype),
             sv["v_in"].name: jax.ShapeDtypeStruct(
-                tuple(self._v.shape), np.float32),
+                tuple(self._v.shape), self._cache_np_dtype),
         }
+        if self.kv_dtype == "int8":
+            cache_names += [sv["k_scale_in"].name, sv["v_scale_in"].name,
+                            sv["k_scale"].name, sv["v_scale"].name]
+            feed_specs[sv["k_scale_in"].name] = jax.ShapeDtypeStruct(
+                tuple(self._kscale.shape), np.float32)
+            feed_specs[sv["v_scale_in"].name] = jax.ShapeDtypeStruct(
+                tuple(self._vscale.shape), np.float32)
         est = _memory.estimate(
             pred.program, feed_specs=feed_specs,
             state_specs=pred._state, fetch_names=pred.fetch_names,
@@ -496,7 +632,8 @@ class DecodeEngine:
 
         report = tpu_lint.lint_decode_ladder(
             self.prompt_buckets, slot_counts=(self.slots,),
-            cache_lens=(self.cache_len,))
+            cache_lens=(self.cache_len,),
+            kv_dtypes=(self.kv_dtype,))
         for d in report.findings:
             obs.event("decode_ladder_lint", source="serving",
                       model=self.name, message=d.message[:200])
@@ -510,13 +647,20 @@ class DecodeEngine:
             self.check_hbm_budget()
         self.check_ladder()
         report = []
-        source = self._step_pred.warm({
+        warm_feeds = {
             "gpt_step_tok": self._tok, "gpt_step_pos": self._pos,
-            "gpt_step_k": np.zeros(self._k.shape, np.float32),
-            "gpt_step_v": np.zeros(self._v.shape, np.float32)})
+            "gpt_step_k": np.zeros(self._k.shape, self._cache_np_dtype),
+            "gpt_step_v": np.zeros(self._v.shape, self._cache_np_dtype)}
+        if self.kv_dtype == "int8":
+            warm_feeds["gpt_step_kscale"] = np.zeros(
+                self._kscale.shape, np.float32)
+            warm_feeds["gpt_step_vscale"] = np.zeros(
+                self._vscale.shape, np.float32)
+        source = self._step_pred.warm(warm_feeds)
         report.append({"program": "step", "slots": self.slots,
-                       "cache_len": self.cache_len, "source": source})
-        for b in self.prompt_buckets:
+                       "cache_len": self.cache_len,
+                       "kv_dtype": self.kv_dtype, "source": source})
+        for b in sorted(self._prefill_preds):
             source = self._prefill_preds[b].warm({
                 "gpt_prefill_ids": np.zeros((1, b), np.int64),
                 "gpt_prefill_len": np.ones((1, 1), np.int64)})
@@ -600,9 +744,23 @@ class DecodeEngine:
                         "deadline expired after %s ms in decode queue "
                         "(model %r)" % (waited_ms, self.name)))
                     req = None
-            self._prefill(i, req)
+            if req.handoff is not None:
+                self._adopt(i, req)
+            else:
+                self._prefill(i, req)
         obs.set_gauge("serving.queue_depth.%s" % self.name,
                       self._q.qsize())
+
+    def _write_slot_cache(self, slot, k1, v1, ks=None, vs=None):
+        """Install one sequence's cache pair into slot ``slot``.
+        ``k1``/``v1`` are (1, L, T, H) in the engine's residency dtype;
+        int8 engines also take the (1, L, T, 1) fp32 scale pair."""
+        slot_i = np.int32(slot)
+        self._k = self._write(self._k, k1, slot_i)
+        self._v = self._write(self._v, v1, slot_i)
+        if self.kv_dtype == "int8":
+            self._kscale = self._write(self._kscale, ks, slot_i)
+            self._vscale = self._write(self._vscale, vs, slot_i)
 
     def _prefill(self, slot, req):
         t0 = time.monotonic()
@@ -619,9 +777,17 @@ class DecodeEngine:
                       error="%s: %s" % (type(e).__name__, str(e)[:200]))
             req.handle._fail(e)
             return
-        slot_i = np.int32(slot)
-        self._k = self._write(self._k, k1, slot_i)
-        self._v = self._write(self._v, v1, slot_i)
+        if self.kv_dtype == "int8":
+            # the prefill program stays fp32; quantize per row on the
+            # way into the resident buffers (same codec as the wire)
+            from .disagg import kv_wire
+
+            kq, ks = kv_wire.quantize_rows(np.asarray(k1)[0])
+            vq, vs = kv_wire.quantize_rows(np.asarray(v1)[0])
+            self._write_slot_cache(slot, kq[None], vq[None],
+                                   ks[None], vs[None])
+        else:
+            self._write_slot_cache(slot, k1, v1)
         self._tok[slot, 0] = tok = int(np.asarray(nxt)[0, 0])
         self._pos[slot, 0] = req.plen
         self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id)
@@ -630,6 +796,47 @@ class DecodeEngine:
         obs.observe("serving.decode.ttft_seconds",
                     now - req.handle.t_submit)
         self._bump("prefills")
+        self._emit(slot, tok)
+        self._gauges()
+
+    def _adopt(self, slot, req):
+        """Install a remote prefill's :class:`KVHandoff` into a slot —
+        the decode half of the disaggregated handoff. An int8 handoff
+        whose block is the hidden width drops payload+scales straight
+        into an int8-resident engine (no requantize); every other
+        combination goes through fp32."""
+        t0 = time.monotonic()
+        h = req.handoff
+        try:
+            if self.kv_dtype == "int8":
+                if h.wire_dtype == "int8":
+                    kq, ks = np.asarray(h.k, np.int8), h.k_scales
+                    vq, vs = np.asarray(h.v, np.int8), h.v_scales
+                else:
+                    from .disagg import kv_wire
+
+                    kd, vd = h.dense()
+                    kq, ks = kv_wire.quantize_rows(kd)
+                    vq, vs = kv_wire.quantize_rows(vd)
+                self._write_slot_cache(
+                    slot, kq[None], vq[None],
+                    np.asarray(ks, np.float32)[None],
+                    np.asarray(vs, np.float32)[None])
+            else:
+                kd, vd = h.dense()
+                self._write_slot_cache(slot, kd[None], vd[None])
+        except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+            self._bump("adopt_errors")
+            obs.event("adopt_error", source="serving", model=self.name,
+                      error="%s: %s" % (type(e).__name__, str(e)[:200]))
+            req.handle._fail(e)
+            return
+        self._tok[slot, 0] = tok = int(h.next_token)
+        self._pos[slot, 0] = req.plen
+        self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id)
+        obs.observe("serving.disagg.adopt_seconds",
+                    time.monotonic() - t0)
+        self._bump("adopts")
         self._emit(slot, tok)
         self._gauges()
 
@@ -667,13 +874,24 @@ class DecodeEngine:
                   model=self.name, slot=slot, reason=reason,
                   tokens=len(s.handle._tokens))
 
+    def _step_feeds(self):
+        feeds = {"gpt_step_tok": self._tok, "gpt_step_pos": self._pos,
+                 "gpt_step_k": self._k, "gpt_step_v": self._v}
+        if self.kv_dtype == "int8":
+            feeds["gpt_step_kscale"] = self._kscale
+            feeds["gpt_step_vscale"] = self._vscale
+        return feeds
+
     def _step(self):
         t0 = time.monotonic()
         try:
-            nxt, self._k, self._v = self._step_pred.run(
-                {"gpt_step_tok": self._tok, "gpt_step_pos": self._pos,
-                 "gpt_step_k": self._k, "gpt_step_v": self._v},
-                return_numpy=False)
+            if self.kv_dtype == "int8":
+                (nxt, self._k, self._v, self._kscale,
+                 self._vscale) = self._step_pred.run(
+                    self._step_feeds(), return_numpy=False)
+            else:
+                nxt, self._k, self._v = self._step_pred.run(
+                    self._step_feeds(), return_numpy=False)
         except Exception as e:  # noqa: BLE001 — fail the slots, not the loop
             self._bump("step_errors")
             obs.event("step_error", source="serving", model=self.name,
@@ -719,13 +937,20 @@ class DecodeEngine:
         step_errors."""
         with self._stats_lock:
             out = dict(self._stats)
-        for k in ("requests", "tokens", "prefills", "steps", "retired",
-                  "shed", "deadline_miss", "cancelled",
-                  "prefill_errors", "step_errors"):
+        for k in ("requests", "tokens", "prefills", "adopts", "steps",
+                  "retired", "shed", "deadline_miss", "cancelled",
+                  "prefill_errors", "adopt_errors", "step_errors"):
             out.setdefault(k, 0)
         out["live_slots"] = sum(1 for s in self._slots if s is not None)
         out["slots"] = self.slots
+        out["kv_dtype"] = self.kv_dtype
+        out["role"] = self.role
         return out
+
+    def slot_bytes(self):
+        """HBM bytes one slot's resident KV pair occupies (see
+        :func:`kv_slot_bytes`)."""
+        return kv_slot_bytes(self.cfg, self.cache_len, self.kv_dtype)
 
     def queue_depth(self):
         return self._q.qsize()

@@ -12,9 +12,14 @@ thread per model does the batching):
   as JSON numbers coerce to the program's int32/int64), so a plain
   nested-list payload round-trips bit-exact for float32 models.
 - ``POST /v1/models/<name>:generate`` — decode engines only
-  (:class:`~paddle_tpu.serving.decode.DecodeEngine` published into the
+  (:class:`~paddle_tpu.serving.decode.DecodeEngine` or a
+  :class:`~paddle_tpu.serving.disagg.DisaggRouter` published into the
   registry). Body ``{"prompt": [ids], "max_new_tokens": 32?,
-  "eos_id": 2?, "deadline_ms": 50?, "timeout_s": 10?, "stream": true?}``.
+  "eos_id": 2?, "deadline_ms": 50?, "timeout_s": 10?, "stream": true?,
+  "tenant": "chat"?, "priority": "interactive"|0..2?}`` — ``tenant``
+  must be a non-empty string and ``priority`` an int 0..2 or a named
+  class (400 otherwise); both feed the disagg fleet's multi-tenant
+  admission and are harmless on a lone engine.
   With ``stream`` (the default) the reply is **chunked
   transfer-encoding** (HTTP/1.1), one JSON line per token flushed as
   the engine's step loop produces it — ``{"token": 7, "index": 0}`` —
@@ -35,7 +40,10 @@ the JSON body names the shedding model + replica and the response
 carries a ``Retry-After`` header derived from the engine's observed
 queue drain rate), 504 deadline missed or wait timeout, 503
 draining/stopped or a replica fleet with zero live replicas, 404
-unknown model, 400 malformed request.
+unknown model, 400 malformed request. Both ``:predict`` and the
+``:generate`` streaming path carry ``Retry-After`` on 429 AND 503 —
+a draining engine and a zero-replica fleet are as retryable as a full
+queue.
 
 Standalone entry point::
 
@@ -124,19 +132,48 @@ class ServingHandler(BaseHTTPRequestHandler):
         self.wfile.flush()
 
     def _generate_errdoc(self, exc, name, engine):
-        """(status, doc, headers) for a pre-stream generate failure."""
+        """(status, doc, headers) for a pre-stream generate failure.
+        429 AND 503 both carry Retry-After: a draining engine or a
+        zero-replica fleet is as retryable as a full queue."""
         if isinstance(exc, ShedError):
             return (429, self._shed_doc(exc, name, engine),
                     self._shed_headers(exc, engine))
         if isinstance(exc, DeadlineExceededError):
             return 504, {"error": str(exc), "model": name}, None
         if isinstance(exc, EngineClosedError):
-            return 503, {"error": str(exc), "model": name}, None
+            return (503, {"error": str(exc), "model": name},
+                    self._shed_headers(exc, engine))
         if isinstance(exc, (TimeoutError, _FutureTimeout)):
             return (504, {"error": "timed out waiting for model %r"
                           % name, "model": name}, None)
+        if type(exc).__name__ == "NoReplicasError":
+            # fleet with zero live replicas: unavailable, not internal
+            # (matched by name to avoid importing the router here)
+            return (503, {"error": str(exc), "model": name},
+                    self._shed_headers(exc, engine))
         return (500, {"error": "%s: %s" % (type(exc).__name__, exc),
                       "model": name}, None)
+
+    @staticmethod
+    def _parse_tenant_priority(body):
+        """Validate the multi-tenant request fields; raises ValueError
+        (400 upstream) on malformed values. Returns kwargs to forward
+        only when the fields are present, so engines that predate them
+        keep working."""
+        kw = {}
+        if "tenant" in body:
+            tenant = body["tenant"]
+            if not isinstance(tenant, str) or not tenant.strip():
+                raise ValueError(
+                    "tenant must be a non-empty string, got %r"
+                    % (tenant,))
+            kw["tenant"] = tenant.strip()
+        if "priority" in body and body["priority"] is not None:
+            from .disagg.tenancy import resolve_priority
+
+            resolve_priority(body["priority"])  # raises on malformed
+            kw["priority"] = body["priority"]
+        return kw
 
     def _do_generate(self, name, engine):
         if getattr(engine, "engine_kind", None) != "decode":
@@ -150,6 +187,7 @@ class ServingHandler(BaseHTTPRequestHandler):
             kw = {"max_new": body.get("max_new_tokens"),
                   "eos_id": body.get("eos_id"),
                   "deadline_ms": body.get("deadline_ms")}
+            kw.update(self._parse_tenant_priority(body))
             timeout_s = body.get("timeout_s")
             stream = bool(body.get("stream", True))
         except (ValueError, KeyError, TypeError) as e:

@@ -155,6 +155,7 @@ class ModelRegistry:
             name: {
                 "version": e["version"],
                 "dirname": e["dirname"],
+                "kind": getattr(e["engine"], "engine_kind", "predict"),
                 "queue_depth": e["engine"].queue_depth(),
                 "stats": e["engine"].stats(),
             }

@@ -46,6 +46,12 @@ def pytest_configure(config):
         "search/pricing/CLI); `pytest -m planner` is the slice "
         "bench_experiments/planner_lane.sh runs under the jax "
         "version matrix")
+    config.addinivalue_line(
+        "markers",
+        "disagg: disaggregated prefill/decode serving tests "
+        "(paddle_tpu.serving.disagg: KV handoff wire, prefill fleet, "
+        "session-affine router, tenancy); `pytest -m disagg` is the "
+        "slice bench_experiments/disagg_lane.sh runs")
 
 
 @pytest.fixture(autouse=True)
