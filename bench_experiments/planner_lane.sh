@@ -99,6 +99,15 @@ if [[ -n "${PADDLE_TPU_JAX_LATEST_PY:-}" ]]; then
         tests/test_decode_serving.py tests/test_disagg_serving.py \
         || echo "WARN: serving slices not clean under latest jax" \
                "(non-gating; see output above)"
+    # analysis slice (verifier/shapes/lint + the concurrency/donation
+    # sanitizers) rides the matrix non-gating the same way: the
+    # dataflow pass reads donation semantics off jax's donate_argnums
+    # contract, so a pin move that shifts it gets flagged here first
+    echo "-- latest jax, analysis slice (non-gating) --"
+    "$PADDLE_TPU_JAX_LATEST_PY" -m pytest -q -p no:cacheprovider \
+        -m analysis tests/ \
+        || echo "WARN: analysis slice not clean under latest jax" \
+               "(non-gating; see output above)"
 else
     echo "SKIP latest-jax leg: set PADDLE_TPU_JAX_LATEST_PY to a python"
     echo "with a newer jax to run the matrix (no packages are installed"

@@ -16,6 +16,14 @@ Three passes over the symbolic Program IR plus one runtime guard:
   bodies, collectives without deadlines, shape-vocabulary blowups.
 - :mod:`.sanitizer` — opt-in cross-thread Scope mutation detector
   (``PADDLE_TPU_SCOPE_SANITIZER=on``).
+- :mod:`.concurrency` — named-lock lock-order recorder (cycle =
+  potential deadlock, with both acquisition stacks), blocking-call-
+  while-holding-lock detection, and the framework thread registry
+  behind zero-leak ``stop()``/``close()`` checks
+  (``PADDLE_TPU_LOCK_SANITIZER=on``).
+- :mod:`.dataflow` — def-use/donation dataflow over the Program IR:
+  use-after-donate and double-donate proven (errors at ``full``
+  level), plus cross-program donated-alias checks, static and runtime.
 - :mod:`.costs` / :mod:`.memory` — the quantitative layer: per-op
   FLOPs/bytes from the same lowering registry (traced with
   ``jax.make_jaxpr``), a roofline step-time/MFU prediction against the
@@ -37,6 +45,7 @@ __all__ = [
     "analyze_cost", "CostReport", "device_profile",
     "analyzer", "verifier", "shapes", "tpu_lint", "walker",
     "diagnostics", "sanitizer", "cli", "costs", "memory",
+    "concurrency", "dataflow",
 ]
 
 _LAZY_ATTRS = {
@@ -53,7 +62,8 @@ _LAZY_ATTRS = {
 }
 
 _SUBMODULES = ("analyzer", "verifier", "shapes", "tpu_lint", "walker",
-               "diagnostics", "sanitizer", "cli", "costs", "memory")
+               "diagnostics", "sanitizer", "cli", "costs", "memory",
+               "concurrency", "dataflow")
 
 
 def __getattr__(name):

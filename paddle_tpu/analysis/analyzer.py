@@ -10,7 +10,9 @@ executor's verify-on-first-compile mode switch.
   that provably cannot fit the device — with attributed diagnostics.
 - ``full``   — verifier + abstract shape/dtype propagation + the
   roofline cost model (per-op FLOPs/bytes, predicted step seconds and
-  MFU) + TPU-lint. Costs one ``jax.eval_shape``/``make_jaxpr`` per op;
+  MFU) + TPU-lint + the donation dataflow pass (use-after-donate /
+  double-donate proven over def-use chains, sub-block closure reads
+  included). Costs one ``jax.eval_shape``/``make_jaxpr`` per op;
   meant for CI lanes, the CLI, and first-failure triage
   (GuardedExecutor re-runs it on a failed dispatch), not for every
   interactive run.
@@ -61,7 +63,7 @@ def analyze(program, feed_names=(), fetch_names=(), state_names=None,
     if level == "full" and not report.errors:
         # shape propagation assumes structural well-formedness; on a
         # broken program the verifier errors are the actionable output
-        from . import costs, shapes, tpu_lint
+        from . import costs, dataflow, shapes, tpu_lint
 
         if feed_specs is None and feed_names:
             # derive specs for the caller's ACTUAL feed list — it may
@@ -91,6 +93,11 @@ def analyze(program, feed_names=(), fetch_names=(), state_names=None,
             program, shape_env=env, feed_names=feed_names,
             fetch_names=fetch_names, state_names=state_names,
             platform=platform, cost=cost))
+        # donation dataflow: proves the hazards tpu_lint only
+        # heuristically warns about (use-after-donate, double-donate)
+        report.extend(dataflow.analyze_donation(
+            program, feed_names=feed_names, fetch_names=fetch_names,
+            state_names=state_names))
     if not report.errors:
         _quantify(report, program, cost=cost, feed_specs=feed_specs,
                   state_specs=state_specs, fetch_names=fetch_names,

@@ -17,12 +17,28 @@ import sys
 __all__ = ["main"]
 
 _EPILOG = """\
-exit codes:
+exit codes (stable API — lanes gate on them):
   0   clean (or --fail-on never); with --plan: a ranked plan exists
   1   findings — errors and warnings per --fail-on (predicted-oom is
       an error: the program's peak live-set exceeds the device HBM);
       with --plan: every candidate was rejected (nothing fits)
   2   usage error / target failed to load / malformed --mesh
+
+lint gating:
+  --fail-on picks the severity floor for exit 1: 'findings' (default:
+  errors+warnings), 'perf' (errors+warnings+perf hints — the strict
+  lane gate, e.g. `python -m paddle_tpu.analysis --fail-on perf DIR`),
+  'error', 'never'. Recorded concurrency violations (--concurrency)
+  count under every --fail-on except 'never'.
+
+concurrency:
+  --concurrency appends the in-process concurrency sanitizer report:
+  the named-lock order graph, lock-order cycles (= potential
+  deadlocks, with both acquisition stacks), blocking-under-lock /
+  thread-leak / cross-program-donated-alias violations, and live
+  framework threads. Arm recording with PADDLE_TPU_LOCK_SANITIZER=on
+  (or analysis.concurrency.arm() in-process). TARGET is optional when
+  --concurrency is given.
 
 plan mode:
   --plan --devices N searches mesh factorizations of N (dp/tp/pp) x
@@ -268,10 +284,19 @@ def main(argv=None):
                          "(tmp + rename); stdout is unchanged")
     ap.add_argument("--text", action="store_true",
                     help="human-readable report instead of JSON")
-    ap.add_argument("--fail-on", choices=("findings", "error", "never"),
+    ap.add_argument("--concurrency", action="store_true",
+                    help="append the in-process concurrency sanitizer "
+                         "report (lock-order graph, potential-deadlock "
+                         "cycles, blocking-under-lock/thread-leak "
+                         "violations, live framework threads); recorded "
+                         "violations make the exit nonzero; TARGET "
+                         "becomes optional (see epilog)")
+    ap.add_argument("--fail-on",
+                    choices=("findings", "perf", "error", "never"),
                     default="findings",
-                    help="what makes the exit code nonzero "
-                         "(default: findings = errors+warnings)")
+                    help="severity floor for exit 1: findings (default: "
+                         "errors+warnings), perf (also perf hints — the "
+                         "strict lane lint gate), error, never")
     args = ap.parse_args(argv)
 
     # malformed --mesh is a usage error with its own message — not a
@@ -285,39 +310,45 @@ def main(argv=None):
     if args.plan:
         return _run_plan(args, mesh)
 
-    if args.target is None:
-        print("error: TARGET is required without --plan",
+    if args.target is None and not args.concurrency:
+        print("error: TARGET is required without --plan/--concurrency",
               file=sys.stderr)
         return 2
-    try:
-        program, feed_names, fetch_names, state_specs = _load_target(
-            args.target)
-    except Exception as e:  # noqa: BLE001 — CLI boundary
-        print("error: cannot load %s: %s: %s"
-              % (args.target, type(e).__name__, e), file=sys.stderr)
-        return 2
 
-    from .analyzer import analyze
-    from .memory import shard_divisors
-
+    report = None
+    doc = {}
     level = "full" if args.cost else args.level
-    param_shards, act_shards = shard_divisors(mesh)
+    if args.target is not None:
+        try:
+            program, feed_names, fetch_names, state_specs = _load_target(
+                args.target)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print("error: cannot load %s: %s: %s"
+                  % (args.target, type(e).__name__, e), file=sys.stderr)
+            return 2
 
-    # saved models are inference programs: analyze in test mode
-    report = analyze(
-        program, feed_names=feed_names, fetch_names=fetch_names,
-        state_names=set(state_specs) if state_specs is not None else None,
-        state_specs=state_specs, platform=args.platform, level=level,
-        is_test=True, default_dim=args.batch, device_kind=args.device,
-        param_shards=param_shards, act_shards=act_shards)
+        from .analyzer import analyze
+        from .memory import shard_divisors
 
-    doc = {
-        "target": args.target,
-        "platform": args.platform,
-        "level": level,
-        "report": report.to_dict(),
-    }
-    if args.cost:
+        param_shards, act_shards = shard_divisors(mesh)
+
+        # saved models are inference programs: analyze in test mode
+        report = analyze(
+            program, feed_names=feed_names, fetch_names=fetch_names,
+            state_names=(set(state_specs)
+                         if state_specs is not None else None),
+            state_specs=state_specs, platform=args.platform, level=level,
+            is_test=True, default_dim=args.batch,
+            device_kind=args.device,
+            param_shards=param_shards, act_shards=act_shards)
+
+        doc = {
+            "target": args.target,
+            "platform": args.platform,
+            "level": level,
+            "report": report.to_dict(),
+        }
+    if args.cost and args.target is not None:
         from .costs import analyze_cost
 
         # gradient sync rides the batch-sharding axes; sp/seq shard the
@@ -340,12 +371,33 @@ def main(argv=None):
         except Exception as e:  # noqa: BLE001 — cost model must not
             # take down the structural report
             doc["cost"] = {"error": "%s: %s" % (type(e).__name__, e)}
+    n_conc = 0
+    if args.concurrency:
+        from . import concurrency
+
+        cdoc = concurrency.report()
+        doc["concurrency"] = cdoc
+        n_conc = len(cdoc["violations"]) + cdoc["violations_dropped"]
+
     rendered = json.dumps(doc, sort_keys=True, indent=2)
     if args.text:
-        print("target: %s (platform %s, level %s)"
-              % (args.target, args.platform, level))
-        print(str(report))
-        if args.cost and "error" not in doc["cost"]:
+        if report is not None:
+            print("target: %s (platform %s, level %s)"
+                  % (args.target, args.platform, level))
+            print(str(report))
+        if args.concurrency:
+            cdoc = doc["concurrency"]
+            print("concurrency: %d lock(s), %d order edge(s), "
+                  "%d cycle(s), %d violation(s)%s, %d live thread(s)"
+                  % (len(cdoc["locks"]), len(cdoc["edges"]),
+                     len(cdoc["cycles"]), len(cdoc["violations"]),
+                     " (+%d dropped)" % cdoc["violations_dropped"]
+                     if cdoc["violations_dropped"] else "",
+                     len(cdoc["live_threads"])))
+            for v in cdoc["violations"]:
+                print("%s: %s" % (v.get("check"), v.get("message")))
+        if (args.cost and report is not None
+                and "error" not in doc["cost"]):
             c = doc["cost"]
             print("cost: %.3g flops, %.3g bytes moved, peak HBM %.3g MB"
                   % (c["total_flops"], c["total_bytes"],
@@ -379,8 +431,17 @@ def main(argv=None):
 
     if args.fail_on == "never":
         return 0
+    # concurrency violations are error-grade under every gating mode:
+    # a recorded lock-order cycle IS a latent deadlock
+    if n_conc:
+        return 1
+    if report is None:
+        return 0
     if args.fail_on == "error":
         return 1 if report.errors else 0
+    if args.fail_on == "perf":
+        return 1 if (report.findings
+                     or report.by_severity("perf")) else 0
     return 1 if report.findings else 0
 
 

@@ -1,0 +1,449 @@
+"""Concurrency sanitizer: lock-order recorder + framework thread registry.
+
+The runtime is a dozen cooperating background threads — serving dispatch
+threads (``serving/engine.py``, ``serving/decode.py``), the disagg
+session pumps and health tick (``serving/disagg/router.py``), the
+async-pipeline stager (``fluid/async_pipeline.py``), heartbeat beaters
+(``parallel/elastic.py``) — coordinating through a handful of framework
+locks. A refactor that inverts two lock acquisitions, or parks a
+blocking call under a lock, deadlocks (or convoys) only under load,
+long after the diff landed. This module makes both hazard classes
+observable *the first time the orders are exercised*, without needing
+the unlucky interleaving:
+
+- **Named locks** — framework locks are :class:`NamedLock` wrappers
+  (``named_lock("serving.engine.admit")``). Lock names are per *lock
+  class*, not per instance, so an order recorded on one engine applies
+  to every engine (classic lockdep semantics).
+- **Lock-order graph** — armed (``PADDLE_TPU_LOCK_SANITIZER=on`` or
+  :func:`arm`), every acquisition while other named locks are held
+  records a ``held -> acquiring`` edge with BOTH acquisition stacks.
+  An edge whose reverse path already exists is a cycle: a
+  ``potential-deadlock`` violation carrying the stacks of every edge on
+  the cycle — the two threads' acquisition sites, attributed.
+- **Blocking-under-lock** — instrumented blocking sites
+  (:func:`note_blocking` at ``queue.get``, ``time.sleep``, device
+  dispatch, FileStore directory scans) flag a ``blocking-under-lock``
+  violation when the calling thread holds any named lock: the lock
+  acquisition stack plus the blocking site stack.
+- **Thread registry** — subsystems :func:`track_thread` their
+  background threads under an owner token; ``stop()``/``close()`` call
+  :func:`check_stopped`, which reports still-alive threads as
+  ``thread-leak`` violations (and always returns their names, so tests
+  can assert zero leaks even disarmed).
+
+Off (the default), every hook is a single module-bool check —
+``NamedLock`` delegates straight to the underlying ``threading``
+primitive and ``note_blocking`` returns immediately — so the
+instrumentation stays compiled into the hot paths permanently.
+
+Metrics (armed): ``analysis.lock_graph_edges`` gauge,
+``sanitizer.violations`` / ``threads.leaked`` counters, and
+``lock_violation`` flight-recorder events (source ``sanitizer``).
+Stdlib-only (+observability): importable from supervisor/crash paths
+without accelerator init.
+"""
+import collections
+import os
+import threading
+import traceback
+import weakref
+
+from .. import observability as obs
+
+__all__ = [
+    "LOCK_SANITIZER_ENV", "MAX_VIOLATIONS", "NamedLock", "arm",
+    "armed", "check_stopped", "disarm", "dropped", "find_cycles",
+    "held_locks", "live_threads", "lock_order_edges", "named_lock",
+    "note_blocking", "owner_token", "report", "reset", "track_thread",
+    "violations",
+]
+
+LOCK_SANITIZER_ENV = "PADDLE_TPU_LOCK_SANITIZER"
+
+# the hot-path gate: every hook checks this single module bool
+_on = os.environ.get(LOCK_SANITIZER_ENV, "").lower() in ("1", "on", "true")
+
+MAX_VIOLATIONS = 256
+
+_state = threading.Lock()   # guards everything below (never a NamedLock)
+_edges = {}                 # (held_name, acq_name) -> edge record
+_lock_names = set()         # every NamedLock name ever constructed
+_violations = collections.deque(maxlen=MAX_VIOLATIONS)
+_dropped = 0
+_threads = {}               # owner token -> [weakref.ref(Thread)]
+_tls = threading.local()    # .held = [(name, stack)] acquisition order
+
+
+def armed():
+    return _on
+
+
+def arm():
+    """Enable recording (tests / debugging sessions / CI lanes)."""
+    global _on
+    _on = True
+
+
+def disarm():
+    global _on
+    _on = False
+
+
+def reset():
+    """Clear the lock-order graph, violations, and drop counter (keeps
+    the thread registry and armed state — live threads stay tracked)."""
+    global _dropped
+    with _state:
+        _edges.clear()
+        _violations.clear()
+        _dropped = 0
+
+
+def _stack(skip=2, limit=9):
+    """Compact acquisition/blocking-site stack: innermost frames last,
+    the sanitizer's own frames stripped."""
+    frames = traceback.extract_stack(limit=limit)
+    if skip:
+        frames = frames[:-skip]
+    return ["%s:%d in %s" % (f.filename, f.lineno, f.name)
+            for f in frames[-5:]]
+
+
+def _held():
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def held_locks():
+    """Names of named locks the CALLING thread currently holds, in
+    acquisition order."""
+    return [name for name, _stk in _held()]
+
+
+def _record_violation(v):
+    """Append one violation (bounded; overflow counts as dropped) and
+    mirror it to the obs hub. Called with ``_state`` NOT held."""
+    global _dropped
+    with _state:
+        if len(_violations) == _violations.maxlen:
+            _dropped += 1
+        _violations.append(v)
+    obs.inc("sanitizer.violations")
+    obs.event("lock_violation", source="sanitizer", check=v["check"],
+              locks=",".join(v.get("locks", ())),
+              threads=",".join(v.get("threads", ())))
+
+
+def violations():
+    """Snapshot of recorded violations (list of dicts, oldest first)."""
+    with _state:
+        return list(_violations)
+
+
+def dropped():
+    """Violations discarded because the bounded buffer overflowed."""
+    with _state:
+        return _dropped
+
+
+# ---------------------------------------------------------------------------
+# named locks + the lock-order graph
+# ---------------------------------------------------------------------------
+
+class NamedLock:
+    """A ``threading.Lock``/``RLock`` with a lock-class name that
+    registers acquisition order in the sanitizer's graph when armed.
+    Supports the full context-manager / acquire / release protocol."""
+
+    __slots__ = ("name", "recursive", "_lock")
+
+    def __init__(self, name, recursive=False):
+        self.name = str(name)
+        self.recursive = bool(recursive)
+        self._lock = threading.RLock() if recursive else threading.Lock()
+        with _state:
+            _lock_names.add(self.name)
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok and _on:
+            self._note_acquire()
+        return ok
+
+    def release(self):
+        if _on:
+            self._note_release()
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- armed-mode bookkeeping (off the hot path) -----------------------
+    def _note_acquire(self):
+        held = _held()
+        stack = _stack(skip=3)
+        for held_name, held_stack in held:
+            if held_name != self.name:  # RLock re-entry adds no edge
+                _add_edge(held_name, self.name, held_stack, stack)
+        held.append((self.name, stack))
+
+    def _note_release(self):
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == self.name:
+                del held[i]
+                break
+
+    def locked(self):
+        if self.recursive:
+            # RLock has no locked(); a non-blocking probe answers it
+            if self._lock.acquire(blocking=False):
+                self._lock.release()
+                return False
+            return True
+        return self._lock.locked()
+
+    def __repr__(self):
+        return "NamedLock(%r%s)" % (
+            self.name, ", recursive=True" if self.recursive else "")
+
+
+def named_lock(name, recursive=False):
+    """Build a :class:`NamedLock`. ``name`` is the lock *class*
+    (e.g. ``"serving.engine.admit"``) shared by every instance of the
+    owning component, so orders learned on one instance guard all."""
+    return NamedLock(name, recursive=recursive)
+
+
+def _add_edge(a, b, stack_a, stack_b):
+    me = threading.current_thread().name
+    with _state:
+        if (a, b) in _edges:
+            return
+        _edges[(a, b)] = {
+            "from": a, "to": b, "thread": me,
+            "stacks": [list(stack_a), list(stack_b)],
+        }
+        n_edges = len(_edges)
+        path = _path_between(b, a)  # reverse path => cycle through (a, b)
+    obs.set_gauge("analysis.lock_graph_edges", n_edges)
+    if path is None:
+        return
+    # cycle: a -> b (new edge, this thread) then b ->* a (recorded by
+    # other threads). Attach every edge's acquisition stacks — for the
+    # two-lock case that is exactly "both threads' stacks".
+    cycle_names = [a, b] + [e["to"] for e in path if e["to"] != a]
+    _record_violation({
+        "check": "potential-deadlock",
+        "locks": cycle_names,
+        "threads": [me] + [e["thread"] for e in path],
+        "stacks": [list(stack_a), list(stack_b)]
+        + [s for e in path for s in e["stacks"]],
+        "message": "lock-order cycle %s: this thread acquired %r while "
+                   "holding %r, but the reverse order is already "
+                   "recorded — two threads interleaving these paths "
+                   "deadlock" % (" -> ".join(cycle_names + [a]), b, a),
+    })
+
+
+def _path_between(src, dst):
+    """Edge records along some ``src ->* dst`` path in the recorded
+    graph, or None. Called with ``_state`` held."""
+    adj = collections.defaultdict(list)
+    for (x, _y), rec in _edges.items():
+        adj[x].append(rec)
+    parent = {src: None}
+    queue = collections.deque([src])
+    while queue:
+        node = queue.popleft()
+        for rec in adj.get(node, ()):
+            nxt = rec["to"]
+            if nxt in parent:
+                continue
+            parent[nxt] = (node, rec)
+            if nxt == dst:
+                path = []
+                cur = nxt
+                while parent[cur] is not None:
+                    prev, rec2 = parent[cur]
+                    path.append(rec2)
+                    cur = prev
+                path.reverse()
+                return path
+            queue.append(nxt)
+    return None
+
+
+def lock_order_edges():
+    """Snapshot of the recorded lock-order graph: list of edge dicts
+    (``from``/``to``/``thread``/``stacks``), deterministic order."""
+    with _state:
+        return [dict(_edges[k]) for k in sorted(_edges)]
+
+
+def find_cycles():
+    """Every simple cycle in the recorded graph as a list of lock-name
+    lists (each rotated to start at its smallest name, deduplicated)."""
+    with _state:
+        edges = list(_edges)
+    adj = collections.defaultdict(list)
+    for a, b in edges:
+        adj[a].append(b)
+    cycles = set()
+
+    def walk(start, node, trail):
+        for nxt in adj.get(node, ()):
+            if nxt == start:
+                cyc = trail[:]
+                k = cyc.index(min(cyc))
+                cycles.add(tuple(cyc[k:] + cyc[:k]))
+            elif nxt not in trail:
+                walk(start, nxt, trail + [nxt])
+
+    for a in sorted(adj):
+        walk(a, a, [a])
+    return [list(c) for c in sorted(cycles)]
+
+
+# ---------------------------------------------------------------------------
+# blocking-call-while-holding-lock
+# ---------------------------------------------------------------------------
+
+def note_blocking(what):
+    """Mark a blocking call site (``queue.get``, ``time.sleep``, device
+    dispatch, directory scans). Armed + any named lock held => a
+    ``blocking-under-lock`` violation with the lock acquisition stack
+    and this call site's stack. Disarmed: one module-bool check."""
+    if not _on:
+        return
+    held = _held()
+    if not held:
+        return
+    lock_name, lock_stack = held[-1]
+    _record_violation({
+        "check": "blocking-under-lock",
+        "what": str(what),
+        "locks": [n for n, _s in held],
+        "threads": [threading.current_thread().name],
+        "stacks": [list(lock_stack), _stack(skip=2)],
+        "message": "blocking call %r while holding lock(s) %s — every "
+                   "other thread contending the lock convoys behind "
+                   "this wait; move the blocking call outside the "
+                   "critical section"
+                   % (what, ", ".join(repr(n) for n, _s in held)),
+    })
+
+
+# ---------------------------------------------------------------------------
+# framework thread registry
+# ---------------------------------------------------------------------------
+
+def owner_token(kind, name, instance=None):
+    """Stable registry key for one component instance's threads, e.g.
+    ``owner_token("serving-engine", self.name, self)``."""
+    tok = "%s:%s" % (kind, name)
+    if instance is not None:
+        tok += ":%x" % id(instance)
+    return tok
+
+
+def track_thread(thread, owner):
+    """Register a framework background thread under ``owner`` (an
+    :func:`owner_token`). Always on — the registry is how
+    ``stop()``/``close()`` prove zero leaked threads."""
+    with _state:
+        refs = _threads.setdefault(str(owner), [])
+        refs[:] = [r for r in refs
+                   if r() is not None and r().is_alive()]
+        refs.append(weakref.ref(thread))
+
+
+def live_threads(owner=None):
+    """Still-alive registered threads (for ``owner``, or all)."""
+    with _state:
+        if owner is None:
+            refs = [r for rs in _threads.values() for r in rs]
+        else:
+            refs = list(_threads.get(str(owner), ()))
+    out = []
+    for r in refs:
+        t = r()
+        if t is not None and t.is_alive():
+            out.append(t)
+    return out
+
+
+def check_stopped(owner, grace=1.0):
+    """Assert every thread registered under ``owner`` has exited —
+    called at the END of ``stop()``/``close()``, after joins. Waits up
+    to ``grace`` seconds for stragglers (joins already signalled them),
+    then returns the leaked thread names; armed, each leak is also a
+    ``thread-leak`` violation and a ``threads.leaked`` count."""
+    deadline = None
+    while True:
+        alive = live_threads(owner)
+        if not alive:
+            break
+        import time as _time
+        now = _time.monotonic()
+        if deadline is None:
+            deadline = now + max(0.0, float(grace))
+        if now >= deadline:
+            break
+        _time.sleep(0.01)
+    with _state:
+        if not alive:
+            _threads.pop(str(owner), None)
+        else:
+            refs = _threads.get(str(owner))
+            if refs is not None:
+                refs[:] = [r for r in refs
+                           if r() is not None and r().is_alive()]
+    if not alive:
+        return []
+    names = [t.name for t in alive]
+    obs.inc("threads.leaked", len(names))
+    if _on:
+        _record_violation({
+            "check": "thread-leak",
+            "owner": str(owner),
+            "locks": [],
+            "threads": names,
+            "stacks": [_stack(skip=2)],
+            "message": "stop()/close() of %s left %d thread(s) alive: "
+                       "%s — the component's shutdown path does not "
+                       "join every thread it spawned"
+                       % (owner, len(names), ", ".join(names)),
+        })
+    return names
+
+
+# ---------------------------------------------------------------------------
+# report surface (CLI --concurrency, tests, lanes)
+# ---------------------------------------------------------------------------
+
+def report():
+    """One dict over everything recorded: registered lock classes, the
+    order graph, cycles, violations (+ drop count), live registered
+    threads. Stable ordering — lanes can diff it."""
+    with _state:
+        locks = sorted(_lock_names)
+        n_dropped = _dropped
+    live = sorted(t.name for t in live_threads())
+    return {
+        "armed": _on,
+        "locks": locks,
+        "edges": lock_order_edges(),
+        "cycles": find_cycles(),
+        "violations": violations(),
+        "violations_dropped": n_dropped,
+        "live_threads": live,
+    }

@@ -1,0 +1,259 @@
+"""Def-use/donation dataflow pass: proven buffer-donation hazards.
+
+The executor lowers every program to ``jax.jit(step, donate_argnums=
+(0,))`` with argument 0 = the state dict of ALL persistables gathered
+from the Scope (``fluid/executor.py``). Donation is what makes in-place
+parameter updates fit in HBM — and it is also an aliasing footgun:
+after dispatch the donated input buffers are invalid, and any output
+the runtime hands back may occupy one of them. ``tpu_lint`` flags the
+shallow heuristic (``donated-and-fetched``); this pass walks the
+def-use chains and upgrades the provable cases to errors:
+
+- ``use-after-donate`` (ERROR) — a fetch target is donated state that
+  the program REWRITES: the fetched array is (or aliases) the buffer
+  the next dispatch donates, so holding it across the next ``run()``
+  reads freed memory. Also: a donated var whose update op has non-
+  writer readers BOTH before and after it — one step observes two
+  generations of the same parameter (e.g. a gradient computed against
+  the old value while a later op consumes the new one), the exact
+  misordered-update class donation turns from "stale value" into
+  "garbage read". Reads only-after are fine (lr-decay then optimizer
+  reads is the canonical pattern) and stay silent.
+- ``double-donate`` (ERROR) — two distinct global-block ops rewrite
+  one donated var: the first generation is silently discarded and XLA
+  may consume the donated buffer twice across the fused step.
+- ``cross-program-donated-alias`` (WARNING, :func:`check_cross_program`
+  / runtime :func:`note_donation`+:func:`note_capture`) — one Scope
+  var both donated by a training signature and captured by a
+  serving/decode engine. Engines that host-snapshot params
+  (``jax.device_put(np.asarray(...))``) pass ``snapshot=True`` and are
+  exempt; a zero-copy capture of a donated buffer is flagged, because
+  the next training dispatch invalidates the engine's weights mid-
+  flight.
+
+Sub-block reads count: an op whose ``while``/``cond`` body reads a
+donated name via closure (no declared input) is a reader at the owning
+op's position — ``walker._op_reads`` supplies those, mirroring the
+lowering env-copy semantics.
+
+The static pass runs at ``level="full"`` in :func:`analyzer.analyze`
+and in the CLI; the runtime registry is gated on the concurrency
+sanitizer (``PADDLE_TPU_LOCK_SANITIZER``) and costs one module-bool
+check when off.
+"""
+import weakref
+
+from . import concurrency, walker
+from .diagnostics import ERROR, WARNING, AnalysisReport
+
+__all__ = [
+    "analyze_donation", "check_cross_program", "note_capture",
+    "note_donation", "reset_runtime",
+]
+
+
+def _global_writers(program, donated):
+    """donated name -> [op indices in the global block writing it]."""
+    writers = {}
+    gb = program.global_block()
+    for i, op in enumerate(gb.ops):
+        for ns in op.outputs.values():
+            for n in ns:
+                if n in donated:
+                    writers.setdefault(n, []).append(i)
+    return writers
+
+
+def analyze_donation(program, feed_names=(), fetch_names=(),
+                     state_names=None):
+    """Run the static donation dataflow pass over one program.
+
+    ``state_names`` mirrors the executor's donation set; ``None`` means
+    every global-block persistable (what ``_gather_state`` donates).
+    """
+    report = AnalysisReport(checks=["dataflow"])
+    gb = program.global_block()
+    donated = set(state_names) if state_names is not None else {
+        n for n, v in gb.vars.items() if v.persistable}
+    writers = _global_writers(program, donated)
+    report.meta["donated_vars"] = len(donated)
+    report.meta["donated_rewritten"] = len(writers)
+
+    # -- feed shadows donated state: the host feed wins, the scope copy
+    # is donated anyway, so the value the user fed never persists ---------
+    for name in feed_names:
+        if name in donated:
+            report.add(
+                WARNING, "feed-shadows-donated-state",
+                "feed var '%s' is also donated state: the dispatch "
+                "donates the scope copy while the host feed shadows it, "
+                "so the fed value never persists past this run() — feed "
+                "a non-persistable input or drop it from the state set"
+                % name, block_idx=0, var=name)
+
+    # -- double-donate: two ops rewrite one donated buffer ----------------
+    for name in sorted(writers):
+        idxs = writers[name]
+        if len(idxs) > 1:
+            first, last = idxs[0], idxs[-1]
+            report.add(
+                ERROR, "double-donate",
+                "donated var '%s' is rewritten by %d ops (op %d '%s' "
+                "then op %d '%s'): the intermediate generation is "
+                "discarded and the donated buffer is consumed more than "
+                "once in one step — fold the updates into one op or "
+                "stage through a non-persistable temp"
+                % (name, len(idxs), first, gb.ops[first].type, last,
+                   gb.ops[last].type),
+                block_idx=0, op_index=last, var=name, op=gb.ops[last])
+
+    # -- use-after-donate: fetched donated-and-rewritten buffer -----------
+    for name in fetch_names:
+        if name in donated and name in writers:
+            idx = writers[name][-1]
+            report.add(
+                ERROR, "use-after-donate",
+                "fetch var '%s' is donated state rewritten by op %d "
+                "'%s': the fetched array occupies a buffer the NEXT "
+                "dispatch donates, so holding it across another run() "
+                "reads invalidated memory — fetch a non-persistable "
+                "copy (assign to a temp) or read it from the scope "
+                "after the run" % (name, idx, gb.ops[idx].type),
+                block_idx=0, op_index=idx, var=name, op=gb.ops[idx])
+
+    # -- use-after-donate: reads straddling the update op -----------------
+    # reader map at global-op granularity, closure reads included
+    for name in sorted(set(writers) - set(fetch_names)):
+        if len(writers[name]) != 1:
+            continue  # double-donate already errored; keep one report
+        widx = writers[name][0]
+        before, after = [], []
+        for i, op in enumerate(gb.ops):
+            if i == widx:
+                continue  # the update op's own read is the old gen by
+                # construction — functional lowering, not a hazard
+            if name in walker._op_reads(program, op):
+                (before if i < widx else after).append(i)
+        if before and after:
+            a = after[0]
+            closure = name not in {
+                n for ns in gb.ops[a].inputs.values() for n in ns}
+            report.add(
+                ERROR, "use-after-donate",
+                "donated var '%s' is read%s by op %d '%s' AFTER its "
+                "update at op %d '%s', while op %d '%s' read it before: "
+                "one step observes both generations of a donated "
+                "buffer — move the update after every consumer, or "
+                "stage the pre-update value in a temp"
+                % (name,
+                   " (via sub-block closure)" if closure else "",
+                   a, gb.ops[a].type, widx, gb.ops[widx].type,
+                   before[0], gb.ops[before[0]].type),
+                block_idx=0, op_index=a, var=name, op=gb.ops[a])
+    return report
+
+
+def check_cross_program(donor_program, reader_program,
+                        donor_state_names=None, donor_label="training",
+                        reader_label="serving"):
+    """Static cross-program aliasing check: vars the donor program
+    donates AND rewrites that the reader program also consumes. Sharing
+    one Scope between them means the donor's dispatch invalidates the
+    reader's captured weights."""
+    report = AnalysisReport(checks=["dataflow"])
+    dgb = donor_program.global_block()
+    donated = set(donor_state_names) if donor_state_names is not None \
+        else {n for n, v in dgb.vars.items() if v.persistable}
+    rewritten = set(_global_writers(donor_program, donated))
+    if not rewritten:
+        return report
+    reads = set()
+    for _block, _i, op in walker.iter_ops(reader_program):
+        reads |= walker._op_reads(reader_program, op)
+        for ns in op.inputs.values():
+            reads.update(ns)
+    for name in sorted(rewritten & reads):
+        report.add(
+            WARNING, "cross-program-donated-alias",
+            "var '%s' is donated and rewritten by the %s program and "
+            "read by the %s program: sharing one Scope aliases the %s "
+            "weights to a buffer the %s dispatch donates — run them on "
+            "separate scopes, or host-snapshot the captured params "
+            "(jax.device_put(np.asarray(...)))"
+            % (name, donor_label, reader_label, reader_label,
+               donor_label),
+            block_idx=0, var=name)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# runtime donation/capture registry (armed with the lock sanitizer)
+# ---------------------------------------------------------------------------
+
+# scope token -> {var name -> (consumer, snapshot)}
+_captures = {}
+_finalized = set()
+
+
+def _scope_key(scope):
+    from . import sanitizer
+    tok = sanitizer.scope_token(scope)
+    if tok not in _finalized:
+        _finalized.add(tok)
+        try:
+            weakref.finalize(scope, _evict, tok)
+        except TypeError:
+            pass
+    return tok
+
+
+def _evict(tok):
+    _captures.pop(tok, None)
+    _finalized.discard(tok)
+
+
+def note_capture(scope, names, consumer, snapshot=False):
+    """An engine captured ``names`` from ``scope``. ``snapshot=True``
+    means it copied host-side (decode/prefill engines) — exempt from
+    aliasing. Gated on the concurrency sanitizer; off = one bool check."""
+    if not concurrency._on:
+        return
+    caps = _captures.setdefault(_scope_key(scope), {})
+    for n in names:
+        caps[n] = (str(consumer), bool(snapshot))
+
+
+def note_donation(scope, names):
+    """The executor is about to donate ``names`` from ``scope``. Any
+    non-snapshot capture of one of them is a live aliasing hazard —
+    recorded as a ``cross-program-donated-alias`` violation on the
+    shared concurrency report surface."""
+    if not concurrency._on:
+        return
+    caps = _captures.get(_scope_key(scope))
+    if not caps:
+        return
+    for n in names:
+        hit = caps.get(n)
+        if hit is None or hit[1]:
+            continue
+        consumer = hit[0]
+        caps.pop(n, None)  # report each capture once
+        concurrency._record_violation({
+            "check": "cross-program-donated-alias",
+            "var": n,
+            "consumer": consumer,
+            "locks": [],
+            "threads": [],
+            "stacks": [concurrency._stack(skip=2)],
+            "message": "scope var %r is captured (zero-copy) by %s and "
+                       "is about to be DONATED by a training dispatch "
+                       "on the same scope — the capture's buffer is "
+                       "invalidated mid-flight; snapshot the params "
+                       "host-side or split the scopes" % (n, consumer),
+        })
+
+
+def reset_runtime():
+    """Drop every recorded capture (tests / session scoping)."""
+    _captures.clear()

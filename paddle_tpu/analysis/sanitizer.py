@@ -16,27 +16,47 @@ Sequential handoff (previous writer already exited, e.g. a finished
 watchdog worker) transfers ownership silently: that is a
 happens-before edge, not a race.
 
+Scopes are identified by a monotonically increasing token bound to the
+scope via ``weakref.finalize`` — NOT by raw ``id(scope)``. Raw ids
+leak an entry per dead scope AND, worse, CPython reuses ids after GC,
+so a fresh scope allocated at a recycled address would inherit the
+dead scope's writer records and mis-attribute a legitimate handoff as
+a same-scope cross-thread write. Finalizers evict a dead scope's
+tokens and writer entries, so long sessions stay bounded. Violations
+are bounded too (:data:`MAX_VIOLATIONS`, overflow counted by
+:func:`dropped`) — a hot racing pair must not OOM the process it is
+diagnosing.
+
 Off (the default), the hook in ``Scope`` is a single module-bool check.
 Stdlib-only (+observability) so the executor can import it at module
 level without accelerator init.
 """
+import collections
+import itertools
 import os
 import threading
 import traceback
+import weakref
 
 from .. import observability as obs
 
 __all__ = ["armed", "arm", "disarm", "record_write", "violations",
-           "reset", "SANITIZER_ENV"]
+           "reset", "dropped", "scope_token", "SANITIZER_ENV",
+           "MAX_VIOLATIONS"]
 
 SANITIZER_ENV = "PADDLE_TPU_SCOPE_SANITIZER"
 
 # the hot-path gate: Scope.set/update check this single bool
 _on = os.environ.get(SANITIZER_ENV, "").lower() in ("1", "on", "true")
 
+MAX_VIOLATIONS = 256
+
 _lock = threading.Lock()
-_writers = {}     # (id(scope), name) -> (thread, stack_summary)
-_violations = []
+_writers = {}       # (scope_token, name) -> (thread, stack_summary)
+_scope_tokens = {}  # id(scope) -> token (valid while the scope lives)
+_next_token = itertools.count(1)
+_violations = collections.deque(maxlen=MAX_VIOLATIONS)
+_dropped = 0
 
 
 def armed():
@@ -54,11 +74,43 @@ def disarm():
     _on = False
 
 
+def scope_token(scope):
+    """Process-unique token for a live scope. Unlike ``id(scope)``, a
+    token is never reused: a finalizer retires it (and its writer
+    entries) when the scope is collected, so a new scope at a recycled
+    address gets a fresh token."""
+    key = id(scope)
+    with _lock:
+        tok = _scope_tokens.get(key)
+        if tok is not None:
+            return tok
+        tok = next(_next_token)
+        _scope_tokens[key] = tok
+    try:
+        weakref.finalize(scope, _evict_scope, key, tok)
+    except TypeError:
+        # non-weakref-able scope stand-ins (tests may pass plain dicts);
+        # the entry stays until reset() — degraded, not wrong, since the
+        # token still never aliases another live scope
+        pass
+    return tok
+
+
+def _evict_scope(key, tok):
+    """Finalizer: retire a dead scope's token + writer entries."""
+    with _lock:
+        if _scope_tokens.get(key) == tok:
+            del _scope_tokens[key]
+        for k in [k for k in _writers if k[0] == tok]:
+            del _writers[k]
+
+
 def record_write(scope, name):
     """Called by Scope.set/update when armed. Never raises."""
+    global _dropped
     me = threading.current_thread()
     stack = traceback.extract_stack(limit=7)[:-2]
-    key = (id(scope), name)
+    key = (scope_token(scope), name)
     with _lock:
         prev = _writers.get(key)
         _writers[key] = (me, stack)
@@ -69,7 +121,7 @@ def record_write(scope, name):
             return
         v = {
             "var": name,
-            "scope": id(scope),
+            "scope": key[0],
             "threads": [prev_thread.name, me.name],
             "stacks": [
                 ["%s:%d in %s" % (f.filename, f.lineno, f.name)
@@ -77,19 +129,31 @@ def record_write(scope, name):
                 for s in (prev_stack, stack)
             ],
         }
+        if len(_violations) == _violations.maxlen:
+            _dropped += 1
         _violations.append(v)
+    obs.inc("sanitizer.violations")
     obs.event("scope_race", source="sanitizer", var=name,
               threads="%s -> %s" % (prev_thread.name, me.name))
 
 
 def violations():
-    """Snapshot of recorded violations (list of dicts)."""
+    """Snapshot of recorded violations (list of dicts, oldest first)."""
     with _lock:
         return list(_violations)
 
 
+def dropped():
+    """Violations discarded because the bounded buffer overflowed."""
+    with _lock:
+        return _dropped
+
+
 def reset():
-    """Clear tracked writers + violations (does not change armed state)."""
+    """Clear tracked writers + violations (does not change armed state
+    or retire live scope tokens — those stay valid for reuse)."""
+    global _dropped
     with _lock:
         _writers.clear()
-        del _violations[:]
+        _violations.clear()
+        _dropped = 0

@@ -49,6 +49,7 @@ import numpy as np
 
 from . import core
 from .. import observability as obs
+from ..analysis import concurrency as _conc
 
 __all__ = ["PipelinedRunner", "ASYNC_DEPTH_ENV"]
 
@@ -85,6 +86,7 @@ class PipelinedRunner:
         self._stop = threading.Event()
         self._thread = None
         self._iterated = False
+        self._owner = _conc.owner_token("pipelined-runner", "stager", self)
         # timing records for the overlap gauge (and for tests):
         # stage = [(t0, t1), ...] per staged batch (stager thread),
         # busy  = [(dispatch_t0, results_t1), ...] per step (consumer)
@@ -171,10 +173,13 @@ class PipelinedRunner:
         self._thread = threading.Thread(
             target=self._stage_loop, daemon=True,
             name="paddle_tpu-feed-stager")
+        _conc.track_thread(self._thread, self._owner)
         self._thread.start()
         inflight = collections.deque()
         try:
             while True:
+                if _conc._on:
+                    _conc.note_blocking("queue.get")
                 item = self._q.get()
                 if item is _END:
                     break
@@ -237,3 +242,6 @@ class PipelinedRunner:
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout=2.0)
+        # the stager must be gone after close(); a survivor is a leak
+        # (a violation when the lock sanitizer is armed)
+        _conc.check_stopped(self._owner, grace=0.5)

@@ -25,6 +25,7 @@ from .resilience import fault_check
 from .. import observability as obs
 # stdlib-only runtime guard (PADDLE_TPU_SCOPE_SANITIZER); the hot-path
 # cost with the sanitizer off is one module-bool check per Scope write
+from ..analysis import concurrency as _conc
 from ..analysis import sanitizer as _sanitizer
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
@@ -342,6 +343,14 @@ class Executor:
             else:
                 obs.inc("executor.cache_hit")
 
+            if _conc._on:
+                # dispatch donates the state buffers: flag captures of
+                # them (serving engines sharing this scope) and any lock
+                # held across the blocking device call
+                from ..analysis import dataflow as _dataflow
+
+                _dataflow.note_donation(scope, state)
+                _conc.note_blocking("device.dispatch")
             with obs.span("executor.device_compute"):
                 try:
                     fetches, new_state = entry(state, feed_arrays, rng)
@@ -484,6 +493,11 @@ class Executor:
             self._cache_store(sig, entry)
         else:
             obs.inc("executor.cache_hit")
+        if _conc._on:
+            from ..analysis import dataflow as _dataflow
+
+            _dataflow.note_donation(scope, state)
+            _conc.note_blocking("device.dispatch")
         new_state = entry(state, stacked, rngs)
         for name, v in new_state.items():
             scope.update(name, v)

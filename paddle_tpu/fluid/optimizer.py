@@ -139,11 +139,13 @@ class Optimizer:
         return optimize_ops
 
     def apply_gradients(self, params_grads, grad_clip=None):
-        # The reference only honors grad_clip in dygraph mode (TODO at
-        # ref optimizer.py:3774 for static) — here the static path
-        # honors it too, by emitting clip ops over the grad vars under
-        # the current program guard. Direct apply_gradients callers get
-        # the same clipping minimize() routes through here.
+        # Contract: grad_clip is honored on the STATIC path — clip ops
+        # are emitted over the grad vars under the current program
+        # guard, BEFORE per-param clip attrs and regularization, so a
+        # global-norm clip sees the raw gradients. minimize() routes
+        # through here, and direct apply_gradients callers get
+        # identical clipping (tests/test_round3_fixes.py pins the
+        # clipped-vs-unclipped delta norm to max_norm).
         params_grads = sorted(params_grads, key=lambda x: x[0].name)
         params_grads, table_param_and_grad, table_optimize_op = (
             params_grads,

@@ -146,6 +146,24 @@ Well-known disaggregated-serving metrics (PR 12, ``serving.disagg``):
   ``serving.disagg.handoff_bytes.<engine>`` gauge price the KV handoff
   itself (int8 block-scaled wire ≈ 3.9x smaller than fp32).
 
+Well-known concurrency/donation metrics (PR 13,
+``analysis.concurrency`` / ``analysis.dataflow``):
+
+- ``analysis.lock_graph_edges`` gauge — distinct ``held -> acquiring``
+  edges in the armed lock-order graph (``PADDLE_TPU_LOCK_SANITIZER``);
+  a growing value means new lock nestings are being exercised.
+- ``sanitizer.violations`` counter — every recorded violation across
+  BOTH runtime sanitizers: lock-order cycles (``potential-deadlock``),
+  ``blocking-under-lock``, ``thread-leak``,
+  ``cross-program-donated-alias`` (a zero-copy engine capture of a var
+  a training dispatch donates), and scope write races.
+- ``threads.leaked`` counter — threads still alive when a component's
+  ``stop()``/``close()`` called ``check_stopped`` (counted even
+  disarmed; the violation record itself requires the armed sanitizer).
+- ``lock_violation`` events (source ``sanitizer``) carry the check
+  name, lock names, and thread names of each concurrency violation
+  into the flight recorder, next to the existing ``scope_race`` events.
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

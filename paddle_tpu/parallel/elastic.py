@@ -64,6 +64,7 @@ import time
 import numpy as np
 
 from .. import observability as obs
+from ..analysis import concurrency as _conc
 from ..fluid import resilience as R
 from ..fluid.resilience import (  # re-exported surface  # noqa: F401
     CollectiveTimeoutError, collective_deadline, deadline_remaining,
@@ -166,7 +167,7 @@ class InMemoryStore(HeartbeatStore):
     reference semantics the FileStore must match."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _conc.named_lock("elastic.memstore", recursive=True)
         self._data = collections.defaultdict(dict)
 
     def put(self, namespace, key, payload):
@@ -207,7 +208,7 @@ class FileStore(HeartbeatStore):
     def __init__(self, root):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
-        self._cache_lock = threading.Lock()
+        self._cache_lock = _conc.named_lock("elastic.filestore.cache")
         self._cache = {}   # dir -> (dir_mtime_ns, scan_wall_ns, parsed)
         self._made = set()  # dirs already created (skip makedirs per op)
 
@@ -246,6 +247,11 @@ class FileStore(HeartbeatStore):
             self._cache.pop(d, None)
 
     def _scan(self, d):
+        # a directory of beacon files is a blocking filesystem walk —
+        # it must never run under the cache lock (or any engine lock):
+        # health polls would convoy every submitter behind disk latency
+        if _conc._on:
+            _conc.note_blocking("filestore.scan")
         out = {}
         for entry in os.listdir(d):
             if not entry.endswith(".json"):
@@ -555,6 +561,8 @@ class FleetGuard:
         # transfer, or every long step reads as death to the peers
         self._beater = None
         self._beater_stop = threading.Event()
+        self._owner = _conc.owner_token(
+            "fleet-guard", "worker-%d" % self.worker_index, self)
         self._fatal = None        # exception that killed the beater
 
     # -- background beacon thread ----------------------------------------
@@ -576,12 +584,14 @@ class FleetGuard:
             self._beater = threading.Thread(
                 target=self._beat_loop, daemon=True,
                 name="paddle_tpu-heartbeat-%d" % self.worker_index)
+            _conc.track_thread(self._beater, self._owner)
             self._beater.start()
 
     def _stop_beater(self):
         self._beater_stop.set()
         if self._beater is not None:
             self._beater.join(timeout=2.0)
+        _conc.check_stopped(self._owner, grace=0.5)
 
     def _check_fatal(self):
         if self._fatal is not None:

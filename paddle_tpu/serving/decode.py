@@ -67,6 +67,8 @@ import time
 import numpy as np
 
 from .. import observability as obs
+from ..analysis import concurrency as _conc
+from ..analysis import dataflow as _dataflow
 from .engine import DeadlineExceededError, EngineClosedError, ShedError
 
 __all__ = ["DecodeEngine", "DecodeStream", "default_prompt_buckets",
@@ -302,6 +304,13 @@ class DecodeEngine:
                 # executor's buffers would let its donating step
                 # invalidate them under this engine mid-serve
                 persist[v.name] = jax.device_put(np.asarray(scope[v.name]))
+        if _conc._on:
+            # the copy above breaks aliasing with the training executor's
+            # donated buffers — register it so the donation registry can
+            # prove (not assume) no cross-program alias survives
+            _dataflow.note_capture(scope, persist,
+                                   "decode-engine %r" % self.name,
+                                   snapshot=True)
         self._params = persist
         self._step_vars = step_vars
         self._step_pred = Predictor(
@@ -339,11 +348,12 @@ class DecodeEngine:
         self._stop_event = threading.Event()
         self._abort = False
         self._closed = False
-        self._admit_lock = threading.Lock()
-        self._stats_lock = threading.Lock()
+        self._admit_lock = _conc.named_lock("serving.decode.admit")
+        self._stats_lock = _conc.named_lock("serving.decode.stats")
         self._stats = collections.Counter()
         self._rate = collections.deque(maxlen=64)  # (t_done, 1) retires
         self._thread = None
+        self._owner = _conc.owner_token("decode-engine", self.name, self)
         if auto_start:
             self.start()
 
@@ -375,6 +385,7 @@ class DecodeEngine:
             self._thread = threading.Thread(
                 target=self._loop, daemon=True,
                 name="decode-dispatch-%s" % self.name)
+            _conc.track_thread(self._thread, self._owner)
             self._thread.start()
         return self
 
@@ -401,6 +412,13 @@ class DecodeEngine:
                 self._slots[i] = None
                 s.handle._fail(EngineClosedError(
                     "engine %r stopped mid-generation" % self.name))
+        # a dispatch thread alive past stop() is a leak (violation when
+        # the lock sanitizer is armed). The grace window must outlast an
+        # in-flight jit trace+compile — chaos kill() joins for only
+        # 0.2s, and a slot-composition signature miss can hold the loop
+        # in compile for seconds; the poll returns the instant the
+        # thread exits, so clean shutdowns never wait.
+        _conc.check_stopped(self._owner, grace=10.0)
         obs.event("engine_stop", source="serving", count=False,
                   model=self.name, engine="decode", drained=bool(drain))
 
@@ -685,6 +703,8 @@ class DecodeEngine:
             if live == 0:
                 if self._stop_event.is_set() and self._q.empty():
                     return
+                if _conc._on:
+                    _conc.note_blocking("time.sleep(idle)")
                 time.sleep(0.002)
                 continue
             self._step()
@@ -768,6 +788,8 @@ class DecodeEngine:
         ids[0, :req.plen] = req.prompt
         plen = np.asarray([[req.plen]], np.int64)
         try:
+            if _conc._on:
+                _conc.note_blocking("device.dispatch")
             nxt, k1, v1 = self._prefill_preds[req.bucket].run(
                 {"gpt_prefill_ids": ids, "gpt_prefill_len": plen},
                 return_numpy=False)
@@ -885,6 +907,8 @@ class DecodeEngine:
     def _step(self):
         t0 = time.monotonic()
         try:
+            if _conc._on:
+                _conc.note_blocking("device.dispatch")
             if self.kv_dtype == "int8":
                 (nxt, self._k, self._v, self._kscale,
                  self._vscale) = self._step_pred.run(

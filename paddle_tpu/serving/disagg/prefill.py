@@ -29,6 +29,8 @@ import time
 import numpy as np
 
 from ... import observability as obs
+from ...analysis import concurrency as _conc
+from ...analysis import dataflow as _dataflow
 from ..engine import DeadlineExceededError, EngineClosedError, ShedError
 from . import kv_wire
 
@@ -145,6 +147,10 @@ class PrefillEngine:
                         "param %r required by the prefill programs is "
                         "missing from the given scope" % v.name)
                 persist[v.name] = jax.device_put(np.asarray(scope[v.name]))
+        if _conc._on:
+            _dataflow.note_capture(scope, persist,
+                                   "prefill-engine %r" % self.name,
+                                   snapshot=True)
         self._params = persist
         self._prefill_preds = {}
         for b, (prog, pv) in prefill.items():
@@ -154,13 +160,17 @@ class PrefillEngine:
         self._capacity = int(queue_capacity)
         self._heap = []          # (priority, seq, req) — min-heap
         self._seq = 0
+        # submit/stop coordination needs wait/notify — a Condition's
+        # inner lock stays a plain threading primitive (the lock-order
+        # recorder only wraps plain mutexes)
         self._cond = threading.Condition()
         self._closed = False
         self._abort = False
-        self._stats_lock = threading.Lock()
+        self._stats_lock = _conc.named_lock("serving.prefill.stats")
         self._stats = collections.Counter()
         self._rate = collections.deque(maxlen=64)
         self._thread = None
+        self._owner = _conc.owner_token("prefill-engine", self.name, self)
         if auto_start:
             self.start()
 
@@ -172,6 +182,7 @@ class PrefillEngine:
             self._thread = threading.Thread(
                 target=self._loop, daemon=True,
                 name="prefill-dispatch-%s" % self.name)
+            _conc.track_thread(self._thread, self._owner)
             self._thread.start()
         return self
 
@@ -191,6 +202,9 @@ class PrefillEngine:
         for req in leftovers:
             req.ticket._fail(EngineClosedError(
                 "engine %r stopped before prefill" % self.name))
+        # grace outlasts an in-flight jit compile on short-join stops;
+        # the poll returns the instant the thread exits
+        _conc.check_stopped(self._owner, grace=10.0)
         obs.event("engine_stop", source="serving", count=False,
                   model=self.name, engine="prefill", drained=bool(drain))
 
@@ -302,6 +316,8 @@ class PrefillEngine:
         ids[0, :req.plen] = req.prompt
         plen = np.asarray([[req.plen]], np.int64)
         try:
+            if _conc._on:
+                _conc.note_blocking("device.dispatch")
             nxt, k1, v1 = self._prefill_preds[req.bucket].run(
                 {"gpt_prefill_ids": ids, "gpt_prefill_len": plen})
             handoff = kv_wire.encode_kv(

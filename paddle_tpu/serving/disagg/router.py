@@ -47,6 +47,7 @@ import time
 import numpy as np
 
 from ... import observability as obs
+from ...analysis import concurrency as _conc
 from ...parallel.elastic import ElasticConfig, HeartbeatMonitor, InMemoryStore
 from ..decode import DecodeEngine, DecodeStream
 from ..engine import EngineClosedError, ShedError
@@ -97,6 +98,8 @@ class DisaggReplica:
         self._beats = 0
         self._beat_stop = threading.Event()
         self._beater = None
+        self._owner = _conc.owner_token(
+            "disagg-replica", "%s-%d" % (self.name, self.rid), self)
         if start_beating:
             self.start_beating()
 
@@ -127,6 +130,7 @@ class DisaggReplica:
             self._beater = threading.Thread(
                 target=self._beat_loop, daemon=True,
                 name="disagg-beat-%s-%d" % (self.name, self.rid))
+            _conc.track_thread(self._beater, self._owner)
             self._beater.start()
 
     def queue_depth(self):
@@ -142,6 +146,7 @@ class DisaggReplica:
         if self._beater is not None:
             self._beater.join(timeout=1.0)
         self.engine.stop(drain=False, timeout=0.2)
+        _conc.check_stopped(self._owner, grace=1.0)
 
     def stop(self, drain=True, timeout=30.0):
         self.engine.stop(drain=drain, timeout=timeout)
@@ -152,6 +157,7 @@ class DisaggReplica:
             self.monitor.leave()
         except BaseException:  # noqa: BLE001 — best-effort goodbye
             pass
+        _conc.check_stopped(self._owner, grace=1.0)
 
 
 class _Session:
@@ -203,7 +209,9 @@ class DisaggRouter:
         self.tenants = tenants or TenantTable(model=self.name)
         self.request_timeout_s = float(request_timeout_s)
         self.max_migrations = int(max_migrations)
-        self._lock = threading.RLock()
+        self._lock = _conc.named_lock("serving.disagg.router",
+                                      recursive=True)
+        self._owner = _conc.owner_token("disagg-router", self.name, self)
         self._prefill = {r.rid: r for r in prefill_replicas}
         self._decode = {r.rid: r for r in decode_replicas}
         if len(self._prefill) + len(self._decode) != (
@@ -292,6 +300,7 @@ class DisaggRouter:
             name="disagg-session-%s" % self.name)
         with self._lock:
             self._pumps.add(pump)
+        _conc.track_thread(pump, self._owner)
         pump.start()
         return handle
 
@@ -443,6 +452,8 @@ class DisaggRouter:
                     "every decode replica shed for %r" % self.name,
                     model=self.name,
                     retry_after=self.retry_after_hint())
+            if _conc._on:
+                _conc.note_blocking("time.sleep(backoff)")
             time.sleep(backoff)
             backoff = min(0.2, backoff * 2)
         rid = rep.rid
@@ -486,6 +497,7 @@ class DisaggRouter:
             self._health = threading.Thread(
                 target=self._health_loop, daemon=True,
                 name="disagg-health-%s" % self.name)
+            _conc.track_thread(self._health, self._owner)
             self._health.start()
         return self
 
@@ -643,6 +655,10 @@ class DisaggRouter:
                     + list(self._decode.values()))
         for rep in pool:
             rep.stop(drain=drain, timeout=timeout)
+        # pumps unwind once their replica streams fail/finish; the
+        # grace window covers that unwind (including a migration
+        # re-prefill dispatch caught mid-flight) before declaring a leak
+        _conc.check_stopped(self._owner, grace=10.0)
         obs.event("engine_stop", source="serving", count=False,
                   model=self.name, engine="disagg", drained=bool(drain))
 

@@ -36,6 +36,7 @@ import time
 from concurrent.futures import Future
 
 from .. import observability as obs
+from ..analysis import concurrency as _conc
 from .batcher import assemble, round_up_pow2, tail_signature
 
 __all__ = [
@@ -101,9 +102,10 @@ class ServingEngine:
         # (closed check + put) and the stop-side closed flip are both
         # atomic under _admit_lock, so every request either reaches the
         # queue before the drain starts or gets EngineClosedError.
-        self._admit_lock = threading.Lock()
+        self._admit_lock = _conc.named_lock("serving.engine.admit")
         self._thread = None
-        self._stats_lock = threading.Lock()
+        self._stats_lock = _conc.named_lock("serving.engine.stats")
+        self._owner = _conc.owner_token("serving-engine", self.name, self)
         self._stats = collections.Counter()
         # (t_done, n_requests) per dispatched group — the drain-rate
         # window behind retry_after_hint()
@@ -120,6 +122,7 @@ class ServingEngine:
             self._thread = threading.Thread(
                 target=self._loop, daemon=True,
                 name="serving-dispatch-%s" % self.name)
+            _conc.track_thread(self._thread, self._owner)
             self._thread.start()
         return self
 
@@ -133,6 +136,8 @@ class ServingEngine:
         if drain and alive:
             t_end = time.monotonic() + float(timeout)
             while not self._q.empty() and time.monotonic() < t_end:
+                if _conc._on:
+                    _conc.note_blocking("time.sleep(drain)")
                 time.sleep(0.005)
         self._stop_event.set()
         if alive:
@@ -146,6 +151,11 @@ class ServingEngine:
                 break
             r.future.set_exception(EngineClosedError(
                 "engine %r stopped before dispatch" % self.name))
+        # the dispatch thread must be gone now — a survivor is a leak
+        # (recorded as a violation when the lock sanitizer is armed).
+        # Grace outlasts an in-flight jit compile on short-join stops;
+        # the poll returns the instant the thread exits.
+        _conc.check_stopped(self._owner, grace=10.0)
         obs.event("engine_stop", source="serving", count=False,
                   model=self.name, drained=bool(drain))
 
@@ -298,6 +308,8 @@ class ServingEngine:
                 first, carry = carry, None
             else:
                 try:
+                    if _conc._on:
+                        _conc.note_blocking("queue.get")
                     first = self._q.get(timeout=0.05)
                 except queue.Empty:
                     if self._stop_event.is_set():
@@ -311,6 +323,8 @@ class ServingEngine:
                 if remaining <= 0:
                     break
                 try:
+                    if _conc._on:
+                        _conc.note_blocking("queue.get")
                     r = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
@@ -369,6 +383,8 @@ class ServingEngine:
             obs.observe("serving.queue_wait_seconds", t0 - r.t_enqueue)
         try:
             feeds = assemble(self._predictor.feed_names, reqs, target)
+            if _conc._on:
+                _conc.note_blocking("device.dispatch")
             outs = self._predictor.run(feeds, return_numpy=True)
             for o in outs:
                 if getattr(o, "ndim", 0) < 1 or o.shape[0] != target:

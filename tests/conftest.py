@@ -54,6 +54,36 @@ def pytest_configure(config):
         "slice bench_experiments/disagg_lane.sh runs")
 
 
+@pytest.fixture()
+def armed_sanitizers():
+    """Arm the lock-order/thread sanitizer and the scope sanitizer for
+    one test, then assert it recorded ZERO violations. Chaos drills use
+    this: kill/brownout paths must stay deadlock-free, convoy-free, and
+    leak-free even while replicas die mid-stream."""
+    from paddle_tpu.analysis import concurrency, sanitizer
+
+    was_conc, was_scope = concurrency.armed(), sanitizer.armed()
+    concurrency.arm()
+    concurrency.reset()
+    sanitizer.arm()
+    sanitizer.reset()
+    try:
+        yield
+        conc_v = concurrency.violations()
+        scope_v = sanitizer.violations()
+        leaked = [t.name for t in concurrency.live_threads()]
+    finally:
+        if not was_conc:
+            concurrency.disarm()
+        if not was_scope:
+            sanitizer.disarm()
+        concurrency.reset()
+        sanitizer.reset()
+    assert conc_v == [], conc_v
+    assert scope_v == [], scope_v
+    assert leaked == [], leaked
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Give every test fresh default programs + scope + name generator."""

@@ -1,0 +1,521 @@
+"""Concurrency & donation analyzer (ISSUE 13): the named-lock order
+recorder (seeded deadlock cycles with both stacks, blocking-under-lock,
+thread leaks), the bounded/weakref-scoped sanitizers, the donation
+dataflow pass (use-after-donate / double-donate / cross-program
+aliasing, static AND runtime), and the CLI ``--concurrency`` /
+``--fail-on`` exit-code contract. See ``paddle_tpu/analysis/``."""
+import gc
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import concurrency, dataflow, sanitizer
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture(autouse=True)
+def _pristine_sanitizers():
+    """Every test starts disarmed+empty (module state is
+    process-global); the prior armed state is restored afterwards so an
+    env-armed lane run stays armed across this file."""
+    was_conc, was_scope = concurrency.armed(), sanitizer.armed()
+    concurrency.disarm()
+    concurrency.reset()
+    sanitizer.disarm()
+    sanitizer.reset()
+    dataflow.reset_runtime()
+    yield
+    if was_conc:
+        concurrency.arm()
+    else:
+        concurrency.disarm()
+    if was_scope:
+        sanitizer.arm()
+    else:
+        sanitizer.disarm()
+    concurrency.reset()
+    sanitizer.reset()
+    dataflow.reset_runtime()
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def _fit_a_line():
+    """One SGD training program; returns (program, loss, param_name)."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return fluid.default_main_program(), loss, "fc_0.w_0"
+
+
+# ---------------------------------------------------------------------------
+# lock-order recorder: the seeded deadlock
+# ---------------------------------------------------------------------------
+
+def test_seeded_lock_order_cycle_reports_both_stacks():
+    """Two threads taking two locks in opposite order — sequenced via
+    joins so no real deadlock occurs — must still produce a
+    potential-deadlock violation naming both locks, both threads, and
+    carrying both acquisition stacks."""
+    concurrency.arm()
+    concurrency.reset()
+    a = concurrency.named_lock("test.A")
+    b = concurrency.named_lock("test.B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=forward, name="t1")
+    t1.start()
+    t1.join()
+    assert concurrency.violations() == []  # one order alone is fine
+    t2 = threading.Thread(target=backward, name="t2")
+    t2.start()
+    t2.join()
+
+    hits = [v for v in concurrency.violations()
+            if v["check"] == "potential-deadlock"]
+    assert len(hits) == 1, concurrency.violations()
+    v = hits[0]
+    assert set(v["locks"]) == {"test.A", "test.B"}
+    assert set(v["threads"]) == {"t1", "t2"}
+    # both threads' acquisition stacks, pointing at THIS file
+    assert len(v["stacks"]) >= 2
+    assert all(any("test_concurrency_analysis" in line for line in stk)
+               for stk in v["stacks"][:2])
+    assert "deadlock" in v["message"]
+
+    assert ["test.A", "test.B"] in concurrency.find_cycles()
+    rep = concurrency.report()
+    assert rep["armed"] and rep["cycles"]
+    assert {"test.A", "test.B"} <= set(rep["locks"])
+    edges = {(e["from"], e["to"]) for e in rep["edges"]}
+    assert {("test.A", "test.B"), ("test.B", "test.A")} <= edges
+
+
+def test_consistent_lock_order_stays_clean():
+    concurrency.arm()
+    concurrency.reset()
+    a = concurrency.named_lock("test.C")
+    b = concurrency.named_lock("test.D")
+
+    def nest():
+        with a:
+            with b:
+                pass
+
+    threads = [threading.Thread(target=nest) for _ in range(3)]
+    for t in threads:
+        t.start()
+        t.join()
+    assert concurrency.violations() == []
+    assert concurrency.find_cycles() == []
+    # the one learned edge is deduplicated across instances/threads
+    assert [(e["from"], e["to"]) for e in concurrency.lock_order_edges()] \
+        == [("test.C", "test.D")]
+
+
+def test_recursive_reentry_adds_no_edge_and_disarmed_is_passthrough():
+    concurrency.arm()
+    concurrency.reset()
+    r = concurrency.named_lock("test.re", recursive=True)
+    with r:
+        with r:  # RLock re-entry: no self-edge, no violation
+            assert "test.re" in concurrency.held_locks()
+    assert concurrency.lock_order_edges() == []
+    assert concurrency.violations() == []
+    assert not r.locked()
+
+    concurrency.disarm()
+    plain = concurrency.named_lock("test.off")
+    with plain:
+        assert plain.locked()
+        # disarmed, acquisitions leave no per-thread record
+        assert "test.off" not in concurrency.held_locks()
+    assert concurrency.lock_order_edges() == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock + bounded buffer
+# ---------------------------------------------------------------------------
+
+def test_blocking_under_lock_flagged_with_lock_and_site_stacks():
+    concurrency.arm()
+    concurrency.reset()
+    lock = concurrency.named_lock("test.hold")
+    concurrency.note_blocking("queue.get")  # no lock held: silent
+    assert concurrency.violations() == []
+    with lock:
+        concurrency.note_blocking("time.sleep(test)")
+    v, = concurrency.violations()
+    assert v["check"] == "blocking-under-lock"
+    assert v["what"] == "time.sleep(test)"
+    assert v["locks"] == ["test.hold"]
+    assert len(v["stacks"]) == 2  # acquisition stack + blocking site
+
+
+def test_violation_buffer_bounded_with_drop_counter():
+    concurrency.arm()
+    concurrency.reset()
+    lock = concurrency.named_lock("test.bound")
+    extra = 50
+    with lock:
+        for _ in range(concurrency.MAX_VIOLATIONS + extra):
+            concurrency.note_blocking("spin")
+    assert len(concurrency.violations()) == concurrency.MAX_VIOLATIONS
+    assert concurrency.dropped() == extra
+    assert concurrency.report()["violations_dropped"] == extra
+    concurrency.reset()
+    assert concurrency.violations() == [] and concurrency.dropped() == 0
+
+
+# ---------------------------------------------------------------------------
+# thread registry / leak detection
+# ---------------------------------------------------------------------------
+
+def test_thread_leak_detected_then_clean_after_join():
+    concurrency.arm()
+    concurrency.reset()
+    stop = threading.Event()
+    owner = concurrency.owner_token("test-comp", "x")
+    t = threading.Thread(target=stop.wait, name="leaky-worker",
+                         daemon=True)
+    concurrency.track_thread(t, owner)
+    t.start()
+    assert [x.name for x in concurrency.live_threads(owner)] \
+        == ["leaky-worker"]
+    leaked = concurrency.check_stopped(owner, grace=0.05)
+    assert leaked == ["leaky-worker"]
+    v = [x for x in concurrency.violations() if x["check"] == "thread-leak"]
+    assert v and v[0]["owner"] == owner
+    assert "leaky-worker" in v[0]["threads"]
+    stop.set()
+    t.join(2.0)
+    assert concurrency.check_stopped(owner, grace=2.0) == []
+    assert concurrency.live_threads(owner) == []
+
+
+def test_check_stopped_reports_names_even_disarmed():
+    stop = threading.Event()
+    owner = concurrency.owner_token("test-comp", "off")
+    t = threading.Thread(target=stop.wait, name="silent-leak",
+                         daemon=True)
+    concurrency.track_thread(t, owner)
+    t.start()
+    try:
+        assert concurrency.check_stopped(owner, grace=0.05) \
+            == ["silent-leak"]
+        assert concurrency.violations() == []  # disarmed: no violation
+    finally:
+        stop.set()
+        t.join(2.0)
+        concurrency.check_stopped(owner, grace=2.0)
+
+
+# ---------------------------------------------------------------------------
+# scope sanitizer hardening (satellite: weakref tokens + bounded buffer)
+# ---------------------------------------------------------------------------
+
+def test_scope_token_stable_then_evicted_on_gc():
+    class S:
+        pass
+
+    s = S()
+    tok = sanitizer.scope_token(s)
+    assert sanitizer.scope_token(s) == tok  # stable while alive
+    sanitizer.arm()
+    sanitizer.record_write(s, "w0")
+    assert any(k[0] == tok for k in sanitizer._writers)
+    key = id(s)
+    del s
+    gc.collect()
+    # finalizer retired the token AND its writer entries
+    assert all(k[0] != tok for k in sanitizer._writers)
+    assert sanitizer._scope_tokens.get(key) != tok
+
+
+def test_scope_sanitizer_violations_bounded_with_drop_counter():
+    class S:
+        pass
+
+    s = S()
+    sanitizer.arm()
+    n = sanitizer.MAX_VIOLATIONS + 25
+    wrote = threading.Event()
+    done = threading.Event()
+
+    def first_writer():
+        for i in range(n):
+            sanitizer.record_write(s, "v%d" % i)
+        wrote.set()
+        done.wait(10.0)  # stay alive so the rewrite is a live race
+
+    t = threading.Thread(target=first_writer, name="writer-a",
+                         daemon=True)
+    t.start()
+    assert wrote.wait(10.0)
+    try:
+        for i in range(n):
+            sanitizer.record_write(s, "v%d" % i)
+    finally:
+        done.set()
+        t.join(2.0)
+    assert len(sanitizer.violations()) == sanitizer.MAX_VIOLATIONS
+    assert sanitizer.dropped() == 25
+    v = sanitizer.violations()[0]
+    assert v["threads"][0] == "writer-a"
+
+
+# ---------------------------------------------------------------------------
+# donation dataflow: the static pass
+# ---------------------------------------------------------------------------
+
+def _errs(report, check):
+    return [d for d in report.findings
+            if d.check == check and d.severity == "error"]
+
+
+def test_use_after_donate_fetched_param():
+    prog, loss, w = _fit_a_line()
+    report = dataflow.analyze_donation(
+        prog, feed_names=["x", "y"], fetch_names=[loss.name, w])
+    bad = _errs(report, "use-after-donate")
+    assert bad and bad[0].var == w
+    assert "NEXT" in bad[0].message
+    # fetching only the loss is clean
+    clean = dataflow.analyze_donation(
+        prog, feed_names=["x", "y"], fetch_names=[loss.name])
+    assert not clean.findings, str(clean)
+    assert clean.meta["donated_vars"] > 0
+    assert clean.meta["donated_rewritten"] >= 1
+
+
+def test_feed_shadowing_donated_state_warns():
+    prog, loss, w = _fit_a_line()
+    # feeding the donated param itself: the host feed shadows the scope
+    # copy the dispatch donates, so the fed value never persists
+    report = dataflow.analyze_donation(
+        prog, feed_names=["x", "y", w], fetch_names=[loss.name])
+    shadows = [d for d in report.findings
+               if d.check == "feed-shadows-donated-state"]
+    assert len(shadows) == 1 and shadows[0].var == w
+    assert shadows[0].severity == "warning"
+
+
+def test_use_after_donate_raises_before_compile(monkeypatch):
+    """The executor's analysis gate at level=full turns the fetched
+    donated param into a ProgramVerifyError BEFORE any lowering/compile
+    of that signature."""
+    from paddle_tpu.analysis.diagnostics import ProgramVerifyError
+
+    _prog, loss, w = _fit_a_line()
+    monkeypatch.setenv("PADDLE_TPU_ANALYSIS", "full")
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    x = np.zeros((2, 4), dtype=np.float32)
+    y = np.zeros((2, 1), dtype=np.float32)
+    with pytest.raises(ProgramVerifyError, match="use-after-donate"):
+        exe.run(feed={"x": x, "y": y}, fetch_list=[loss, w])
+    # same program without the param fetch runs fine at level=full
+    exe.run(feed={"x": x, "y": y}, fetch_list=[loss])
+
+
+def test_double_donate_two_writers_flagged():
+    prog, loss, w = _fit_a_line()
+    gb = prog.global_block()
+    src = fluid.layers.fill_constant([4, 1], "float32", 0.0)
+    fluid.layers.assign(src, output=gb.vars[w])  # second writer of w
+    report = dataflow.analyze_donation(prog, fetch_names=[loss.name])
+    bad = _errs(report, "double-donate")
+    assert bad and bad[0].var == w
+    assert "rewritten by 2 ops" in bad[0].message
+
+
+def test_reads_straddling_update_flagged_only_after_is_silent():
+    prog, loss, w = _fit_a_line()
+    gb = prog.global_block()
+    # seed a reader AFTER the sgd update: forward already read w before
+    fluid.layers.scale(gb.vars[w], scale=1.0)
+    report = dataflow.analyze_donation(prog, fetch_names=[loss.name])
+    bad = _errs(report, "use-after-donate")
+    assert bad and bad[0].var == w
+    assert "AFTER its update" in bad[0].message
+
+    # only-after reads (the lr-decay -> optimizer pattern) stay silent:
+    # a persistable written then read, with no earlier reader
+    p = fluid.layers.create_parameter([4], "float32", name="only_after_p")
+    src = fluid.layers.fill_constant([4], "float32", 1.0)
+    fluid.layers.assign(src, output=p)
+    fluid.layers.scale(p, scale=2.0)
+    report2 = dataflow.analyze_donation(prog, fetch_names=[loss.name])
+    assert not [d for d in _errs(report2, "use-after-donate")
+                if d.var == "only_after_p"]
+
+
+def test_sub_block_closure_read_counts_as_reader():
+    prog, loss, w = _fit_a_line()
+    gb = prog.global_block()
+    # a while body reading w via closure AFTER the sgd update
+    i = fluid.layers.fill_constant([1], "float32", 0.0)
+    n = fluid.layers.fill_constant([1], "float32", 2.0)
+    cond = fluid.layers.less_than(i, n)
+    wh = fluid.layers.While(cond)
+    with wh.block():
+        fluid.layers.reduce_sum(gb.vars[w])  # closure read of w
+        fluid.layers.increment(i, value=1.0)
+        fluid.layers.less_than(i, n, cond=cond)
+    report = dataflow.analyze_donation(prog, fetch_names=[loss.name])
+    bad = [d for d in _errs(report, "use-after-donate") if d.var == w]
+    assert bad, str(report)
+    assert "sub-block closure" in bad[0].message
+
+
+def test_analyzer_full_level_runs_dataflow_verify_does_not():
+    _prog, loss, w = _fit_a_line()
+    full = analysis.analyze(
+        fluid.default_main_program(), feed_names=["x", "y"],
+        fetch_names=[loss.name, w], platform="cpu", level="full")
+    assert "dataflow" in full.checks
+    assert any(d.check == "use-after-donate" for d in full.errors)
+    # tpu_lint's shallow heuristic coexists under its own check name
+    assert any(d.check == "donated-and-fetched" for d in full.findings)
+    shallow = analysis.analyze(
+        fluid.default_main_program(), feed_names=["x", "y"],
+        fetch_names=[loss.name, w], platform="cpu", level="verify")
+    assert "dataflow" not in shallow.checks
+
+
+def test_cross_program_aliasing_static_check():
+    prog, _loss, w = _fit_a_line()
+    test_prog = prog.clone(for_test=True)
+    report = dataflow.check_cross_program(
+        prog, test_prog, donor_label="training", reader_label="serving")
+    names = [d.var for d in report.findings
+             if d.check == "cross-program-donated-alias"]
+    assert w in names
+    # a reader touching none of the donor's params is clean
+    other = fluid.Program()
+    with fluid.program_guard(other, fluid.Program()):
+        fluid.layers.data(name="z", shape=[2], dtype="float32")
+    assert not dataflow.check_cross_program(prog, other).findings
+
+
+def test_runtime_capture_donation_registry():
+    class S:
+        pass
+
+    s = S()
+    concurrency.arm()
+    concurrency.reset()
+    # snapshot captures (decode/prefill engines) are exempt
+    dataflow.note_capture(s, ["w1", "w2"], "decode-engine 'd'",
+                          snapshot=True)
+    dataflow.note_donation(s, ["w1", "w2"])
+    assert concurrency.violations() == []
+    # a zero-copy capture of a var the executor donates is a violation
+    dataflow.note_capture(s, ["w3"], "zero-copy engine 'z'")
+    dataflow.note_donation(s, ["w3"])
+    v = [x for x in concurrency.violations()
+         if x["check"] == "cross-program-donated-alias"]
+    assert len(v) == 1
+    assert v[0]["var"] == "w3" and "zero-copy engine" in v[0]["consumer"]
+    # each capture is reported once, not per dispatch
+    dataflow.note_donation(s, ["w3"])
+    assert len(concurrency.violations()) == 1
+    # disarmed, both hooks are single-bool no-ops
+    concurrency.disarm()
+    before = len(dataflow._captures)
+    dataflow.note_capture(s, ["w4"], "late")
+    assert len(dataflow._captures) == before
+
+
+def test_armed_training_steps_record_zero_violations():
+    """A normal train loop under the armed sanitizer: the executor's
+    note_donation fires every dispatch and must stay silent (no capture
+    of the donated state exists)."""
+    _prog, loss, _w = _fit_a_line()
+    concurrency.arm()
+    concurrency.reset()
+    sanitizer.arm()
+    sanitizer.reset()
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    y = np.random.default_rng(1).normal(size=(8, 1)).astype(np.float32)
+    for _ in range(3):
+        exe.run(feed={"x": x, "y": y}, fetch_list=[loss])
+    assert concurrency.violations() == []
+    assert sanitizer.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# CLI: --concurrency + --fail-on exit codes (stable API)
+# ---------------------------------------------------------------------------
+
+def test_cli_concurrency_exit_codes(capsys):
+    from paddle_tpu.analysis import cli
+
+    # clean in-process state -> 0, and the report section is present
+    concurrency.arm()
+    concurrency.reset()
+    assert cli.main(["--concurrency"]) == 0
+    out = capsys.readouterr().out
+    assert '"concurrency"' in out
+
+    # a recorded violation gates the exit under every mode but 'never'
+    lock = concurrency.named_lock("test.cli")
+    with lock:
+        concurrency.note_blocking("queue.get")
+    assert cli.main(["--concurrency"]) == 1
+    assert cli.main(["--concurrency", "--fail-on", "error"]) == 1
+    assert cli.main(["--concurrency", "--fail-on", "never"]) == 0
+    text_rc = cli.main(["--concurrency", "--text"])
+    out = capsys.readouterr().out
+    assert text_rc == 1
+    assert "blocking-under-lock" in out
+
+    # no target and no --concurrency is a usage error
+    assert cli.main([]) == 2
+
+
+def test_cli_fail_on_gates_on_donation_error(tmp_path, capsys):
+    """A saved training program whose fetch list includes a
+    donated-and-rewritten param exits 1 at every --fail-on floor except
+    'never', with the use-after-donate error in the report.
+    (``save_inference_model`` prunes optimizer ops, so the meta file is
+    written directly — the shape a full-program export produces.)"""
+    import json
+
+    from paddle_tpu.analysis import cli
+
+    prog, loss, w = _fit_a_line()
+    model_dir = tmp_path / "m"
+    model_dir.mkdir()
+    meta = {"program": json.loads(prog.to_json()),
+            "feed_names": ["x", "y"], "fetch_names": [loss.name, w]}
+    (model_dir / "__model__").write_text(json.dumps(meta))
+    model_dir = str(model_dir)
+    assert cli.main([model_dir, "--platform", "cpu"]) == 1
+    assert cli.main([model_dir, "--platform", "cpu",
+                     "--fail-on", "perf"]) == 1
+    assert cli.main([model_dir, "--platform", "cpu",
+                     "--fail-on", "error"]) == 1
+    assert cli.main([model_dir, "--platform", "cpu",
+                     "--fail-on", "never"]) == 0
+    out = capsys.readouterr().out
+    assert "use-after-donate" in out

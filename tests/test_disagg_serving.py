@@ -429,10 +429,13 @@ def test_disagg_fleet_bit_identical_and_tenancy(m):
 
 
 @pytest.mark.chaos
-def test_chaos_decode_replica_kill_migrates_streams_exactly(m):
+def test_chaos_decode_replica_kill_migrates_streams_exactly(
+        m, armed_sanitizers):
     """SIGKILL-equivalent on a decode replica mid-stream: every live
     session re-prefills ``prompt + so_far()`` and finishes on the
-    survivor BIT-identical to solo — zero failed streams."""
+    survivor BIT-identical to solo — zero failed streams. Runs with the
+    lock-order/thread sanitizer AND the scope sanitizer armed: the kill
+    path must leave zero violations and zero leaked threads."""
     router = disagg_fleet(
         m["cfg"], m["scope"], n_prefill=1, n_decode=2, slots=2,
         cache_len=64, kv_dtype="fp32", wire_dtype="fp32",

@@ -116,6 +116,19 @@ def test_static_minimize_applies_grad_clip():
         np.asarray(scope.find_var(n).get_tensor()) - before[n] for n in params
     ]
     assert abs(_global_norm(deltas) - max_norm) < 1e-3
+    # the Optimizer.apply_gradients contract: static-path grad_clip is
+    # real clip ops IN the program — every param update consumes the
+    # clipped grad, and the clip ops precede the first update op
+    ops = main.global_block().ops
+    sgd_idx = [i for i, op in enumerate(ops) if op.type == "sgd"]
+    assert sgd_idx
+    for i in sgd_idx:
+        (g,) = ops[i].inputs["Grad"]
+        assert g.endswith("@GCLIP"), g
+    clip_writers = [i for i, op in enumerate(ops)
+                    if any(n.endswith("@GCLIP")
+                           for ns in op.outputs.values() for n in ns)]
+    assert clip_writers and max(clip_writers) < min(sgd_idx)
 
 
 # ---------------------------------------------------------------------------

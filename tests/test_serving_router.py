@@ -545,7 +545,8 @@ def test_silent_store_replica_requests_replay_on_survivor(
 
 @pytest.mark.faults
 @pytest.mark.chaos
-def test_replica_fault_drill_absorbed_by_failover(model_dir):
+def test_replica_fault_drill_absorbed_by_failover(
+        model_dir, armed_sanitizers):
     obs.reset()
     base = Predictor.from_model(str(model_dir))
     router = _fleet(model_dir, n_replicas=2)
@@ -564,7 +565,8 @@ def test_replica_fault_drill_absorbed_by_failover(model_dir):
 
 @pytest.mark.faults
 @pytest.mark.chaos
-def test_dispatch_and_slow_fault_drills(model_dir, monkeypatch):
+def test_dispatch_and_slow_fault_drills(
+        model_dir, monkeypatch, armed_sanitizers):
     base = Predictor.from_model(str(model_dir))
     router = _fleet(model_dir, n_replicas=2,
                     router_opts={"retry_base_s": 0.01})
@@ -589,7 +591,8 @@ def test_dispatch_and_slow_fault_drills(model_dir, monkeypatch):
 @pytest.mark.slow
 @pytest.mark.chaos
 @pytest.mark.multihost
-def test_process_fleet_survives_sigkill(model_dir, tmp_path):
+def test_process_fleet_survives_sigkill(
+        model_dir, tmp_path, armed_sanitizers):
     base = Predictor.from_model(str(model_dir))
     store_dir = tmp_path / "store"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
