@@ -1338,12 +1338,33 @@ def _measure_disagg_serving(latency_clients=6, long_clients=2,
         queue_capacity=256, request_timeout_s=180.0)
     router.warmup(check_hbm=False)
     # clean mixed-tenant drive first: the latency numbers must not mix
-    # steady-state inter-token gaps with migration stalls from the kill
-    dis_gaps, dis_errors, dis_wall, dis_tokens = drive(
-        lambda p, n, t: router.submit(p, max_new=n, tenant=t),
-        expect_tokens=expect)
+    # steady-state inter-token gaps with migration stalls from the kill.
+    # This leg runs traced (ISSUE 14) so the lane banks the per-phase
+    # queue/prefill/handoff/adopt/decode split, not just end-to-end.
+    from paddle_tpu import observability as obs
+
+    trace_root = tempfile.mkdtemp(prefix="paddle_tpu_disagg_trace_")
+    prev_trace = os.environ.get(obs.TRACE_DIR_ENV)
+    os.environ[obs.TRACE_DIR_ENV] = trace_root
+    try:
+        dis_gaps, dis_errors, dis_wall, dis_tokens = drive(
+            lambda p, n, t: router.submit(
+                p, max_new=n, tenant=t,
+                trace_ctx=obs.TraceContext.new()),
+            expect_tokens=expect)
+    finally:
+        if prev_trace is None:
+            os.environ.pop(obs.TRACE_DIR_ENV, None)
+        else:
+            os.environ[obs.TRACE_DIR_ENV] = prev_trace
     if dis_errors:
         raise RuntimeError("disagg clean leg failed: %r" % dis_errors[:3])
+    phase_ms = {
+        phase: {"count": st_["count"],
+                "mean_ms": round(st_["mean_s"] * 1e3, 3),
+                "max_ms": round(st_["max_s"] * 1e3, 3)}
+        for phase, st_ in obs.phase_breakdown(
+            obs.read_spans(trace_root)).items()}
 
     # -- leg 3: same fleet, mid-run decode-replica kill ----------------
     # a long-lived canary guarantees the kill catches a live stream
@@ -1400,6 +1421,7 @@ def _measure_disagg_serving(latency_clients=6, long_clients=2,
         "chaos_latency_per_token_ms_p99": pct(
             chaos_gaps["latency"], 0.99),
         "killed_decode_replica": killed[0] if killed else None,
+        "phase_latency_ms": phase_ms,
         "migrations": int(st["migrations"]),
         "failed_streams": int(st["failed_streams"]),
         "replica_dead": int(st["replica_dead"]),

@@ -110,8 +110,12 @@ assert lat["p50"] is not None and lat["p99"] is not None
 assert waste is not None and 0.0 <= waste["mean"] < 1.0, \
     "padding-waste histogram missing from the telemetry snapshot"
 prom = obs.render_prom()
-assert 'paddle_tpu_serving_request_seconds{quantile="0.5"}' in prom
-assert 'paddle_tpu_serving_request_seconds{quantile="0.99"}' in prom
+assert 'paddle_tpu_serving_request_seconds_bucket{le="' in prom
+assert "paddle_tpu_serving_request_seconds_count %d" % N_REQS in prom
+# legacy summary style stays reachable behind the flag
+summ = obs.render_prom(style="summary")
+assert 'paddle_tpu_serving_request_seconds{quantile="0.5"}' in summ
+assert 'paddle_tpu_serving_request_seconds{quantile="0.99"}' in summ
 
 reg.close()
 print("serving OK: %d reqs / %d clients in %.2fs -> %.1f req/s | "

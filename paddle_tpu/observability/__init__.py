@@ -164,19 +164,55 @@ Well-known concurrency/donation metrics (PR 13,
   name, lock names, and thread names of each concurrency violation
   into the flight recorder, next to the existing ``scope_race`` events.
 
+Well-known distributed-tracing + fleet metrics (PR 14,
+``observability.distributed``):
+
+- ``trace.spans_exported`` / ``trace.export_errors`` counters — JSONL
+  span records appended to ``$PADDLE_TPU_TRACE_DIR`` (one
+  ``trace-<pid>.jsonl`` per process; merge them with
+  ``python -m paddle_tpu.observability trace <dir>``) and append
+  failures. Tracing is opt-in per request via the
+  ``TraceContext.sampled`` bit (a ``traceparent`` header or
+  ``"trace": true`` in a ``:generate`` body); unsampled requests skip
+  every export site.
+- ``fleet.replicas`` gauge — replicas merged into the last
+  ``/metrics?scope=fleet`` view; ``fleet.<name>`` counter/gauge/
+  histogram families — the FleetMetrics merge of per-replica beacon
+  docs (counters sum, gauges labeled ``{replica="..."}``, reservoir
+  histograms merged), e.g. ``fleet.requests``, ``fleet.tokens``,
+  ``fleet.queue_depth{replica="decode-1"}``.
+- ``fleet.slo_burn_ttft.<tenant>`` /
+  ``fleet.slo_burn_per_token.<tenant>`` gauges — SLOMonitor burn
+  rates: (fraction of recent observations over the tenant's
+  ``ttft_slo_ms`` / ``per_token_slo_ms`` target) / budget; 1.0 means
+  the error budget is being consumed exactly at the allowed rate.
+- ``span.*.seconds`` histograms gain distributed siblings: spans
+  created with ``ctx=`` still observe locally but also export
+  trace records whose names carry the phase
+  (``serving.http.request``, ``disagg.queue`` / ``.prefill`` /
+  ``.handoff`` / ``.adopt``, ``decode.token``), which the collector
+  folds into per-phase breakdowns.
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """
+from . import distributed as _distributed
 from . import recorder as _recorder
 from . import telemetry as _telemetry
 from . import tracing as _tracing
+from .distributed import (  # noqa: F401
+    TRACE_DIR_ENV, TRACE_PROC_ENV, TRACE_SAMPLE_ENV, FleetMetrics,
+    SLOMonitor, TraceContext, chrome_trace, collect_trace, export_span,
+    phase_breakdown, process_label, read_spans, replica_metrics_doc,
+    sample_request, set_process_label, trace_dir,
+)
 from .recorder import (  # noqa: F401
     CRASH_DUMP_ENV, FlightRecorder, crash_dump_path, get_recorder,
     install_excepthook,
 )
 from .telemetry import (  # noqa: F401
-    OFF, ON, TRACE, TELEMETRY_ENV, Histogram, Telemetry, get_telemetry,
-    mode,
+    OFF, ON, TRACE, TELEMETRY_ENV, PROM_STYLE_ENV, Histogram,
+    Telemetry, get_telemetry, mode,
 )
 from .tracing import active_spans, current_span, span  # noqa: F401
 
@@ -188,6 +224,11 @@ __all__ = [
     "snapshot", "render_prom", "reset", "install_excepthook",
     "crash_dump_path", "TELEMETRY_ENV", "CRASH_DUMP_ENV",
     "OFF", "ON", "TRACE",
+    "TraceContext", "TRACE_DIR_ENV", "TRACE_PROC_ENV",
+    "TRACE_SAMPLE_ENV", "trace_dir", "sample_request",
+    "process_label", "set_process_label", "export_span", "read_spans",
+    "chrome_trace", "collect_trace", "phase_breakdown", "FleetMetrics",
+    "SLOMonitor", "replica_metrics_doc", "PROM_STYLE_ENV",
 ]
 
 
@@ -259,8 +300,8 @@ def snapshot():
     return _telemetry._hub.snapshot()
 
 
-def render_prom():
-    return _telemetry._hub.render_prom()
+def render_prom(style=None):
+    return _telemetry._hub.render_prom(style=style)
 
 
 def reset():

@@ -1,0 +1,67 @@
+"""Observability CLI.
+
+``python -m paddle_tpu.observability trace <dir> [-o out.json]``
+merges the per-process ``trace-*.jsonl`` span files a traced serving
+run left under ``$PADDLE_TPU_TRACE_DIR`` into one Perfetto-loadable
+Chrome trace-event file (load it at https://ui.perfetto.dev or
+``chrome://tracing``) and prints a per-trace phase summary.
+"""
+import argparse
+import json
+import sys
+
+from . import distributed as _dist
+
+
+def _cmd_trace(args):
+    spans = _dist.read_spans(args.dir)
+    if not spans:
+        print("no span records under %s" % args.dir, file=sys.stderr)
+        return 1
+    doc = _dist.chrome_trace(spans, trace_id=args.trace_id)
+    out = args.out or "trace.json"
+    tmp = out + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    import os
+
+    os.replace(tmp, out)
+    meta = doc["otherData"]
+    print("wrote %s: %d spans, %d cross-process flows, %d process "
+          "tracks, %d trace(s)" % (out, meta["spans"], meta["flows"],
+                                   len(meta["processes"]),
+                                   len(meta["traces"])))
+    for tid in meta["traces"]:
+        phases = _dist.phase_breakdown(spans, trace_id=tid)
+        if not phases:
+            continue
+        parts = []
+        for phase in _dist.PHASES:
+            st = phases.get(phase)
+            if st:
+                parts.append("%s %.1fms x%d"
+                             % (phase, st["total_s"] * 1e3, st["count"]))
+        print("  trace %s: %s" % (tid[:16], ", ".join(parts) or "-"))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability",
+        description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    tr = sub.add_parser("trace", help="merge JSONL span files into a "
+                        "Chrome trace-event JSON")
+    tr.add_argument("dir", help="trace directory "
+                    "(the run's $PADDLE_TPU_TRACE_DIR)")
+    tr.add_argument("-o", "--out", default=None,
+                    help="output path (default: trace.json)")
+    tr.add_argument("--trace-id", default=None,
+                    help="keep only this trace id")
+    tr.set_defaults(fn=_cmd_trace)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

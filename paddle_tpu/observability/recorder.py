@@ -35,10 +35,27 @@ __all__ = [
 CRASH_DUMP_ENV = "PADDLE_TPU_CRASH_DUMP"
 
 
-def crash_dump_path():
-    """Where a crash dump would be written right now."""
-    return os.environ.get(CRASH_DUMP_ENV) or os.path.join(
-        tempfile.gettempdir(), "paddle_tpu_crash_%d.json" % os.getpid())
+def crash_dump_path(per_pid=False):
+    """Where a crash dump would be written right now.
+
+    ``per_pid=True`` derives a pid-suffixed variant of the
+    ``$PADDLE_TPU_CRASH_DUMP`` override (``dump.json`` ->
+    ``dump.<pid>.json``) so several crashing worker processes that
+    inherited one env value don't clobber each other's dump. The
+    default (unset env) path already embeds the pid. Idempotent: a
+    path that already carries this pid's suffix is returned as-is."""
+    base = os.environ.get(CRASH_DUMP_ENV)
+    if not base:
+        return os.path.join(
+            tempfile.gettempdir(),
+            "paddle_tpu_crash_%d.json" % os.getpid())
+    if not per_pid:
+        return base
+    root, ext = os.path.splitext(base)
+    tag = ".%d" % os.getpid()
+    if root.endswith(tag):
+        return base
+    return root + tag + (ext or ".json")
 
 
 def _san(v):

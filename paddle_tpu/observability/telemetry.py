@@ -22,6 +22,7 @@ test or a driver can flip it without restarting the process. This
 module is stdlib-only — the bench supervisor and crash-path code can
 import it without pulling in jax.
 """
+import bisect
 import collections
 import math
 import os
@@ -30,10 +31,15 @@ import threading
 
 __all__ = [
     "Telemetry", "Histogram", "get_telemetry", "mode", "TELEMETRY_ENV",
-    "OFF", "ON", "TRACE",
+    "OFF", "ON", "TRACE", "PROM_STYLE_ENV", "DEFAULT_BUCKETS",
 ]
 
 TELEMETRY_ENV = "PADDLE_TPU_TELEMETRY"
+
+# ``render_prom`` histogram style: "histogram" (default) emits proper
+# Prometheus ``_bucket{le=...}`` exposition; "summary" restores the
+# pre-PR-14 quantile lines for lanes/dashboards that grep them
+PROM_STYLE_ENV = "PADDLE_TPU_PROM_STYLE"
 
 OFF, ON, TRACE = 0, 1, 2
 
@@ -62,13 +68,24 @@ def mode():
     return m
 
 
-class Histogram:
-    """Streaming count/sum/min/max plus a bounded reservoir of the most
-    recent observations (deterministic — no sampling randomness) for
-    percentile estimates. Memory is bounded by ``cap`` regardless of
-    how many values are observed."""
+# log-spaced ``le`` bounds tuned for latencies in seconds (0.5 ms to
+# 60 s); the final implicit bucket is +Inf. Streaming bucket counts are
+# exact (unlike the bounded reservoir) so the Prometheus exposition
+# survives arbitrarily long runs.
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
 
-    __slots__ = ("count", "sum", "min", "max", "_reservoir")
+
+class Histogram:
+    """Streaming count/sum/min/max plus exact cumulative bucket counts
+    (Prometheus ``le`` semantics over :data:`DEFAULT_BUCKETS`) plus a
+    bounded reservoir of the most recent observations (deterministic —
+    no sampling randomness) for percentile estimates. Memory is bounded
+    by ``cap`` regardless of how many values are observed."""
+
+    __slots__ = ("count", "sum", "min", "max", "_reservoir", "_buckets")
 
     def __init__(self, cap=512):
         self.count = 0
@@ -76,6 +93,8 @@ class Histogram:
         self.min = math.inf
         self.max = -math.inf
         self._reservoir = collections.deque(maxlen=int(cap))
+        # one count per bound in DEFAULT_BUCKETS, plus the +Inf overflow
+        self._buckets = [0] * (len(DEFAULT_BUCKETS) + 1)
 
     def observe(self, value):
         v = float(value)
@@ -86,6 +105,7 @@ class Histogram:
         if v > self.max:
             self.max = v
         self._reservoir.append(v)
+        self._buckets[bisect.bisect_left(DEFAULT_BUCKETS, v)] += 1
 
     def quantile(self, q):
         vals = sorted(self._reservoir)
@@ -107,6 +127,43 @@ class Histogram:
             "p90": self.quantile(0.90),
             "p99": self.quantile(0.99),
         }
+
+    # -- federation ------------------------------------------------------
+    def export(self, reservoir_cap=64):
+        """JSON-safe doc a replica publishes for fleet merging: exact
+        count/sum/buckets plus the tail of the reservoir (capped so a
+        heartbeat beacon stays small)."""
+        tail = list(self._reservoir)
+        if reservoir_cap is not None:
+            tail = tail[-int(reservoir_cap):]
+        doc = {"count": self.count, "sum": self.sum,
+               "buckets": list(self._buckets), "reservoir": tail}
+        if self.count:
+            doc["min"] = self.min
+            doc["max"] = self.max
+        return doc
+
+    @classmethod
+    def from_docs(cls, docs, cap=512):
+        """Merge :meth:`export` docs from several replicas into one
+        histogram: counts/sums/buckets add, reservoirs concatenate
+        (bounded by ``cap``), min/max widen."""
+        merged = cls(cap=cap)
+        for doc in docs:
+            if not doc:
+                continue
+            merged.count += int(doc.get("count", 0))
+            merged.sum += float(doc.get("sum", 0.0))
+            mn, mx = doc.get("min"), doc.get("max")
+            if mn is not None and mn < merged.min:
+                merged.min = mn
+            if mx is not None and mx > merged.max:
+                merged.max = mx
+            for i, n in enumerate(doc.get("buckets", ())):
+                if i < len(merged._buckets):
+                    merged._buckets[i] += int(n)
+            merged._reservoir.extend(doc.get("reservoir", ()))
+        return merged
 
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
@@ -159,6 +216,14 @@ class Telemetry:
             hist = self._hists.get(name)
             return hist.summary() if hist is not None else None
 
+    def reservoir(self, name):
+        """The raw reservoir values (most recent observations) for
+        `name`, or None. Used by the SLO monitor to score observed
+        latencies against tenant targets."""
+        with self._lock:
+            hist = self._hists.get(name)
+            return list(hist._reservoir) if hist is not None else None
+
     def snapshot(self):
         """Nested dict of everything the hub holds right now."""
         with self._lock:
@@ -172,9 +237,15 @@ class Telemetry:
                 },
             }
 
-    def render_prom(self):
-        """Prometheus text exposition (counters, gauges, and histogram
-        summaries with quantile labels)."""
+    def render_prom(self, style=None):
+        """Prometheus text exposition. Histograms render as proper
+        ``_bucket{le=...}``/``_sum``/``_count`` exposition by default;
+        ``style="summary"`` (or ``PADDLE_TPU_PROM_STYLE=summary``)
+        restores the pre-PR-14 quantile lines under the same metric
+        names for lanes that grep them."""
+        if style is None:
+            style = (os.environ.get(PROM_STYLE_ENV, "")
+                     .strip().lower() or "histogram")
         lines = []
         with self._lock:
             for name in sorted(self._counters):
@@ -188,15 +259,45 @@ class Telemetry:
             for name in sorted(self._hists):
                 pn = _prom_name(name)
                 hist = self._hists[name]
-                lines.append("# TYPE %s summary" % pn)
-                for q in (0.5, 0.9, 0.99):
-                    val = hist.quantile(q)
-                    if val is not None:
-                        lines.append(
-                            '%s{quantile="%s"} %.9g' % (pn, q, val))
+                if style == "summary":
+                    lines.append("# TYPE %s summary" % pn)
+                    for q in (0.5, 0.9, 0.99):
+                        val = hist.quantile(q)
+                        if val is not None:
+                            lines.append(
+                                '%s{quantile="%s"} %.9g' % (pn, q, val))
+                else:
+                    lines.append("# TYPE %s histogram" % pn)
+                    cum = 0
+                    for bound, n in zip(DEFAULT_BUCKETS, hist._buckets):
+                        cum += n
+                        lines.append('%s_bucket{le="%.12g"} %d'
+                                     % (pn, bound, cum))
+                    lines.append('%s_bucket{le="+Inf"} %d'
+                                 % (pn, hist.count))
                 lines.append("%s_sum %.9g" % (pn, hist.sum))
                 lines.append("%s_count %d" % (pn, hist.count))
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def federation_doc(self, reservoir_cap=64, prefix=None):
+        """The per-process payload a replica publishes for fleet
+        merging (heartbeat ``extra=`` or the elastic FileStore):
+        counters/gauges verbatim, histograms as :meth:`Histogram.export`
+        docs. ``prefix`` filters metric names (e.g. ``"serving."``) so
+        a beacon stays small."""
+        def keep(name):
+            return prefix is None or name.startswith(prefix)
+        with self._lock:
+            return {
+                "counters": {k: v for k, v in self._counters.items()
+                             if keep(k)},
+                "gauges": {k: v for k, v in self._gauges.items()
+                           if keep(k)},
+                "histograms": {
+                    k: h.export(reservoir_cap=reservoir_cap)
+                    for k, h in self._hists.items() if keep(k)
+                },
+            }
 
     def reset(self):
         with self._lock:

@@ -13,6 +13,14 @@ and a constructor that stores two attributes).
 Per-thread stacks are registered in a process-wide table so the crash
 dumper can report what every thread was inside when the process died
 (``active_spans()``).
+
+Spans optionally participate in **distributed traces**: pass a sampled
+:class:`~paddle_tpu.observability.distributed.TraceContext` as
+``ctx=`` and the span derives a child span id on entry (readable as
+``.ctx`` for further propagation) and appends a JSONL record to
+``$PADDLE_TPU_TRACE_DIR`` on exit. With no ctx (or an unsampled one)
+the extra work is a single attribute store — the per-request sampling
+bit keeps tracing opt-in.
 """
 import threading
 import time
@@ -40,16 +48,30 @@ def _stack():
 
 
 class span:
-    """``with span("executor.run", program=uid): ...``"""
+    """``with span("executor.run", program=uid): ...``
 
-    __slots__ = ("name", "fields", "t0", "_live", "_mode")
+    ``ctx=`` attaches a distributed :class:`TraceContext`; when it is
+    sampled the span gets its own child span id (``.ctx``) and its
+    exit is exported as a JSONL trace record."""
 
-    def __init__(self, name, **fields):
+    __slots__ = ("name", "fields", "t0", "_live", "_mode", "_ctx",
+                 "_wall0")
+
+    def __init__(self, name, ctx=None, **fields):
         self.name = name
         self.fields = fields or None
         self.t0 = None
         self._live = False
         self._mode = _t.OFF
+        self._ctx = ctx
+        self._wall0 = None
+
+    @property
+    def ctx(self):
+        """The context to propagate downstream: this span's own child
+        context once entered (so downstream spans parent to it), else
+        whatever was passed in."""
+        return self._ctx
 
     def __enter__(self):
         m = _t.mode()
@@ -58,6 +80,10 @@ class span:
             return self
         self._live = True
         _stack().append(self)
+        ctx = self._ctx
+        if ctx is not None and ctx.sampled:
+            self._ctx = ctx.child()
+            self._wall0 = time.time()
         self.t0 = time.monotonic()
         return self
 
@@ -72,6 +98,14 @@ class span:
             pass
         parent = st[-1].name if st else None
         _t.get_telemetry().observe("span.%s.seconds" % self.name, dt)
+        ctx = self._ctx
+        if ctx is not None and ctx.sampled and self._wall0 is not None:
+            from . import distributed as _dist
+
+            fields = dict(self.fields or {})
+            if exc_type is not None:
+                fields["error"] = exc_type.__name__
+            _dist.export_span(self.name, ctx, self._wall0, dt, fields)
         if self._mode == _t.TRACE:
             from . import recorder as _r
 

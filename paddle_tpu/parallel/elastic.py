@@ -369,6 +369,17 @@ class HeartbeatMonitor:
         return {int(k): v
                 for k, v in self.store.all(self.NAMESPACE).items()}
 
+    def extras(self, key):
+        """{worker_index: beacon[key]} for every live beacon carrying
+        the extra field — the fleet-metrics federation reads replica
+        ``metrics`` docs (and crash-dump paths) off beacons with this,
+        so aggregators never need a side channel to the replicas."""
+        out = {}
+        for w, rec in self.table().items():
+            if isinstance(rec, dict) and rec.get(key) is not None:
+                out[w] = rec[key]
+        return out
+
     def dead_peers(self, members=None, now=None):
         """Worker indices (excluding self) whose beacons went silent
         past the miss threshold — or that never appeared within the

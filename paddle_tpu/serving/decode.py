@@ -113,6 +113,10 @@ class DecodeStream:
     frees the request's slot at the dispatch loop's next iteration —
     or drops it from the queue if it never reached a slot."""
 
+    # distributed-trace context of a sampled request (None otherwise);
+    # class attr so pre-trace pickles/subclasses still read it
+    trace = None
+
     def __init__(self, prompt_len, max_new, stall_timeout_s=60.0):
         self.prompt_len = int(prompt_len)
         self.max_new = int(max_new)
@@ -192,17 +196,24 @@ class DecodeStream:
 
 class _Request:
     __slots__ = ("prompt", "plen", "bucket", "max_new", "eos_id",
-                 "deadline", "handle", "handoff", "tenant", "priority")
+                 "deadline", "handle", "handoff", "tenant", "priority",
+                 "trace", "t_wall")
 
 
 class _Slot:
-    __slots__ = ("handle", "remaining", "eos_id", "t_prefill")
+    __slots__ = ("handle", "remaining", "eos_id", "t_prefill",
+                 "trace", "t_wall", "t_last")
 
-    def __init__(self, handle, remaining, eos_id):
+    def __init__(self, handle, remaining, eos_id, trace=None):
         self.handle = handle
         self.remaining = remaining
         self.eos_id = eos_id
         self.t_prefill = time.monotonic()
+        # sampled TraceContext of the span that filled this slot; the
+        # per-token spans and the retire summary parent to it
+        self.trace = trace
+        self.t_wall = time.time() if trace is not None else None
+        self.t_last = self.t_prefill
 
 
 class DecodeEngine:
@@ -354,6 +365,10 @@ class DecodeEngine:
         self._rate = collections.deque(maxlen=64)  # (t_done, 1) retires
         self._thread = None
         self._owner = _conc.owner_token("decode-engine", self.name, self)
+        # cost-model predictions keyed ("step",) / ("prefill", bucket),
+        # computed lazily on the first TRACED request (annotation only;
+        # unsampled requests never run the analyzer)
+        self._cost_cache = {}
         if auto_start:
             self.start()
 
@@ -430,13 +445,15 @@ class DecodeEngine:
         return None
 
     def submit(self, prompt, max_new=None, eos_id=None, deadline_ms=None,
-               tenant=None, priority=None):
+               tenant=None, priority=None, trace_ctx=None):
         """Enqueue one generation request; returns a
         :class:`DecodeStream`. Raises :class:`ShedError` when the queue
         is full, :class:`EngineClosedError` after ``stop()``, and
         ``ValueError`` for prompts that cannot fit the ladder.
         ``tenant``/``priority`` are carried for observability — the
-        disagg router schedules on them; a lone engine records them."""
+        disagg router schedules on them; a lone engine records them.
+        A sampled ``trace_ctx`` puts this request's queue/prefill/
+        per-token spans into its distributed trace."""
         if self._closed:
             raise EngineClosedError(
                 "engine %r is draining/stopped" % self.name)
@@ -478,10 +495,14 @@ class DecodeEngine:
             deadline_ms = self._default_deadline_ms
         req.deadline = (time.monotonic() + float(deadline_ms) / 1000.0
                         if deadline_ms is not None else None)
+        sampled = trace_ctx is not None and trace_ctx.sampled
+        req.trace = trace_ctx if sampled else None
+        req.t_wall = time.time() if sampled else None
         req.handle = DecodeStream(
             plen, max_new, stall_timeout_s=self.request_timeout_s)
         req.handle.tenant = tenant
         req.handle.priority = priority
+        req.handle.trace = req.trace
         try:
             with self._admit_lock:
                 if self._closed:
@@ -511,7 +532,8 @@ class DecodeEngine:
             timeout if timeout is not None else self.request_timeout_s)
 
     def submit_prefilled(self, handoff, max_new=None, eos_id=None,
-                         deadline_ms=None, tenant=None, priority=None):
+                         deadline_ms=None, tenant=None, priority=None,
+                         trace_ctx=None):
         """Enqueue a generation whose prefill already happened on
         another replica: ``handoff`` is a
         :class:`~paddle_tpu.serving.disagg.kv_wire.KVHandoff` whose KV
@@ -552,10 +574,18 @@ class DecodeEngine:
             deadline_ms = self._default_deadline_ms
         req.deadline = (time.monotonic() + float(deadline_ms) / 1000.0
                         if deadline_ms is not None else None)
+        if trace_ctx is None:
+            # the handoff's embedded context keeps the prefill-side
+            # trace alive across a transport that dropped the kwarg
+            trace_ctx = getattr(handoff, "trace", None)
+        sampled = trace_ctx is not None and trace_ctx.sampled
+        req.trace = trace_ctx if sampled else None
+        req.t_wall = time.time() if sampled else None
         req.handle = DecodeStream(
             plen, max_new, stall_timeout_s=self.request_timeout_s)
         req.handle.tenant = tenant
         req.handle.priority = priority
+        req.handle.trace = req.trace
         try:
             with self._admit_lock:
                 if self._closed:
@@ -782,8 +812,28 @@ class DecodeEngine:
             self._kscale = self._write(self._kscale, ks, slot_i)
             self._vscale = self._write(self._vscale, vs, slot_i)
 
+    def _trace_queue_span(self, req, now):
+        """Export the (already finished) queue-wait span for a traced
+        request; returns the context its work span should parent to."""
+        ctx = req.trace.child()
+        obs.export_span(
+            "decode.queue", ctx, req.t_wall,
+            now - req.handle.t_submit,
+            {"proc": "decode:%s" % self.name, "tenant": req.tenant})
+        return ctx
+
     def _prefill(self, slot, req):
         t0 = time.monotonic()
+        ctx = (self._trace_queue_span(req, t0)
+               if req.trace is not None else None)
+        sp = None
+        if ctx is not None:
+            sp = obs.span("decode.prefill", ctx=ctx,
+                          proc="decode:%s" % self.name, slot=slot,
+                          bucket=req.bucket, plen=req.plen,
+                          predicted_s=self._predicted_s(
+                              "prefill", req.bucket))
+            sp.__enter__()
         ids = np.zeros((1, req.bucket), np.int64)
         ids[0, :req.plen] = req.prompt
         plen = np.asarray([[req.plen]], np.int64)
@@ -794,6 +844,8 @@ class DecodeEngine:
                 {"gpt_prefill_ids": ids, "gpt_prefill_len": plen},
                 return_numpy=False)
         except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+            if sp is not None:
+                sp.__exit__(type(e), e, None)
             self._bump("prefill_errors")
             obs.event("prefill_error", source="serving", model=self.name,
                       error="%s: %s" % (type(e).__name__, str(e)[:200]))
@@ -810,9 +862,13 @@ class DecodeEngine:
                                    ks[None], vs[None])
         else:
             self._write_slot_cache(slot, k1, v1)
+        if sp is not None:
+            sp.__exit__(None, None, None)
         self._tok[slot, 0] = tok = int(np.asarray(nxt)[0, 0])
         self._pos[slot, 0] = req.plen
-        self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id)
+        self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id,
+                                  trace=sp.ctx if sp is not None
+                                  else None)
         now = time.monotonic()
         obs.observe("serving.decode.prefill_seconds", now - t0)
         obs.observe("serving.decode.ttft_seconds",
@@ -829,6 +885,18 @@ class DecodeEngine:
         combination goes through fp32."""
         t0 = time.monotonic()
         h = req.handoff
+        if req.trace is not None:
+            self._trace_queue_span(req, t0)
+        # the adopt span parents to the PREFILL side's span when the
+        # handoff carries one — that's the cross-process flow arrow
+        actx = getattr(h, "trace", None) or req.trace
+        sp = None
+        if actx is not None and actx.sampled:
+            sp = obs.span("decode.adopt", ctx=actx,
+                          proc="decode:%s" % self.name, slot=slot,
+                          plen=req.plen, wire_dtype=h.wire_dtype,
+                          wire_bytes=h.wire_bytes())
+            sp.__enter__()
         try:
             if self.kv_dtype == "int8":
                 if h.wire_dtype == "int8":
@@ -848,14 +916,20 @@ class DecodeEngine:
                 kd, vd = h.dense()
                 self._write_slot_cache(slot, kd[None], vd[None])
         except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+            if sp is not None:
+                sp.__exit__(type(e), e, None)
             self._bump("adopt_errors")
             obs.event("adopt_error", source="serving", model=self.name,
                       error="%s: %s" % (type(e).__name__, str(e)[:200]))
             req.handle._fail(e)
             return
+        if sp is not None:
+            sp.__exit__(None, None, None)
         self._tok[slot, 0] = tok = int(h.next_token)
         self._pos[slot, 0] = req.plen
-        self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id)
+        self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id,
+                                  trace=sp.ctx if sp is not None
+                                  else None)
         obs.observe("serving.disagg.adopt_seconds",
                     time.monotonic() - t0)
         self._bump("adopts")
@@ -870,6 +944,17 @@ class DecodeEngine:
         s.remaining -= 1
         self._bump("tokens")
         obs.inc("serving.decode.tokens")
+        if s.trace is not None:
+            # one tiny span per generated token on a SAMPLED request:
+            # dur is the inter-token gap (the per-token-p99 SLO leg)
+            now = time.monotonic()
+            gap = now - s.t_last
+            s.t_last = now
+            obs.export_span(
+                "decode.token", s.trace.child(), time.time() - gap, gap,
+                {"proc": "decode:%s" % self.name, "slot": slot,
+                 "index": len(s.handle._tokens),
+                 "predicted_s": self._predicted_s("step")})
         if s.eos_id is not None and tok == s.eos_id:
             self._retire(slot, "eos")
         elif s.remaining <= 0:
@@ -890,6 +975,12 @@ class DecodeEngine:
         now = time.monotonic()
         obs.observe("serving.decode.request_seconds",
                     now - s.handle.t_submit)
+        if s.trace is not None:
+            obs.export_span(
+                "decode.stream", s.trace.child(), s.t_wall,
+                now - s.t_prefill,
+                {"proc": "decode:%s" % self.name, "slot": slot,
+                 "reason": reason, "tokens": len(s.handle._tokens)})
         with self._stats_lock:
             self._rate.append((now, 1))
         obs.event("slot_retired", source="serving", count=False,
@@ -936,6 +1027,37 @@ class DecodeEngine:
             self._tok[i, 0] = tok
             self._emit(i, tok)
         self._gauges()
+
+    def _predicted_s(self, kind, bucket=None):
+        """Cost-model predicted seconds for one prefill of `bucket` or
+        one step, cached; None when the analyzer can't price it (trace
+        annotation is best-effort — never fail a request on it)."""
+        key = (kind, bucket)
+        if key in self._cost_cache:
+            return self._cost_cache[key]
+        val = None
+        try:
+            from ..analysis import costs as _costs
+
+            kind_dev = getattr(self._jax.devices()[0], "device_kind",
+                               None)
+            if kind == "step":
+                prog = self._step_pred.program
+                feeds = {k: np.asarray(v) for k, v in
+                         self._step_feeds().items()}
+            else:
+                prog = self._prefill_preds[bucket].program
+                feeds = {"gpt_prefill_ids": np.zeros((1, bucket),
+                                                     np.int64),
+                         "gpt_prefill_len": np.ones((1, 1), np.int64)}
+            pred = _costs.predict_program(
+                prog, feed_specs=feeds, is_test=True,
+                device_kind=kind_dev)
+            val = pred.get("predicted_step_seconds")
+        except Exception:  # noqa: BLE001 — annotation only
+            val = None
+        self._cost_cache[key] = val
+        return val
 
     def _gauges(self):
         live = sum(1 for s in self._slots if s is not None)

@@ -72,10 +72,10 @@ class KVHandoff:
     """
 
     __slots__ = ("k", "v", "k_scales", "v_scales", "next_token",
-                 "plen", "prompt", "wire_dtype")
+                 "plen", "prompt", "wire_dtype", "trace")
 
     def __init__(self, k, v, k_scales, v_scales, next_token, plen,
-                 prompt, wire_dtype):
+                 prompt, wire_dtype, trace=None):
         self.k = k
         self.v = v
         self.k_scales = k_scales
@@ -84,6 +84,10 @@ class KVHandoff:
         self.plen = int(plen)
         self.prompt = np.asarray(prompt, np.int64).reshape(-1)
         self.wire_dtype = str(wire_dtype)
+        # TraceContext of the prefill-side span that produced this
+        # handoff — the decode replica's adopt span parents to it so
+        # one trace_id spans both processes
+        self.trace = trace
 
     @property
     def shape(self):
@@ -127,10 +131,14 @@ class KVHandoff:
                 self.k_scales, np.float32).tobytes()
             doc["v_scales"] = np.ascontiguousarray(
                 self.v_scales, np.float32).tobytes()
+        if self.trace is not None:
+            doc["trace"] = self.trace.to_doc()
         return doc
 
     @classmethod
     def from_wire(cls, doc):
+        from ...observability.distributed import TraceContext
+
         shape = tuple(int(s) for s in doc["shape"])
         wire_dtype = doc["wire_dtype"]
         pdt = np.float32 if wire_dtype == "fp32" else np.int8
@@ -142,10 +150,12 @@ class KVHandoff:
             ks = np.frombuffer(doc["k_scales"], np.float32).reshape(sshape)
             vs = np.frombuffer(doc["v_scales"], np.float32).reshape(sshape)
         return cls(k, v, ks, vs, doc["next_token"], doc["plen"],
-                   np.frombuffer(doc["prompt"], np.int64), wire_dtype)
+                   np.frombuffer(doc["prompt"], np.int64), wire_dtype,
+                   trace=TraceContext.from_doc(doc.get("trace")))
 
 
-def encode_kv(k, v, next_token, plen, prompt, wire_dtype="int8"):
+def encode_kv(k, v, next_token, plen, prompt, wire_dtype="int8",
+              trace=None):
     """Encode a prefilled slot cache pair (each (layers, cache_len,
     hidden) fp32 — a leading batch-of-1 axis is squeezed) into a
     :class:`KVHandoff`."""
@@ -158,11 +168,11 @@ def encode_kv(k, v, next_token, plen, prompt, wire_dtype="int8"):
         k, v = k[0], v[0]
     if wire_dtype == "fp32":
         return KVHandoff(k, v, None, None, next_token, plen, prompt,
-                         wire_dtype)
+                         wire_dtype, trace=trace)
     kq, ks = quantize_rows(k, wire_dtype)
     vq, vs = quantize_rows(v, wire_dtype)
     return KVHandoff(kq, vq, ks, vs, next_token, plen, prompt,
-                     wire_dtype)
+                     wire_dtype, trace=trace)
 
 
 def decode_kv(handoff):

@@ -22,6 +22,7 @@ Admission mirrors the decode engine: a full queue fast-rejects with
 from the observed drain rate.
 """
 import collections
+import contextlib
 import heapq
 import threading
 import time
@@ -82,7 +83,7 @@ class PrefillTicket:
 
 class _PrefillReq:
     __slots__ = ("prompt", "plen", "bucket", "priority", "tenant",
-                 "deadline", "ticket", "wire_dtype")
+                 "deadline", "ticket", "wire_dtype", "trace", "t_wall")
 
 
 class PrefillEngine:
@@ -171,6 +172,10 @@ class PrefillEngine:
         self._rate = collections.deque(maxlen=64)
         self._thread = None
         self._owner = _conc.owner_token("prefill-engine", self.name, self)
+        # cost-model predicted prefill seconds per bucket, computed
+        # lazily on the first TRACED request touching the bucket (the
+        # static analysis costs ~ms; unsampled requests never pay it)
+        self._cost_cache = {}
         if auto_start:
             self.start()
 
@@ -216,12 +221,16 @@ class PrefillEngine:
         return None
 
     def submit(self, prompt, priority=1, tenant=None, deadline_ms=None,
-               wire_dtype=None):
+               wire_dtype=None, trace_ctx=None):
         """Enqueue one prefill; returns a :class:`PrefillTicket` whose
         ``result()`` is the :class:`KVHandoff`. Lower ``priority``
         numbers run first (ties FIFO). ``wire_dtype`` overrides the
         engine's handoff codec for this one request (e.g. ``"fp32"``
-        for a lossless handoff out of an int8-wire fleet)."""
+        for a lossless handoff out of an int8-wire fleet).
+        ``trace_ctx`` (a sampled
+        :class:`~paddle_tpu.observability.TraceContext`) makes the
+        queue-wait and prefill-compute spans part of the request's
+        distributed trace and rides the handoff to the decode side."""
         if self._closed:
             raise EngineClosedError(
                 "engine %r is draining/stopped" % self.name)
@@ -247,6 +256,9 @@ class PrefillEngine:
                         if deadline_ms is not None else None)
         req.wire_dtype = (str(wire_dtype) if wire_dtype is not None
                           else self.wire_dtype)
+        sampled = trace_ctx is not None and trace_ctx.sampled
+        req.trace = trace_ctx if sampled else None
+        req.t_wall = time.time() if sampled else None
         req.ticket = PrefillTicket(plen, self.request_timeout_s)
         with self._cond:
             if self._closed:
@@ -312,17 +324,39 @@ class PrefillEngine:
 
     def _run_one(self, req):
         t0 = time.monotonic()
+        ctx = req.trace
+        sp_fields = None
+        if ctx is not None:
+            # the queue-wait span already finished (submit -> pop);
+            # export it directly, then parent the compute span to it
+            ctx = ctx.child()
+            obs.export_span(
+                "prefill.queue", ctx, req.t_wall,
+                t0 - req.ticket.t_submit,
+                {"proc": "prefill:%s" % self.name, "bucket": req.bucket,
+                 "plen": req.plen, "tenant": req.tenant})
+            sp_fields = {"proc": "prefill:%s" % self.name,
+                         "bucket": req.bucket, "plen": req.plen}
+            if req.tenant is not None:
+                sp_fields["tenant"] = str(req.tenant)
+            pred = self._predicted_s(req.bucket)
+            if pred is not None:
+                sp_fields["predicted_s"] = pred
         ids = np.zeros((1, req.bucket), np.int64)
         ids[0, :req.plen] = req.prompt
         plen = np.asarray([[req.plen]], np.int64)
         try:
             if _conc._on:
                 _conc.note_blocking("device.dispatch")
-            nxt, k1, v1 = self._prefill_preds[req.bucket].run(
-                {"gpt_prefill_ids": ids, "gpt_prefill_len": plen})
-            handoff = kv_wire.encode_kv(
-                k1, v1, int(np.asarray(nxt)[0, 0]), req.plen,
-                req.prompt, wire_dtype=req.wire_dtype)
+            cm = (obs.span("disagg.prefill", ctx=ctx, **sp_fields)
+                  if ctx is not None else contextlib.nullcontext())
+            with cm as sp:
+                nxt, k1, v1 = self._prefill_preds[req.bucket].run(
+                    {"gpt_prefill_ids": ids, "gpt_prefill_len": plen})
+                handoff = kv_wire.encode_kv(
+                    k1, v1, int(np.asarray(nxt)[0, 0]), req.plen,
+                    req.prompt, wire_dtype=req.wire_dtype,
+                    trace=getattr(sp, "ctx", None))
         except Exception as e:  # noqa: BLE001 — fail the request, not the loop
             self._bump("prefill_errors")
             obs.event("prefill_error", source="serving", model=self.name,
@@ -333,6 +367,10 @@ class PrefillEngine:
         now = time.monotonic()
         ttft = now - req.ticket.t_submit
         obs.observe("serving.disagg.prefill_ttft_seconds", ttft)
+        if req.tenant is not None:
+            obs.observe(
+                "serving.disagg.prefill_ttft_seconds.%s" % req.tenant,
+                ttft)
         obs.observe("serving.decode.prefill_seconds", now - t0)
         if (self.ttft_slo_ms is not None
                 and ttft * 1000.0 > self.ttft_slo_ms):
@@ -345,6 +383,32 @@ class PrefillEngine:
         with self._stats_lock:
             self._rate.append((now, 1))
         req.ticket._set(handoff)
+
+    def _predicted_s(self, bucket):
+        """Cost-model predicted seconds for one prefill of `bucket`,
+        cached per bucket; None when the analyzer can't price it (the
+        trace annotation is best-effort — never fail a request on it)."""
+        if bucket in self._cost_cache:
+            return self._cost_cache[bucket]
+        val = None
+        try:
+            import jax
+
+            from ...analysis import costs as _costs
+
+            pred = _costs.predict_program(
+                self._prefill_preds[bucket].program,
+                feed_specs={
+                    "gpt_prefill_ids": np.zeros((1, bucket), np.int64),
+                    "gpt_prefill_len": np.ones((1, 1), np.int64)},
+                is_test=True,
+                device_kind=getattr(jax.devices()[0], "device_kind",
+                                    None))
+            val = pred.get("predicted_step_seconds")
+        except Exception:  # noqa: BLE001 — annotation only
+            val = None
+        self._cost_cache[bucket] = val
+        return val
 
     # -- warmup / introspection ------------------------------------------
     def warmup(self):

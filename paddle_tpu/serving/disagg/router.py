@@ -106,11 +106,22 @@ class DisaggReplica:
     def _beat_once(self):
         self._beats += 1
         rate = self.engine.drain_rate()
+        depth = self.engine.queue_depth()
+        extra = {"queue_depth": depth,
+                 "model": self.name, "kind": self.kind}
+        if obs.mode() != obs.OFF:
+            # federation: the beacon carries this replica's metrics doc
+            # so a FleetMetrics aggregator anywhere on the store can
+            # merge the fleet without talking to engines directly
+            try:
+                extra["metrics"] = obs.replica_metrics_doc(
+                    self.engine.stats(), queue_depth=depth)
+            except Exception:  # noqa: BLE001 — beacons must not die
+                pass
         self.monitor.beat(
             self._beats,
             latency=(1.0 / rate) if rate else None,
-            extra={"queue_depth": self.engine.queue_depth(),
-                   "model": self.name, "kind": self.kind})
+            extra=extra)
 
     def _beat_loop(self):
         interval = max(0.005, self.config.heartbeat_interval / 2.0)
@@ -162,10 +173,10 @@ class DisaggReplica:
 
 class _Session:
     __slots__ = ("prompt", "max_new", "eos_id", "spec", "priority",
-                 "handle", "deadline_ms", "rid")
+                 "handle", "deadline_ms", "rid", "trace", "migration")
 
     def __init__(self, prompt, max_new, eos_id, spec, priority, handle,
-                 deadline_ms):
+                 deadline_ms, trace=None):
         self.prompt = prompt
         self.max_new = max_new
         self.eos_id = eos_id
@@ -174,6 +185,8 @@ class _Session:
         self.handle = handle
         self.deadline_ms = deadline_ms
         self.rid = None
+        self.trace = trace       # TraceContext (sampled) or None
+        self.migration = 0       # bumps on every re-prefill migration
 
 
 class DisaggRouter:
@@ -254,11 +267,14 @@ class DisaggRouter:
         return None
 
     def submit(self, prompt, max_new=None, eos_id=None, deadline_ms=None,
-               tenant=None, priority=None):
+               tenant=None, priority=None, trace_ctx=None):
         """Admit one generation session; returns a
         :class:`DisaggStream`. Sheds with 429 when the tenant is at
         quota or the prefill fleet is saturated; malformed priority
-        raises ``ValueError`` (400 upstream)."""
+        raises ``ValueError`` (400 upstream). ``trace_ctx`` (a sampled
+        :class:`~paddle_tpu.observability.TraceContext`, e.g. from a
+        ``traceparent`` header) threads one trace_id through the
+        prefill leg, the KV handoff and every decode-side span."""
         if self._closed:
             raise EngineClosedError(
                 "disagg router %r is draining/stopped" % self.name)
@@ -290,9 +306,14 @@ class DisaggRouter:
         handle = DisaggStream(
             plen, max_new, stall_timeout_s=self.request_timeout_s,
             tenant=spec.name, priority=prio)
+        if trace_ctx is not None and getattr(trace_ctx, "sampled", False):
+            handle.trace = trace_ctx
+        else:
+            trace_ctx = None
         sess = _Session(prompt, max_new,
                         self.eos_id if eos_id is None else eos_id,
-                        spec, prio, handle, deadline_ms)
+                        spec, prio, handle, deadline_ms,
+                        trace=trace_ctx)
         self._bump("sessions")
         obs.inc("serving.disagg.sessions")
         pump = threading.Thread(
@@ -306,10 +327,10 @@ class DisaggRouter:
 
     def generate(self, prompt, max_new=None, eos_id=None,
                  deadline_ms=None, tenant=None, priority=None,
-                 timeout=None):
+                 timeout=None, trace_ctx=None):
         h = self.submit(prompt, max_new=max_new, eos_id=eos_id,
                         deadline_ms=deadline_ms, tenant=tenant,
-                        priority=priority)
+                        priority=priority, trace_ctx=trace_ctx)
         return h.result(
             timeout if timeout is not None else self.request_timeout_s)
 
@@ -324,6 +345,7 @@ class DisaggRouter:
                     return
                 except _ReplicaLost as lost:
                     migrations += 1
+                    sess.migration = migrations
                     self._bump("migrations")
                     obs.inc("serving.disagg.migrations")
                     obs.event("session_migrated", source="serving",
@@ -371,7 +393,30 @@ class DisaggRouter:
 
     def _prefill_leg(self, sess, prompt):
         """Run one prefill on the least-loaded live prefill replica,
-        failing over on dead/shedding replicas."""
+        failing over on dead/shedding replicas. Traced sessions get a
+        ``disagg.prefill_leg`` span on the router track annotated with
+        the migration count — a re-prefill after replica death shows up
+        in the merged timeline under the ORIGINAL trace_id with
+        ``migration >= 1``."""
+        sp = None
+        if sess.trace is not None:
+            sp = obs.span(
+                "disagg.prefill_leg", ctx=sess.trace,
+                proc="router:%s" % self.name, tenant=sess.spec.name,
+                plen=int(prompt.shape[0]), migration=sess.migration)
+            sp.__enter__()
+        try:
+            handoff = self._prefill_leg_inner(
+                sess, prompt, sp.ctx if sp is not None else None)
+        except BaseException as e:
+            if sp is not None:
+                sp.__exit__(type(e), e, e.__traceback__)
+            raise
+        if sp is not None:
+            sp.__exit__(None, None, None)
+        return handoff
+
+    def _prefill_leg_inner(self, sess, prompt, tctx):
         deadline = time.monotonic() + self.request_timeout_s
         tried_all_shed = 0.01
         while True:
@@ -391,7 +436,8 @@ class DisaggRouter:
                     ticket = rep.engine.submit(
                         prompt, priority=sess.priority,
                         tenant=sess.spec.name,
-                        deadline_ms=sess.deadline_ms)
+                        deadline_ms=sess.deadline_ms,
+                        trace_ctx=tctx)
                     handoff = ticket.result(self.request_timeout_s)
                     ttft_ms = 1000 * (time.monotonic()
                                       - ticket.t_submit)
@@ -418,44 +464,30 @@ class DisaggRouter:
         the router-level stream until the sequence finishes. Raises
         :class:`_ReplicaLost` if the replica dies underneath."""
         remaining = sess.max_new - len(sess.handle.so_far())
-        deadline = time.monotonic() + self.request_timeout_s
-        backoff = 0.01
-        while True:
-            with self._lock:
-                if self._closed:
-                    raise EngineClosedError(
-                        "disagg router %r stopped" % self.name)
-                candidates = sorted(
-                    self._decode.values(),
-                    key=lambda r: len(self._sessions[r.rid]))
-            if not candidates:
-                raise NoReplicasError(
-                    "no live decode replicas for %r" % self.name)
-            inner = None
-            lost = None
-            for rep in candidates:
-                try:
-                    inner = rep.engine.submit_prefilled(
-                        handoff, max_new=remaining, eos_id=sess.eos_id,
-                        tenant=sess.spec.name, priority=sess.priority)
-                    break
-                except ShedError:
-                    continue
-                except EngineClosedError as e:
-                    lost = e
-                    self._mark_dead(rep.rid)
-                    continue
-            if inner is not None:
-                break
-            if time.monotonic() > deadline:
-                raise lost or ShedError(
-                    "every decode replica shed for %r" % self.name,
-                    model=self.name,
-                    retry_after=self.retry_after_hint())
-            if _conc._on:
-                _conc.note_blocking("time.sleep(backoff)")
-            time.sleep(backoff)
-            backoff = min(0.2, backoff * 2)
+        hsp = None
+        if sess.trace is not None:
+            # the handoff span bridges the two processes: it parents to
+            # the prefill-side span that encoded the KV (carried on the
+            # handoff itself) so the merged timeline draws a flow arrow
+            # prefill -> router -> decode under one trace_id
+            hctx = getattr(handoff, "trace", None) or sess.trace
+            hsp = obs.span(
+                "disagg.handoff", ctx=hctx,
+                proc="router:%s" % self.name,
+                wire_dtype=handoff.wire_dtype,
+                wire_bytes=handoff.wire_bytes(), plen=handoff.plen,
+                migration=sess.migration)
+            hsp.__enter__()
+        try:
+            rep, inner = self._adopt_on_decode(
+                sess, handoff, remaining,
+                hsp.ctx if hsp is not None else None)
+        except BaseException as e:
+            if hsp is not None:
+                hsp.__exit__(type(e), e, e.__traceback__)
+            raise
+        if hsp is not None:
+            hsp.__exit__(None, None, None)
         rid = rep.rid
         sess.rid = rid
         with self._lock:
@@ -489,6 +521,50 @@ class DisaggRouter:
                 self._sessions[rid].discard(sess.handle)
             obs.set_gauge("serving.disagg.decode_sessions.%d" % rid,
                           len(self._sessions[rid]))
+
+    def _adopt_on_decode(self, sess, handoff, remaining, tctx):
+        """Place the handoff on the fewest-sessions live decode
+        replica, failing over on shed/dead ones; returns
+        ``(replica, inner_stream)``."""
+        deadline = time.monotonic() + self.request_timeout_s
+        backoff = 0.01
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise EngineClosedError(
+                        "disagg router %r stopped" % self.name)
+                candidates = sorted(
+                    self._decode.values(),
+                    key=lambda r: len(self._sessions[r.rid]))
+            if not candidates:
+                raise NoReplicasError(
+                    "no live decode replicas for %r" % self.name)
+            inner = None
+            lost = None
+            for rep in candidates:
+                try:
+                    inner = rep.engine.submit_prefilled(
+                        handoff, max_new=remaining, eos_id=sess.eos_id,
+                        tenant=sess.spec.name, priority=sess.priority,
+                        trace_ctx=tctx)
+                    break
+                except ShedError:
+                    continue
+                except EngineClosedError as e:
+                    lost = e
+                    self._mark_dead(rep.rid)
+                    continue
+            if inner is not None:
+                return rep, inner
+            if time.monotonic() > deadline:
+                raise lost or ShedError(
+                    "every decode replica shed for %r" % self.name,
+                    model=self.name,
+                    retry_after=self.retry_after_hint())
+            if _conc._on:
+                _conc.note_blocking("time.sleep(backoff)")
+            time.sleep(backoff)
+            backoff = min(0.2, backoff * 2)
 
     # -- health / membership ---------------------------------------------
     def start_health(self):
@@ -609,6 +685,33 @@ class DisaggRouter:
         out["tenant_shed"] = sum(
             self.tenants.stats()["shed"].values())
         return dict(out)
+
+    # -- fleet metrics federation ----------------------------------------
+    def fleet_metrics(self):
+        """A :class:`~paddle_tpu.observability.FleetMetrics` aggregator
+        fed from the heartbeat table — every replica's beacon carries
+        its metrics doc, so this works identically for in-process
+        replicas and store-backed worker processes."""
+        fm = obs.FleetMetrics()
+        fm.ingest_beacons(self.monitor.table())
+        return fm
+
+    def fleet_render_prom(self, style=None):
+        """Prometheus exposition of the federated fleet view (what
+        ``/metrics?scope=fleet`` serves): merged ``fleet.*`` series
+        plus per-tenant SLO burn-rate gauges."""
+        fm = self.fleet_metrics()
+        out = fm.render_prom(style=style)
+        try:
+            obs.SLOMonitor(self.tenants).tick(publish=True)
+            slo = "\n".join(
+                ln for ln in obs.render_prom().splitlines()
+                if "fleet_slo_burn" in ln)
+            if slo:
+                out += slo + "\n"
+        except Exception:  # noqa: BLE001 — metrics must not 500
+            pass
+        return out
 
     def queue_depth(self):
         with self._lock:

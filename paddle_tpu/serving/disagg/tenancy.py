@@ -91,6 +91,13 @@ class TenantTable:
         self._queued = {}
         self._shed = {}
 
+    def specs(self):
+        """Every configured spec plus the default (deduped by name) —
+        the set the SLO monitor scores burn rates for."""
+        out = {self.default_spec.name: self.default_spec}
+        out.update(self._specs)
+        return list(out.values())
+
     def resolve(self, tenant):
         """The spec governing `tenant` (None -> the default spec)."""
         if tenant is None:

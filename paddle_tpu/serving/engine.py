@@ -160,12 +160,14 @@ class ServingEngine:
                   model=self.name, drained=bool(drain))
 
     # -- admission -------------------------------------------------------
-    def submit(self, feeds, deadline_ms=None):
+    def submit(self, feeds, deadline_ms=None, trace_ctx=None):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the per-request fetch list (rows sliced back out of
         the coalesced batch). Raises :class:`ShedError` immediately when
         the queue is full and :class:`EngineClosedError` after
-        ``stop()``."""
+        ``stop()``. A sampled ``trace_ctx`` exports one
+        ``serving.predict`` span (queue wait + batch compute) when the
+        request resolves."""
         if self._closed:  # cheap early reject; re-checked under the lock
             raise EngineClosedError(
                 "engine %r is draining/stopped" % self.name)
@@ -211,6 +213,15 @@ class ServingEngine:
                 retry_after=self.retry_after_hint())
         self._bump("requests")
         obs.set_gauge("serving.queue_depth.%s" % self.name, self._q.qsize())
+        if trace_ctx is not None and getattr(trace_ctx, "sampled", False):
+            ctx = trace_ctx.child()
+            t_wall = time.time()
+            req.future.add_done_callback(
+                lambda f, c=ctx, t=t_wall: obs.export_span(
+                    "serving.predict", c, t, time.time() - t,
+                    {"proc": "engine:%s" % self.name, "rows": rows,
+                     "error": (type(f.exception()).__name__
+                               if f.exception() else None)}))
         return req.future
 
     def predict(self, feeds, deadline_ms=None, timeout=None):

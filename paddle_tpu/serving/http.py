@@ -107,14 +107,38 @@ class ServingHandler(BaseHTTPRequestHandler):
         seconds = max(1, int(-(-float(hint) // 1))) if hint else 1
         return {"Retry-After": str(seconds)}
 
+    def _fleet_prom(self):
+        """Federated ``scope=fleet`` exposition: every published engine
+        that aggregates a fleet (``fleet_render_prom``) contributes its
+        merged view; a registry with only lone engines answers with the
+        process hub so the page is never empty."""
+        parts = []
+        registry = self.server.registry
+        for name in sorted(registry.info()):
+            engine = registry.get(name)
+            render = getattr(engine, "fleet_render_prom", None)
+            if render is None:
+                continue
+            try:
+                parts.append(render())
+            except Exception:  # noqa: BLE001 — metrics must not 500
+                continue
+        return "".join(parts) or obs.render_prom()
+
     def do_GET(self):  # noqa: N802 — stdlib handler name
-        if self.path == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
             self._send_json(200, {
                 "status": "ok",
                 "models": self.server.registry.info(),
             })
-        elif self.path == "/metrics":
-            body = obs.render_prom().encode("utf-8")
+        elif path == "/metrics":
+            from urllib.parse import parse_qs
+
+            scope = (parse_qs(query).get("scope") or ["process"])[0]
+            text = (self._fleet_prom() if scope == "fleet"
+                    else obs.render_prom())
+            body = text.encode("utf-8")
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
@@ -175,6 +199,20 @@ class ServingHandler(BaseHTTPRequestHandler):
             kw["priority"] = body["priority"]
         return kw
 
+    def _trace_ctx(self, body=None):
+        """TraceContext for this request: an incoming W3C
+        ``traceparent`` header wins (distributed callers pick the
+        sampling bit); ``"trace": true`` in the body forces a fresh
+        sampled context; otherwise the deterministic stride sampler
+        over ``$PADDLE_TPU_TRACE_SAMPLE`` decides."""
+        ctx = obs.TraceContext.from_header(
+            self.headers.get("traceparent"))
+        if ctx is not None:
+            return ctx if ctx.sampled else None
+        if body and body.get("trace") and obs.trace_dir() is not None:
+            return obs.TraceContext.new()
+        return obs.sample_request()
+
     def _do_generate(self, name, engine):
         if getattr(engine, "engine_kind", None) != "decode":
             return self._send_json(
@@ -194,6 +232,10 @@ class ServingHandler(BaseHTTPRequestHandler):
             return self._send_json(
                 400, {"error": "bad request: %s: %s"
                                % (type(e).__name__, e)})
+        tctx = self._trace_ctx(body)
+        t_req = time.time() if tctx is not None else None
+        if tctx is not None:
+            kw["trace_ctx"] = tctx
         try:
             handle = engine.submit(prompt, **kw)
         except (ValueError, TypeError) as e:
@@ -209,9 +251,16 @@ class ServingHandler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001
                 return self._send_json(
                     *self._generate_errdoc(e, name, engine))
+            if tctx is not None:
+                obs.export_span(
+                    "http.generate", tctx, t_req, time.time() - t_req,
+                    {"proc": "http", "model": name,
+                     "tokens": len(toks)})
             return self._send_json(200, {
                 "tokens": toks, "n_tokens": len(toks),
-                "finish_reason": handle.finish_reason, "model": name})
+                "finish_reason": handle.finish_reason, "model": name,
+                "trace_id": tctx.trace_id if tctx is not None
+                else None})
 
         # hold the headers until the first token (or failure) exists:
         # a request shed/expired in the queue must answer 429/504, not
@@ -233,9 +282,12 @@ class ServingHandler(BaseHTTPRequestHandler):
                     for i, tok in enumerate(gen, start=1):
                         self._chunk({"token": tok, "index": i})
                 toks = handle.so_far()
-                self._chunk({"done": True,
-                             "finish_reason": handle.finish_reason,
-                             "tokens": toks, "n_tokens": len(toks)})
+                done = {"done": True,
+                        "finish_reason": handle.finish_reason,
+                        "tokens": toks, "n_tokens": len(toks)}
+                if tctx is not None:
+                    done["trace_id"] = tctx.trace_id
+                self._chunk(done)
             except (BrokenPipeError, ConnectionResetError):
                 # client went away: free the slot at the next dispatch
                 # iteration instead of decoding to nobody
@@ -251,6 +303,11 @@ class ServingHandler(BaseHTTPRequestHandler):
         finally:
             if not handle.done:
                 handle.cancel()
+            if tctx is not None:
+                obs.export_span(
+                    "http.generate", tctx, t_req, time.time() - t_req,
+                    {"proc": "http", "model": name,
+                     "tokens": len(handle.so_far())})
             try:
                 self.wfile.write(b"0\r\n\r\n")
                 self.wfile.flush()
@@ -294,8 +351,18 @@ class ServingHandler(BaseHTTPRequestHandler):
             return self._send_json(
                 400, {"error": "bad request: %s: %s"
                                % (type(e).__name__, e)})
+        tctx = self._trace_ctx(body)
+        t_req = time.time() if tctx is not None else None
         try:
-            fut = engine.submit(feeds, deadline_ms=deadline_ms)
+            if tctx is not None:
+                try:
+                    fut = engine.submit(feeds, deadline_ms=deadline_ms,
+                                        trace_ctx=tctx)
+                except TypeError:
+                    # engine predates the kwarg: serve untraced
+                    fut = engine.submit(feeds, deadline_ms=deadline_ms)
+            else:
+                fut = engine.submit(feeds, deadline_ms=deadline_ms)
         except ShedError as e:
             return self._send_json(429, self._shed_doc(e, name, engine),
                                    headers=self._shed_headers(e, engine))
@@ -331,6 +398,10 @@ class ServingHandler(BaseHTTPRequestHandler):
                     503, {"error": str(e), "model": name})
             return self._send_json(
                 500, {"error": "%s: %s" % (type(e).__name__, e)})
+        if tctx is not None:
+            obs.export_span(
+                "http.predict", tctx, t_req, time.time() - t_req,
+                {"proc": "http", "model": name})
         self._send_json(200, {"outputs": [
             {"data": o.tolist(), "shape": list(o.shape),
              "dtype": str(o.dtype)}

@@ -101,7 +101,16 @@ class NoReplicasError(RuntimeError):
 
 class ReplicaGoneError(RuntimeError):
     """A replica died with this request in flight; the router treats it
-    as retryable and replays the request on a survivor."""
+    as retryable and replays the request on a survivor.
+
+    ``dump_paths`` lists any crash-dump files the dead worker
+    advertised on its beacons — per-pid paths (see
+    :func:`paddle_tpu.observability.crash_dump_path`), so two workers
+    crashing together never clobber one dump file."""
+
+    def __init__(self, msg, dump_paths=()):
+        RuntimeError.__init__(self, msg)
+        self.dump_paths = tuple(dump_paths)
 
 
 class RolloutError(RuntimeError):
@@ -227,14 +236,23 @@ class LocalReplica:
     def _beat_once(self):
         self._beats += 1
         rate = self.engine.drain_rate()
+        extra = {"queue_depth": self.engine.queue_depth(),
+                 "version": self.version, "model": self.name,
+                 "kind": "replica"}
+        if obs.mode() != obs.OFF:
+            # federation: beacons carry this replica's stats() doc so a
+            # FleetMetrics aggregator can merge the fleet off the store
+            try:
+                extra["metrics"] = obs.replica_metrics_doc(
+                    self.engine.stats(), queue_depth=extra["queue_depth"])
+            except Exception:  # noqa: BLE001 — beacons must not die
+                pass
         self.monitor.beat(
             self._beats,
             # per-request service time: the straggler classifier's
             # latency signal (a slow replica drains slowly)
             latency=(1.0 / rate) if rate else None,
-            extra={"queue_depth": self.engine.queue_depth(),
-                   "version": self.version, "model": self.name,
-                   "kind": "replica"})
+            extra=extra)
 
     def _beat_loop(self):
         interval = max(0.005, self.config.heartbeat_interval / 2.0)
@@ -257,8 +275,11 @@ class LocalReplica:
             self._beater.start()
 
     # -- engine surface --------------------------------------------------
-    def submit(self, feeds, deadline_ms=None):
+    def submit(self, feeds, deadline_ms=None, trace_ctx=None):
         R.fault_check("replica")
+        if trace_ctx is not None:
+            return self.engine.submit(feeds, deadline_ms=deadline_ms,
+                                      trace_ctx=trace_ctx)
         return self.engine.submit(feeds, deadline_ms=deadline_ms)
 
     def queue_depth(self):
@@ -343,7 +364,7 @@ class StoreReplica:
         self._poller.start()
 
     # -- engine surface --------------------------------------------------
-    def submit(self, feeds, deadline_ms=None):
+    def submit(self, feeds, deadline_ms=None, trace_ctx=None):
         if self._closed:
             raise EngineClosedError(
                 "replica proxy %d of %r is stopped" % (self.rid, self.name))
@@ -351,9 +372,13 @@ class StoreReplica:
         fut = Future()
         with self._lock:
             self._pending[key] = fut
-        self.store.put(self._req_ns, key, {
-            "feeds": _encode_feeds(feeds),
-            "deadline_ms": deadline_ms, "t": time.time()})
+        doc = {"feeds": _encode_feeds(feeds),
+               "deadline_ms": deadline_ms, "t": time.time()}
+        if trace_ctx is not None and getattr(trace_ctx, "sampled", False):
+            # the req mailbox carries the trace context across the
+            # process boundary; the worker's span parents to it
+            doc["trace"] = trace_ctx.to_doc()
+        self.store.put(self._req_ns, key, doc)
         return fut
 
     def queue_depth(self):
@@ -489,17 +514,38 @@ class ReplicaWorker:
         self._beats = 0
         self.monitor = HeartbeatMonitor(
             store, self.rid, world_size=1, config=self.config)
+        # crash dump routing: $PADDLE_TPU_CRASH_DUMP names ONE file —
+        # route this worker's dump to a per-pid sibling so two workers
+        # crashing together never clobber each other, and advertise the
+        # path on beacons (the router surfaces it in ReplicaGoneError)
+        self._crash_dump = None
+        if os.environ.get(obs.CRASH_DUMP_ENV):
+            self._crash_dump = obs.crash_dump_path(per_pid=True)
+            os.environ[obs.CRASH_DUMP_ENV] = self._crash_dump
+        if obs.process_label() == "pid%d" % os.getpid():
+            obs.set_process_label(
+                "worker:%s-%d" % (self.name, self.rid))
 
     def _beat(self):
         self._beats += 1
         rate = self.engine.drain_rate()
+        extra = {"queue_depth": self.engine.queue_depth(),
+                 "version": self.version, "model": self.name,
+                 "kind": "replica", "pid": os.getpid()}
+        if self._crash_dump:
+            extra["crash_dump"] = self._crash_dump
+        if obs.mode() != obs.OFF:
+            # federation: a worker process owns its whole telemetry
+            # hub, so the beacon ships the full federation doc
+            try:
+                extra["metrics"] = obs.get_telemetry().federation_doc()
+            except Exception:  # noqa: BLE001 — beacons must not die
+                pass
         self.monitor.beat(
             self._beats, latency=(1.0 / rate) if rate else None,
-            extra={"queue_depth": self.engine.queue_depth(),
-                   "version": self.version, "model": self.name,
-                   "kind": "replica", "pid": os.getpid()})
+            extra=extra)
 
-    def _finish(self, key, fut):
+    def _finish(self, key, fut, trace=None, t_wall=None):
         try:
             outs = fut.result()
             payload = {"ok": True,
@@ -509,6 +555,11 @@ class ReplicaWorker:
                        "message": str(e),
                        "retry_after": getattr(e, "retry_after", None)}
         self.store.put(self._resp_ns, key, payload)
+        if trace is not None and t_wall is not None:
+            obs.export_span(
+                "worker.predict", trace, t_wall, time.time() - t_wall,
+                {"replica": self.rid, "ok": payload["ok"],
+                 "error": payload.get("error")})
 
     def _take_requests(self):
         reqs = self.store.all(self._req_ns)
@@ -522,6 +573,9 @@ class ReplicaWorker:
             # not grow every later poll's scan (the proxy side recovers
             # lost work from heartbeats, not from the request file)
             self.store.delete(self._req_ns, key)
+            trace = obs.TraceContext.from_doc(doc.get("trace"))
+            trace = trace.child() if trace is not None else None
+            t_wall = time.time() if trace is not None else None
             try:
                 fut = self.engine.submit(
                     _decode_feeds(doc["feeds"]),
@@ -533,7 +587,8 @@ class ReplicaWorker:
                     "retry_after": getattr(e, "retry_after", None)})
                 continue
             fut.add_done_callback(
-                lambda f, key=key: self._finish(key, f))
+                lambda f, key=key, tr=trace, tw=t_wall:
+                self._finish(key, f, trace=tr, t_wall=tw))
 
     def _take_control(self):
         """Returns False once a stop command was obeyed."""
@@ -684,18 +739,24 @@ class ServingRouter:
         return min(hints) if hints else 1.0
 
     # -- dispatch --------------------------------------------------------
-    def submit(self, feeds, deadline_ms=None):
+    def submit(self, feeds, deadline_ms=None, trace_ctx=None):
         """Engine-compatible: returns ONE future the caller holds while
-        the router moves the request between replicas underneath."""
+        the router moves the request between replicas underneath.
+        ``trace_ctx`` (a sampled TraceContext) rides the dispatch to
+        the chosen replica — across the FileStore wire for worker
+        processes."""
         if self._closed:
             raise EngineClosedError(
                 "router %r is draining/stopped" % self.name)
         t0 = time.monotonic()
         budget = (float(deadline_ms) / 1000.0 if deadline_ms is not None
                   else self.request_timeout_s)
+        if trace_ctx is not None and not getattr(trace_ctx, "sampled",
+                                                 False):
+            trace_ctx = None
         state = {"feeds": feeds, "deadline_ms": deadline_ms,
                  "future": Future(), "t0": t0, "t_deadline": t0 + budget,
-                 "tried": set(), "rounds": 0}
+                 "tried": set(), "rounds": 0, "trace": trace_ctx}
         with self._inflight_lock:
             self._inflight.add(state["future"])
         state["future"].add_done_callback(self._forget)
@@ -737,8 +798,21 @@ class ServingRouter:
             return
         for replica in self._candidates(state["tried"]):
             try:
-                fut = replica.submit(
-                    state["feeds"], deadline_ms=state["deadline_ms"])
+                if state.get("trace") is not None:
+                    try:
+                        fut = replica.submit(
+                            state["feeds"],
+                            deadline_ms=state["deadline_ms"],
+                            trace_ctx=state["trace"])
+                    except TypeError:
+                        # duck-typed replica without the kwarg: the
+                        # request matters more than its trace
+                        fut = replica.submit(
+                            state["feeds"],
+                            deadline_ms=state["deadline_ms"])
+                else:
+                    fut = replica.submit(
+                        state["feeds"], deadline_ms=state["deadline_ms"])
             except (ValueError, KeyError):
                 raise  # malformed request: permanent, caller's problem
             except Exception:  # noqa: BLE001 — shed/closed/injected: next
@@ -886,16 +960,28 @@ class ServingRouter:
             n_live = len(self._live)
         self._bump("replica_dead")
         obs.set_gauge("serving.replicas_live", n_live)
+        dumps = []
+        try:
+            table = self.monitor.table()
+            beacon = table.get(rid, table.get(str(rid)))
+            if isinstance(beacon, dict) and beacon.get("crash_dump"):
+                dumps.append(str(beacon["crash_dump"]))
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
         replayed = 0
         fail = getattr(replica, "fail_inflight", None)
         if fail is not None:
             # orphaned in-flight requests come back through
             # _on_replica_done as ReplicaGoneError -> replayed
             replayed = fail(ReplicaGoneError(
-                "replica %d of %r died mid-request (missed %d beacons)"
-                % (rid, self.name, self.config.miss_threshold)))
+                "replica %d of %r died mid-request (missed %d beacons)%s"
+                % (rid, self.name, self.config.miss_threshold,
+                   " — crash dump: %s" % ", ".join(dumps)
+                   if dumps else ""),
+                dump_paths=dumps))
         obs.event("replica_dead", source="serving", model=self.name,
-                  replica=rid, replayed=replayed, live=n_live)
+                  replica=rid, replayed=replayed, live=n_live,
+                  crash_dump=dumps[0] if dumps else None)
         self._activate_standby(reason="replace_dead")
 
     def _activate_standby(self, reason, scaled=False):
@@ -1181,8 +1267,14 @@ def worker_main(argv=None):
     p.add_argument("--queue-capacity", type=int, default=64)
     p.add_argument("--no-warm", action="store_true")
     p.add_argument("--heartbeat-interval", type=float, default=None)
+    p.add_argument("--trace-proc", default=None,
+                   help="trace track label for this process (default "
+                        "worker:<name>-<rid>)")
     args = p.parse_args(argv)
 
+    obs.set_process_label(
+        args.trace_proc or "worker:%s-%d" % (args.name, args.rid))
+    obs.install_excepthook()
     config = ElasticConfig(heartbeat_interval=args.heartbeat_interval)
     factory = make_engine_factory(
         buckets=_parse_buckets(args.buckets), name=args.name,

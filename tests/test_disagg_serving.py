@@ -430,12 +430,18 @@ def test_disagg_fleet_bit_identical_and_tenancy(m):
 
 @pytest.mark.chaos
 def test_chaos_decode_replica_kill_migrates_streams_exactly(
-        m, armed_sanitizers):
+        m, armed_sanitizers, tmp_path, monkeypatch):
     """SIGKILL-equivalent on a decode replica mid-stream: every live
     session re-prefills ``prompt + so_far()`` and finishes on the
     survivor BIT-identical to solo — zero failed streams. Runs with the
     lock-order/thread sanitizer AND the scope sanitizer armed: the kill
-    path must leave zero violations and zero leaked threads."""
+    path must leave zero violations and zero leaked threads. Runs
+    traced (ISSUE 14): the migrated streams' re-prefill spans must
+    carry the ORIGINAL trace_id plus a ``migration`` annotation, so
+    the merged timeline shows the failover instead of losing it."""
+    from paddle_tpu import observability as obs
+
+    monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path))
     router = disagg_fleet(
         m["cfg"], m["scope"], n_prefill=1, n_decode=2, slots=2,
         cache_len=64, kv_dtype="fp32", wire_dtype="fp32",
@@ -443,7 +449,9 @@ def test_chaos_decode_replica_kill_migrates_streams_exactly(
     try:
         lens = (3, 5, 6, 8)
         n_new = 50
-        handles = [(plen, router.submit(_prompt(plen), max_new=n_new))
+        traces = {plen: obs.TraceContext.new() for plen in lens}
+        handles = [(plen, router.submit(_prompt(plen), max_new=n_new,
+                                        trace_ctx=traces[plen]))
                    for plen in lens]
         # wait until every session is adopted (first token emitted) —
         # the earliest instant the kill can catch all four mid-stream
@@ -468,6 +476,30 @@ def test_chaos_decode_replica_kill_migrates_streams_exactly(
         assert st["decode_live"] == 1
         # each migrated session re-adopted on the survivor
         assert st["adopts"] >= len(lens) + st["migrations"]
+        # --- traced failover: re-prefill spans keep the original
+        # trace_id and carry the migration annotation ---
+        spans = obs.read_spans(str(tmp_path))
+        want = {t.trace_id for t in traces.values()}
+        got = {s["trace"] for s in spans}
+        assert want <= got  # every request traced end to end
+        legs = [s for s in spans if s["name"] == "disagg.prefill_leg"]
+        migrated = [s for s in legs
+                    if (s.get("args") or {}).get("migration", 0) >= 1]
+        assert len(legs) >= len(lens) + st["migrations"]
+        assert len(migrated) >= st["migrations"]
+        # the re-prefill rides the ORIGINAL trace, not a fresh one
+        assert all(s["trace"] in want for s in migrated)
+        for s in migrated:
+            engine_prefills = [
+                p for p in spans if p["name"] == "disagg.prefill"
+                and p["trace"] == s["trace"]]
+            assert len(engine_prefills) >= 2  # original + re-prefill
+        # the merged chrome trace keeps one timeline per request with
+        # spans from >= 3 logical processes and cross-process flows
+        doc = obs.chrome_trace(spans,
+                               trace_id=migrated[0]["trace"])
+        assert len(doc["otherData"]["processes"]) >= 3
+        assert doc["otherData"]["flows"] >= 1
     finally:
         router.stop(drain=False, timeout=10.0)
 

@@ -132,10 +132,39 @@ class TestProm:
         assert "paddle_tpu_executor_cache_hit 3" in lines
         assert "paddle_tpu_reader_queue_depth 4" in lines
         assert "paddle_tpu_checkpoint_save_seconds_count 2" in lines
-        # quantile lines carry the label form
+        # default exposition is a proper Prometheus histogram
+        assert "# TYPE paddle_tpu_checkpoint_save_seconds histogram" in lines
+        buckets = [
+            l for l in lines
+            if l.startswith('paddle_tpu_checkpoint_save_seconds_bucket{le=')]
+        assert buckets
+        # the +Inf bucket closes the series and equals the count
+        assert any('le="+Inf"} 2' in l for l in buckets)
+        # cumulative: bucket counts never decrease
+        counts = [float(l.rsplit(" ", 1)[1]) for l in buckets]
+        assert counts == sorted(counts)
+        assert any(
+            l.startswith("paddle_tpu_checkpoint_save_seconds_sum ")
+            for l in lines)
+
+    def test_render_prom_summary_fallback(self, monkeypatch):
+        obs.observe("checkpoint.save_seconds", 0.25)
+        obs.observe("checkpoint.save_seconds", 0.75)
+        # explicit style argument restores the legacy quantile lines
+        text = obs.render_prom(style="summary")
+        lines = text.strip().split("\n")
+        for line in lines:
+            assert _PROM_LINE.match(line), "bad prom line: %r" % line
         assert any(
             l.startswith('paddle_tpu_checkpoint_save_seconds{quantile=')
             for l in lines)
+        assert not any("_bucket{le=" in l for l in lines)
+        # ... and so does the env flag with no argument
+        monkeypatch.setenv(obs.PROM_STYLE_ENV, "summary")
+        env_lines = obs.render_prom().strip().split("\n")
+        assert any(
+            l.startswith('paddle_tpu_checkpoint_save_seconds{quantile=')
+            for l in env_lines)
 
     def test_render_prom_empty_hub(self):
         assert obs.render_prom() == ""

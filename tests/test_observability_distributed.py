@@ -1,0 +1,366 @@
+"""Distributed tracing + fleet metrics federation (ISSUE 14): trace
+context propagation, span export/merge into Chrome trace docs, the
+stride sampler, fleet metric merging, and SLO burn rates."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import distributed as dist
+from paddle_tpu.serving.disagg.tenancy import TenantSpec, TenantTable
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv(obs.TELEMETRY_ENV, raising=False)
+    monkeypatch.delenv(obs.TRACE_DIR_ENV, raising=False)
+    monkeypatch.delenv(obs.TRACE_PROC_ENV, raising=False)
+    monkeypatch.delenv(obs.TRACE_SAMPLE_ENV, raising=False)
+    monkeypatch.delenv(obs.CRASH_DUMP_ENV, raising=False)
+    monkeypatch.setattr(dist, "_sample_n", 0)
+    monkeypatch.setattr(dist, "_writer", None)
+    obs.set_process_label(None)
+    obs.reset()
+    yield
+    obs.set_process_label(None)
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# trace context
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_new_and_child(self):
+        ctx = obs.TraceContext.new()
+        assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+        assert ctx.sampled and ctx.parent is None
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id != ctx.span_id
+        assert kid.parent == ctx.span_id
+        assert kid.sampled
+
+    def test_header_round_trip(self):
+        ctx = obs.TraceContext.new()
+        back = obs.TraceContext.from_header(ctx.to_header())
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.sampled
+
+    def test_header_sampling_bit(self):
+        ctx = obs.TraceContext.new(sampled=False)
+        assert ctx.to_header().endswith("-00")
+        back = obs.TraceContext.from_header(ctx.to_header())
+        assert back is not None and not back.sampled
+
+    @pytest.mark.parametrize("bad", [
+        None, "", 42, "not-a-header", "00-short-abc-01",
+        "00-" + "g" * 32 + "-" + "0" * 16 + "-01",   # non-hex trace
+        "00-" + "0" * 32 + "-" + "0" * 15 + "-01",   # short span
+        "00-" + "0" * 32 + "-" + "0" * 16 + "-zz",   # bad flags
+    ])
+    def test_malformed_header_is_none(self, bad):
+        assert obs.TraceContext.from_header(bad) is None
+
+    def test_doc_round_trip(self):
+        ctx = obs.TraceContext.new(sampled=False)
+        back = obs.TraceContext.from_doc(ctx.to_doc())
+        assert (back.trace_id, back.span_id, back.sampled) == (
+            ctx.trace_id, ctx.span_id, False)
+        assert obs.TraceContext.from_doc(None) is None
+        assert obs.TraceContext.from_doc({"trace_id": ""}) is None
+        assert obs.TraceContext.from_doc("nope") is None
+
+
+# ---------------------------------------------------------------------------
+# span export + collector
+# ---------------------------------------------------------------------------
+
+
+def _export_chain(tmp_path, monkeypatch):
+    """One request timeline across three logical processes."""
+    monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path))
+    root = obs.TraceContext.new()
+    obs.export_span("http.generate", root, 1.0, 0.5, {"proc": "http"})
+    leg = root.child()
+    obs.export_span("disagg.prefill_leg", leg, 1.0, 0.2,
+                    {"proc": "router:r", "migration": 0})
+    pre = leg.child()
+    obs.export_span("disagg.prefill", pre, 1.05, 0.1,
+                    {"proc": "prefill:p0", "predicted_s": 0.08})
+    hand = pre.child()
+    obs.export_span("disagg.handoff", hand, 1.15, 0.01,
+                    {"proc": "router:r"})
+    adopt = hand.child()
+    obs.export_span("decode.adopt", adopt, 1.16, 0.02,
+                    {"proc": "decode:d1"})
+    tok = adopt.child()
+    obs.export_span("decode.token", tok, 1.2, 0.01,
+                    {"proc": "decode:d1"})
+    return root
+
+
+class TestSpanExport:
+    def test_export_noop_without_dir(self):
+        assert not obs.export_span("x", obs.TraceContext.new(), 0.0, 0.1)
+
+    def test_export_noop_unsampled(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path))
+        ctx = obs.TraceContext.new(sampled=False)
+        assert not obs.export_span("x", ctx, 0.0, 0.1)
+        assert not obs.export_span("x", None, 0.0, 0.1)
+        assert obs.read_spans(str(tmp_path)) == []
+
+    def test_export_writes_jsonl_and_drops_none_fields(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path))
+        ctx = obs.TraceContext.new()
+        assert obs.export_span("decode.token", ctx, 2.0, 0.25,
+                               {"slot": 3, "error": None})
+        path = os.path.join(str(tmp_path),
+                            "trace-%d.jsonl" % os.getpid())
+        assert os.path.exists(path)
+        (rec,) = obs.read_spans(str(tmp_path))
+        assert rec["trace"] == ctx.trace_id
+        assert rec["span"] == ctx.span_id
+        assert rec["name"] == "decode.token"
+        assert rec["dur"] == 0.25
+        assert rec["args"] == {"slot": 3}  # None field dropped
+
+    def test_read_spans_skips_torn_lines(self, tmp_path):
+        p = os.path.join(str(tmp_path), "trace-1.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"span": "a", "trace": "t",
+                                "name": "n", "t0": 0, "dur": 0.1}))
+            f.write("\n{\"span\": \"tor")  # killed mid-write
+        spans = obs.read_spans(str(tmp_path))
+        assert len(spans) == 1 and spans[0]["span"] == "a"
+
+    def test_chrome_trace_tracks_and_flows(self, tmp_path, monkeypatch):
+        root = _export_chain(tmp_path, monkeypatch)
+        doc = obs.collect_trace(str(tmp_path))
+        other = doc["otherData"]
+        assert other["spans"] == 6
+        assert other["traces"] == [root.trace_id]
+        # >= 3 distinct logical processes under one trace id
+        assert len(other["processes"]) >= 3
+        assert {"http", "router:r", "prefill:p0",
+                "decode:d1"} <= set(other["processes"])
+        # a flow arrow for every cross-process parent link
+        # (http->router, router->prefill, prefill->router,
+        # router->decode; adopt->token is same-process)
+        assert other["flows"] == 4
+        evs = doc["traceEvents"]
+        assert any(e["ph"] == "s" for e in evs)
+        assert any(e["ph"] == "f" and e.get("bp") == "e" for e in evs)
+        # predicted-vs-measured annotation on the cost-modelled span
+        pre = [e for e in evs if e["ph"] == "X"
+               and e["name"] == "disagg.prefill"][0]
+        assert pre["args"]["predicted_ms"] == 80.0
+        assert pre["args"]["measured_ms"] == 100.0
+        assert pre["args"]["cost_model_error_pct"] == 25.0
+
+    def test_collect_trace_writes_atomic_file(self, tmp_path,
+                                              monkeypatch):
+        _export_chain(tmp_path, monkeypatch)
+        out = os.path.join(str(tmp_path), "merged.json")
+        obs.collect_trace(str(tmp_path), out=out)
+        with open(out) as f:
+            doc = json.load(f)
+        assert doc["otherData"]["spans"] == 6
+        assert not any(fn.startswith("merged.json.tmp")
+                       for fn in os.listdir(str(tmp_path)))
+
+    def test_trace_id_filter(self, tmp_path, monkeypatch):
+        _export_chain(tmp_path, monkeypatch)
+        other = obs.TraceContext.new()
+        obs.export_span("http.generate", other, 5.0, 0.1,
+                        {"proc": "http"})
+        doc = obs.collect_trace(str(tmp_path),
+                                trace_id=other.trace_id)
+        assert doc["otherData"]["spans"] == 1
+        assert doc["otherData"]["traces"] == [other.trace_id]
+
+    def test_phase_breakdown(self, tmp_path, monkeypatch):
+        root = _export_chain(tmp_path, monkeypatch)
+        spans = obs.read_spans(str(tmp_path))
+        br = obs.phase_breakdown(spans, trace_id=root.trace_id)
+        assert set(br) == {"prefill", "handoff", "adopt", "decode"}
+        assert br["decode"]["count"] == 1  # decode.token classified
+        assert br["prefill"]["count"] == 1  # prefill_leg NOT a phase
+        assert br["prefill"]["mean_s"] == pytest.approx(0.1)
+        assert br["handoff"]["max_s"] == pytest.approx(0.01)
+
+    def test_process_label_precedence(self, monkeypatch):
+        assert obs.process_label() == "pid%d" % os.getpid()
+        obs.set_process_label("decode-7")
+        assert obs.process_label() == "decode-7"
+        monkeypatch.setenv(obs.TRACE_PROC_ENV, "from-env")
+        assert obs.process_label() == "from-env"
+
+
+# ---------------------------------------------------------------------------
+# stride sampler
+# ---------------------------------------------------------------------------
+
+
+class TestSampler:
+    def test_requires_dir_and_rate(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs.TRACE_SAMPLE_ENV, "1.0")
+        assert obs.sample_request() is None  # no dir
+        monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path))
+        monkeypatch.delenv(obs.TRACE_SAMPLE_ENV)
+        assert obs.sample_request() is None  # no rate
+        monkeypatch.setenv(obs.TRACE_SAMPLE_ENV, "garbage")
+        assert obs.sample_request() is None  # bad rate
+
+    def test_full_sampling(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path))
+        monkeypatch.setenv(obs.TRACE_SAMPLE_ENV, "1.0")
+        ctxs = [obs.sample_request() for _ in range(5)]
+        assert all(c is not None and c.sampled for c in ctxs)
+        assert len({c.trace_id for c in ctxs}) == 5
+
+    def test_stride_is_deterministic(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path))
+        monkeypatch.setenv(obs.TRACE_SAMPLE_ENV, "0.25")
+        admitted = [obs.sample_request() is not None
+                    for _ in range(100)]
+        assert sum(admitted) == 25  # exactly one in four
+        # rate > 1 clamps to every request
+        monkeypatch.setenv(obs.TRACE_SAMPLE_ENV, "7")
+        assert obs.sample_request() is not None
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics federation
+# ---------------------------------------------------------------------------
+
+
+class TestFleetMetrics:
+    def test_counters_sum_gauges_labeled(self):
+        fm = obs.FleetMetrics()
+        fm.ingest("rep0", {"counters": {"served": 3, "adopts": 1},
+                           "gauges": {"queue_depth": 2}})
+        fm.ingest("rep1", {"counters": {"served": 4},
+                           "gauges": {"queue_depth": 0}})
+        fm.ingest("bad", "not-a-doc")
+        assert fm.replicas() == ["rep0", "rep1"]
+        m = fm.merged()
+        assert m["counters"] == {"served": 7, "adopts": 1}
+        assert m["gauges"]["queue_depth"] == {"rep0": 2, "rep1": 0}
+        assert fm.counter_totals()["served"] == 7
+
+    def test_histograms_merge_via_docs(self):
+        h0, h1 = obs.Histogram(), obs.Histogram()
+        for v in (0.1, 0.2):
+            h0.observe(v)
+        h1.observe(0.4)
+        fm = obs.FleetMetrics()
+        fm.ingest("a", {"histograms": {"lat": h0.export()}})
+        fm.ingest("b", {"histograms": {"lat": h1.export()}})
+        s = fm.merged()["histograms"]["lat"]
+        assert s["count"] == 3
+        assert s["sum"] == pytest.approx(0.7)
+        assert s["max"] == pytest.approx(0.4)
+
+    def test_ingest_beacons(self):
+        table = {
+            0: {"step": 9, "metrics": {"counters": {"served": 1}}},
+            1: {"step": 9},            # no metrics extra
+            2: "stale-non-dict",
+        }
+        fm = obs.FleetMetrics()
+        assert fm.ingest_beacons(table) == 1
+        assert fm.counter_totals() == {"served": 1}
+
+    def test_render_prom_fleet_prefix(self):
+        fm = obs.FleetMetrics()
+        h = obs.Histogram()
+        h.observe(0.2)
+        fm.ingest("rep0", {"counters": {"served": 2},
+                           "gauges": {"queue_depth": 1},
+                           "histograms": {"lat": h.export()}})
+        text = fm.render_prom()
+        assert "paddle_tpu_fleet_replicas 1" in text
+        assert "paddle_tpu_fleet_served 2" in text
+        assert ('paddle_tpu_fleet_queue_depth{replica="rep0"} 1'
+                in text)
+        assert "paddle_tpu_fleet_lat_bucket{le=" in text
+        assert "paddle_tpu_fleet_lat_count 1" in text
+        # summary style restores quantile lines
+        assert ('{quantile="0.5"}'
+                in fm.render_prom(style="summary"))
+
+    def test_replica_metrics_doc_shapes(self):
+        doc = obs.replica_metrics_doc(
+            {"served": 5, "ttft": 0.1, "name": "rep", "ok": True},
+            queue_depth=3, extra_gauges={"slots": 7, "bad": "x"})
+        assert doc["counters"] == {"served": 5, "ttft": 0.1}
+        assert doc["gauges"] == {"queue_depth": 3, "slots": 7}
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor
+# ---------------------------------------------------------------------------
+
+
+class TestSLOMonitor:
+    def _tenants(self):
+        return TenantTable([
+            TenantSpec("gold", ttft_slo_ms=100.0,
+                       per_token_slo_ms=50.0),
+            TenantSpec("free"),  # no SLOs
+        ])
+
+    def test_burn_math(self):
+        mon = obs.SLOMonitor(self._tenants(), budget=0.1)
+        # 2 of 4 observations above the 100ms TTFT SLO
+        res = {"%s.gold" % mon.TTFT_METRIC: [0.05, 0.09, 0.2, 0.3],
+               "%s.gold" % mon.PER_TOKEN_METRIC: [0.01] * 10}
+        out = mon.tick(reservoirs=res, publish=False)
+        assert out["gold"]["ttft_burn"] == pytest.approx(5.0)
+        assert out["gold"]["per_token_burn"] == pytest.approx(0.0)
+        # tenants without SLOs (or without data) score None
+        assert out["free"] == {"ttft_burn": None,
+                               "per_token_burn": None}
+
+    def test_tick_reads_local_hub_and_publishes(self):
+        mon = obs.SLOMonitor(self._tenants(), budget=0.1)
+        for v in (0.05, 0.2):
+            obs.observe("%s.gold" % mon.TTFT_METRIC, v)
+        out = mon.tick()
+        assert out["gold"]["ttft_burn"] == pytest.approx(5.0)
+        snap = obs.snapshot()
+        assert snap["gauges"]["fleet.slo_burn_ttft.gold"] == (
+            pytest.approx(5.0))
+
+    def test_budget_must_be_positive(self):
+        with pytest.raises(ValueError):
+            obs.SLOMonitor(self._tenants(), budget=0.0)
+
+
+# ---------------------------------------------------------------------------
+# per-pid crash dumps (satellite: worker crash_dump routing)
+# ---------------------------------------------------------------------------
+
+
+class TestPerPidCrashDump:
+    def test_default_already_pid_scoped(self):
+        p = obs.crash_dump_path(per_pid=True)
+        assert str(os.getpid()) in p
+        assert p == obs.crash_dump_path()  # env unset: same path
+
+    def test_env_override_gets_pid_suffix(self, tmp_path, monkeypatch):
+        base = os.path.join(str(tmp_path), "dump.json")
+        monkeypatch.setenv(obs.CRASH_DUMP_ENV, base)
+        assert obs.crash_dump_path() == base  # default: verbatim
+        p = obs.crash_dump_path(per_pid=True)
+        assert p == os.path.join(
+            str(tmp_path), "dump.%d.json" % os.getpid())
+        # idempotent: re-routing an already-suffixed path is a no-op
+        monkeypatch.setenv(obs.CRASH_DUMP_ENV, p)
+        assert obs.crash_dump_path(per_pid=True) == p

@@ -672,10 +672,13 @@ def test_http_acceptance_mixed_shape_clients(tmp_path):
 
         prom = urllib.request.urlopen(
             srv.url + "/metrics", timeout=10).read().decode()
-        assert 'paddle_tpu_serving_request_seconds{quantile="0.5"}' in prom
-        assert 'paddle_tpu_serving_request_seconds{quantile="0.99"}' in prom
+        assert 'paddle_tpu_serving_request_seconds_bucket{le="' in prom
+        assert "paddle_tpu_serving_request_seconds_count" in prom
         assert "paddle_tpu_serving_padding_waste" in prom
         assert "paddle_tpu_serving_shed" in prom
+        # legacy summary exposition stays reachable behind the flag
+        assert ('paddle_tpu_serving_request_seconds{quantile="0.99"}'
+                in obs.render_prom(style="summary"))
     finally:
         srv.stop(close_registry=True)
 
