@@ -581,8 +581,14 @@ def _measure(tag, on_accel, use_flash, batch, seq, n_steps,
 
     # timed steps; keep fetches on device so the loop isn't serialized on
     # per-step host readbacks (sync once at the end)
+    seed_slowdown = os.environ.get("PADDLE_TPU_BENCH_SEED_SLOWDOWN")
     t0 = time.time()
     for _ in range(n_steps):
+        if seed_slowdown:
+            # deliberate regression for perf_lane.sh: dropping the
+            # executable LRU forces a cache lookup + AOT reload every
+            # step, which --check-regressions must flag
+            exe._cache.clear()
         out = exe.run(feed=feed, fetch_list=fetch, return_numpy=False)
     last = float(np.asarray(out[0]))
     dt = time.time() - t0
@@ -603,6 +609,7 @@ def _measure(tag, on_accel, use_flash, batch, seq, n_steps,
     # static roofline prediction next to the measurement: the
     # predicted-vs-measured column continuously validates the analyzer's
     # cost model against this lane (never sink the bench on a model bug)
+    pred = None
     try:
         import jax as _jax
 
@@ -622,6 +629,18 @@ def _measure(tag, on_accel, use_flash, batch, seq, n_steps,
                 pred["predicted_peak_hbm_bytes"] / 1e9, 3)
     except Exception as e:  # noqa: BLE001 — prediction is advisory
         variant["predicted_error"] = "%s: %s" % (type(e).__name__, e)
+    # pair the prediction + measured step with the program's ledger
+    # entry: the perf CLI's drift table and DeviceProfile.calibrated_from
+    # both read these
+    try:
+        fp = compile_cache.fingerprint_or_none(
+            fluid.default_main_program())
+        led = obs.get_ledger()
+        if pred is not None:
+            led.note_prediction(fp, pred)
+        led.note_measured(fp, dt / n_steps, kind="executor")
+    except Exception:  # noqa: BLE001 — ledger is observability only
+        pass
     if compile_cache.enabled():
         hits = obs.counter("compile_cache.disk_hit") - cc_hit0
         variant["compile_cache"] = {
@@ -1939,7 +1958,14 @@ def child_main(status_path):
         try:
             from paddle_tpu import observability as _obs
 
-            _atomic_write_json(tel_out, _obs.snapshot())
+            doc = _obs.snapshot()
+            # the executable ledger rides along: `python -m
+            # paddle_tpu.observability perf <this file>` renders its
+            # predicted-vs-XLA-vs-measured drift table, and
+            # DeviceProfile.calibrated_from fits effective roofline
+            # constants from it
+            doc["ledger"] = _obs.get_ledger().snapshot()
+            _atomic_write_json(tel_out, doc)
         except Exception as e:  # noqa: BLE001 — never sink the bench
             st.error("telemetry-out failed: %s: %s"
                      % (type(e).__name__, str(e)[:200]))
@@ -1949,7 +1975,56 @@ def child_main(status_path):
     return 0
 
 
+def baseline_cli(argv):
+    """``bench.py --update-baseline | --check-regressions`` — the
+    perf-regression gate over the persistent baseline store
+    (``bench_experiments/_baseline.py``). Supervisor-side: stdlib only,
+    never imports jax. Reads a bench result JSON (``--result``, default
+    the ``.bench_last_good.json`` bank), compares/banks it against
+    ``bench_experiments/BASELINE.json`` (or ``--baseline``).
+
+    Exit codes: 0 clean (or banked), 1 regression(s) beyond tolerance,
+    2 unreadable result."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py (baseline gate)")
+    ap.add_argument("--check-regressions", action="store_true")
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--result", default=None,
+                    help="bench result JSON (default: the last-good "
+                    "bank)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline store path (default: "
+                    "bench_experiments/BASELINE.json)")
+    args = ap.parse_args(argv)
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_experiments"))
+    from _baseline import BaselineStore
+
+    result_path = args.result or _last_good_path()
+    try:
+        with open(result_path) as f:
+            result = json.load(f)
+    except (OSError, ValueError) as e:
+        print("baseline gate: cannot read result %s (%s: %s)"
+              % (result_path, type(e).__name__, e), file=sys.stderr)
+        return 2
+    store = BaselineStore(args.baseline)
+    if args.update_baseline:
+        banked = store.update(result)
+        print(json.dumps({"banked": banked, "path": store.path}))
+        return 0
+    report = store.check(result)
+    print(store.render_report(report))
+    return 1 if report["regressions"] else 0
+
+
 if __name__ == "__main__":
+    # baseline gate: pure supervisor-side JSON comparison, dispatched
+    # before any probe/child logic so it never touches the chips
+    if ("--check-regressions" in sys.argv[1:]
+            or "--update-baseline" in sys.argv[1:]):
+        sys.exit(baseline_cli(sys.argv[1:]))
     # --telemetry-out PATH: write the final Telemetry.snapshot() JSON
     # there. Carried via env so the supervisor (which never imports
     # jax/paddle_tpu) hands it to the chip-holding child untouched.

@@ -108,6 +108,16 @@ if [[ -n "${PADDLE_TPU_JAX_LATEST_PY:-}" ]]; then
         -m analysis tests/ \
         || echo "WARN: analysis slice not clean under latest jax" \
                "(non-gating; see output above)"
+    # perf/ledger slice: the executable ledger probes cost_analysis()/
+    # memory_analysis() off compiled executables, APIs that drift with
+    # jax HEAD — run it under the matrix so a shape change degrades to
+    # a WARN here before the pin moves
+    echo "-- latest jax, perf/ledger slice (non-gating) --"
+    "$PADDLE_TPU_JAX_LATEST_PY" -m pytest -q -p no:cacheprovider \
+        tests/test_perf_observatory.py \
+        || echo "WARN: perf/ledger slice not clean under latest jax" \
+               "(non-gating; cost_analysis/memory_analysis probing" \
+               "tracks jax HEAD — see output above)"
 else
     echo "SKIP latest-jax leg: set PADDLE_TPU_JAX_LATEST_PY to a python"
     echo "with a newer jax to run the matrix (no packages are installed"

@@ -41,6 +41,12 @@ PY
     continue
   fi
   echo "bench done $(date -u)" >> $LOG
+  # perf-observatory lane (ISSUE 15): ledger slice + baseline gate +
+  # calibration on the CPU lane. Non-blocking — a perf regression is
+  # recorded for the next session, never stops the experiment queue.
+  echo "== perf_lane start $(date -u)" >> $LOG
+  bash bench_experiments/perf_lane.sh > .bench_runs/perf_lane.log 2>&1
+  echo "== perf_lane done rc=$? $(date -u)" >> $LOG
   for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
     # an experiment whose json already holds variants is DONE — its
     # results are cited in BENCHMARKS.md and must not be clobbered by

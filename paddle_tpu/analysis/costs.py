@@ -37,7 +37,8 @@ __all__ = [
     "CostReport", "analyze_cost", "predict_program",
     "ring_allreduce_seconds", "allreduce_bandwidth",
     "pipeline_bubble_fraction", "dp_grad_bytes", "ICI_BW_ENV",
-    "DCN_BW_ENV", "SLICE_CHIPS_ENV",
+    "DCN_BW_ENV", "SLICE_CHIPS_ENV", "CALIBRATION_ENV",
+    "load_calibration",
 ]
 
 PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
@@ -46,6 +47,10 @@ HBM_BW_ENV = "PADDLE_TPU_HBM_BW"
 ICI_BW_ENV = "PADDLE_TPU_ICI_BW"
 DCN_BW_ENV = "PADDLE_TPU_DCN_BW"
 SLICE_CHIPS_ENV = "PADDLE_TPU_SLICE_CHIPS"
+# path to a calibration JSON written by DeviceProfile.calibrated_from;
+# device_profile() layers it OVER the table match and UNDER the env
+# overrides (operator pins always win)
+CALIBRATION_ENV = "PADDLE_TPU_CALIBRATION_FILE"
 
 
 class DeviceProfile:
@@ -89,6 +94,99 @@ class DeviceProfile:
                    self.hbm_bw, self.ici_bw, self.dcn_bw,
                    self.slice_chips))
 
+    @classmethod
+    def calibrated_from(cls, ledger, measured_steps=None, path=None):
+        """Fit *effective* peak-FLOPs / HBM-BW from measured step
+        times in an executable ledger (the live
+        ``observability.ExecutableLedger``, its ``snapshot()`` dict,
+        or a bare entry list). ``measured_steps`` ({fingerprint:
+        seconds}) augments/overrides the per-entry
+        ``measured_step_seconds``.
+
+        Two fit rungs, best first:
+
+        - **ratio**: entries carrying both a prediction made under a
+          known profile (``predicted["device"]``) and a measurement
+          scale that profile's peak_flops/hbm_bw by the median
+          ``predicted_step / measured_step``. The roofline's per-op
+          ``max(compute leg, memory leg)`` sum scales inversely with
+          a common factor on both constants, so the re-prediction
+          under the calibrated profile lands on the measurement
+          exactly (modulo run-to-run noise).
+        - **rate** (fallback, no usable prediction): effective
+          FLOPs/s and bytes/s as the median ``flops / measured`` and
+          ``bytes / measured`` over entries (XLA's ``cost_analysis``
+          figures when present, else the analyzer totals). An upper
+          bound per leg — the per-op max-sum may over-predict up to
+          2x — but it turns "no profile" into a usable one.
+
+        With ``path`` the fit is also written as a calibration JSON
+        that :func:`device_profile` layers under the env overrides
+        (point ``PADDLE_TPU_CALIBRATION_FILE`` at it). Returns the
+        calibrated profile, or None when no entry had a usable
+        measurement."""
+        entries, extra_measured = _ledger_entries(ledger)
+        measured = dict(extra_measured)
+        measured.update(measured_steps or {})
+        ratio, peaks, bws, hbm_caps = [], [], [], []
+        rate_flops, rate_bytes = [], []
+        n_used = 0
+        for e in entries:
+            if not isinstance(e, dict):
+                continue
+            fp = e.get("fingerprint")
+            t = measured.get(fp) or e.get("measured_step_seconds")
+            if not t or t <= 0:
+                continue
+            n_used += 1
+            pred = e.get("predicted") or {}
+            dev = pred.get("device") or {}
+            ps = pred.get("predicted_step_seconds")
+            if ps and ps > 0 and (dev.get("peak_flops")
+                                  or dev.get("hbm_bw")):
+                r = float(ps) / float(t)
+                ratio.append(r)
+                if dev.get("peak_flops"):
+                    peaks.append(float(dev["peak_flops"]) * r)
+                if dev.get("hbm_bw"):
+                    bws.append(float(dev["hbm_bw"]) * r)
+                if dev.get("hbm_bytes"):
+                    hbm_caps.append(float(dev["hbm_bytes"]))
+            xla = e.get("xla") or {}
+            f = xla.get("flops") or pred.get("total_flops")
+            b = xla.get("bytes_accessed") or pred.get("total_bytes")
+            if f and f > 0:
+                rate_flops.append(float(f) / float(t))
+            if b and b > 0:
+                rate_bytes.append(float(b) / float(t))
+        if peaks or bws:
+            method = "ratio"
+            peak = _median(peaks)
+            bw = _median(bws)
+        elif rate_flops or rate_bytes:
+            method = "rate"
+            peak = _median(rate_flops)
+            bw = _median(rate_bytes)
+        else:
+            return None
+        prof = cls("calibrated", peak_flops=peak, hbm_bw=bw,
+                   hbm_bytes=_median(hbm_caps))
+        if path:
+            doc = prof.to_dict()
+            doc["fit"] = {
+                "method": method,
+                "entries_used": n_used,
+                "ratio_median": round(_median(ratio), 6)
+                if ratio else None,
+            }
+            import json
+
+            tmp = "%s.tmp-%d" % (path, os.getpid())
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1)
+            os.replace(tmp, path)
+        return prof
+
 
 # Public per-chip figures, matched by device_kind substring — the
 # LONGEST matching key wins ("v5p" beats "v5" regardless of row order,
@@ -123,13 +221,84 @@ def _env_float(name):
         return None
 
 
+# one-slot mtime cache: the calibration file is read once per mtime,
+# not once per device_profile() call (executors resolve profiles on
+# every compile)
+_cal_cache = {"path": None, "mtime": None, "doc": None}
+
+
+def load_calibration(path=None):
+    """The calibration JSON written by
+    :meth:`DeviceProfile.calibrated_from`, as a dict of profile fields
+    (or None). ``path`` defaults to ``$PADDLE_TPU_CALIBRATION_FILE``.
+    Unreadable/ill-formed files resolve to None — a stale calibration
+    must never break profile resolution."""
+    path = path or os.environ.get(CALIBRATION_ENV)
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    if _cal_cache["path"] == path and _cal_cache["mtime"] == mtime:
+        return _cal_cache["doc"]
+    doc = None
+    try:
+        import json
+
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        if isinstance(raw, dict):
+            doc = {}
+            for k in ("name", "peak_flops", "hbm_bytes", "hbm_bw",
+                      "ici_bw", "dcn_bw", "slice_chips"):
+                v = raw.get(k)
+                if k == "name":
+                    if isinstance(v, str):
+                        doc[k] = v
+                elif isinstance(v, (int, float)) and v > 0:
+                    doc[k] = float(v)
+            if not any(k != "name" for k in doc):
+                doc = None
+    except (OSError, ValueError):
+        doc = None
+    _cal_cache.update(path=path, mtime=mtime, doc=doc)
+    return doc
+
+
+def _median(xs):
+    xs = sorted(x for x in xs if x is not None)
+    if not xs:
+        return None
+    n = len(xs)
+    mid = xs[n // 2]
+    return mid if n % 2 else (xs[n // 2 - 1] + mid) / 2.0
+
+
+def _ledger_entries(ledger):
+    """(entries, measured) from an ExecutableLedger, its snapshot()
+    dict, or a bare entry list."""
+    if ledger is None:
+        return [], {}
+    snap = getattr(ledger, "snapshot", None)
+    if callable(snap):
+        ledger = snap()
+    if isinstance(ledger, dict):
+        return (list(ledger.get("entries") or ()),
+                dict(ledger.get("measured") or {}))
+    return list(ledger), {}
+
+
 def device_profile(device_kind=None):
     """Resolve a :class:`DeviceProfile` for a jax ``device_kind`` string
     (substring match against the table; when several keys match, the
     LONGEST — most specific — wins, so the result is independent of
-    table row order), then apply the env overrides. Returns None when
-    neither the table nor any override knows the device — callers must
-    treat that as "no prediction possible"."""
+    table row order), then layer the calibration file
+    (``$PADDLE_TPU_CALIBRATION_FILE``, measured effective constants)
+    and finally the env overrides (operator pins always win). Returns
+    None when neither the table, the calibration, nor any override
+    knows the device — callers must treat that as "no prediction
+    possible"."""
     prof = None
     dk = (device_kind or "").lower()
     best_key = None
@@ -137,6 +306,7 @@ def device_profile(device_kind=None):
         if key in dk and (best_key is None or len(key) > len(best_key)):
             best_key = key
             prof = p.copy()
+    cal = load_calibration()
     over = {
         "peak_flops": _env_float(PEAK_FLOPS_ENV),
         "hbm_bytes": _env_float(HBM_BYTES_ENV),
@@ -145,10 +315,16 @@ def device_profile(device_kind=None):
         "dcn_bw": _env_float(DCN_BW_ENV),
         "slice_chips": _env_float(SLICE_CHIPS_ENV),
     }
-    if prof is None and not any(v is not None for v in over.values()):
+    if (prof is None and cal is None
+            and not any(v is not None for v in over.values())):
         return None
     if prof is None:
         prof = DeviceProfile(device_kind or "env")
+    if cal is not None:
+        for k, v in cal.items():
+            if k != "name":
+                setattr(prof, k, v)
+        prof.name = "%s+cal" % prof.name
     for k, v in over.items():
         if v is not None:
             setattr(prof, k, v)
@@ -605,4 +781,8 @@ def predict_program(program, feed_specs=None, fetch_names=(),
     }
     if rep.memory is not None:
         out["predicted_peak_hbm_bytes"] = rep.memory.peak_bytes
+    # the profile the prediction was made under — what
+    # DeviceProfile.calibrated_from's ratio fit rescales
+    out["device"] = (rep.profile.to_dict()
+                     if rep.profile is not None else None)
     return out

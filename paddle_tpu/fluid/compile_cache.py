@@ -60,7 +60,8 @@ from .. import observability as obs
 
 __all__ = [
     "CACHE_DIR_ENV", "Unfingerprintable", "activate", "cache_dir",
-    "enabled", "entry_key", "has", "load", "program_fingerprint", "store",
+    "enabled", "entry_key", "fingerprint_or_none", "has", "load",
+    "program_fingerprint", "store",
 ]
 
 CACHE_DIR_ENV = "PADDLE_TPU_COMPILE_CACHE_DIR"
@@ -179,6 +180,17 @@ def program_fingerprint(program):
     fp = h.hexdigest()
     program._fingerprint_cache = (program._version, fp)
     return fp
+
+
+def fingerprint_or_none(program):
+    """:func:`program_fingerprint`, degraded to None instead of raising
+    — the identity key observability consumers (the executable ledger)
+    use, where an unfingerprintable program just means an anonymous
+    entry, never a failed step."""
+    try:
+        return program_fingerprint(program)
+    except Exception:  # noqa: BLE001 — ledger identity is best-effort
+        return None
 
 
 def _device_fingerprint():

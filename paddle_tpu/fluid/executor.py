@@ -52,6 +52,31 @@ def _publish_analysis_gauges(report):
         obs.set_gauge("analysis.predicted_mfu", mfu)
 
 
+def _ledger_register(program, kind, compiled, source,
+                     compile_seconds=None, donated=None):
+    """Register one executable in the process-wide ledger (best effort
+    — the observatory must never break a step)."""
+    try:
+        obs.get_ledger().register(
+            kind=kind,
+            fingerprint=compile_cache.fingerprint_or_none(program),
+            compiled=compiled, source=source,
+            compile_seconds=compile_seconds, donated=donated)
+    except Exception:  # noqa: BLE001 — ledger is observability only
+        pass
+
+
+def _ledger_predict(program, meta):
+    """Attach the analyzer's prediction to the program's fingerprint so
+    the ledger can report predicted-vs-XLA-vs-measured drift."""
+    try:
+        fp = compile_cache.fingerprint_or_none(program)
+        if fp is not None:
+            obs.get_ledger().note_prediction(fp, meta)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class _TensorView:
     """Compat shim for `scope.find_var(name).get_tensor()` usage."""
 
@@ -296,6 +321,8 @@ class Executor:
                     entry = compile_cache.load(disk_key)
                     if entry is not None:
                         self._cache_store(sig, entry)
+                        _ledger_register(program, "executor", entry,
+                                         "disk")
             if entry is None:
                 obs.inc("executor.cache_miss")
                 obs.event("compile_start", source="executor", count=False,
@@ -338,6 +365,9 @@ class Executor:
                 obs.event("compile_done", source="executor", count=False,
                           program=program._uid, version=program._version,
                           seconds=round(dt_compile, 6))
+                _ledger_register(program, "executor", entry, "compile",
+                                 compile_seconds=dt_compile,
+                                 donated=sorted(state.keys()))
                 if use_program_cache:
                     self._cache_store(sig, entry)
             else:
@@ -447,6 +477,8 @@ class Executor:
                 entry = compile_cache.load(disk_key)
                 if entry is not None:
                     self._cache_store(sig, entry)
+                    _ledger_register(program, "executor.scan", entry,
+                                     "disk")
         if entry is None:
             obs.inc("executor.cache_miss")
             t_compile = time.monotonic()
@@ -488,8 +520,11 @@ class Executor:
             if disk_key is not None:
                 compile_cache.store(disk_key, jitted,
                                     (state, stacked, rngs))
-            obs.observe("executor.compile_seconds",
-                        time.monotonic() - t_compile)
+            dt_compile = time.monotonic() - t_compile
+            obs.observe("executor.compile_seconds", dt_compile)
+            _ledger_register(program, "executor.scan", entry, "compile",
+                             compile_seconds=dt_compile,
+                             donated=sorted(state.keys()))
             self._cache_store(sig, entry)
         else:
             obs.inc("executor.cache_hit")
@@ -648,6 +683,7 @@ class Executor:
             return
         obs.observe("analysis.verify_seconds", time.monotonic() - t0)
         _publish_analysis_gauges(report)
+        _ledger_predict(program, report.meta)
         if report.diagnostics:
             obs.inc("analysis.findings", len(report.findings))
             obs.event("analysis_report", source="executor", count=False,

@@ -31,6 +31,7 @@ import numpy as np
 
 from . import compile_cache, core
 from .executor import (Executor, Scope, global_scope, _device_kind,
+                       _ledger_predict, _ledger_register,
                        _publish_analysis_gauges)
 from .lowering import build_step_fn
 from .. import observability as obs
@@ -77,6 +78,10 @@ class Predictor:
         self._fwd = fwd
         self._platform = platform
         self._compiled = {}  # shape signature -> executable
+        # executable-ledger kind for this predictor's entries; serving
+        # engines overwrite it ("serving:<name>", "decode.step:<name>")
+        # so the perf CLI attributes executables to their engine
+        self.ledger_tag = "predict"
         self.compile_seconds = {}
         # check-then-compile must be atomic per signature: without the
         # locks, N concurrent first callers of one shape all pay (and
@@ -123,6 +128,7 @@ class Predictor:
             return
         obs.observe("analysis.verify_seconds", time.monotonic() - t0)
         _publish_analysis_gauges(report)
+        _ledger_predict(self.program, report.meta)
         if report.diagnostics:
             obs.inc("analysis.findings", len(report.findings))
             obs.event("analysis_report", source="predictor", count=False,
@@ -212,6 +218,8 @@ class Predictor:
                     ex = compile_cache.load(disk_key)
                     if ex is not None:
                         source = "disk"
+                        _ledger_register(self.program, self.ledger_tag,
+                                         ex, "disk")
             if ex is None:
                 obs.event("compile_start", source="predictor", count=False,
                           sig=repr(sig))
@@ -223,6 +231,9 @@ class Predictor:
                 obs.observe("predictor.compile_seconds", dt)
                 obs.event("compile_done", source="predictor", count=False,
                           sig=repr(sig), seconds=round(dt, 6))
+                _ledger_register(self.program, self.ledger_tag, ex,
+                                 "compile", compile_seconds=dt,
+                                 donated=())
                 if disk_key is not None:
                     compile_cache.store(
                         disk_key, jitted, (self._state, prepared))

@@ -193,10 +193,42 @@ Well-known distributed-tracing + fleet metrics (PR 14,
   ``.handoff`` / ``.adopt``, ``decode.token``), which the collector
   folds into per-phase breakdowns.
 
+Well-known perf-ledger metrics (PR 15, ``observability.ledger`` /
+``.perf``):
+
+- ``ledger.registered`` counter — executables recorded in the
+  process-wide :class:`ExecutableLedger` (executor step compiles,
+  dataset-scan bodies, Predictor engines — serving/decode warmups
+  register through the predictor with their own ``kind`` tags — and
+  compile-cache disk hits); ``ledger.partial`` counter — entries
+  whose executable exposed neither ``cost_analysis()`` nor
+  ``memory_analysis()`` (deserialized disk artifacts, backends
+  without the API); ``ledger.disk_hits`` counter — entries whose
+  source was the compile-cache disk tier.
+- ``ledger.entries`` gauge — entries currently held;
+  ``ledger.hbm_total_bytes`` gauge — XLA's HBM total (argument +
+  output + temp + generated code - aliased) of the last registered
+  executable.
+- ``ledger.compile_seconds`` histogram — per-registration compile
+  cost (absent on disk hits); ``ledger.measured_step_seconds``
+  histogram — steady-state step times attached via
+  ``note_measured`` (the measured column of the drift table).
+- ``executable_registered`` events (source ``ledger``) carry the
+  fingerprint prefix, kind, and source of each registration into the
+  flight recorder; ``FlightRecorder.crash_dump`` appends the ledger
+  tail + compile-cache hit/miss counters so post-mortems show what
+  was compiled and resident at death.
+- Render the predicted-vs-XLA-vs-measured drift per executable with
+  ``python -m paddle_tpu.observability perf <dir|snapshot.json>``
+  (bench ``--telemetry-out`` files embed the ledger snapshot under
+  their ``"ledger"`` key).
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """
 from . import distributed as _distributed
+from . import ledger as _ledger_mod
+from . import perf as _perf_mod
 from . import recorder as _recorder
 from . import telemetry as _telemetry
 from . import tracing as _tracing
@@ -205,6 +237,10 @@ from .distributed import (  # noqa: F401
     SLOMonitor, TraceContext, chrome_trace, collect_trace, export_span,
     phase_breakdown, process_label, read_spans, replica_metrics_doc,
     sample_request, set_process_label, trace_dir,
+)
+from .ledger import ExecutableLedger, get_ledger  # noqa: F401
+from .perf import (  # noqa: F401
+    drift_rows, drift_summary, load_snapshot, render_drift_table,
 )
 from .recorder import (  # noqa: F401
     CRASH_DUMP_ENV, FlightRecorder, crash_dump_path, get_recorder,
@@ -229,6 +265,8 @@ __all__ = [
     "process_label", "set_process_label", "export_span", "read_spans",
     "chrome_trace", "collect_trace", "phase_breakdown", "FleetMetrics",
     "SLOMonitor", "replica_metrics_doc", "PROM_STYLE_ENV",
+    "ExecutableLedger", "get_ledger", "drift_rows", "drift_summary",
+    "load_snapshot", "render_drift_table",
 ]
 
 
@@ -305,7 +343,8 @@ def render_prom(style=None):
 
 
 def reset():
-    """Clear the hub and the global event ring (testing / session
-    scoping). Does not uninstall the excepthook."""
+    """Clear the hub, the global event ring, and the executable ledger
+    (testing / session scoping). Does not uninstall the excepthook."""
     _telemetry._hub.reset()
     _recorder._global.clear()
+    _ledger_mod._global.clear()

@@ -5,12 +5,19 @@ merges the per-process ``trace-*.jsonl`` span files a traced serving
 run left under ``$PADDLE_TPU_TRACE_DIR`` into one Perfetto-loadable
 Chrome trace-event file (load it at https://ui.perfetto.dev or
 ``chrome://tracing``) and prints a per-trace phase summary.
+
+``python -m paddle_tpu.observability perf <dir|snapshot.json>``
+renders the executable ledger's predicted-vs-XLA-vs-measured drift
+table from a bench ``--telemetry-out`` file (the ledger rides under
+its ``"ledger"`` key), a bare ``ExecutableLedger.snapshot()`` JSON, or
+a directory of either.
 """
 import argparse
 import json
 import sys
 
 from . import distributed as _dist
+from . import perf as _perf
 
 
 def _cmd_trace(args):
@@ -45,6 +52,37 @@ def _cmd_trace(args):
     return 0
 
 
+def _cmd_perf(args):
+    snap = _perf.load_snapshot(args.path)
+    rows = _perf.drift_rows(snap)
+    if not rows:
+        print("no ledger entries under %s (want a bench "
+              "--telemetry-out JSON or an ExecutableLedger.snapshot() "
+              "file)" % args.path, file=sys.stderr)
+        return 1
+    print(_perf.render_drift_table(rows))
+    s = _perf.drift_summary(rows)
+    parts = ["%d executable(s)" % s["entries"],
+             "%d partial" % s["partial"],
+             "%d measured" % s["with_measured"]]
+    if s["mean_abs_step_drift_pct"] is not None:
+        parts.append("mean |step drift| %.1f%%"
+                     % s["mean_abs_step_drift_pct"])
+    if s["mean_abs_hbm_drift_pct"] is not None:
+        parts.append("mean |hbm drift| %.1f%%"
+                     % s["mean_abs_hbm_drift_pct"])
+    print(", ".join(parts))
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"rows": rows, "summary": s}, f)
+        import os
+
+        os.replace(tmp, args.out)
+        print("wrote %s" % args.out)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability",
@@ -59,6 +97,13 @@ def main(argv=None):
     tr.add_argument("--trace-id", default=None,
                     help="keep only this trace id")
     tr.set_defaults(fn=_cmd_trace)
+    pf = sub.add_parser("perf", help="render the executable ledger's "
+                        "predicted-vs-XLA-vs-measured drift table")
+    pf.add_argument("path", help="bench --telemetry-out JSON, a ledger "
+                    "snapshot JSON, or a directory of either")
+    pf.add_argument("-o", "--out", default=None,
+                    help="also write the rows+summary as JSON here")
+    pf.set_defaults(fn=_cmd_perf)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -442,17 +442,35 @@ class FleetMetrics:
         with self._lock:
             self._docs[str(replica)] = doc
 
-    def ingest_beacons(self, table, key="metrics"):
+    def ingest_beacons(self, table, key="metrics", prune=True):
         """Pull metric docs off a heartbeat ``table()`` snapshot —
         {worker: beacon} — where each beacon may carry a ``metrics``
-        extra field."""
+        extra field. The table is the authoritative member set: with
+        ``prune`` (the default) docs for replicas no longer in it are
+        dropped, so removed/parked replicas stop emitting stale
+        ``{replica=...}``-labeled gauges on ``/metrics``. A member
+        whose beacon carries no metrics doc keeps its last one."""
+        table = table or {}
         n = 0
-        for worker, beacon in (table or {}).items():
+        for worker, beacon in table.items():
             doc = beacon.get(key) if isinstance(beacon, dict) else None
             if doc:
                 self.ingest(worker, doc)
                 n += 1
+        if prune:
+            self.prune(table)
         return n
+
+    def prune(self, members):
+        """Drop docs whose replica label is not in ``members`` (any
+        iterable of labels; matching uses the same ``str()`` form
+        :meth:`ingest` stores under). Returns the dropped labels."""
+        live = {str(m) for m in members}
+        with self._lock:
+            stale = [r for r in self._docs if r not in live]
+            for r in stale:
+                del self._docs[r]
+        return stale
 
     def replicas(self):
         with self._lock:

@@ -157,9 +157,10 @@ class FlightRecorder:
 
     def crash_dump(self, path=None, exc=None):
         """Write the black box: last events + active spans + telemetry
-        snapshot (+ the exception, when given). Returns the path, or
-        None if even the dump write failed (a crash path must not
-        raise)."""
+        snapshot + the executable-ledger tail and compile-cache
+        hit/miss counters (what was compiled and resident at death),
+        plus the exception when given. Returns the path, or None if
+        even the dump write failed (a crash path must not raise)."""
         path = path or crash_dump_path()
         doc = {
             "wall": time.time(),
@@ -169,6 +170,20 @@ class FlightRecorder:
             "active_spans": _tr.active_spans(),
             "telemetry": _t.get_telemetry().snapshot(),
         }
+        try:
+            from . import ledger as _ledger
+
+            doc["executables"] = _ledger.get_ledger().tail(16)
+        except Exception:  # noqa: BLE001 — crash path must not raise
+            doc["executables"] = []
+        try:
+            hub = _t.get_telemetry()
+            doc["compile_cache"] = {
+                k: hub.counter("compile_cache." + k)
+                for k in ("disk_hit", "disk_miss", "corrupt", "store",
+                          "store_error")}
+        except Exception:  # noqa: BLE001
+            doc["compile_cache"] = {}
         if exc is not None:
             doc["exception"] = {
                 "type": type(exc).__name__,

@@ -327,11 +327,14 @@ class DecodeEngine:
         self._step_pred = Predictor(
             step_prog, step_vars["feed_names"], step_vars["fetch_vars"],
             scope=persist)
+        self._step_pred.ledger_tag = "decode.step:%s" % self.name
         self._prefill_preds = {}
         self._prefill_vars = {}
         for b, (prog, pv) in prefill.items():
             self._prefill_preds[b] = Predictor(
                 prog, pv["feed_names"], pv["fetch_vars"], scope=persist)
+            self._prefill_preds[b].ledger_tag = (
+                "decode.prefill:%s" % self.name)
             self._prefill_vars[b] = pv
 
         # -- the persistent slot buffer pair + host-side slot state ----

@@ -84,6 +84,11 @@ class ServingEngine:
                  name="default", replica_id=None, auto_start=True):
         self._predictor = predictor
         self.name = str(name)
+        # attribute this engine's executables in the ledger/perf CLI
+        try:
+            predictor.ledger_tag = "serving:%s" % self.name
+        except Exception:  # noqa: BLE001 — duck-typed predictors in tests
+            pass
         self.replica_id = replica_id
         self._max_batch_size = int(max_batch_size)
         self._max_wait_s = float(max_wait_ms) / 1000.0

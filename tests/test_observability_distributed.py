@@ -139,6 +139,57 @@ class TestSpanExport:
         spans = obs.read_spans(str(tmp_path))
         assert len(spans) == 1 and spans[0]["span"] == "a"
 
+    def test_read_spans_empty_dir_and_collector_cli(self, tmp_path,
+                                                    capsys):
+        from paddle_tpu.observability import __main__ as obs_cli
+
+        assert obs.read_spans(str(tmp_path)) == []
+        assert obs.read_spans(str(tmp_path / "never-created")) == []
+        # collector CLI reports, not crashes, on a span-less dir
+        assert obs_cli.main(["trace", str(tmp_path)]) == 1
+        assert "no span records" in capsys.readouterr().err
+
+    def test_read_spans_torn_tail_only_file(self, tmp_path, capsys):
+        from paddle_tpu.observability import __main__ as obs_cli
+
+        # a process killed during its FIRST span write leaves a file
+        # holding nothing but the torn line
+        with open(os.path.join(str(tmp_path), "trace-9.jsonl"),
+                  "w") as f:
+            f.write('{"span": "tor')
+        assert obs.read_spans(str(tmp_path)) == []
+        assert obs_cli.main(["trace", str(tmp_path)]) == 1
+        assert "no span records" in capsys.readouterr().err
+
+    def test_duplicate_span_ids_across_processes(self, tmp_path,
+                                                 capsys):
+        from paddle_tpu.observability import __main__ as obs_cli
+
+        # two processes can (pathologically) emit the same span_id for
+        # one trace — pid-reuse, copied context, replayed beacons; the
+        # merge must keep both records and never crash
+        rec = {"trace": "t" * 32, "span": "s" * 16, "parent": None,
+               "name": "serve.request", "t0": 1.0, "dur": 0.5,
+               "proc": "router:r0"}
+        rec2 = dict(rec, proc="decode:d0", t0=1.1, name="decode.token",
+                    parent="s" * 16)
+        with open(os.path.join(str(tmp_path), "trace-1.jsonl"),
+                  "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        with open(os.path.join(str(tmp_path), "trace-2.jsonl"),
+                  "w") as f:
+            f.write(json.dumps(rec2) + "\n")
+            f.write(json.dumps(rec2) + "\n")  # duplicate IN one file too
+        spans = obs.read_spans(str(tmp_path))
+        assert len(spans) == 3
+        doc = obs.chrome_trace(spans)
+        assert doc["otherData"]["spans"] == 3
+        out_path = str(tmp_path / "out.json")
+        assert obs_cli.main(
+            ["trace", str(tmp_path), "-o", out_path]) == 0
+        json.load(open(out_path))
+        assert "3 spans" in capsys.readouterr().out
+
     def test_chrome_trace_tracks_and_flows(self, tmp_path, monkeypatch):
         root = _export_chain(tmp_path, monkeypatch)
         doc = obs.collect_trace(str(tmp_path))
@@ -276,6 +327,32 @@ class TestFleetMetrics:
         fm = obs.FleetMetrics()
         assert fm.ingest_beacons(table) == 1
         assert fm.counter_totals() == {"served": 1}
+
+    def test_ingest_beacons_prunes_departed_replicas(self):
+        fm = obs.FleetMetrics()
+        fm.ingest(0, {"counters": {"served": 1},
+                      "gauges": {"queue_depth": 5}})
+        fm.ingest(1, {"counters": {"served": 2},
+                      "gauges": {"queue_depth": 7}})
+        assert fm.replicas() == ["0", "1"]
+        # replica 1 left the heartbeat member set: its labeled gauges
+        # must disappear instead of reporting a stale queue_depth=7
+        # forever
+        fm.ingest_beacons({0: {"step": 10}})
+        assert fm.replicas() == ["0"]
+        assert fm.merged()["gauges"]["queue_depth"] == {"0": 5}
+        assert 'replica="1"' not in fm.render_prom()
+        assert fm.counter_totals() == {"served": 1}
+
+    def test_ingest_beacons_prune_opt_out_and_explicit_prune(self):
+        fm = obs.FleetMetrics()
+        fm.ingest("a", {"counters": {"served": 1}})
+        fm.ingest("b", {"counters": {"served": 1}})
+        fm.ingest_beacons({"a": {"step": 1}}, prune=False)
+        assert fm.replicas() == ["a", "b"]
+        # int members match the str() labels ingest stores under
+        assert fm.prune(["a"]) == ["b"]
+        assert fm.replicas() == ["a"]
 
     def test_render_prom_fleet_prefix(self):
         fm = obs.FleetMetrics()
