@@ -47,6 +47,11 @@ PY
   echo "== perf_lane start $(date -u)" >> $LOG
   bash bench_experiments/perf_lane.sh > .bench_runs/perf_lane.log 2>&1
   echo "== perf_lane done rc=$? $(date -u)" >> $LOG
+  # autopilot lane (ISSUE 16): control-loop units + chaos drill +
+  # decision-trail audit. Non-blocking for the same reason as perf_lane.
+  echo "== autopilot_lane start $(date -u)" >> $LOG
+  bash bench_experiments/autopilot_lane.sh > .bench_runs/autopilot_lane.log 2>&1
+  echo "== autopilot_lane done rc=$? $(date -u)" >> $LOG
   for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
     # an experiment whose json already holds variants is DONE — its
     # results are cited in BENCHMARKS.md and must not be clobbered by

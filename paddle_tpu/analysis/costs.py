@@ -231,8 +231,10 @@ def load_calibration(path=None):
     """The calibration JSON written by
     :meth:`DeviceProfile.calibrated_from`, as a dict of profile fields
     (or None). ``path`` defaults to ``$PADDLE_TPU_CALIBRATION_FILE``.
-    Unreadable/ill-formed files resolve to None — a stale calibration
-    must never break profile resolution."""
+    A torn/corrupt file (truncated mid-write, non-JSON bytes, wrong
+    schema, bool/NaN/inf constants) warns once per mtime and resolves
+    to None — the profile falls back to the table; a stale or mangled
+    calibration must never crash a serving process."""
     path = path or os.environ.get(CALIBRATION_ENV)
     if not path:
         return None
@@ -243,8 +245,10 @@ def load_calibration(path=None):
     if _cal_cache["path"] == path and _cal_cache["mtime"] == mtime:
         return _cal_cache["doc"]
     doc = None
+    why = None
     try:
         import json
+        import math
 
         with open(path, "r", encoding="utf-8") as f:
             raw = json.load(f)
@@ -256,12 +260,27 @@ def load_calibration(path=None):
                 if k == "name":
                     if isinstance(v, str):
                         doc[k] = v
-                elif isinstance(v, (int, float)) and v > 0:
+                elif (isinstance(v, (int, float))
+                      and not isinstance(v, bool)
+                      and math.isfinite(v) and v > 0):
                     doc[k] = float(v)
             if not any(k != "name" for k in doc):
                 doc = None
-    except (OSError, ValueError):
+                why = "no usable numeric field"
+        else:
+            why = "top-level %s, want an object" % type(raw).__name__
+    except Exception as e:  # noqa: BLE001 — torn write, bad bytes, ...
         doc = None
+        why = "%s: %s" % (type(e).__name__, str(e)[:120])
+    if doc is None and why is not None:
+        # once per mtime: the cache short-circuits until the file
+        # changes again, so a bad file cannot spam a serving loop
+        import warnings
+
+        warnings.warn(
+            "ignoring corrupt calibration file %s (%s); falling back "
+            "to the device table" % (path, why), RuntimeWarning,
+            stacklevel=2)
     _cal_cache.update(path=path, mtime=mtime, doc=doc)
     return doc
 

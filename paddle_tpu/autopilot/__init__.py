@@ -1,0 +1,51 @@
+"""Self-healing performance autopilot: ledger -> planner -> fleet.
+
+Every instrument the previous subsystems built — the cost-model
+planner (``planner.plan_search``), per-tenant SLO burn rates
+(``observability.SLOMonitor``), the executable ledger with
+predicted-vs-measured drift and device auto-calibration
+(``observability.ExecutableLedger`` + ``DeviceProfile
+.calibrated_from``) — reported to a human who then edited configs.
+This package closes the loop:
+
+::
+
+                 +--------------------------------------+
+                 |            Autopilot.tick()          |
+                 +--------------------------------------+
+      measured     |  calibrate  |    SLO    |  drift   |
+      step times   |  (profile   |   burn    | replan + |
+    ledger ------->|   refit +   |  remedi-  |  gated   |
+      SLO burn --->|   reprice)  |   ation   |  apply   |
+                   +------+------+-----+-----+----+-----+
+                          |            |          |
+                          v            v          v
+                    DeviceProfile  kill_replica  plan_search
+                    +cal written   scale_up      -> rolling
+                    to disk        reweight         reload
+
+Modes (``PADDLE_TPU_AUTOPILOT``, read live every tick):
+
+- ``off`` — the loop observes nothing and decides nothing.
+- ``propose`` (default) — every decision is minted, journaled, and
+  traced, but the fleet is never touched: a dry-run audit trail.
+- ``apply`` — remediations execute, still rate-limited (hysteresis +
+  cooldown), measured before/after, auto-rolled-back on a verified
+  regression, and the offending trigger quarantined with exponential
+  backoff.
+
+The decision trail: every :class:`AutopilotAction` lands in the
+append-only :class:`DecisionJournal` and as ``autopilot.detect`` /
+``autopilot.replan`` / ``autopilot.act`` / ``autopilot.apply`` /
+``autopilot.verify`` spans sharing one trace id per incident on the
+PR-14 request timeline — one merged Perfetto doc shows the slowdown,
+the detection, and the fix.
+"""
+from .actions import (AUTOPILOT_ENV, MODES, AutopilotAction,
+                      DecisionJournal, autopilot_mode)
+from .gates import ActionGate, verify_measurement
+from .loop import Autopilot
+
+__all__ = ["AUTOPILOT_ENV", "MODES", "ActionGate", "Autopilot",
+           "AutopilotAction", "DecisionJournal", "autopilot_mode",
+           "verify_measurement"]

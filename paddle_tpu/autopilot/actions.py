@@ -1,0 +1,180 @@
+"""Typed autopilot decisions + the append-only decision journal.
+
+Every move the autopilot makes — a calibration fit, a standby
+activation, a replica kill, a re-plan, a rollback — is one
+:class:`AutopilotAction`: a flat, JSON-serializable record carrying
+the action kind, the trigger that demanded it, the mode it ran under,
+its outcome, and the trace id of the incident timeline its spans were
+exported on. The record IS the audit trail: the loop never mutates
+the fleet without first minting one.
+
+The :class:`DecisionJournal` persists them append-only (one JSON line
+per action, flushed per append, never rewritten) so a post-mortem can
+replay exactly what the loop decided and why — including the actions
+it *refused* (cooldown, quarantine, missing standby). Journal I/O is
+best-effort: a full disk degrades to the in-memory ring and bumps
+``autopilot.journal_errors``; it never takes the control loop down.
+"""
+import json
+import os
+import threading
+import time
+
+from .. import observability as obs
+
+__all__ = ["AUTOPILOT_ENV", "MODES", "AutopilotAction",
+           "DecisionJournal", "autopilot_mode"]
+
+# PADDLE_TPU_AUTOPILOT=off|propose|apply — the fleet-wide mode switch.
+# ``off`` parks the loop (ticks observe, decide nothing), ``propose``
+# records + journals every decision without touching the fleet, and
+# ``apply`` executes remediations (still gated, rate-limited, and
+# auto-rolled-back on a verified regression).
+AUTOPILOT_ENV = "PADDLE_TPU_AUTOPILOT"
+MODES = ("off", "propose", "apply")
+
+
+def autopilot_mode(default="propose"):
+    """The env-resolved autopilot mode (an unknown value degrades to
+    ``off`` — a typo must park the loop, not arm it)."""
+    raw = os.environ.get(AUTOPILOT_ENV)
+    if not raw:
+        return default
+    raw = raw.strip().lower()
+    return raw if raw in MODES else "off"
+
+
+class AutopilotAction:
+    """One decision of the control loop.
+
+    ``kind`` names the move (``calibrate`` / ``scale_up`` /
+    ``reprice`` / ``reweight`` / ``kill_replica`` / ``replan`` /
+    ``apply_plan`` / ``rollback``), ``trigger`` names the condition
+    that demanded it (``slo:<tenant>:<leg>``, ``drift:<fingerprint>``,
+    ``cadence``), and ``outcome`` tracks its lifecycle:
+
+    - ``proposed`` — recorded, not executed (propose mode, or an apply
+      pending its verify leg),
+    - ``applied`` — executed, verification pending or not applicable,
+    - ``verified`` — executed and the post-change measurement held,
+    - ``rolled_back`` — executed, regressed, reverted by the gate,
+    - ``rejected`` — refused before execution (cooldown, quarantine,
+      no standby to activate, mode off),
+    - ``quarantined`` — the trigger itself was benched with backoff.
+    """
+
+    __slots__ = ("seq", "kind", "trigger", "mode", "outcome", "detail",
+                 "trace_id", "wall")
+
+    OUTCOMES = frozenset({"proposed", "applied", "verified",
+                          "rolled_back", "rejected", "quarantined"})
+
+    def __init__(self, kind, trigger, mode, outcome="proposed",
+                 detail=None, trace_id=None, seq=None, wall=None):
+        if outcome not in self.OUTCOMES:
+            raise ValueError("unknown action outcome %r (want one of %s)"
+                             % (outcome, sorted(self.OUTCOMES)))
+        self.seq = seq
+        self.kind = str(kind)
+        self.trigger = str(trigger)
+        self.mode = str(mode)
+        self.outcome = outcome
+        self.detail = dict(detail or {})
+        self.trace_id = trace_id
+        self.wall = time.time() if wall is None else float(wall)
+
+    def resolve(self, outcome, **detail):
+        """Advance the lifecycle (``applied`` -> ``verified`` /
+        ``rolled_back``) in place, merging extra detail fields."""
+        if outcome not in self.OUTCOMES:
+            raise ValueError("unknown action outcome %r" % (outcome,))
+        self.outcome = outcome
+        self.detail.update(detail)
+        return self
+
+    def to_dict(self):
+        return {"seq": self.seq, "wall": self.wall, "kind": self.kind,
+                "trigger": self.trigger, "mode": self.mode,
+                "outcome": self.outcome, "trace_id": self.trace_id,
+                "detail": dict(self.detail)}
+
+    def __repr__(self):
+        return ("AutopilotAction(%s, trigger=%r, mode=%s, outcome=%s)"
+                % (self.kind, self.trigger, self.mode, self.outcome))
+
+
+class DecisionJournal:
+    """Append-only record of every :class:`AutopilotAction`.
+
+    With a ``path`` each append writes one JSON line and flushes —
+    the file is never truncated or rewritten, so a reader can tail it
+    live and a crash can lose at most the final partial line (which
+    :meth:`read_jsonl` skips). Without a path the journal is the
+    in-memory ring alone (tests, propose-mode dry runs)."""
+
+    def __init__(self, path=None, capacity=512):
+        self.path = str(path) if path else None
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._ring = []
+        self._seq = 0
+
+    def append(self, action):
+        """Stamp ``action.seq``, retain it, and (best-effort) persist
+        it. Returns the action for chaining."""
+        with self._lock:
+            self._seq += 1
+            action.seq = self._seq
+            self._ring.append(action)
+            if len(self._ring) > self.capacity:
+                del self._ring[:len(self._ring) - self.capacity]
+            line = None
+            if self.path:
+                try:
+                    line = json.dumps(action.to_dict(), sort_keys=True)
+                except (TypeError, ValueError):
+                    # undumpable detail payload: journal the envelope
+                    d = action.to_dict()
+                    d["detail"] = {"unserializable": True}
+                    line = json.dumps(d, sort_keys=True)
+        if line is not None:
+            try:
+                with open(self.path, "a", encoding="utf-8") as fh:
+                    fh.write(line + "\n")
+                    fh.flush()
+            except OSError:
+                obs.inc("autopilot.journal_errors")
+        return action
+
+    def tail(self, n=32):
+        """The most recent ``n`` actions, oldest first (dicts)."""
+        with self._lock:
+            return [a.to_dict() for a in self._ring[-int(n):]]
+
+    def entries(self):
+        with self._lock:
+            return [a.to_dict() for a in self._ring]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    @staticmethod
+    def read_jsonl(path):
+        """Load a journal file back as a list of action dicts. A torn
+        final line (crash mid-append) is skipped, matching the
+        append-only write discipline."""
+        out = []
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            return []
+        return out

@@ -1,0 +1,559 @@
+"""The self-healing control loop: ledger -> planner -> fleet.
+
+:class:`Autopilot` closes the observe/decide/act cycle the previous
+subsystems left open. One :meth:`tick` runs three legs in order:
+
+1. **calibrate** — measured step times the serving/bench loops feed
+   into the :class:`~paddle_tpu.observability.ExecutableLedger` are
+   fitted into an *effective* :class:`DeviceProfile`
+   (``DeviceProfile.calibrated_from``) on a cadence, so every later
+   decision prices against what the chips actually deliver, not table
+   constants. A fresh fit also re-prices the decode bucket ladder
+   under the calibrated HBM view (the ``reprice`` action).
+2. **SLO** — per-tenant burn rates (:class:`SLOMonitor`) above
+   ``burn_threshold``, confirmed over ``ActionGate.confirm_n``
+   consecutive ticks, trigger the existing remediations in order of
+   specificity: ``kill_replica`` + migrate for a confirmed-degraded
+   decode replica (beacon latency >= ``degrade_factor`` x its own
+   healthy baseline), warm-standby ``scale_up`` on the classic
+   router, admission ``reweight`` (demote best-effort tenants one
+   priority class) otherwise.
+3. **drift** — when a measured step time departs the *calibrated*
+   re-prediction beyond ``drift_tolerance_pct``, the planner re-ranks
+   under the calibrated profile (``replan`` callback, typically a
+   ``plan_search`` wrapper) and proposes the new config; in ``apply``
+   mode the proposal is applied (``apply`` callback — e.g.
+   ``ServingRouter.rolling_reload`` with its built-in rollback),
+   measured again, and auto-rolled-back if the post-change
+   measurement regresses past ``verify_tolerance_pct`` — with the
+   trigger quarantined under exponential backoff.
+
+Every decision is an :class:`AutopilotAction` journaled append-only,
+exported as spans on one incident trace (detect -> replan -> apply ->
+verify share a trace_id), and rate-limited by the shared
+:class:`ActionGate` so the loop cannot flap. The mode switch
+(``PADDLE_TPU_AUTOPILOT=off|propose|apply``) is read live: flipping
+the env var to ``off`` parks a running loop at its next tick.
+"""
+import threading
+import time
+
+from .. import observability as obs
+from ..analysis import concurrency as _conc
+from .actions import AutopilotAction, DecisionJournal, autopilot_mode
+from .gates import ActionGate, verify_measurement
+
+__all__ = ["Autopilot"]
+
+_MODE_GAUGE = {"off": 0, "propose": 1, "apply": 2}
+
+
+def _median(xs):
+    xs = sorted(x for x in xs if x is not None)
+    if not xs:
+        return None
+    n = len(xs)
+    mid = xs[n // 2]
+    return mid if n % 2 else (xs[n // 2 - 1] + mid) / 2.0
+
+
+class Autopilot:
+    """Supervised control loop over a serving fleet.
+
+    Wire in what exists — every collaborator is optional and its leg
+    simply stays quiet without it:
+
+    - ``ledger`` — an ExecutableLedger (default: the process-global
+      one) feeding the calibrate + drift legs.
+    - ``tenants`` — a TenantTable; arms the SLO leg (burn rates) and
+      the ``reweight`` remediation.
+    - ``disagg`` — a DisaggRouter; arms ``kill_replica``+migrate.
+    - ``router`` — a ServingRouter; arms warm-standby ``scale_up``.
+    - ``replan`` — ``callable(profile) -> proposal dict``; the drift
+      leg's planner hook (wrap ``plan_search`` + ``best_runnable``).
+    - ``measure`` / ``apply`` / ``rollback`` — the apply path:
+      ``measure() -> seconds`` (lower is better) brackets
+      ``apply(proposal)``; a regressing delta triggers ``rollback()``
+      and quarantines the trigger.
+
+    ``tick()`` is synchronous and returns the actions it took (tests
+    drive it directly); ``start()`` runs it on a daemon thread every
+    ``interval_s``.
+    """
+
+    def __init__(self, ledger=None, tenants=None, router=None,
+                 disagg=None, replan=None, measure=None, apply=None,
+                 rollback=None, mode=None, journal=None, gate=None,
+                 calibration_path=None, device_kind=None,
+                 burn_threshold=1.0, slo_budget=0.1,
+                 drift_tolerance_pct=50.0, verify_tolerance_pct=15.0,
+                 degrade_factor=3.0, calibrate_every_s=30.0,
+                 interval_s=0.5, name="autopilot",
+                 clock=time.monotonic):
+        self.ledger = ledger if ledger is not None else obs.get_ledger()
+        self.tenants = tenants
+        self.router = router
+        self.disagg = disagg
+        self.replan = replan
+        self.measure = measure
+        self.apply = apply
+        self.rollback = rollback
+        self._mode_override = mode
+        self.journal = journal if journal is not None else DecisionJournal()
+        self.gate = gate if gate is not None else ActionGate(clock=clock)
+        self.calibration_path = calibration_path
+        self.device_kind = device_kind
+        self.burn_threshold = float(burn_threshold)
+        self.slo_budget = float(slo_budget)
+        self.drift_tolerance_pct = float(drift_tolerance_pct)
+        self.verify_tolerance_pct = float(verify_tolerance_pct)
+        self.degrade_factor = float(degrade_factor)
+        self.calibrate_every_s = float(calibrate_every_s)
+        self.interval_s = float(interval_s)
+        self.name = str(name)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.profile = None          # latest calibrated DeviceProfile
+        self._cal_ratio = None       # median predicted/measured at fit
+        self._cal_measured = {}      # measured map the last fit used
+        self._last_cal = None        # clock stamp of the last fit
+        self._lat_baseline = {}      # decode rid -> healthy latency
+        self._ticks = 0
+        self._slo_mon = None
+        self._stop = threading.Event()
+        self._thread = None
+        self._owner = _conc.owner_token("autopilot", self.name, self)
+
+    # -- mode ------------------------------------------------------------
+    def mode(self):
+        """Live mode: the constructor override, else the env var
+        (``PADDLE_TPU_AUTOPILOT``), else ``propose``."""
+        m = (self._mode_override if self._mode_override is not None
+             else autopilot_mode())
+        obs.set_gauge("autopilot.mode", _MODE_GAUGE.get(m, 0))
+        return m
+
+    # -- record keeping ----------------------------------------------------
+    def _record(self, action, ctx=None):
+        """Journal + trace + meter one action. ``ctx`` stamps the
+        incident trace id the action's spans were exported on."""
+        if ctx is not None:
+            action.trace_id = ctx.trace_id
+        self.journal.append(action)
+        obs.inc("autopilot.actions")
+        obs.inc("autopilot.%s" % action.outcome)
+        obs.event("autopilot_action", source="autopilot",
+                  action=action.kind, trigger=action.trigger,
+                  mode=action.mode, outcome=action.outcome,
+                  seq=action.seq, trace=action.trace_id)
+        return action
+
+    def _span(self, name, ctx, **fields):
+        """An exported child span on the incident timeline (annotation
+        only — the loop proceeds even with tracing unconfigured)."""
+        fields.setdefault("proc", "autopilot:%s" % self.name)
+        return obs.span(name, ctx=ctx, **fields)
+
+    # -- the loop ----------------------------------------------------------
+    def tick(self):
+        """One observe/decide/act pass; returns the list of
+        :class:`AutopilotAction` records it minted (possibly empty)."""
+        mode = self.mode()
+        self._ticks += 1
+        obs.inc("autopilot.ticks")
+        if mode == "off":
+            return []
+        self._observe_fleet()
+        actions = []
+        self._leg_calibrate(actions, mode)
+        self._leg_slo(actions, mode)
+        self._leg_drift(actions, mode)
+        return actions
+
+    def _observe_fleet(self):
+        """Refresh per-replica latency baselines every tick — the first
+        latency a replica ever reports is its healthy baseline, so it
+        must be captured while the fleet is healthy, not at incident
+        time (when the reading is already degraded)."""
+        if self.disagg is None:
+            return
+        try:
+            lat = self.disagg.decode_latencies()
+        except Exception:  # noqa: BLE001 — beacons are best-effort
+            return
+        for rid, v in lat.items():
+            self._lat_baseline.setdefault(rid, v)
+
+    def start(self):
+        """Run :meth:`tick` every ``interval_s`` on a daemon thread."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="autopilot-%s" % self.name)
+            _conc.track_thread(self._thread, self._owner)
+            self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                obs.inc("autopilot.tick_errors")
+                obs.event("autopilot_tick_error", source="autopilot",
+                          error="%s: %s" % (type(e).__name__, e))
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        _conc.check_stopped(self._owner, grace=2.0)
+
+    # -- leg 1: continuous calibration -------------------------------------
+    def _leg_calibrate(self, actions, mode):
+        """Fit an effective DeviceProfile from the ledger's measured
+        step times when they changed since the last fit (and the
+        cadence elapsed). The fit is a *sensor* update — it runs in
+        propose mode too; only fleet mutations honor propose/apply."""
+        now = self._clock()
+        if (self._last_cal is not None
+                and now - self._last_cal < self.calibrate_every_s):
+            return
+        try:
+            snap = self.ledger.snapshot()
+        except Exception:  # noqa: BLE001 — observability-side failure
+            return
+        measured = dict(snap.get("measured") or {})
+        if not measured or measured == self._cal_measured:
+            return
+        from ..analysis.costs import DeviceProfile
+
+        prof = DeviceProfile.calibrated_from(
+            snap, path=self.calibration_path)
+        self._last_cal = now
+        if prof is None:
+            return
+        ratios = []
+        for e in snap.get("entries") or ():
+            pred = (e.get("predicted") or {}).get(
+                "predicted_step_seconds")
+            meas = e.get("measured_step_seconds")
+            if pred and meas and pred > 0 and meas > 0:
+                ratios.append(float(pred) / float(meas))
+        with self._lock:
+            self.profile = prof
+            self._cal_ratio = _median(ratios)
+            self._cal_measured = measured
+        obs.inc("autopilot.calibrations")
+        if prof.peak_flops:
+            obs.set_gauge("autopilot.calibrated_peak_flops",
+                          prof.peak_flops)
+        actions.append(self._record(AutopilotAction(
+            "calibrate", "cadence", mode, outcome="applied",
+            detail={"peak_flops": prof.peak_flops, "hbm_bw": prof.hbm_bw,
+                    "ratio": self._cal_ratio,
+                    "entries_measured": len(measured),
+                    "path": self.calibration_path})))
+        self._reprice(actions, mode)
+
+    def _reprice(self, actions, mode):
+        """Bucket-ladder re-pricing under the freshly calibrated HBM
+        view: re-run each decode engine's admission pricing so a
+        calibration that shrank the effective capacity surfaces an
+        over-budget ladder *now*, not at the next cold warmup."""
+        if self.disagg is None or not self.gate.ready("reprice"):
+            return
+        with self.disagg._lock:
+            decodes = list(self.disagg._decode.items())
+        budget = self.profile.hbm_bytes if self.profile else None
+        priced = {}
+        ok = True
+        for rid, rep in decodes:
+            check = getattr(rep.engine, "check_hbm_budget", None)
+            if check is None:
+                continue
+            try:
+                check(budget_bytes=budget)
+                priced[str(rid)] = "ok"
+            except Exception as e:  # noqa: BLE001 — verdict, not crash
+                priced[str(rid)] = "%s: %s" % (type(e).__name__,
+                                               str(e)[:120])
+                ok = False
+        if not priced:
+            return
+        self.gate.stamp("reprice")
+        actions.append(self._record(AutopilotAction(
+            "reprice", "cadence", mode,
+            outcome="applied" if ok else "rejected",
+            detail={"budget_bytes": budget, "replicas": priced})))
+
+    # -- leg 2: SLO burn ----------------------------------------------------
+    def _leg_slo(self, actions, mode):
+        if self.tenants is None:
+            return
+        if self._slo_mon is None:
+            self._slo_mon = obs.SLOMonitor(self.tenants,
+                                           budget=self.slo_budget)
+        try:
+            burns = self._slo_mon.tick(publish=True)
+        except Exception:  # noqa: BLE001 — a broken hub must not stop us
+            return
+        worst = 0.0
+        for tenant, legs in burns.items():
+            for leg, key in (("ttft", "ttft_burn"),
+                             ("per_token", "per_token_burn")):
+                burn = legs.get(key) or 0.0
+                worst = max(worst, burn)
+                trigger = "slo:%s:%s" % (tenant, leg)
+                firing = burn > self.burn_threshold
+                if not self.gate.confirm(trigger, firing):
+                    continue
+                self.gate.clear(trigger)
+                if self.gate.quarantined(trigger):
+                    actions.append(self._record(AutopilotAction(
+                        "remediate", trigger, mode, outcome="rejected",
+                        detail={"reason": "quarantined",
+                                "burn": round(burn, 3)})))
+                    continue
+                self._remediate_burn(actions, mode, trigger, tenant,
+                                     leg, burn)
+        obs.set_gauge("autopilot.worst_burn", worst)
+
+    def _remediate_burn(self, actions, mode, trigger, tenant, leg,
+                        burn):
+        """One confirmed burn incident: detect span, then the most
+        specific available remediation (kill degraded decode replica >
+        warm-standby scale-up > admission reweight), then verify."""
+        ctx = obs.TraceContext.new()
+        with self._span("autopilot.detect", ctx, trigger=trigger,
+                        tenant=tenant, leg=leg,
+                        burn=round(burn, 3)) as sp:
+            ictx = sp.ctx if sp is not None else ctx
+        degraded = self._degraded_decode()
+        if degraded is not None and self.gate.ready("kill_replica"):
+            rid, lat, base = degraded
+            act = AutopilotAction(
+                "kill_replica", trigger, mode,
+                detail={"replica": rid, "latency_s": round(lat, 4),
+                        "baseline_s": round(base, 4), "leg": leg,
+                        "burn": round(burn, 3)})
+            if mode != "apply":
+                actions.append(self._record(act, ctx=ictx))
+                return
+            before = self.disagg.stats().get("failed_streams", 0)
+            with self._span("autopilot.act", ictx, kind="kill_replica",
+                            replica=rid):
+                try:
+                    self.disagg.kill_replica(rid)
+                except KeyError:
+                    actions.append(self._record(act.resolve(
+                        "rejected", reason="replica already gone"),
+                        ctx=ictx))
+                    return
+            self.gate.stamp("kill_replica")
+            self._lat_baseline.pop(rid, None)
+            failed = (self.disagg.stats().get("failed_streams", 0)
+                      - before)
+            with self._span("autopilot.verify", ictx,
+                            kind="kill_replica",
+                            failed_streams=failed):
+                pass
+            actions.append(self._record(act.resolve(
+                "verified" if failed == 0 else "applied",
+                failed_streams=failed), ctx=ictx))
+            return
+        if self.router is not None and self.gate.ready("scale_up"):
+            act = AutopilotAction(
+                "scale_up", trigger, mode,
+                detail={"leg": leg, "burn": round(burn, 3)})
+            if mode != "apply":
+                actions.append(self._record(act, ctx=ictx))
+                return
+            with self._span("autopilot.act", ictx, kind="scale_up"):
+                replica = self.router.scale_up(reason="autopilot")
+            if replica is None:
+                actions.append(self._record(act.resolve(
+                    "rejected", reason="no standby"), ctx=ictx))
+                return
+            self.gate.stamp("scale_up")
+            actions.append(self._record(act.resolve(
+                "applied", replica=replica.rid), ctx=ictx))
+            return
+        if self.gate.ready("reweight"):
+            demoted = self._demote_best_effort(tenant, mode)
+            act = AutopilotAction(
+                "reweight", trigger, mode,
+                detail={"burning_tenant": tenant, "leg": leg,
+                        "burn": round(burn, 3), "demoted": demoted})
+            if not demoted:
+                act.resolve("rejected", reason="no demotable tenant")
+            elif mode == "apply":
+                self.gate.stamp("reweight")
+                act.resolve("applied")
+            actions.append(self._record(act, ctx=ictx))
+
+    def _degraded_decode(self):
+        """``(rid, latency, baseline)`` of the worst decode replica
+        whose beacon latency sits ``degrade_factor`` over its own
+        healthy baseline (captured by :meth:`_observe_fleet` while the
+        fleet was healthy), or None. Never nominates the LAST decode
+        replica — killing it would fail every stream, which is worse
+        than any slowdown. In a uniformly slow fleet (traffic surge,
+        host contention) only the max-latency replica is nominated,
+        not all of them."""
+        if self.disagg is None:
+            return None
+        try:
+            lat = self.disagg.decode_latencies()
+        except Exception:  # noqa: BLE001 — beacons are best-effort
+            return None
+        if len(lat) < 2:
+            return None
+        worst = None
+        for rid, v in lat.items():
+            base = self._lat_baseline.get(rid, v)
+            if base <= 0 or v < self.degrade_factor * base:
+                continue
+            peers = [p for r, p in lat.items() if r != rid]
+            med = _median(peers)
+            if med is not None and v < self.degrade_factor * med \
+                    and len(peers) >= 1:
+                # worst of a uniformly slow fleet: still nominate the
+                # max-latency one only if it IS the max
+                if v < max(lat.values()):
+                    continue
+            if worst is None or v > worst[1]:
+                worst = (rid, v, base)
+        return worst
+
+    def _demote_best_effort(self, burning, mode):
+        """Demote (priority += 1) every tenant that is NOT the burning
+        one and still has headroom below the lowest class — admission
+        re-weighting that gives the burning tenant queue priority.
+        Returns the list of demoted tenant names (propose mode lists
+        them without mutating)."""
+        from ..serving.disagg.tenancy import MAX_PRIORITY
+
+        demoted = []
+        for spec in self.tenants.specs():
+            if spec.name == burning or spec.priority >= MAX_PRIORITY:
+                continue
+            demoted.append(spec.name)
+            if mode == "apply":
+                self.tenants.reweight(spec.name,
+                                      priority=spec.priority + 1)
+        return demoted
+
+    # -- leg 3: re-plan on drift --------------------------------------------
+    def _leg_drift(self, actions, mode):
+        """Score measured step times against the *calibrated*
+        re-prediction. Until the first calibration fit the leg stays
+        quiet: table constants are nominal, and judging drift against
+        them would re-plan on day one of every new device."""
+        ratio = self._cal_ratio
+        if not ratio or ratio <= 0:
+            return
+        try:
+            rows = obs.drift_rows(self.ledger.snapshot())
+        except Exception:  # noqa: BLE001
+            return
+        worst_pct = 0.0
+        for row in rows:
+            pred_ms = row.get("predicted_step_ms")
+            meas_ms = row.get("measured_step_ms")
+            if not pred_ms or not meas_ms:
+                continue
+            cal_pred_ms = pred_ms / ratio
+            drift_pct = 100.0 * (meas_ms - cal_pred_ms) / cal_pred_ms
+            worst_pct = max(worst_pct, abs(drift_pct))
+            trigger = "drift:%s" % row.get("fingerprint")
+            firing = abs(drift_pct) > self.drift_tolerance_pct
+            if not self.gate.confirm(trigger, firing):
+                continue
+            self.gate.clear(trigger)
+            if self.gate.quarantined(trigger):
+                actions.append(self._record(AutopilotAction(
+                    "replan", trigger, mode, outcome="rejected",
+                    detail={"reason": "quarantined",
+                            "drift_pct": round(drift_pct, 1)})))
+                continue
+            if not self.gate.ready("replan"):
+                continue
+            self._replan_incident(actions, mode, trigger, row,
+                                  drift_pct)
+        obs.set_gauge("autopilot.worst_drift_pct", worst_pct)
+
+    def _replan_incident(self, actions, mode, trigger, row, drift_pct):
+        """One confirmed drift incident: detect -> replan -> apply ->
+        verify, all children of one trace. A regressing apply is
+        rolled back and the trigger quarantined with backoff."""
+        ctx = obs.TraceContext.new()
+        with self._span("autopilot.detect", ctx, trigger=trigger,
+                        drift_pct=round(drift_pct, 1),
+                        kind_entry=row.get("kind"),
+                        measured_ms=row.get("measured_step_ms")) as sp:
+            ictx = sp.ctx if sp is not None else ctx
+        profile = self.profile
+        proposal = None
+        with self._span("autopilot.replan", ictx,
+                        profile=getattr(profile, "name", None)):
+            if self.replan is not None:
+                try:
+                    proposal = self.replan(profile)
+                except Exception as e:  # noqa: BLE001 — planner bug != outage
+                    actions.append(self._record(AutopilotAction(
+                        "replan", trigger, mode, outcome="rejected",
+                        detail={"error": "%s: %s"
+                                % (type(e).__name__, str(e)[:200])}),
+                        ctx=ictx))
+                    return
+            if proposal is None:
+                proposal = {"profile": profile.to_dict()
+                            if profile is not None else None}
+        self.gate.stamp("replan")
+        act = AutopilotAction(
+            "replan", trigger, mode,
+            detail={"drift_pct": round(drift_pct, 1),
+                    "proposal": proposal})
+        if mode != "apply" or self.apply is None:
+            actions.append(self._record(act, ctx=ictx))
+            return
+        before = self.measure() if self.measure is not None else None
+        with self._span("autopilot.apply", ictx,
+                        before_s=before):
+            try:
+                self.apply(proposal)
+            except Exception as e:  # noqa: BLE001 — failed apply = no change
+                actions.append(self._record(act.resolve(
+                    "rejected", error="%s: %s"
+                    % (type(e).__name__, str(e)[:200])), ctx=ictx))
+                return
+        after = self.measure() if self.measure is not None else None
+        verdict = verify_measurement(
+            before, after, tolerance_pct=self.verify_tolerance_pct,
+            higher_is_better=False)
+        with self._span("autopilot.verify", ictx,
+                        after_s=after,
+                        regressed=verdict["regressed"],
+                        delta_pct=verdict["delta_pct"]):
+            if verdict["regressed"]:
+                if self.rollback is not None:
+                    try:
+                        self.rollback()
+                    except Exception as e:  # noqa: BLE001
+                        verdict["rollback_error"] = "%s: %s" % (
+                            type(e).__name__, str(e)[:200])
+                backoff = self.gate.quarantine(trigger)
+                obs.inc("autopilot.rollbacks")
+                actions.append(self._record(act.resolve(
+                    "rolled_back", verify=verdict), ctx=ictx))
+                actions.append(self._record(AutopilotAction(
+                    "quarantine", trigger, mode, outcome="quarantined",
+                    detail={"backoff_s": backoff,
+                            "strikes": self.gate.state()
+                            ["quarantine"][trigger]["strikes"]}),
+                    ctx=ictx))
+            else:
+                actions.append(self._record(act.resolve(
+                    "verified", verify=verdict), ctx=ictx))

@@ -39,9 +39,12 @@ Fault spec grammar (clauses joined by ``;`` or ``,``)::
                                            1-based)
     action   := exception class name (builtins or "EOFException"),
                 "nan" (site "fetch" only: corrupt the first fetched
-                float into NaN), or "slow" (sleep
+                float into NaN), "slow" (sleep
                 PADDLE_TPU_FAULT_SLOW_S seconds, default 0.25 — the
-                straggler/slow-replica drill)
+                straggler/slow-replica drill), or "slow=" SECONDS
+                (per-clause duration, e.g. ``dispatch:every=1:slow=0.05``
+                — degrade one site without re-pacing every other slow
+                clause in the spec)
 
 The fleet-level sites (see ``parallel/elastic.py``): ``collective``
 fires in the collective-op lowerings (``ops/collective_ops.py``) and
@@ -50,8 +53,10 @@ the elastic rendezvous paths, ``heartbeat`` in the beacon writer — so a
 "worker goes silent mid-run" drill is one env var away.
 
 The serving-fleet sites (see ``serving/router.py``): ``dispatch``
-fires in the router's per-attempt dispatch path, ``replica`` in each
-replica's admission path — replica kill is ``replica:at=N:RuntimeError``
+fires in the router's per-attempt dispatch path and in the decode
+engine's step loop (``DecodeEngine._step`` — so
+``dispatch:every=1:slow=0.05`` seeds a decode-replica slowdown, the
+autopilot chaos drill), ``replica`` in each replica's admission path — replica kill is ``replica:at=N:RuntimeError``
 (the router fails over), replica slow is ``replica:every=N:slow`` (the
 straggler classifier demotes it), and partition is a ``heartbeat``
 fault on one replica's beater (beacons stop while the engine lives).
@@ -185,19 +190,22 @@ def _slow_seconds():
 
 
 _CLAUSE_RE = re.compile(
-    r"^(?P<site>[a-z_]+):(?P<mode>every|at)=(?P<n>\d+):(?P<action>\w+)$"
+    r"^(?P<site>[a-z_]+):(?P<mode>every|at)=(?P<n>\d+)"
+    r":(?P<action>\w+)(?:=(?P<arg>[0-9.]+))?$"
 )
 
 
 class _Clause:
-    __slots__ = ("site", "mode", "n", "action_name", "exc", "checks", "fires")
+    __slots__ = ("site", "mode", "n", "action_name", "exc", "slow_s",
+                 "checks", "fires")
 
-    def __init__(self, site, mode, n, action_name, exc):
+    def __init__(self, site, mode, n, action_name, exc, slow_s=None):
         self.site = site
         self.mode = mode
         self.n = n
         self.action_name = action_name
         self.exc = exc  # exception class, or None for the "nan" action
+        self.slow_s = slow_s  # per-clause 'slow' duration override
         self.checks = 0
         self.fires = 0
 
@@ -259,9 +267,9 @@ class FaultInjector:
                     "bad fault clause %r (want site:every=N:Action or "
                     "site:at=N:Action)" % raw
                 )
-            site, mode, n, action = (
+            site, mode, n, action, arg = (
                 m.group("site"), m.group("mode"), int(m.group("n")),
-                m.group("action"),
+                m.group("action"), m.group("arg"),
             )
             if site not in self.SITES:
                 raise FaultSpecError(
@@ -270,6 +278,11 @@ class FaultInjector:
                 )
             if n <= 0:
                 raise FaultSpecError("fault trigger count must be >= 1")
+            if arg is not None and action != _SLOW_ACTION:
+                raise FaultSpecError(
+                    "action argument %r only applies to 'slow' "
+                    "(slow=SECONDS), not %r" % (arg, action))
+            slow_s = None
             if action == _NAN_ACTION:
                 if site != "fetch":
                     raise FaultSpecError(
@@ -277,9 +290,19 @@ class FaultInjector:
                 exc = None
             elif action == _SLOW_ACTION:
                 exc = None  # sleeps instead of raising (straggler drill)
+                if arg is not None:
+                    try:
+                        slow_s = float(arg)
+                    except ValueError:
+                        raise FaultSpecError(
+                            "bad slow duration %r (want seconds, e.g. "
+                            "dispatch:every=1:slow=0.05)" % arg)
+                    if slow_s < 0:
+                        raise FaultSpecError(
+                            "slow duration must be >= 0, got %r" % arg)
             else:
                 exc = _resolve_exception(action)
-            clause = _Clause(site, mode, n, action, exc)
+            clause = _Clause(site, mode, n, action, exc, slow_s=slow_s)
             self.clauses.append(clause)
             by_site[site].append(clause)
         if not self.clauses:
@@ -316,14 +339,17 @@ class FaultInjector:
     def check(self, site):
         """Count a check at `site`; raise the first triggered exception
         clause, or return True if a 'nan' clause fired. A triggered
-        'slow' clause sleeps PADDLE_TPU_FAULT_SLOW_S seconds in place —
-        the checked path stalls but survives."""
+        'slow' clause sleeps in place — its per-clause ``slow=SECONDS``
+        duration when given, else PADDLE_TPU_FAULT_SLOW_S — so the
+        checked path stalls but survives."""
         nan_fired = False
         fire = None
         for clause in self._by_site.get(site, ()):
             if clause.poke():
                 if clause.action_name == _SLOW_ACTION:
-                    time.sleep(_slow_seconds())
+                    time.sleep(clause.slow_s
+                               if clause.slow_s is not None
+                               else _slow_seconds())
                 elif clause.exc is None:
                     nan_fired = True
                 elif fire is None:

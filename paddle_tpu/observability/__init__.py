@@ -223,6 +223,34 @@ Well-known perf-ledger metrics (PR 15, ``observability.ledger`` /
   (bench ``--telemetry-out`` files embed the ledger snapshot under
   their ``"ledger"`` key).
 
+Well-known autopilot metrics (PR 16, ``paddle_tpu.autopilot`` — the
+self-healing control loop over the ledger/SLO/planner signals above):
+
+- ``autopilot.ticks`` counter — control-loop passes;
+  ``autopilot.tick_errors`` — ticks that raised (the daemon loop
+  survives and counts them); ``autopilot.actions`` — decisions minted,
+  with per-outcome siblings ``autopilot.proposed`` / ``.applied`` /
+  ``.verified`` / ``.rolled_back`` / ``.rejected`` /
+  ``.quarantined``.
+- ``autopilot.calibrations`` counter — DeviceProfile refits from the
+  ledger's measured step times; ``autopilot.rollbacks`` counter —
+  applied re-plans reverted after a regressing verify measurement;
+  ``autopilot.journal_errors`` counter — decision-journal appends that
+  could not reach disk (the in-memory ring still holds them).
+- ``autopilot.mode`` gauge — 0 off / 1 propose / 2 apply, refreshed
+  every tick from ``PADDLE_TPU_AUTOPILOT``;
+  ``autopilot.worst_burn`` gauge — the worst per-tenant SLO burn seen
+  last tick; ``autopilot.worst_drift_pct`` gauge — the worst
+  |measured vs calibrated-predicted| step drift;
+  ``autopilot.calibrated_peak_flops`` gauge — the effective peak of
+  the latest fit.
+- ``autopilot_action`` events (source ``autopilot``) carry each
+  decision's kind, trigger, mode, outcome, journal seq, and incident
+  trace id into the flight recorder; the same decisions land
+  append-only in the ``DecisionJournal`` and as ``autopilot.detect`` /
+  ``.replan`` / ``.act`` / ``.apply`` / ``.verify`` spans on the
+  request timeline.
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

@@ -597,13 +597,17 @@ class SLOMonitor:
             raise ValueError("budget must be positive")
 
     def _burn(self, values, slo_ms):
-        if not values or slo_ms is None:
-            return None
+        # a tenant with no target (absent/zero/negative SLO) or no
+        # traffic this window is not burning budget: 0.0, never a
+        # None/NaN that poisons gauges or autopilot thresholds
+        if not values or slo_ms is None or slo_ms <= 0:
+            return 0.0
         over = sum(1 for v in values if v * 1e3 > slo_ms)
         return (over / len(values)) / self.budget
 
     def tick(self, reservoirs=None, publish=True):
-        """{tenant: {"ttft_burn": x|None, "per_token_burn": y|None}}.
+        """{tenant: {"ttft_burn": x, "per_token_burn": y}} — always
+        finite floats; no-target and zero-traffic legs read 0.0.
 
         ``reservoirs`` optionally maps metric name -> list of observed
         seconds (e.g. from a merged fleet snapshot); by default the
@@ -627,11 +631,9 @@ class SLOMonitor:
                               "per_token_burn": per_tok}
             if publish and _t.mode() != _t.OFF:
                 hub = _t.get_telemetry()
-                if ttft is not None:
-                    hub.set_gauge("fleet.slo_burn_ttft.%s" % spec.name,
-                                  ttft)
-                if per_tok is not None:
-                    hub.set_gauge(
-                        "fleet.slo_burn_per_token.%s" % spec.name,
-                        per_tok)
+                hub.set_gauge("fleet.slo_burn_ttft.%s" % spec.name,
+                              ttft)
+                hub.set_gauge(
+                    "fleet.slo_burn_per_token.%s" % spec.name,
+                    per_tok)
         return out

@@ -380,6 +380,25 @@ class HeartbeatMonitor:
                 out[w] = rec[key]
         return out
 
+    def latencies(self, members=None):
+        """{worker_index: beacon latency seconds} for every live beacon
+        reporting one (departed workers and non-numeric values are
+        skipped). A serving replica's beacon latency is its inverse
+        drain rate, so this is the autopilot's degraded-replica
+        signal — read fleet-wide off the store, no engine channel."""
+        members = None if members is None else {int(m) for m in members}
+        out = {}
+        for w, rec in self.table().items():
+            if members is not None and w not in members:
+                continue
+            if not isinstance(rec, dict) or rec.get("state") == "left":
+                continue
+            lat = rec.get("latency")
+            if (isinstance(lat, (int, float))
+                    and not isinstance(lat, bool) and lat > 0):
+                out[w] = float(lat)
+        return out
+
     def dead_peers(self, members=None, now=None):
         """Worker indices (excluding self) whose beacons went silent
         past the miss threshold — or that never appeared within the

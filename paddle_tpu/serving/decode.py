@@ -69,6 +69,7 @@ import numpy as np
 from .. import observability as obs
 from ..analysis import concurrency as _conc
 from ..analysis import dataflow as _dataflow
+from ..fluid import resilience as R
 from .engine import DeadlineExceededError, EngineClosedError, ShedError
 
 __all__ = ["DecodeEngine", "DecodeStream", "default_prompt_buckets",
@@ -372,6 +373,11 @@ class DecodeEngine:
         # computed lazily on the first TRACED request (annotation only;
         # unsampled requests never run the analyzer)
         self._cost_cache = {}
+        # measured-step feed into the executable ledger ("" = program
+        # has no fingerprint, stop trying)
+        self._step_fp = None
+        self._step_ema = None
+        self._step_noted = False
         if auto_start:
             self.start()
 
@@ -1001,6 +1007,11 @@ class DecodeEngine:
     def _step(self):
         t0 = time.monotonic()
         try:
+            # chaos site: a 'slow' clause stalls the step in place (it
+            # shows up in step_seconds + the ledger, the autopilot
+            # drill's seeded degradation); an exception clause flows to
+            # the step_error path below like a real device fault
+            R.fault_check("dispatch")
             if _conc._on:
                 _conc.note_blocking("device.dispatch")
             if self.kv_dtype == "int8":
@@ -1018,8 +1029,9 @@ class DecodeEngine:
                 if s is not None:
                     self._retire(i, "error", error=e)
             return
-        obs.observe("serving.decode.step_seconds",
-                    time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        obs.observe("serving.decode.step_seconds", dt)
+        self._note_step_measured(dt)
         self._bump("steps")
         nxt_np = np.asarray(nxt)
         for i, s in enumerate(self._slots):
@@ -1031,16 +1043,42 @@ class DecodeEngine:
             self._emit(i, tok)
         self._gauges()
 
+    def _note_step_measured(self, dt):
+        """Feed the measured step time into the executable ledger
+        (EMA-smoothed) so drift scoring and device auto-calibration see
+        live serving numbers, not only bench runs. Best-effort: the
+        ledger must never fail a step."""
+        try:
+            if self._step_fp is None:
+                from ..fluid import compile_cache as _cc
+
+                self._step_fp = _cc.fingerprint_or_none(
+                    self._step_pred.program) or ""
+            if not self._step_fp:
+                return
+            ema = self._step_ema
+            self._step_ema = dt if ema is None else 0.8 * ema + 0.2 * dt
+            obs.get_ledger().note_measured(self._step_fp,
+                                           self._step_ema)
+            if not self._step_noted:
+                self._step_noted = True
+                self._predicted_s("step")  # pair a prediction with it
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
     def _predicted_s(self, kind, bucket=None):
         """Cost-model predicted seconds for one prefill of `bucket` or
         one step, cached; None when the analyzer can't price it (trace
-        annotation is best-effort — never fail a request on it)."""
+        annotation is best-effort — never fail a request on it). The
+        full prediction is also attached to the program's ledger entry,
+        arming predicted-vs-measured drift for the autopilot."""
         key = (kind, bucket)
         if key in self._cost_cache:
             return self._cost_cache[key]
         val = None
         try:
             from ..analysis import costs as _costs
+            from ..fluid import compile_cache as _cc
 
             kind_dev = getattr(self._jax.devices()[0], "device_kind",
                                None)
@@ -1057,6 +1095,9 @@ class DecodeEngine:
                 prog, feed_specs=feeds, is_test=True,
                 device_kind=kind_dev)
             val = pred.get("predicted_step_seconds")
+            fp = _cc.fingerprint_or_none(prog)
+            if fp:
+                obs.get_ledger().note_prediction(fp, pred)
         except Exception:  # noqa: BLE001 — annotation only
             val = None
         self._cost_cache[key] = val

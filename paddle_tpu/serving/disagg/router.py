@@ -719,6 +719,19 @@ class DisaggRouter:
                        for r in list(self._prefill.values())
                        + list(self._decode.values()))
 
+    def decode_latencies(self):
+        """{rid: beacon latency seconds} for the live decode fleet —
+        each replica's inverse drain rate as last published on its
+        heartbeat. The autopilot's degraded-replica signal: a replica
+        whose latency departs its own baseline (and its peers') is the
+        kill+migrate candidate."""
+        with self._lock:
+            rids = set(self._decode)
+        return {rid: lat
+                for rid, lat in self.monitor.latencies(
+                    members=rids).items()
+                if rid in rids}
+
     def drain_rate(self):
         rates = []
         with self._lock:

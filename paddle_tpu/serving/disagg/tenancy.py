@@ -115,6 +115,33 @@ class TenantTable:
                           per_token_slo_ms=(
                               self.default_spec.per_token_slo_ms))
 
+    def reweight(self, tenant, priority=None, max_live=None,
+                 max_queued=None):
+        """Admission re-weighting: adjust one tenant's priority class
+        and/or quotas in place (None = keep). New requests see the new
+        weights immediately — live sessions are untouched. Unlisted
+        tenants are materialized from the default spec first, so the
+        autopilot can demote an anonymous burst. Returns the updated
+        spec."""
+        with self._lock:
+            spec = self._specs.get(str(tenant))
+            if spec is None:
+                spec = self.resolve(tenant)
+                self._specs[spec.name] = spec
+            if priority is not None:
+                spec.priority = resolve_priority(
+                    min(int(priority), MAX_PRIORITY)
+                    if isinstance(priority, int)
+                    and not isinstance(priority, bool) else priority)
+            if max_live is not None:
+                spec.max_live = int(max_live)
+            if max_queued is not None:
+                spec.max_queued = int(max_queued)
+        obs.event("tenant_reweight", source="serving", model=self.model,
+                  tenant=spec.name, priority=spec.priority,
+                  max_live=spec.max_live, max_queued=spec.max_queued)
+        return spec
+
     # -- quota accounting ------------------------------------------------
     def acquire(self, tenant):
         """Claim one live-session token for `tenant`; raises

@@ -998,6 +998,17 @@ class ServingRouter:
                   replica=replica.rid, reason=reason, live=n_live)
         return replica
 
+    def scale_up(self, reason="manual"):
+        """Activate one warm standby into the dispatch set NOW —
+        the operator/autopilot override of the sustained-pressure
+        autoscaler. The replica counts as scaled-up, so the autoscaler
+        parks it back once pressure subsides. Returns the activated
+        replica, or None when no standby is available."""
+        replica = self._activate_standby(reason=str(reason), scaled=True)
+        if replica is not None:
+            self._pressure.clear()
+        return replica
+
     def _autoscale_tick(self):
         now = time.monotonic()
         with self._lock:

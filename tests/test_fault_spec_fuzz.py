@@ -9,6 +9,7 @@ asserts no garbage string can escape as a different exception type.
 """
 import random
 import string
+import time
 
 import pytest
 
@@ -50,6 +51,13 @@ BAD_SPECS = [
     "run:at=1:RuntimeError;barrier",     # one good + one bad clause
     "run at=1 RuntimeError",             # wrong separators
     "run:at==1:RuntimeError",
+    "run:at=1:RuntimeError=0.5",         # duration arg is slow-only
+    "fetch:at=1:nan=0.5",
+    "dispatch:every=1:slow=",            # empty duration
+    "dispatch:every=1:slow=0.5s",        # non-numeric duration
+    "dispatch:every=1:slow=1.2.3",       # not a float
+    "dispatch:every=1:slow=.",           # dots alone are not a float
+    "dispatch:every=1:slow=-0.5",        # sign rejected by the regex
 ]
 
 
@@ -117,6 +125,72 @@ def test_valid_grammar_separators_and_whitespace():
     assert [c.site for c in inj.clauses] == ["run", "barrier", "heartbeat"]
     assert [c.mode for c in inj.clauses] == ["every", "at", "at"]
     assert [c.n for c in inj.clauses] == [3, 2, 5]
+
+
+# ---------------------------------------------------------------------------
+# the per-clause slow=SECONDS arm (autopilot chaos drills)
+# ---------------------------------------------------------------------------
+
+
+def test_slow_duration_parses_per_clause():
+    inj = R.FaultInjector("dispatch:every=1:slow=0.05;run:every=2:slow")
+    assert inj.clauses[0].slow_s == pytest.approx(0.05)
+    assert inj.clauses[0].action_name == "slow"
+    assert inj.clauses[1].slow_s is None  # bare slow stays env-paced
+
+
+def test_slow_duration_overrides_env_pacing(monkeypatch):
+    # the env default would stall this test for 5s; the per-clause
+    # duration must win
+    monkeypatch.setenv(R._SLOW_S_ENV, "5.0")
+    inj = R.FaultInjector.install("dispatch:every=1:slow=0.01")
+    t0 = time.monotonic()
+    R.fault_check("dispatch")
+    dt = time.monotonic() - t0
+    assert 0.005 <= dt < 1.0
+    stats = inj.stats()[0]
+    assert stats["action"] == "slow" and stats["fires"] == 1
+
+
+def test_slow_bare_still_env_paced(monkeypatch):
+    monkeypatch.setenv(R._SLOW_S_ENV, "0.02")
+    R.FaultInjector.install("run:every=1:slow")
+    t0 = time.monotonic()
+    R.fault_check("run")
+    assert time.monotonic() - t0 >= 0.015
+
+
+def test_slow_zero_duration_legal():
+    # slow=0 is a legal pacing probe: fires (counts) without stalling
+    inj = R.FaultInjector.install("dispatch:every=1:slow=0")
+    t0 = time.monotonic()
+    for _ in range(3):
+        R.fault_check("dispatch")
+    assert time.monotonic() - t0 < 0.5
+    assert inj.stats()[0]["fires"] == 3
+
+
+def test_fuzz_mutated_slow_specs():
+    """Mutations of a slow=SECONDS spec stay valid (with a finite
+    non-negative duration) or raise FaultSpecError — nothing else."""
+    base = "dispatch:every=1:slow=0.25;run:every=3:slow"
+    rng = random.Random(7)
+    for _ in range(300):
+        pos = rng.randrange(len(base))
+        ch = rng.choice(string.ascii_lowercase + string.digits + ":;=.")
+        mutated = base[:pos] + ch + base[pos + 1:]
+        try:
+            inj = R.FaultInjector(mutated)
+        except R.FaultSpecError:
+            continue
+        except Exception as e:  # noqa: BLE001
+            pytest.fail("mutation %r escaped as %s: %s"
+                        % (mutated, type(e).__name__, e))
+        for clause in inj.clauses:
+            assert clause.site in R.FaultInjector.SITES
+            if clause.slow_s is not None:
+                assert clause.action_name == "slow"
+                assert clause.slow_s >= 0
 
 
 # ---------------------------------------------------------------------------

@@ -401,9 +401,35 @@ class TestSLOMonitor:
         out = mon.tick(reservoirs=res, publish=False)
         assert out["gold"]["ttft_burn"] == pytest.approx(5.0)
         assert out["gold"]["per_token_burn"] == pytest.approx(0.0)
-        # tenants without SLOs (or without data) score None
-        assert out["free"] == {"ttft_burn": None,
-                               "per_token_burn": None}
+        # tenants without SLOs (or without data) score 0.0 — a silent
+        # tenant is not burning budget, and gauges stay NaN-free
+        assert out["free"] == {"ttft_burn": 0.0,
+                               "per_token_burn": 0.0}
+
+    def test_zero_traffic_and_zero_target_burn_zero(self):
+        tenants = TenantTable([
+            TenantSpec("gold", ttft_slo_ms=100.0,
+                       per_token_slo_ms=50.0),
+            TenantSpec("zeroed", ttft_slo_ms=0.0,
+                       per_token_slo_ms=-1.0),
+        ])
+        mon = obs.SLOMonitor(tenants, budget=0.1)
+        # zero-traffic window: gold has targets but no observations
+        out = mon.tick(reservoirs={}, publish=True)
+        assert out["gold"] == {"ttft_burn": 0.0,
+                               "per_token_burn": 0.0}
+        # zero/negative targets never divide — even with traffic over
+        res = {"%s.zeroed" % mon.TTFT_METRIC: [10.0] * 4,
+               "%s.zeroed" % mon.PER_TOKEN_METRIC: [10.0] * 4}
+        out = mon.tick(reservoirs=res, publish=True)
+        assert out["zeroed"] == {"ttft_burn": 0.0,
+                                 "per_token_burn": 0.0}
+        snap = obs.snapshot()
+        for g in ("fleet.slo_burn_ttft.zeroed",
+                  "fleet.slo_burn_per_token.zeroed",
+                  "fleet.slo_burn_ttft.gold"):
+            v = snap["gauges"][g]
+            assert v == 0.0 and v == v  # present, finite, not NaN
 
     def test_tick_reads_local_hub_and_publishes(self):
         mon = obs.SLOMonitor(self._tenants(), budget=0.1)

@@ -4,6 +4,7 @@ and the persistent perf-baseline regression gate."""
 import json
 import os
 import sys
+import warnings
 
 import numpy as np
 import pytest
@@ -319,8 +320,45 @@ class TestCalibration:
         bad = tmp_path / "bad.json"
         bad.write_text("{torn")
         monkeypatch.setenv(costs.CALIBRATION_ENV, str(bad))
-        assert costs.load_calibration() is None
+        with pytest.warns(RuntimeWarning, match="corrupt calibration"):
+            assert costs.load_calibration() is None
         assert costs.device_profile("no-such-device") is None
+
+    def test_corrupt_calibration_warns_once_and_falls_back(
+            self, tmp_path, monkeypatch):
+        """Seeded corruption sweep: every torn/ill-formed shape warns
+        (once per mtime — never spamming a serving loop), resolves to
+        None, and leaves table resolution intact."""
+        bad = tmp_path / "cal.json"
+        monkeypatch.setenv(costs.CALIBRATION_ENV, str(bad))
+        corruptions = [
+            '{"peak_flops": 1e11, "hbm',                # torn mid-write
+            "\x00\x01 binary junk",
+            "[1, 2, 3]",                                # not an object
+            '{"peak_flops": true, "hbm_bw": "fast"}',   # bool/str schema
+            '{"peak_flops": NaN, "hbm_bw": Infinity}',  # non-finite
+            '{"name": "v9", "peak_flops": -1}',         # nothing usable
+        ]
+        for i, payload in enumerate(corruptions):
+            bad.write_text(payload)
+            os.utime(bad, (i + 1, i + 1))  # distinct mtime per shape
+            with pytest.warns(RuntimeWarning,
+                              match="corrupt calibration"):
+                assert costs.load_calibration() is None
+            with warnings.catch_warnings():  # same mtime: cached, quiet
+                warnings.simplefilter("error")
+                assert costs.load_calibration() is None
+        # the table still resolves underneath the broken calibration
+        prof = costs.device_profile("TPU v4")
+        assert prof is not None and not prof.name.endswith("+cal")
+        # a repaired file heals on the next mtime, no process restart
+        bad.write_text(json.dumps({"peak_flops": 1e11, "hbm_bw": 1e10}))
+        os.utime(bad, (999, 999))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            doc = costs.load_calibration()
+        assert doc["peak_flops"] == pytest.approx(1e11)
+        assert costs.device_profile("TPU v4").name.endswith("+cal")
 
     def test_prediction_carries_device_profile(self, monkeypatch):
         monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "1e13")
