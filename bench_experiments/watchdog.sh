@@ -52,6 +52,12 @@ PY
   echo "== autopilot_lane start $(date -u)" >> $LOG
   bash bench_experiments/autopilot_lane.sh > .bench_runs/autopilot_lane.log 2>&1
   echo "== autopilot_lane done rc=$? $(date -u)" >> $LOG
+  # integrity lane (ISSUE 17): digest envelopes + corruption drills +
+  # SDC sentinel quarantine + overhead budgets. Non-blocking like the
+  # other lanes — a red drill is recorded for the next session.
+  echo "== integrity_lane start $(date -u)" >> $LOG
+  bash bench_experiments/integrity_lane.sh > .bench_runs/integrity_lane.log 2>&1
+  echo "== integrity_lane done rc=$? $(date -u)" >> $LOG
   for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
     # an experiment whose json already holds variants is DONE — its
     # results are cited in BENCHMARKS.md and must not be clobbered by

@@ -48,8 +48,9 @@ class AutopilotAction:
     """One decision of the control loop.
 
     ``kind`` names the move (``calibrate`` / ``scale_up`` /
-    ``reprice`` / ``reweight`` / ``kill_replica`` / ``replan`` /
-    ``apply_plan`` / ``rollback``), ``trigger`` names the condition
+    ``reprice`` / ``reweight`` / ``kill_replica`` /
+    ``quarantine_replica`` / ``replan`` / ``apply_plan`` /
+    ``rollback``), ``trigger`` names the condition
     that demanded it (``slo:<tenant>:<leg>``, ``drift:<fingerprint>``,
     ``cadence``), and ``outcome`` tracks its lifecycle:
 
@@ -163,18 +164,11 @@ class DecisionJournal:
     def read_jsonl(path):
         """Load a journal file back as a list of action dicts. A torn
         final line (crash mid-append) is skipped, matching the
-        append-only write discipline."""
-        out = []
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        out.append(json.loads(line))
-                    except ValueError:
-                        continue
-        except OSError:
-            return []
+        append-only write discipline; skipped lines bump
+        ``integrity.jsonl_dropped`` (shared tolerant reader)."""
+        from ..integrity import jsonl as _jsonl
+
+        out, dropped = _jsonl.read_jsonl(path)
+        if dropped:
+            obs.inc("integrity.jsonl_dropped", dropped)
         return out

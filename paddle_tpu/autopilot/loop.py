@@ -1,7 +1,7 @@
 """The self-healing control loop: ledger -> planner -> fleet.
 
 :class:`Autopilot` closes the observe/decide/act cycle the previous
-subsystems left open. One :meth:`tick` runs three legs in order:
+subsystems left open. One :meth:`tick` runs four legs in order:
 
 1. **calibrate** — measured step times the serving/bench loops feed
    into the :class:`~paddle_tpu.observability.ExecutableLedger` are
@@ -18,7 +18,11 @@ subsystems left open. One :meth:`tick` runs three legs in order:
    healthy baseline), warm-standby ``scale_up`` on the classic
    router, admission ``reweight`` (demote best-effort tenants one
    priority class) otherwise.
-3. **drift** — when a measured step time departs the *calibrated*
+3. **integrity** — pending SDC-sentinel replay disagreements are put
+   to a cross-replica vote; a replica its peers confirm as lying is
+   pulled from rotation with ``quarantine_replica`` (journaled,
+   gated, traced — and never the last decode replica).
+4. **drift** — when a measured step time departs the *calibrated*
    re-prediction beyond ``drift_tolerance_pct``, the planner re-ranks
    under the calibrated profile (``replan`` callback, typically a
    ``plan_search`` wrapper) and proposes the new config; in ``apply``
@@ -68,6 +72,9 @@ class Autopilot:
     - ``tenants`` — a TenantTable; arms the SLO leg (burn rates) and
       the ``reweight`` remediation.
     - ``disagg`` — a DisaggRouter; arms ``kill_replica``+migrate.
+    - ``sentinel`` — an :class:`~paddle_tpu.integrity.sentinel.
+      SDCSentinel`; arms the integrity leg (cross-replica vote +
+      ``quarantine_replica`` for confirmed-lying decode replicas).
     - ``router`` — a ServingRouter; arms warm-standby ``scale_up``.
     - ``replan`` — ``callable(profile) -> proposal dict``; the drift
       leg's planner hook (wrap ``plan_search`` + ``best_runnable``).
@@ -82,8 +89,9 @@ class Autopilot:
     """
 
     def __init__(self, ledger=None, tenants=None, router=None,
-                 disagg=None, replan=None, measure=None, apply=None,
-                 rollback=None, mode=None, journal=None, gate=None,
+                 disagg=None, sentinel=None, replan=None, measure=None,
+                 apply=None, rollback=None, mode=None, journal=None,
+                 gate=None,
                  calibration_path=None, device_kind=None,
                  burn_threshold=1.0, slo_budget=0.1,
                  drift_tolerance_pct=50.0, verify_tolerance_pct=15.0,
@@ -94,6 +102,7 @@ class Autopilot:
         self.tenants = tenants
         self.router = router
         self.disagg = disagg
+        self.sentinel = sentinel
         self.replan = replan
         self.measure = measure
         self.apply = apply
@@ -167,6 +176,7 @@ class Autopilot:
         actions = []
         self._leg_calibrate(actions, mode)
         self._leg_slo(actions, mode)
+        self._leg_integrity(actions, mode)
         self._leg_drift(actions, mode)
         return actions
 
@@ -445,7 +455,92 @@ class Autopilot:
                                       priority=spec.priority + 1)
         return demoted
 
-    # -- leg 3: re-plan on drift --------------------------------------------
+    # -- leg 3: SDC sentinel quarantine -------------------------------------
+    def _leg_integrity(self, actions, mode):
+        """Drain the SDC sentinel: run the cross-replica vote on any
+        pending replay disagreements, then quarantine every
+        confirmed-lying replica — journaled, gated, traced, and never
+        the last decode replica (losing the fleet is strictly worse
+        than corruption the sentinel already withheld)."""
+        sent = self.sentinel
+        if sent is None:
+            return
+        try:
+            if sent.pending:
+                sent.vote()
+            verdicts = sent.confirmed_verdicts()
+        except Exception:  # noqa: BLE001 — sentinel is best-effort
+            obs.inc("autopilot.sentinel_errors")
+            return
+        for verdict in verdicts:
+            self._quarantine_confirmed(actions, mode, verdict)
+
+    def _quarantine_confirmed(self, actions, mode, verdict):
+        """One confirmed SDC verdict -> a gated ``quarantine_replica``
+        action, mirroring the kill path's detect/act/verify spans on
+        one incident trace."""
+        rid = verdict.get("replica")
+        trigger = "sdc:%s" % (rid,)
+        ctx = obs.TraceContext.new()
+        with self._span("autopilot.detect", ctx, trigger=trigger,
+                        replica=str(rid), step=verdict.get("step"),
+                        votes=verdict.get("votes"),
+                        peers=verdict.get("peers")) as sp:
+            ictx = sp.ctx if sp is not None else ctx
+        act = AutopilotAction(
+            "quarantine_replica", trigger, mode,
+            detail={"replica": rid, "step": verdict.get("step"),
+                    "votes": verdict.get("votes"),
+                    "peers": verdict.get("peers"),
+                    "digest_live": verdict.get("digest_live"),
+                    "majority_digest": verdict.get("majority_digest")})
+        if self.disagg is None:
+            actions.append(self._record(act.resolve(
+                "rejected", reason="no disagg router"), ctx=ictx))
+            return
+        if not self.gate.ready("quarantine_replica"):
+            actions.append(self._record(act.resolve(
+                "rejected", reason="gate cooldown"), ctx=ictx))
+            return
+        _, decode_live = self.disagg.live_replicas()
+        # the sentinel stringifies replica ids; map back to the
+        # router's native rid before acting
+        live_map = {str(r): r for r in decode_live}
+        if str(rid) not in live_map:
+            actions.append(self._record(act.resolve(
+                "rejected", reason="replica already gone"), ctx=ictx))
+            return
+        if len(decode_live) <= 1:
+            actions.append(self._record(act.resolve(
+                "rejected", reason="last decode replica"), ctx=ictx))
+            return
+        rid = live_map[str(rid)]
+        if mode != "apply":
+            actions.append(self._record(act, ctx=ictx))
+            return
+        before = self.disagg.stats().get("failed_streams", 0)
+        with self._span("autopilot.act", ictx,
+                        kind="quarantine_replica", replica=str(rid)):
+            try:
+                self.disagg.quarantine_replica(rid)
+            except KeyError:
+                actions.append(self._record(act.resolve(
+                    "rejected", reason="replica already gone"),
+                    ctx=ictx))
+                return
+        self.gate.stamp("quarantine_replica")
+        self._lat_baseline.pop(rid, None)
+        failed = (self.disagg.stats().get("failed_streams", 0)
+                  - before)
+        with self._span("autopilot.verify", ictx,
+                        kind="quarantine_replica",
+                        failed_streams=failed):
+            pass
+        actions.append(self._record(act.resolve(
+            "verified" if failed == 0 else "applied",
+            failed_streams=failed), ctx=ictx))
+
+    # -- leg 4: re-plan on drift --------------------------------------------
     def _leg_drift(self, actions, mode):
         """Score measured step times against the *calibrated*
         re-prediction. Until the first calibration fit the leg stays

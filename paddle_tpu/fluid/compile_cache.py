@@ -42,8 +42,21 @@ serving engine's shape-bucket warmup (``paddle_tpu.serving``) — a
 restarted server deserializes its whole bucket ladder instead of
 compiling.
 
+Every entry is sealed in an integrity envelope
+(:mod:`paddle_tpu.integrity.envelope`): a content digest is verified
+*before* ``jax.export`` deserialization, so a bitflipped blob is caught
+by the digest check rather than by whatever the deserializer happens to
+notice. Both failure classes share the evict-and-recompile path but are
+counted separately — ``compile_cache.corrupt_digest`` (envelope check
+failed) vs ``compile_cache.corrupt_deserialize`` (digest fine, decoder
+rejected it; points at a format/version skew, not disk rot) — with
+``compile_cache.corrupt`` as the total. Reads and writes route through
+the ``load`` / ``save`` corruption fault sites
+(:func:`paddle_tpu.fluid.resilience.fault_corrupt`) for chaos drills.
+
 Telemetry (``paddle_tpu.observability``): ``compile_cache.disk_hit`` /
-``disk_miss`` / ``corrupt`` / ``store`` / ``store_error`` counters and
+``disk_miss`` / ``corrupt`` / ``corrupt_digest`` /
+``corrupt_deserialize`` / ``store`` / ``store_error`` counters and
 ``compile_cache.deserialize_seconds`` / ``serialize_seconds``
 histograms.
 """
@@ -65,8 +78,11 @@ __all__ = [
 ]
 
 CACHE_DIR_ENV = "PADDLE_TPU_COMPILE_CACHE_DIR"
-_FORMAT_VERSION = 1
+# v2: entries are sealed in an integrity envelope (digest-before-
+# deserialize); v1 blobs simply miss under the new keys and re-fill.
+_FORMAT_VERSION = 2
 _SUFFIX = ".jaxexp"
+_ENTRY_KIND = "compile-cache"
 
 _lock = threading.Lock()
 _default_dir = None     # programmatic activation (TrainGuard co-location)
@@ -249,33 +265,51 @@ def has(key):
     return d is not None and os.path.exists(_entry_path(key))
 
 
+def _evict_corrupt(path, key, check, error):
+    """Shared corrupt-entry path: count which check failed (the
+    envelope digest vs the jax.export deserializer), event it, and
+    evict so a recompile fills the entry back."""
+    obs.inc("compile_cache.corrupt")
+    obs.inc("compile_cache.corrupt_%s" % check)
+    obs.event("compile_cache_corrupt", source="executor", count=False,
+              key=key, check=check,
+              error="%s: %s" % (type(error).__name__, error))
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
 def load(key):
     """Fetch the compiled artifact for `key` from disk, or None. Hits
-    deserialize via ``jax.export``; corrupt/unreadable entries are
-    removed and treated as misses (recompile fills them back)."""
+    verify the envelope digest, then deserialize via ``jax.export``;
+    corrupt/unreadable entries are removed and treated as misses
+    (recompile fills them back), counting which check caught them."""
+    from ..integrity import envelope
+    from .resilience import fault_corrupt
+
     d = cache_dir()
     if d is None:
         return None
     path = _entry_path(key)
     try:
         with open(path, "rb") as f:
-            blob = f.read()
+            raw = fault_corrupt("load", f.read())
     except OSError:
         obs.inc("compile_cache.disk_miss")
         return None
     t0 = time.monotonic()
     try:
+        blob = envelope.unseal_bytes(raw, kind=_ENTRY_KIND, path=path)
+    except IOError as e:  # IntegrityError — digest caught it first
+        _evict_corrupt(path, key, "digest", e)
+        return None
+    try:
         from jax import export as jax_export
 
         entry = _DiskEntry(jax_export.deserialize(blob), key)
     except Exception as e:  # noqa: BLE001 — corrupt entry == miss
-        obs.inc("compile_cache.corrupt")
-        obs.event("compile_cache_corrupt", source="executor", count=False,
-                  key=key, error="%s: %s" % (type(e).__name__, e))
-        try:
-            os.remove(path)
-        except OSError:
-            pass
+        _evict_corrupt(path, key, "deserialize", e)
         return None
     dt = time.monotonic() - t0
     obs.inc("compile_cache.disk_hit")
@@ -291,6 +325,9 @@ def store(key, jitted, args):
     last replace wins with identical content). Failures warn once and
     are otherwise ignored: the cache is an optimization, never a
     correctness dependency."""
+    from ..integrity import envelope
+    from .resilience import fault_corrupt
+
     global _warned_store
     d = cache_dir()
     if d is None:
@@ -300,11 +337,13 @@ def store(key, jitted, args):
         from jax import export as jax_export
 
         blob = jax_export.export(jitted)(*args).serialize()
+        sealed = fault_corrupt(
+            "save", envelope.seal_bytes(blob, kind=_ENTRY_KIND))
         os.makedirs(d, exist_ok=True)
         path = _entry_path(key)
         tmp = "%s.tmp.%d.%s" % (path, os.getpid(), uuid.uuid4().hex[:8])
         with open(tmp, "wb") as f:
-            f.write(blob)
+            f.write(sealed)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)

@@ -35,16 +35,22 @@ Fault spec grammar (clauses joined by ``;`` or ``,``)::
     site     := "run" | "feed" | "save" | "fetch"
               | "collective" | "barrier" | "heartbeat"
               | "dispatch" | "replica"
+              | "load" | "wire" | "mailbox"
     trigger  := "every=" N | "at=" N      (N counts checks at that site,
                                            1-based)
     action   := exception class name (builtins or "EOFException"),
                 "nan" (site "fetch" only: corrupt the first fetched
                 float into NaN), "slow" (sleep
                 PADDLE_TPU_FAULT_SLOW_S seconds, default 0.25 — the
-                straggler/slow-replica drill), or "slow=" SECONDS
+                straggler/slow-replica drill), "slow=" SECONDS
                 (per-clause duration, e.g. ``dispatch:every=1:slow=0.05``
                 — degrade one site without re-pacing every other slow
-                clause in the spec)
+                clause in the spec), or "corrupt=" MODE (byte-path
+                corruption: MODE is "bitflip" | "truncate" | "torn",
+                sites "save" | "load" | "wire" | "mailbox" only —
+                ``wire:at=1:corrupt=bitflip`` flips a bit in the next
+                KV handoff so the digest-verification/remediation path
+                is drillable; see paddle_tpu/integrity/)
 
 The fleet-level sites (see ``parallel/elastic.py``): ``collective``
 fires in the collective-op lowerings (``ops/collective_ops.py``) and
@@ -82,6 +88,8 @@ __all__ = [
     "EventLog", "StepReport", "StepTimeoutError", "NonFiniteError",
     "CollectiveTimeoutError", "collective_deadline", "collective_check",
     "deadline_remaining", "fault_check", "fault_nonfinite", "run_guarded",
+    "fault_corrupt", "fault_corrupt_mode", "corrupt_bytes",
+    "corrupt_array",
 ]
 
 FAULT_SPEC_ENV = "PADDLE_TPU_FAULT_SPEC"
@@ -180,6 +188,9 @@ def collective_check(what, site="collective"):
 _NAN_ACTION = "nan"
 _SLOW_ACTION = "slow"
 _SLOW_S_ENV = "PADDLE_TPU_FAULT_SLOW_S"
+_CORRUPT_ACTION = "corrupt"
+CORRUPT_MODES = frozenset({"bitflip", "truncate", "torn"})
+CORRUPT_SITES = frozenset({"save", "load", "wire", "mailbox"})
 
 
 def _slow_seconds():
@@ -191,21 +202,23 @@ def _slow_seconds():
 
 _CLAUSE_RE = re.compile(
     r"^(?P<site>[a-z_]+):(?P<mode>every|at)=(?P<n>\d+)"
-    r":(?P<action>\w+)(?:=(?P<arg>[0-9.]+))?$"
+    r":(?P<action>\w+)(?:=(?P<arg>[A-Za-z0-9.]+))?$"
 )
 
 
 class _Clause:
     __slots__ = ("site", "mode", "n", "action_name", "exc", "slow_s",
-                 "checks", "fires")
+                 "corrupt_mode", "checks", "fires")
 
-    def __init__(self, site, mode, n, action_name, exc, slow_s=None):
+    def __init__(self, site, mode, n, action_name, exc, slow_s=None,
+                 corrupt_mode=None):
         self.site = site
         self.mode = mode
         self.n = n
         self.action_name = action_name
         self.exc = exc  # exception class, or None for the "nan" action
         self.slow_s = slow_s  # per-clause 'slow' duration override
+        self.corrupt_mode = corrupt_mode  # bitflip | truncate | torn
         self.checks = 0
         self.fires = 0
 
@@ -248,7 +261,8 @@ class FaultInjector:
 
     SITES = frozenset({"run", "feed", "save", "fetch",
                        "collective", "barrier", "heartbeat",
-                       "dispatch", "replica"})
+                       "dispatch", "replica",
+                       "load", "wire", "mailbox"})
 
     _installed = None   # programmatic injector, wins over the env var
     _env_cached = None  # injector parsed from the env spec, counters live
@@ -278,11 +292,14 @@ class FaultInjector:
                 )
             if n <= 0:
                 raise FaultSpecError("fault trigger count must be >= 1")
-            if arg is not None and action != _SLOW_ACTION:
+            if arg is not None and action not in (_SLOW_ACTION,
+                                                  _CORRUPT_ACTION):
                 raise FaultSpecError(
                     "action argument %r only applies to 'slow' "
-                    "(slow=SECONDS), not %r" % (arg, action))
+                    "(slow=SECONDS) or 'corrupt' (corrupt=MODE), "
+                    "not %r" % (arg, action))
             slow_s = None
+            corrupt_mode = None
             if action == _NAN_ACTION:
                 if site != "fetch":
                     raise FaultSpecError(
@@ -300,9 +317,26 @@ class FaultInjector:
                     if slow_s < 0:
                         raise FaultSpecError(
                             "slow duration must be >= 0, got %r" % arg)
+            elif action == _CORRUPT_ACTION:
+                exc = None  # mutates payload bytes instead of raising
+                if site not in CORRUPT_SITES:
+                    raise FaultSpecError(
+                        "action 'corrupt' only applies to byte-path "
+                        "sites (%s), not %r"
+                        % (", ".join(sorted(CORRUPT_SITES)), site))
+                if arg is None:
+                    raise FaultSpecError(
+                        "action 'corrupt' needs a mode "
+                        "(corrupt=bitflip|truncate|torn)")
+                if arg not in CORRUPT_MODES:
+                    raise FaultSpecError(
+                        "bad corrupt mode %r (want %s)"
+                        % (arg, "|".join(sorted(CORRUPT_MODES))))
+                corrupt_mode = arg
             else:
                 exc = _resolve_exception(action)
-            clause = _Clause(site, mode, n, action, exc, slow_s=slow_s)
+            clause = _Clause(site, mode, n, action, exc, slow_s=slow_s,
+                             corrupt_mode=corrupt_mode)
             self.clauses.append(clause)
             by_site[site].append(clause)
         if not self.clauses:
@@ -345,6 +379,11 @@ class FaultInjector:
         nan_fired = False
         fire = None
         for clause in self._by_site.get(site, ()):
+            if clause.action_name == _CORRUPT_ACTION:
+                # corrupt clauses fire only where payload bytes flow
+                # (fault_corrupt); counting them here would skew their
+                # trigger schedule against the byte-path call sites.
+                continue
             if clause.poke():
                 if clause.action_name == _SLOW_ACTION:
                     time.sleep(clause.slow_s
@@ -360,6 +399,21 @@ class FaultInjector:
                 % (site, fire.checks, self.spec)
             )
         return nan_fired
+
+    def corrupt_mode(self, site):
+        """Count a byte-path check at `site`; the fired corrupt
+        clause's mode ('bitflip' | 'truncate' | 'torn'), or None."""
+        mode = None
+        for clause in self._by_site.get(site, ()):
+            if clause.action_name != _CORRUPT_ACTION:
+                continue
+            if clause.poke() and mode is None:
+                mode = clause.corrupt_mode
+        if mode is not None:
+            obs.inc("integrity.fault_corrupt_fired")
+            obs.event("fault_corrupt", source="resilience",
+                      site=site, mode=mode)
+        return mode
 
     def stats(self):
         """Per-clause counters for assertions/observability."""
@@ -383,6 +437,68 @@ def fault_nonfinite(site="fetch"):
     this to corrupt a fetched loss, testing the non-finite guard)."""
     inj = FaultInjector.active()
     return bool(inj is not None and inj.check(site))
+
+
+def fault_corrupt_mode(site):
+    """The corrupt mode fired at a byte-path `site` this check, or
+    None. Callers with non-bytes payloads (in-memory KV handoffs) use
+    this with :func:`corrupt_array`; byte writers use
+    :func:`fault_corrupt` directly."""
+    inj = FaultInjector.active()
+    if inj is None:
+        return None
+    return inj.corrupt_mode(site)
+
+
+def corrupt_bytes(mode, data):
+    """Deterministically corrupt a bytes payload: 'bitflip' flips one
+    bit in the middle byte, 'truncate' keeps only the first half,
+    'torn' drops a short tail (a partially flushed write)."""
+    data = bytes(data)
+    if not data:
+        return data
+    if mode == "bitflip":
+        buf = bytearray(data)
+        buf[len(buf) // 2] ^= 0x01
+        return bytes(buf)
+    if mode == "truncate":
+        return data[:len(data) // 2]
+    if mode == "torn":
+        return data[:len(data) - max(1, len(data) // 8)]
+    raise ValueError("unknown corrupt mode %r" % (mode,))
+
+
+def corrupt_array(mode, arr):
+    """Shape-preserving array corruption for in-memory transports
+    (the object must stay well-formed; the content digest still
+    catches it): 'bitflip' flips one bit, 'truncate' zeroes the
+    second half of the flattened payload, 'torn' zeroes a short
+    tail."""
+    a = np.array(np.asarray(arr), copy=True)
+    if a.size == 0:
+        return a
+    raw = bytearray(a.tobytes())
+    if mode == "bitflip":
+        raw[len(raw) // 2] ^= 0x01
+    elif mode == "truncate":
+        half = len(raw) // 2
+        raw[half:] = b"\x00" * (len(raw) - half)
+    elif mode == "torn":
+        tail = max(1, len(raw) // 8)
+        raw[len(raw) - tail:] = b"\x00" * tail
+    else:
+        raise ValueError("unknown corrupt mode %r" % (mode,))
+    return np.frombuffer(bytes(raw), a.dtype).reshape(a.shape)
+
+
+def fault_corrupt(site, data):
+    """Route a bytes payload through any armed corrupt clause at
+    `site`; returns the (possibly corrupted) bytes. Inert without an
+    injector — one dict lookup like every other site hook."""
+    mode = fault_corrupt_mode(site)
+    if mode is None:
+        return data
+    return corrupt_bytes(mode, data)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,56 @@
+"""End-to-end data integrity (PR 17).
+
+Every host<->disk and host<->host byte path in paddle_tpu carries a
+content digest so torn writes, bit rot, and silent data corruption are
+*detected and attributed* instead of deserialized into the job:
+
+- :mod:`~paddle_tpu.integrity.digest` — sha256 content digests for
+  byte payloads and tensors, plus :class:`IntegrityError` (an
+  ``IOError`` subclass so existing fall-back paths treat a digest
+  failure like any other unreadable artifact).
+- :mod:`~paddle_tpu.integrity.envelope` — the versioned wire format:
+  sealed byte blobs (magic + header + payload) for compile-cache
+  entries, JSON manifests with per-tensor digests for checkpoint
+  steps, and ``_integrity``-stamped JSON docs for FileStore
+  mailboxes.
+- :mod:`~paddle_tpu.integrity.jsonl` — the one tolerant JSONL/JSON
+  reader (torn/blank final-line skip + ``dropped`` count) shared by
+  the decision journal, distributed span collection, and FileStore.
+- :mod:`~paddle_tpu.integrity.sentinel` — the SDC sentinel:
+  deterministically sampled decode-step replay (re-dispatch the same
+  program + feeds, compare fetch digests) plus a cross-replica vote
+  that turns a confirmed-disagreeing replica into a
+  ``quarantine_replica`` autopilot action.
+
+Corruption is drillable end to end via the ``corrupt=`` fault-spec
+arms (``PADDLE_TPU_FAULT_SPEC="wire:at=1:corrupt=bitflip"``, see
+:mod:`paddle_tpu.fluid.resilience`).
+
+The package import is deliberately lazy — ``jsonl`` is pure stdlib so
+observability can use it without pulling numpy/jax.
+"""
+
+_SUBMODULES = ("digest", "envelope", "jsonl", "sentinel")
+_NAMES = {
+    "IntegrityError": "digest",
+    "bytes_digest": "digest",
+    "tensor_digest": "digest",
+    "digest_state": "digest",
+    "state_mismatches": "digest",
+    "doc_digest": "digest",
+    "SDCSentinel": "sentinel",
+}
+
+
+def __getattr__(name):
+    import importlib
+    if name in _SUBMODULES:
+        return importlib.import_module("." + name, __name__)
+    mod = _NAMES.get(name)
+    if mod is not None:
+        return getattr(importlib.import_module("." + mod, __name__), name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
+def __dir__():
+    return sorted(list(_SUBMODULES) + list(_NAMES) + list(globals()))

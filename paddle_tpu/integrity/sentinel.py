@@ -1,0 +1,177 @@
+"""SDC sentinel: catch the chip that computes the wrong answer.
+
+Silent data corruption does not crash — a defective core returns
+plausible garbage with a clean exit code. The sentinel's contract:
+
+1. **Sampled replay** — every ``check_every``-th decode/exec step is
+   re-dispatched with the *same* program and the *same* feeds, and the
+   fetch digests are compared. The step is deterministic (one jitted
+   XLA module, fixed inputs), so any disagreement is hardware lying,
+   not numerics. The check runs *before* the step's tokens are
+   emitted, so a disagreeing step never serves its output.
+2. **Cross-replica vote** — a replay disagreement is a suspicion, not
+   a verdict. Peer replicas re-run the suspect's feeds; if the peers
+   agree with each other (majority digest) the suspect is confirmed
+   as the liar.
+3. **Quarantine** — confirmed verdicts are drained by the autopilot,
+   which mints a journaled, gated, traced ``quarantine_replica``
+   action (never the last replica) that pulls the chip out of
+   rotation; live sessions migrate bit-exactly.
+
+Counters: ``integrity.sdc_replay_ok`` / ``sdc_replay_disagree`` /
+``sdc_vote_confirmed`` / ``sdc_vote_inconclusive``; events
+``integrity_sdc_disagree`` / ``integrity_sdc_confirmed``.
+"""
+import collections
+import os
+import threading
+import time
+
+from .. import observability as obs
+from .digest import tensor_digest
+
+# Default replay sampling period. At 1-in-128 the replay adds ~0.8%
+# to steady-state step cost — inside the <2% overhead budget with
+# headroom for the digest transfers.
+DEFAULT_CHECK_EVERY = 128
+_CHECK_EVERY_ENV = "PADDLE_TPU_SDC_CHECK_EVERY"
+
+
+def fetch_digest(outs):
+    """One digest for a whole fetch set (dict or sequence of arrays),
+    order-independent for dicts."""
+    if isinstance(outs, dict):
+        items = [(str(k), outs[k]) for k in sorted(outs, key=str)]
+    else:
+        items = [(str(i), v) for i, v in enumerate(outs)]
+    import hashlib
+    h = hashlib.sha256()
+    for name, v in items:
+        h.update(name.encode("utf-8"))
+        h.update(tensor_digest(v).encode("ascii"))
+    return "sha256:" + h.hexdigest()
+
+
+class SDCSentinel:
+    """Deterministically sampled replay checker + cross-replica vote.
+
+    ``check_every`` defaults to ``PADDLE_TPU_SDC_CHECK_EVERY`` (else
+    128); ``0`` disarms sampling entirely (``sample`` is then a pure
+    counter bump). Engines attach via
+    ``DecodeEngine.attach_sentinel``; the disagg router registers one
+    replay callable per decode replica so votes can re-run a
+    suspect's feeds on its peers.
+    """
+
+    def __init__(self, check_every=None):
+        if check_every is None:
+            check_every = int(
+                os.environ.get(_CHECK_EVERY_ENV, DEFAULT_CHECK_EVERY))
+        self.check_every = int(check_every)
+        self._lock = threading.Lock()
+        self._counts = {}
+        self.pending = collections.deque()    # disagreements -> vote
+        self.confirmed = collections.deque()  # verdicts -> autopilot
+        self._replay_fns = {}                 # rid -> feeds -> outs
+
+    # -- replica registry (for votes) -------------------------------------
+    def register(self, replica, replay_fn):
+        with self._lock:
+            self._replay_fns[str(replica)] = replay_fn
+
+    def unregister(self, replica):
+        with self._lock:
+            self._replay_fns.pop(str(replica), None)
+
+    # -- sampling + replay -------------------------------------------------
+    def sample(self, replica="default"):
+        """True on the deterministically chosen steps for ``replica``."""
+        with self._lock:
+            n = self._counts[replica] = self._counts.get(replica, 0) + 1
+        return self.check_every > 0 and n % self.check_every == 0
+
+    def replay_check(self, replica, run_fn, outs, feeds=None, step=None):
+        """Re-dispatch and compare. True = digests agree; False files
+        a pending disagreement for the cross-replica vote.
+
+        ``run_fn`` must re-run the *same* program on the *same* feeds
+        (callers capture the feed refs before the live dispatch
+        mutates engine state).
+        """
+        d0 = fetch_digest(outs)
+        t0 = time.monotonic()
+        outs2 = run_fn()
+        d1 = fetch_digest(outs2)
+        obs.observe("integrity.sdc_replay_seconds", time.monotonic() - t0)
+        if d0 == d1:
+            obs.inc("integrity.sdc_replay_ok")
+            return True
+        obs.inc("integrity.sdc_replay_disagree")
+        obs.event("integrity_sdc_disagree", source="integrity",
+                  replica=str(replica), step=step,
+                  digest_live=d0[:23], digest_replay=d1[:23])
+        with self._lock:
+            self.pending.append({"replica": str(replica), "feeds": feeds,
+                                 "digests": (d0, d1), "step": step})
+        return False
+
+    # -- cross-replica vote ------------------------------------------------
+    def vote(self):
+        """Adjudicate one pending disagreement; returns the verdict
+        dict if the suspect is confirmed, else ``None``.
+
+        Peers (every registered replica except the suspect) re-run the
+        suspect's feeds; the majority digest among peers is the
+        reference answer. The suspect already disagreed with *itself*
+        (live vs replay), so peers converging on any answer confirms
+        the suspect as the unstable party. No peers, or peers that
+        cannot agree, is inconclusive — never a quarantine.
+        """
+        with self._lock:
+            if not self.pending:
+                return None
+            entry = self.pending.popleft()
+            peers = {rid: fn for rid, fn in self._replay_fns.items()
+                     if rid != entry["replica"]}
+        votes = {}
+        for rid, fn in peers.items():
+            try:
+                votes[rid] = fetch_digest(fn(entry["feeds"]))
+            except Exception:  # noqa: BLE001 — a dead peer abstains
+                continue
+        tally = collections.Counter(votes.values())
+        top = tally.most_common(1)
+        quorum = len(votes) // 2 + 1
+        if not top or top[0][1] < quorum:
+            obs.inc("integrity.sdc_vote_inconclusive")
+            obs.event("integrity_sdc_vote_inconclusive",
+                      source="integrity", replica=entry["replica"],
+                      peers=len(votes))
+            return None
+        verdict = {"replica": entry["replica"], "step": entry["step"],
+                   "peers": len(votes), "votes": top[0][1],
+                   "majority_digest": top[0][0][:23],
+                   "digest_live": entry["digests"][0][:23],
+                   "digest_replay": entry["digests"][1][:23]}
+        obs.inc("integrity.sdc_vote_confirmed")
+        obs.event("integrity_sdc_confirmed", source="integrity",
+                  **verdict)
+        with self._lock:
+            self.confirmed.append(verdict)
+        return verdict
+
+    def confirmed_verdicts(self):
+        """Drain confirmed verdicts (autopilot consumes these)."""
+        out = []
+        with self._lock:
+            while self.confirmed:
+                out.append(self.confirmed.popleft())
+        return out
+
+    def stats(self):
+        with self._lock:
+            return {"check_every": self.check_every,
+                    "replicas": sorted(self._replay_fns),
+                    "sampled": dict(self._counts),
+                    "pending": len(self.pending),
+                    "confirmed": len(self.confirmed)}

@@ -251,6 +251,58 @@ self-healing control loop over the ledger/SLO/planner signals above):
   ``.replan`` / ``.act`` / ``.apply`` / ``.verify`` spans on the
   request timeline.
 
+Well-known data-integrity metrics (PR 17, ``paddle_tpu.integrity``):
+
+- ``integrity.checkpoint_manifests_written`` counter — per-tensor
+  digest manifests written alongside checkpoint saves;
+  ``integrity.checkpoint_verified`` — restores whose tensors all
+  matched; ``integrity.checkpoint_digest_mismatch`` — tensors that
+  did not (restore raises an attributed ``IntegrityError``, consensus
+  restore falls back a step); ``integrity.checkpoint_manifest_corrupt``
+  — manifests present but unreadable.
+  ``integrity.checkpoint_digest_seconds`` / ``checkpoint_verify_seconds``
+  histograms price the digest passes (<5% of the save budget).
+- ``integrity.handoff_digest_mismatch`` counter — KV handoffs whose
+  sealed digest failed on adopt (the stream re-prefills via the
+  migration path; ``failed_streams`` stays 0).
+- ``integrity.sdc_replay_ok`` / ``sdc_replay_disagree`` counters and
+  ``integrity.sdc_replay_seconds`` histogram — the SDC sentinel's
+  sampled step replays (1-in-``PADDLE_TPU_SDC_CHECK_EVERY``, default
+  128); ``integrity.sdc_vote_confirmed`` / ``sdc_vote_inconclusive``
+  — cross-replica vote outcomes; ``integrity.replicas_quarantined``
+  — confirmed liars pulled from rotation by the autopilot's
+  ``quarantine_replica`` action.
+- ``integrity.fault_corrupt_fired`` counter — armed ``corrupt=``
+  fault-arm firings; ``compile_cache.corrupt_digest`` /
+  ``corrupt_deserialize`` split the existing ``compile_cache.corrupt``
+  total by which check caught the entry.
+- ``integrity.jsonl_dropped`` counter — torn/unparseable lines skipped
+  by the shared tolerant JSONL reader (decision journal, trace
+  collector); ``integrity.mailbox_doc_torn`` / ``mailbox_doc_corrupt``
+  — FileStore mailbox docs dropped for a torn write vs a failing
+  ``_integrity`` stamp.
+- ``integrity_violation`` events name the failing check
+  (``manifest`` / ``digest`` / ``done-marker`` / ``kv_handoff`` /
+  ``mailbox``) and, where known, the tensor — attribution rides the
+  event, not just the counter.
+
+Corruption fault grammar (``fluid.resilience``, chaos drills)::
+
+    site:every=N:corrupt=MODE    # or site:at=N:corrupt=MODE
+
+    site  | save    host->disk writes: checkpoint manifests,
+          |         compile-cache entries
+          | load    disk->host reads of the same artifacts
+          | wire    the prefill->decode KV handoff payload
+          | mailbox elastic FileStore doc writes
+    MODE  | bitflip flip one bit mid-payload (silent corruption)
+          | truncate keep the first half (short read/write)
+          | torn    drop the tail (interrupted append)
+
+``corrupt=`` arms only those four byte-path sites; parse rejects any
+other site, a missing mode, or an unknown mode. All other sites keep
+their existing arms (``exception`` / ``slow=SECONDS`` / ``hang`` ...).
+
 This package is stdlib-only (no jax/numpy imports at module level), so
 crash-path and supervisor code can use it without accelerator init.
 """

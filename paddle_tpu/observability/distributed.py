@@ -260,9 +260,13 @@ def export_span(name, ctx, wall0, dur, fields=None):
 
 def read_spans(directory):
     """All span records under `directory` (every ``trace-*.jsonl``),
-    skipping unparseable lines (a process killed mid-write leaves a
-    torn tail — that must not sink the whole merge)."""
+    skipping unparseable lines via the shared tolerant reader (a
+    process killed mid-write leaves a torn tail — that must not sink
+    the whole merge; skipped lines bump ``integrity.jsonl_dropped``)."""
+    from ..integrity import jsonl as _jsonl
+
     spans = []
+    dropped = 0
     try:
         names = sorted(os.listdir(directory))
     except OSError:
@@ -270,21 +274,14 @@ def read_spans(directory):
     for fn in names:
         if not (fn.startswith("trace-") and fn.endswith(".jsonl")):
             continue
-        path = os.path.join(directory, fn)
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    if isinstance(rec, dict) and "span" in rec:
-                        spans.append(rec)
-        except OSError:
-            continue
+        records, bad = _jsonl.read_jsonl(os.path.join(directory, fn))
+        dropped += bad
+        spans.extend(r for r in records
+                     if isinstance(r, dict) and "span" in r)
+    if dropped:
+        from . import inc as _inc
+
+        _inc("integrity.jsonl_dropped", dropped)
     return spans
 
 

@@ -180,8 +180,9 @@ class FlightRecorder:
             hub = _t.get_telemetry()
             doc["compile_cache"] = {
                 k: hub.counter("compile_cache." + k)
-                for k in ("disk_hit", "disk_miss", "corrupt", "store",
-                          "store_error")}
+                for k in ("disk_hit", "disk_miss", "corrupt",
+                          "corrupt_digest", "corrupt_deserialize",
+                          "store", "store_error")}
         except Exception:  # noqa: BLE001
             doc["compile_cache"] = {}
         if exc is not None:

@@ -66,6 +66,8 @@ import numpy as np
 from .. import observability as obs
 from ..analysis import concurrency as _conc
 from ..fluid import resilience as R
+from ..integrity import envelope as _env
+from ..integrity import jsonl as _jsonl
 from ..fluid.resilience import (  # re-exported surface  # noqa: F401
     CollectiveTimeoutError, collective_deadline, deadline_remaining,
     EventLog, FaultInjector, GuardedExecutor,
@@ -227,8 +229,13 @@ class FileStore(HeartbeatStore):
         # train loop both beat for the same key, and a shared tmp path
         # would let one thread's replace() steal the other's file
         tmp = path + ".tmp-%d-%d" % (os.getpid(), threading.get_ident())
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
+        # every mailbox doc carries an ``_integrity`` digest stamp
+        # (stripped again on read); the encoded bytes route through the
+        # ``mailbox`` corruption fault site for chaos drills
+        data = R.fault_corrupt(
+            "mailbox", json.dumps(_env.stamp_doc(payload)).encode("utf-8"))
+        with open(tmp, "wb") as f:
+            f.write(data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -253,14 +260,28 @@ class FileStore(HeartbeatStore):
         if _conc._on:
             _conc.note_blocking("filestore.scan")
         out = {}
+        torn = corrupt = 0
         for entry in os.listdir(d):
             if not entry.endswith(".json"):
                 continue
-            try:
-                with open(os.path.join(d, entry)) as f:
-                    out[entry[:-5]] = json.load(f)
-            except (OSError, ValueError):
-                continue  # concurrent replace / torn write: skip
+            doc, bad = _jsonl.read_json_doc(os.path.join(d, entry))
+            if doc is None:
+                # OSError (concurrent replace) skips silently; a torn
+                # write (unparseable JSON) is counted
+                torn += bad
+                continue
+            if isinstance(doc, dict):
+                ok, doc = _env.check_doc(doc)
+                if not ok:
+                    corrupt += 1
+                    continue
+            out[entry[:-5]] = doc
+        if torn:
+            obs.inc("integrity.mailbox_doc_torn", torn)
+        if corrupt:
+            obs.inc("integrity.mailbox_doc_corrupt", corrupt)
+            obs.event("integrity_violation", source="elastic",
+                      check="mailbox", dir=d, count=corrupt)
         return out
 
     def all(self, namespace):
@@ -744,10 +765,12 @@ class FleetGuard:
         src = getattr(program, "_program", program)
         state = self._exe._gather_state(src, scope)
         wdir = ckpt.worker_dir(self._ckpt_dir, self.worker_index)
-        ckpt.save_checkpoint(wdir, state, step=int(step), wait=True)
+        digests = ckpt.save_checkpoint(wdir, state, step=int(step),
+                                       wait=True)
         ckpt.mark_save_complete(
             self._ckpt_dir, int(step), self.worker_index,
-            world_size=self.world_size, members=sorted(self.members))
+            world_size=self.world_size, members=sorted(self.members),
+            digests=digests)
         self.log.emit("save", step=int(step), vars=len(state),
                       members=sorted(self.members))
 

@@ -378,8 +378,32 @@ class DecodeEngine:
         self._step_fp = None
         self._step_ema = None
         self._step_noted = False
+        # SDC sentinel (paddle_tpu/integrity/sentinel.py): attached by
+        # the disagg router (or a test); None = zero per-step overhead
+        self._sentinel = None
+        self._sentinel_id = self.name
         if auto_start:
             self.start()
+
+    def attach_sentinel(self, sentinel, replica=None):
+        """Arm sampled step-replay SDC checking on this engine; a
+        replay disagreement fails the step BEFORE any token is emitted
+        (streams migrate and regenerate — a lying step never serves).
+        ``replica`` names this engine in the sentinel's vote protocol
+        (defaults to the engine name)."""
+        self._sentinel = sentinel
+        self._sentinel_id = str(replica) if replica is not None \
+            else self.name
+        if sentinel is not None:
+            sentinel.register(self._sentinel_id, self.sentinel_replay)
+        return self
+
+    def sentinel_replay(self, feeds):
+        """Re-dispatch the step program on arbitrary feeds (the
+        cross-replica vote path — peers re-run a suspect's feeds).
+        Stateless: the jitted step is functional, so this never
+        touches this engine's resident cache."""
+        return self._step_pred.run(feeds, return_numpy=False)
 
     # -- construction helpers -------------------------------------------
     @classmethod
@@ -907,6 +931,11 @@ class DecodeEngine:
                           wire_bytes=h.wire_bytes())
             sp.__enter__()
         try:
+            # digest check FIRST: a corrupted handoff must fail the
+            # inner stream here (the router's migration path then
+            # re-prefills) — never install garbage into a slot
+            if getattr(h, "digest", None) is not None:
+                h.verify()
             if self.kv_dtype == "int8":
                 if h.wire_dtype == "int8":
                     kq, ks = np.asarray(h.k, np.int8), h.k_scales
@@ -928,6 +957,13 @@ class DecodeEngine:
             if sp is not None:
                 sp.__exit__(type(e), e, None)
             self._bump("adopt_errors")
+            from ..integrity.digest import IntegrityError
+            if isinstance(e, IntegrityError):
+                obs.inc("integrity.handoff_digest_mismatch")
+                obs.event("integrity_violation", source="serving",
+                          model=self.name, check="kv_handoff",
+                          op="adopt", tensor=e.tensor,
+                          error=str(e)[:200])
             obs.event("adopt_error", source="serving", model=self.name,
                       error="%s: %s" % (type(e).__name__, str(e)[:200]))
             req.handle._fail(e)
@@ -1006,6 +1042,11 @@ class DecodeEngine:
 
     def _step(self):
         t0 = time.monotonic()
+        # the feed dict is captured BEFORE dispatch: the run reassigns
+        # self._k/_v (and _tok/_pos mutate only at emission, below), so
+        # these references are exactly the step's inputs — what the SDC
+        # sentinel re-dispatches on a sampled replay
+        feeds = self._step_feeds()
         try:
             # chaos site: a 'slow' clause stalls the step in place (it
             # shows up in step_seconds + the ledger, the autopilot
@@ -1014,13 +1055,12 @@ class DecodeEngine:
             R.fault_check("dispatch")
             if _conc._on:
                 _conc.note_blocking("device.dispatch")
+            outs = self._step_pred.run(feeds, return_numpy=False)
             if self.kv_dtype == "int8":
                 (nxt, self._k, self._v, self._kscale,
-                 self._vscale) = self._step_pred.run(
-                    self._step_feeds(), return_numpy=False)
+                 self._vscale) = outs
             else:
-                nxt, self._k, self._v = self._step_pred.run(
-                    self._step_feeds(), return_numpy=False)
+                nxt, self._k, self._v = outs
         except Exception as e:  # noqa: BLE001 — fail the slots, not the loop
             self._bump("step_errors")
             obs.event("step_error", source="serving", model=self.name,
@@ -1033,6 +1073,28 @@ class DecodeEngine:
         obs.observe("serving.decode.step_seconds", dt)
         self._note_step_measured(dt)
         self._bump("steps")
+        if (self._sentinel is not None
+                and self._sentinel.sample(self._sentinel_id)):
+            ok = self._sentinel.replay_check(
+                self._sentinel_id,
+                lambda: self._step_pred.run(feeds, return_numpy=False),
+                outs, feeds=feeds)
+            if not ok:
+                # the step disagreed with its own replay: retire every
+                # live slot BEFORE emission so a possibly-corrupted
+                # token is never delivered; the streams migrate and
+                # regenerate on a healthy replica while the sentinel's
+                # cross-replica vote adjudicates this one
+                from ..integrity.digest import IntegrityError
+                self._bump("sdc_disagree")
+                err = IntegrityError(
+                    "SDC replay disagreement on decode replica %r — "
+                    "withholding this step's tokens"
+                    % (self._sentinel_id,))
+                for i, s in enumerate(self._slots):
+                    if s is not None:
+                        self._retire(i, "error", error=err)
+                return
         nxt_np = np.asarray(nxt)
         for i, s in enumerate(self._slots):
             if s is None:

@@ -25,9 +25,20 @@ Quantization here is idempotent for untouched rows: a row decoded from
 |element| is exactly ``127 * scale``), which is what lets the int8-
 resident step program requantize the whole cache every step without
 compounding error on rows it did not write.
+
+Every handoff is sealed with a content digest at encode time
+(``KVHandoff.digest``, riding ``to_wire`` docs unchanged); the decode
+engine verifies it before adoption, so a corrupted handoff fails the
+*inner* stream and the router's migration path re-prefills — garbage
+is never installed into a slot and ``failed_streams`` stays 0. The
+``wire`` corruption fault site (``wire:at=1:corrupt=bitflip``) perturbs
+the payload after sealing, which is the end-to-end drill.
 """
+import hashlib
+
 import numpy as np
 
+from ...integrity.digest import IntegrityError
 from ...parallel.comms import quantize as Q
 
 __all__ = [
@@ -72,10 +83,10 @@ class KVHandoff:
     """
 
     __slots__ = ("k", "v", "k_scales", "v_scales", "next_token",
-                 "plen", "prompt", "wire_dtype", "trace")
+                 "plen", "prompt", "wire_dtype", "trace", "digest")
 
     def __init__(self, k, v, k_scales, v_scales, next_token, plen,
-                 prompt, wire_dtype, trace=None):
+                 prompt, wire_dtype, trace=None, digest=None):
         self.k = k
         self.v = v
         self.k_scales = k_scales
@@ -88,10 +99,50 @@ class KVHandoff:
         # handoff — the decode replica's adopt span parents to it so
         # one trace_id spans both processes
         self.trace = trace
+        # content digest stamped by the sender (seal()); None means an
+        # unsealed (hand-built) handoff, which adopts unverified
+        self.digest = digest
 
     @property
     def shape(self):
         return tuple(int(s) for s in self.k.shape)  # (L, T, H)
+
+    # -- content integrity -----------------------------------------------
+    def content_digest(self):
+        """sha256 over the handoff's semantic content: geometry +
+        scalars + prompt + payloads + scales, in a fixed order."""
+        h = hashlib.sha256()
+        h.update(("%s;%s;%d;%d;" % (self.wire_dtype, self.shape,
+                                    self.next_token, self.plen)).encode())
+        h.update(np.ascontiguousarray(self.prompt).tobytes())
+        for a in (self.k, self.v, self.k_scales, self.v_scales):
+            if a is None:
+                h.update(b";none")
+            else:
+                a = np.ascontiguousarray(a)
+                h.update(a.dtype.str.encode())
+                h.update(a.tobytes())
+        return "sha256:" + h.hexdigest()
+
+    def seal(self):
+        """Stamp the sender-side content digest; returns self."""
+        self.digest = self.content_digest()
+        return self
+
+    def verify(self):
+        """Raise :class:`IntegrityError` if the payload no longer
+        matches the sealed digest. Unsealed handoffs pass (there is
+        nothing to verify against)."""
+        if self.digest is None:
+            return
+        got = self.content_digest()
+        if got != self.digest:
+            raise IntegrityError(
+                "KV handoff digest mismatch (want %s got %s): "
+                "%d-layer cache, plen=%d, next_token=%d — refusing "
+                "to adopt" % (self.digest, got, self.shape[0],
+                              self.plen, self.next_token),
+                tensor="kv_cache", want=self.digest, got=got)
 
     def dense(self):
         """The fp32 ``(k, v)`` cache pair this handoff decodes to."""
@@ -133,6 +184,8 @@ class KVHandoff:
                 self.v_scales, np.float32).tobytes()
         if self.trace is not None:
             doc["trace"] = self.trace.to_doc()
+        if self.digest is not None:
+            doc["digest"] = self.digest
         return doc
 
     @classmethod
@@ -151,7 +204,8 @@ class KVHandoff:
             vs = np.frombuffer(doc["v_scales"], np.float32).reshape(sshape)
         return cls(k, v, ks, vs, doc["next_token"], doc["plen"],
                    np.frombuffer(doc["prompt"], np.int64), wire_dtype,
-                   trace=TraceContext.from_doc(doc.get("trace")))
+                   trace=TraceContext.from_doc(doc.get("trace")),
+                   digest=doc.get("digest"))
 
 
 def encode_kv(k, v, next_token, plen, prompt, wire_dtype="int8",
@@ -167,12 +221,27 @@ def encode_kv(k, v, next_token, plen, prompt, wire_dtype="int8",
                 "encode_kv wants one sequence, got batch %d" % k.shape[0])
         k, v = k[0], v[0]
     if wire_dtype == "fp32":
-        return KVHandoff(k, v, None, None, next_token, plen, prompt,
-                         wire_dtype, trace=trace)
-    kq, ks = quantize_rows(k, wire_dtype)
-    vq, vs = quantize_rows(v, wire_dtype)
-    return KVHandoff(kq, vq, ks, vs, next_token, plen, prompt,
-                     wire_dtype, trace=trace)
+        h = KVHandoff(k, v, None, None, next_token, plen, prompt,
+                      wire_dtype, trace=trace)
+    else:
+        kq, ks = quantize_rows(k, wire_dtype)
+        vq, vs = quantize_rows(v, wire_dtype)
+        h = KVHandoff(kq, vq, ks, vs, next_token, plen, prompt,
+                      wire_dtype, trace=trace)
+    h.seal()
+    return _wire_fault(h)
+
+
+def _wire_fault(h):
+    """The ``wire`` corruption fault site: perturb the sealed payload
+    in transit (shape-preserving — the transport object must stay
+    well-formed; the digest is what catches it on the decode side)."""
+    from ...fluid.resilience import corrupt_array, fault_corrupt_mode
+
+    mode = fault_corrupt_mode("wire")
+    if mode is not None:
+        h.k = corrupt_array(mode, h.k)
+    return h
 
 
 def decode_kv(handoff):

@@ -48,6 +48,7 @@ import numpy as np
 
 from ... import observability as obs
 from ...analysis import concurrency as _conc
+from ...integrity.digest import IntegrityError
 from ...parallel.elastic import ElasticConfig, HeartbeatMonitor, InMemoryStore
 from ..decode import DecodeEngine, DecodeStream
 from ..engine import EngineClosedError, ShedError
@@ -252,6 +253,7 @@ class DisaggRouter:
             else max(0.02, self.config.heartbeat_interval / 2.0))
         self._health_stop = threading.Event()
         self._health = None
+        self._sentinel = None
         obs.set_gauge("serving.disagg.prefill_live", len(self._prefill))
         obs.set_gauge("serving.disagg.decode_live", len(self._decode))
         for c in ("sessions", "migrations", "failed_streams"):
@@ -513,6 +515,11 @@ class DisaggRouter:
             if inner.finish_reason == "error":
                 raise _ReplicaLost(rid, inner._error)
             sess.handle._finish(inner.finish_reason or "length")
+        except IntegrityError as e:
+            # corrupted handoff or an SDC-withheld step: the replica is
+            # healthy — route through the migration path (re-prefill
+            # from prompt + delivered) instead of failing the stream
+            raise _ReplicaLost(rid, e)
         except (EngineClosedError, TimeoutError) as e:
             self._mark_dead(rid)
             raise _ReplicaLost(rid, e)
@@ -644,6 +651,40 @@ class DisaggRouter:
         replica.kill()
         self._mark_dead(rid)
 
+    # -- SDC sentinel ----------------------------------------------------
+    def attach_sentinel(self, sentinel):
+        """Arm sampled step-replay SDC checking on every decode
+        replica and register each replica's replay callable for the
+        sentinel's cross-replica vote (see
+        :mod:`paddle_tpu.integrity.sentinel`). The autopilot drains
+        the sentinel's confirmed verdicts into ``quarantine_replica``
+        actions."""
+        with self._lock:
+            self._sentinel = sentinel
+            replicas = dict(self._decode)
+        for rid, rep in replicas.items():
+            rep.engine.attach_sentinel(sentinel, replica=rid)
+        return sentinel
+
+    def quarantine_replica(self, rid):
+        """Integrity remediation: pull a confirmed-lying decode
+        replica out of rotation. Mechanically a kill (its streams fail
+        fast and migrate — regenerated tokens are bit-exact, so the
+        client never sees the corruption), but counted and evented as
+        a quarantine so the fleet ledger distinguishes 'died' from
+        'caught lying'."""
+        with self._lock:
+            if rid not in self._prefill and rid not in self._decode:
+                raise KeyError("no live replica %r" % (rid,))
+            sentinel = self._sentinel
+        if sentinel is not None:
+            sentinel.unregister(rid)
+        self._bump("quarantined")
+        obs.inc("integrity.replicas_quarantined")
+        obs.event("replica_quarantined", source="integrity",
+                  model=self.name, replica=rid)
+        self.kill_replica(rid)
+
     # -- introspection / lifecycle ---------------------------------------
     def _bump(self, key, n=1):
         with self._lock:
@@ -680,7 +721,7 @@ class DisaggRouter:
             out["live_sessions"] = sum(
                 len(s) for s in self._sessions.values())
         for k in ("sessions", "migrations", "failed_streams",
-                  "replica_dead"):
+                  "replica_dead", "quarantined"):
             out.setdefault(k, 0)
         out["tenant_shed"] = sum(
             self.tenants.stats()["shed"].values())
@@ -718,6 +759,13 @@ class DisaggRouter:
             return sum(r.engine.queue_depth()
                        for r in list(self._prefill.values())
                        + list(self._decode.values()))
+
+    def live_replicas(self):
+        """``(prefill_rids, decode_rids)`` of the live fleet — a
+        membership view that does not depend on heartbeat beacons
+        (the quarantine leg's last-replica guard uses it)."""
+        with self._lock:
+            return sorted(self._prefill), sorted(self._decode)
 
     def decode_latencies(self):
         """{rid: beacon latency seconds} for the live decode fleet —

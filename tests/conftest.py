@@ -52,6 +52,12 @@ def pytest_configure(config):
         "(paddle_tpu.serving.disagg: KV handoff wire, prefill fleet, "
         "session-affine router, tenancy); `pytest -m disagg` is the "
         "slice bench_experiments/disagg_lane.sh runs")
+    config.addinivalue_line(
+        "markers",
+        "integrity: data-integrity tests (paddle_tpu.integrity: "
+        "digest envelopes, corrupt= fault arms, SDC sentinel + "
+        "quarantine); `pytest -m integrity` is the slice "
+        "bench_experiments/integrity_lane.sh runs")
 
 
 @pytest.fixture()

@@ -259,3 +259,88 @@ def test_installed_injector_wins_over_env(monkeypatch):
     R.FaultInjector.uninstall()
     with pytest.raises(OSError):
         R.fault_check("collective")
+
+
+# ---------------------------------------------------------------------------
+# the corrupt=MODE arm on byte-path sites (PR 17)
+# ---------------------------------------------------------------------------
+
+BAD_CORRUPT_SPECS = [
+    "run:at=1:corrupt=bitflip",          # corrupt is byte-path-only
+    "collective:at=1:corrupt=torn",
+    "dispatch:every=2:corrupt=truncate",
+    "save:at=1:corrupt",                 # mode is mandatory
+    "wire:every=3:corrupt=",             # empty mode
+    "load:at=1:corrupt=zero",            # unknown mode
+    "mailbox:at=1:corrupt=BITFLIP",      # modes are lowercase
+    "save:at=1:RuntimeError=bitflip",    # arg on an armless action
+    "wire:at=1:corrupt=bitflip:extra",   # trailing garbage
+]
+
+
+@pytest.mark.parametrize("spec", BAD_CORRUPT_SPECS)
+def test_malformed_corrupt_spec_raises(spec):
+    with pytest.raises(R.FaultSpecError):
+        R.FaultInjector(spec)
+
+
+def test_corrupt_parses_on_every_byte_path_site():
+    for site in sorted(R.CORRUPT_SITES):
+        for mode in sorted(R.CORRUPT_MODES):
+            inj = R.FaultInjector("%s:at=1:corrupt=%s" % (site, mode))
+            (clause,) = inj.clauses
+            assert clause.site == site
+            assert clause.corrupt_mode == mode
+
+
+def test_corrupt_clause_skipped_by_fault_check():
+    # corrupt clauses fire only at byte-path call sites — a plain
+    # fault_check at the same site must neither raise nor consume
+    inj = R.FaultInjector.install("save:at=1:corrupt=bitflip")
+    for _ in range(3):
+        R.fault_check("save")
+    assert inj.clauses[0].fires == 0
+    data = R.fault_corrupt("save", b"payload-bytes")
+    assert data != b"payload-bytes"
+    assert inj.clauses[0].fires == 1
+
+
+def test_corrupt_modes_perturb_bytes():
+    payload = bytes(range(64))
+    flipped = R.corrupt_bytes("bitflip", payload)
+    assert len(flipped) == len(payload) and flipped != payload
+    assert len(R.corrupt_bytes("truncate", payload)) == 32
+    torn = R.corrupt_bytes("torn", payload)
+    assert 0 < len(torn) < len(payload)
+
+
+def test_corrupt_array_preserves_shape():
+    np = pytest.importorskip("numpy")
+    arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    for mode in sorted(R.CORRUPT_MODES):
+        out = R.corrupt_array(mode, arr)
+        assert out.shape == arr.shape and out.dtype == arr.dtype
+        assert not np.array_equal(out, arr), mode
+
+
+def test_fuzz_mutated_corrupt_specs():
+    """Mutations of a corrupt= spec stay valid (byte-path site, known
+    mode) or raise FaultSpecError — never a third behavior."""
+    base = "wire:at=1:corrupt=bitflip;save:every=2:corrupt=torn"
+    rng = random.Random(17)
+    for _ in range(300):
+        pos = rng.randrange(len(base))
+        ch = rng.choice(string.ascii_lowercase + string.digits + ":;=")
+        mutated = base[:pos] + ch + base[pos + 1:]
+        try:
+            inj = R.FaultInjector(mutated)
+        except R.FaultSpecError:
+            continue
+        except Exception as e:  # noqa: BLE001
+            pytest.fail("mutation %r escaped as %s: %s"
+                        % (mutated, type(e).__name__, e))
+        for clause in inj.clauses:
+            assert clause.site in R.FaultInjector.SITES
+            if clause.corrupt_mode is not None:
+                assert clause.site in R.CORRUPT_SITES
+                assert clause.corrupt_mode in R.CORRUPT_MODES

@@ -1,0 +1,692 @@
+"""End-to-end data integrity (ISSUE 17): content-digest envelopes on
+every byte path (checkpoint shards, KV handoffs, compile-cache
+entries, FileStore mailbox docs), ``corrupt=`` fault arms driving the
+chaos drills, and the SDC sentinel that catches a lying chip by
+sampled replay + cross-replica vote and quarantines it through a
+journaled autopilot action.
+
+Exactness bar: every drill that corrupts a byte path must end with the
+SAME bits an unfaulted run produces — re-prefilled tokens bit-identical
+to the solo reference, fallback restores bit-identical to the previous
+consensus step — with ``failed_streams == 0`` and the violation
+attributed (tensor / file / replica) in counters and events.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.autopilot import Autopilot
+from paddle_tpu.fluid import resilience as R
+from paddle_tpu.integrity import digest as dg
+from paddle_tpu.integrity import envelope as env
+from paddle_tpu.integrity import jsonl as tj
+from paddle_tpu.integrity.sentinel import SDCSentinel, fetch_digest
+from paddle_tpu.models import gpt
+from paddle_tpu.parallel import checkpoint as ckpt
+from paddle_tpu.serving.disagg import disagg_fleet, encode_kv
+
+pytestmark = pytest.mark.integrity
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    R.FaultInjector.uninstall()
+    yield
+    R.FaultInjector.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# digests
+# ---------------------------------------------------------------------------
+
+def test_bytes_and_file_digest(tmp_path):
+    d = dg.bytes_digest(b"abc")
+    assert d.startswith("sha256:") and d == dg.bytes_digest([b"a", b"bc"])
+    p = tmp_path / "blob"
+    p.write_bytes(b"abc")
+    assert dg.file_digest(str(p)) == d
+
+
+def test_tensor_digest_is_dtype_and_shape_sensitive():
+    a = np.arange(6, dtype=np.float32)
+    assert dg.tensor_digest(a) == dg.tensor_digest(a.copy())
+    assert dg.tensor_digest(a) != dg.tensor_digest(a.astype(np.float64))
+    assert dg.tensor_digest(a) != dg.tensor_digest(a.reshape(2, 3))
+    b = a.copy()
+    b[3] = np.nextafter(b[3], 99, dtype=np.float32)  # one-ULP flip
+    assert dg.tensor_digest(a) != dg.tensor_digest(b)
+
+
+def test_doc_digest_canonical_across_key_order_and_roundtrip():
+    d1 = dg.doc_digest({"a": 1, "b": [1, 2], "c": "x"})
+    d2 = dg.doc_digest(json.loads('{"c": "x", "b": [1, 2], "a": 1}'))
+    assert d1 == d2
+    assert d1 != dg.doc_digest({"a": 1, "b": [1, 2], "c": "y"})
+
+
+def test_state_mismatches_attributes_tensor():
+    state = {"w": np.ones(4, np.float32), "b": np.zeros(2, np.float32)}
+    digests = dg.digest_state(state)
+    assert dg.state_mismatches(state, digests) == []
+    state["w"][1] = 7.0
+    bad = dg.state_mismatches(state, digests)
+    assert [m[0] for m in bad] == ["w"]
+    missing = dg.state_mismatches({"b": state["b"]}, digests)
+    assert missing[0][0] == "w" and missing[0][2] is None
+
+
+# ---------------------------------------------------------------------------
+# envelopes
+# ---------------------------------------------------------------------------
+
+def test_seal_unseal_roundtrip_and_failure_modes():
+    sealed = env.seal_bytes(b"payload", kind="blob")
+    assert env.is_sealed(sealed)
+    assert env.unseal_bytes(sealed, kind="blob") == b"payload"
+    with pytest.raises(dg.IntegrityError, match="kind"):
+        env.unseal_bytes(sealed, kind="other")
+    with pytest.raises(dg.IntegrityError):
+        env.unseal_bytes(b"not sealed at all")
+    with pytest.raises(dg.IntegrityError):
+        env.unseal_bytes(sealed[:-3])  # truncated payload
+    flipped = bytearray(sealed)
+    flipped[-1] ^= 1
+    with pytest.raises(dg.IntegrityError, match="digest"):
+        env.unseal_bytes(bytes(flipped))
+
+
+def test_manifest_roundtrip_and_corruption(tmp_path):
+    p = str(tmp_path / "m.json")
+    assert env.read_manifest(p) is None  # absent != corrupt
+    doc = env.make_manifest({"w": "sha256:ab"}, kind="checkpoint", step=3)
+    env.write_manifest(p, doc)
+    back = env.read_manifest(p)
+    assert back["digests"] == {"w": "sha256:ab"} and back["step"] == 3
+    with open(p, "w") as f:
+        f.write("{torn")
+    with pytest.raises(dg.IntegrityError):
+        env.read_manifest(p)
+
+
+def test_stamp_and_check_doc():
+    doc = {"rank": 3, "t": 1.5}
+    stamped = env.stamp_doc(doc)
+    assert env.STAMP_KEY in stamped and env.STAMP_KEY not in doc
+    ok, clean = env.check_doc(json.loads(json.dumps(stamped)))
+    assert ok and clean == doc
+    tampered = dict(stamped, rank=4)
+    ok, _ = env.check_doc(tampered)
+    assert not ok
+    ok, clean = env.check_doc({"plain": True})  # unstamped passes
+    assert ok and clean == {"plain": True}
+
+
+# ---------------------------------------------------------------------------
+# the tolerant JSONL reader (shared by journal / traces / mailbox)
+# ---------------------------------------------------------------------------
+
+def test_parse_lines_counts_torn_not_blank():
+    recs, dropped = tj.parse_lines(['{"a": 1}', "", "  ", '{"b"', '{"c": 3}'])
+    assert recs == [{"a": 1}, {"c": 3}] and dropped == 1
+
+
+def test_read_jsonl_and_doc_tolerate_absence(tmp_path):
+    assert tj.read_jsonl(str(tmp_path / "nope.jsonl")) == ([], 0)
+    assert tj.read_json_doc(str(tmp_path / "nope.json")) == (None, 0)
+    p = tmp_path / "t.json"
+    p.write_text("{torn")
+    assert tj.read_json_doc(str(p)) == (None, 1)
+
+
+def test_decision_journal_read_skips_torn_tail(tmp_path):
+    from paddle_tpu.autopilot.actions import AutopilotAction, DecisionJournal
+
+    path = str(tmp_path / "journal.jsonl")
+    j = DecisionJournal(path=path)
+    j.append(AutopilotAction("calibrate", "cadence", "propose"))
+    j.append(AutopilotAction("kill_replica", "slo:a:ttft", "apply"))
+    with open(path, "a") as f:
+        f.write('{"seq": 3, "kind": "torn-mid-')  # crash mid-append
+    obs.reset()
+    back = DecisionJournal.read_jsonl(path)
+    assert [r["kind"] for r in back] == ["calibrate", "kill_replica"]
+    assert obs.snapshot()["counters"]["integrity.jsonl_dropped"] == 1
+
+
+def test_read_spans_uses_tolerant_reader(tmp_path):
+    from paddle_tpu.observability.distributed import read_spans
+
+    with open(tmp_path / "trace-1.jsonl", "w") as f:
+        f.write('{"span": "a", "trace": "t"}\n{"span": "b", "tr')
+    spans = read_spans(str(tmp_path))
+    assert [s["span"] for s in spans] == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# FileStore mailbox docs
+# ---------------------------------------------------------------------------
+
+def test_filestore_docs_stamped_and_verified(tmp_path):
+    from paddle_tpu.parallel.elastic import FileStore
+
+    fs = FileStore(str(tmp_path))
+    fs.put("hb", "w0", {"rank": 0})
+    raw = json.load(open(tmp_path / "hb" / "w0.json"))
+    assert env.STAMP_KEY in raw            # stamped on disk...
+    assert fs.all("hb") == {"w0": {"rank": 0}}  # ...stripped on read
+    # silent tamper: doc is skipped, not served
+    with open(tmp_path / "hb" / "w0.json", "w") as f:
+        json.dump(dict(raw, rank=9), f)
+    fs._cache.clear()
+    obs.reset()
+    assert fs.all("hb") == {}
+    assert obs.snapshot()["counters"]["integrity.mailbox_doc_corrupt"] == 1
+
+
+def test_filestore_mailbox_fault_arm_torn_write(tmp_path):
+    from paddle_tpu.parallel.elastic import FileStore
+
+    fs = FileStore(str(tmp_path))
+    R.FaultInjector.install("mailbox:at=1:corrupt=torn")
+    fs.put("hb", "w0", {"rank": 0})
+    R.FaultInjector.uninstall()
+    fs.put("hb", "w1", {"rank": 1})
+    fs._cache.clear()
+    obs.reset()
+    docs = fs.all("hb")
+    assert docs == {"w1": {"rank": 1}}  # torn doc dropped, not served
+    assert obs.snapshot()["counters"]["integrity.mailbox_doc_torn"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# compile-cache entries
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_digest_vs_deserialize_corruption(tmp_path,
+                                                        monkeypatch):
+    import jax
+
+    from paddle_tpu.fluid import compile_cache as cc
+    from paddle_tpu.observability import recorder
+
+    monkeypatch.setenv(cc.CACHE_DIR_ENV, str(tmp_path))
+    obs.reset()
+    f = jax.jit(lambda x: x * 2)
+    x = np.ones((4,), np.float32)
+    assert cc.store("k1", f, (x,))
+    assert cc.load("k1") is not None
+    # bitflip on disk: the envelope digest catches it BEFORE jax.export
+    path = cc._entry_path("k1")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 1
+    open(path, "wb").write(bytes(blob))
+    assert cc.load("k1") is None and not os.path.exists(path)
+    # digest-clean garbage: the deserializer is what rejects it
+    with open(cc._entry_path("k2"), "wb") as f2:
+        f2.write(env.seal_bytes(b"junk", kind="compile-cache"))
+    assert cc.load("k2") is None
+    c = obs.snapshot()["counters"]
+    assert c["compile_cache.corrupt"] == 2
+    assert c["compile_cache.corrupt_digest"] == 1
+    assert c["compile_cache.corrupt_deserialize"] == 1
+    # both split counters ride the crash dump
+    p = recorder.FlightRecorder().crash_dump(
+        path=str(tmp_path / "dump.json"))
+    doc = json.load(open(p))
+    assert doc["compile_cache"]["corrupt_digest"] == 1
+    assert doc["compile_cache"]["corrupt_deserialize"] == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint digests
+# ---------------------------------------------------------------------------
+
+def _state(fill):
+    # incompressible payloads: ocdbt zlib-packs uniform data so hard
+    # that a mid-file bitflip hits framing instead of tensor bytes
+    rng = np.random.default_rng(fill)
+    return {"w": rng.standard_normal((64, 64)).astype(np.float32),
+            "b": rng.standard_normal(64).astype(np.float32)}
+
+
+def test_checkpoint_save_writes_manifest_and_returns_digests(tmp_path):
+    d = str(tmp_path / "ck")
+    digests = ckpt.save_checkpoint(d, _state(1), step=1, wait=True)
+    assert sorted(digests) == ["b", "w"]
+    m = env.read_manifest(ckpt.manifest_path(d, 1))
+    assert m["digests"] == digests and m["step"] == 1
+    assert ckpt.verify_checkpoint(d, 1)
+    state = ckpt.load_checkpoint(d, step=1)
+    np.testing.assert_array_equal(state["w"], _state(1)["w"])
+    ckpt.finalize(d)
+
+
+def test_checkpoint_digest_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv(ckpt._DIGEST_ENV, "0")
+    d = str(tmp_path / "ck")
+    assert ckpt.save_checkpoint(d, _state(1), step=1, wait=True) is None
+    assert not os.path.exists(ckpt.manifest_path(d, 1))
+    ckpt.finalize(d)
+
+
+def _flip_data_byte(dirname, step):
+    """Bitflip the middle byte of the largest ocdbt DATA file of a
+    step (files under a ``/d/`` component — flipping metadata makes
+    orbax itself raise, which exercises the wrong layer)."""
+    victims = []
+    for root, _, files in os.walk(os.path.join(dirname, str(step))):
+        for f in files:
+            p = os.path.join(root, f)
+            if ("%sd%s" % (os.sep, os.sep)) in p:
+                victims.append((os.path.getsize(p), p))
+    size, path = max(victims)
+    with open(path, "r+b") as fh:
+        fh.seek(size // 2)
+        byte = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([byte[0] ^ 0x01]))
+    return path
+
+
+def test_checkpoint_bitflip_caught_with_tensor_attribution(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, _state(1), step=1, wait=True)
+    ckpt.save_checkpoint(d, _state(2), step=2, wait=True)
+    ckpt.finalize(d)
+    _flip_data_byte(d, 2)
+    obs.reset()
+    with pytest.raises(dg.IntegrityError) as ei:
+        ckpt.load_checkpoint(d, step=2)
+    msg = str(ei.value)
+    assert "step 2" in msg and "failed digest verification" in msg
+    assert ei.value.tensor in ("w", "b")
+    c = obs.snapshot()["counters"]
+    assert c["integrity.checkpoint_digest_mismatch"] >= 1
+    # resume falls back to step 1, bit-identically
+    with pytest.warns(UserWarning, match="falling back"):
+        step, state = ckpt.restore_latest(d)
+    assert step == 1
+    np.testing.assert_array_equal(state["w"], _state(1)["w"])
+    np.testing.assert_array_equal(state["b"], _state(1)["b"])
+    ckpt.finalize(d)
+
+
+def test_manifest_tamper_fails_verify_and_falls_back(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, _state(1), step=1, wait=True)
+    ckpt.save_checkpoint(d, _state(2), step=2, wait=True)
+    ckpt.finalize(d)
+    with open(ckpt.manifest_path(d, 2), "r+b") as fh:
+        fh.seek(os.path.getsize(ckpt.manifest_path(d, 2)) // 2)
+        fh.write(b"\x00")
+    with pytest.warns(UserWarning, match="corrupt digest manifest"):
+        # a corrupt manifest fails the step (absent would not)
+        assert not ckpt.verify_checkpoint(d, 2)
+    with pytest.warns(UserWarning):
+        state = ckpt.load_checkpoint(d)
+    np.testing.assert_array_equal(state["w"], _state(1)["w"])
+    ckpt.finalize(d)
+
+
+def test_consensus_restore_falls_back_past_digest_failing_step(tmp_path):
+    d = str(tmp_path)
+    w = 0
+    wdir = ckpt.worker_dir(d, w)
+    for step, fill in ((1, 1), (2, 2)):
+        digests = ckpt.save_checkpoint(wdir, _state(fill), step=step,
+                                       wait=True)
+        ckpt.mark_save_complete(d, step, w, world_size=1, digests=digests)
+    ckpt.finalize(wdir)
+    # rot the newest shard AND rewrite its manifest to match, modeling
+    # bit rot after consensus formed (the local manifest alone can no
+    # longer tell) — the digests recorded in the done-marker at
+    # consensus time still catch it
+    import orbax.checkpoint as ocp
+
+    _flip_data_byte(wdir, 2)
+    mgr = ckpt._manager(wdir)
+    rotted = {k: np.asarray(v) for k, v in
+              mgr.restore(2, args=ocp.args.StandardRestore()).items()}
+    env.write_manifest(
+        ckpt.manifest_path(wdir, 2),
+        env.make_manifest(dg.digest_state(rotted), kind="checkpoint",
+                          step=2))
+    obs.reset()
+    with pytest.warns(UserWarning, match="done-marker digests"):
+        step, state = ckpt.restore_latest_consensus(d, worker_index=w)
+    assert step == 1
+    np.testing.assert_array_equal(state["w"], _state(1)["w"])
+    c = obs.snapshot()["counters"]
+    assert c["integrity.checkpoint_digest_mismatch"] >= 1
+    ckpt.finalize(wdir)
+
+
+def test_save_load_fault_arms_on_manifest_path(tmp_path):
+    # save arm: the manifest bytes rot in flight to disk; the load-side
+    # verification refuses the step instead of trusting it
+    d = str(tmp_path / "ck1")
+    R.FaultInjector.install("save:at=1:corrupt=bitflip")
+    ckpt.save_checkpoint(d, _state(1), step=1, wait=True)
+    R.FaultInjector.uninstall()
+    with pytest.raises(dg.IntegrityError):
+        ckpt.load_checkpoint(d, step=1)
+    ckpt.finalize(d)
+    # load arm: clean disk, corruption on the read path
+    d2 = str(tmp_path / "ck2")
+    ckpt.save_checkpoint(d2, _state(1), step=1, wait=True)
+    R.FaultInjector.install("load:at=1:corrupt=bitflip")
+    with pytest.raises(dg.IntegrityError):
+        ckpt.load_checkpoint(d2, step=1)
+    R.FaultInjector.uninstall()
+    ckpt.finalize(d2)
+
+
+# ---------------------------------------------------------------------------
+# KV handoff sealing (pure numpy)
+# ---------------------------------------------------------------------------
+
+def test_kv_handoff_seal_rides_wire_and_catches_tamper():
+    rng = np.random.default_rng(3)
+    k = rng.standard_normal((2, 8, 16)).astype(np.float32)
+    v = rng.standard_normal((2, 8, 16)).astype(np.float32)
+    h = encode_kv(k, v, 42, 5, np.arange(1, 6), wire_dtype="int8")
+    assert h.digest and h.digest.startswith("sha256:")
+    h.verify()  # sealed and intact
+    from paddle_tpu.serving.disagg import KVHandoff
+
+    h2 = KVHandoff.from_wire(h.to_wire())
+    assert h2.digest == h.digest
+    h2.verify()
+    h2.k = h2.k.copy()
+    h2.k[0, 0, 0] ^= 1
+    with pytest.raises(dg.IntegrityError, match="refusing to adopt"):
+        h2.verify()
+    # unsealed handoffs (hand-built) adopt unverified
+    h3 = KVHandoff(k, v, None, None, 1, 5, np.arange(1, 6), "fp32")
+    assert h3.digest is None
+    h3.verify()
+
+
+def test_wire_fault_arm_corrupts_after_seal():
+    rng = np.random.default_rng(4)
+    k = rng.standard_normal((2, 8, 16)).astype(np.float32)
+    v = rng.standard_normal((2, 8, 16)).astype(np.float32)
+    R.FaultInjector.install("wire:at=1:corrupt=bitflip")
+    h = encode_kv(k, v, 42, 5, np.arange(1, 6), wire_dtype="fp32")
+    with pytest.raises(dg.IntegrityError):
+        h.verify()
+    h2 = encode_kv(k, v, 42, 5, np.arange(1, 6), wire_dtype="fp32")
+    h2.verify()  # at=1 is one-shot
+
+
+# ---------------------------------------------------------------------------
+# the SDC sentinel (unit level)
+# ---------------------------------------------------------------------------
+
+def test_sentinel_sampling_cadence_and_disarm():
+    s = SDCSentinel(check_every=4)
+    hits = [i for i in range(1, 13) if s.sample("r1")]
+    assert hits == [4, 8, 12]
+    assert all(not SDCSentinel(check_every=0).sample() for _ in range(8))
+
+
+def test_fetch_digest_dict_order_independent():
+    a, b = np.arange(4.0), np.ones(3)
+    assert fetch_digest({"x": a, "y": b}) == fetch_digest({"y": b, "x": a})
+    assert fetch_digest([a, b]) != fetch_digest([b, a])
+
+
+def test_replay_check_agree_and_disagree():
+    s = SDCSentinel(check_every=1)
+    outs = [np.arange(4.0)]
+    assert s.replay_check("r1", lambda: [np.arange(4.0)], outs)
+    assert not s.pending
+    assert not s.replay_check("r1", lambda: [np.arange(4.0) + 1], outs,
+                              feeds={"f": 1}, step=7)
+    assert len(s.pending) == 1
+    assert s.pending[0]["replica"] == "r1" and s.pending[0]["step"] == 7
+
+
+def test_vote_confirms_with_peer_quorum_and_abstains_without():
+    s = SDCSentinel(check_every=1)
+    good = lambda feeds: [np.arange(4.0)]  # noqa: E731
+    s.register("liar", lambda feeds: [np.arange(4.0) + 1])
+    s.register("p1", good)
+    s.register("p2", good)
+    s.replay_check("liar", lambda: [np.arange(4.0) + 2], [np.arange(4.0) + 1])
+    v = s.vote()
+    assert v is not None and v["replica"] == "liar"
+    assert v["votes"] == 2 and v["peers"] == 2
+    assert s.confirmed_verdicts() == [v] and s.confirmed_verdicts() == []
+    # no peers at all -> inconclusive, never a quarantine
+    s2 = SDCSentinel(check_every=1)
+    s2.register("only", lambda feeds: [np.arange(4.0)])
+    s2.replay_check("only", lambda: [np.arange(4.0) + 1], [np.arange(4.0)])
+    assert s2.vote() is None and not s2.confirmed
+
+
+def test_autopilot_integrity_leg_gates_and_quarantines():
+    class FakeDisagg:
+        def __init__(self):
+            self.decode = ["1", "2"]
+            self.killed = []
+            self._stats = {"failed_streams": 0}
+
+        def live_replicas(self):
+            return [], list(self.decode)
+
+        def stats(self):
+            return dict(self._stats)
+
+        def quarantine_replica(self, rid):
+            self.decode.remove(rid)
+            self.killed.append(rid)
+
+        def decode_latencies(self):
+            return {}
+
+    def confirmed(replica):
+        s = SDCSentinel(check_every=1)
+        s.register(replica, lambda feeds: [np.zeros(2)])
+        s.register("peer", lambda feeds: [np.arange(2.0)])
+        s.replay_check(replica, lambda: [np.ones(2)], [np.full(2, 2.0)])
+        return s
+
+    # apply mode: verdict -> journaled quarantine, replica removed
+    fleet = FakeDisagg()
+    pilot = Autopilot(disagg=fleet, sentinel=confirmed("1"), mode="apply")
+    acts = [a for a in pilot.tick() if a.kind == "quarantine_replica"]
+    assert len(acts) == 1 and acts[0].outcome == "verified"
+    assert fleet.killed == ["1"]
+    assert pilot.journal.tail()[-1]["kind"] == "quarantine_replica"
+    # never the last decode replica
+    fleet2 = FakeDisagg()
+    fleet2.decode = ["9"]
+    pilot2 = Autopilot(disagg=fleet2, sentinel=confirmed("9"), mode="apply")
+    acts2 = [a for a in pilot2.tick() if a.kind == "quarantine_replica"]
+    assert acts2[0].outcome == "rejected"
+    assert acts2[0].detail["reason"] == "last decode replica"
+    assert fleet2.killed == []
+    # propose mode records without touching the fleet
+    fleet3 = FakeDisagg()
+    pilot3 = Autopilot(disagg=fleet3, sentinel=confirmed("1"),
+                       mode="propose")
+    acts3 = [a for a in pilot3.tick() if a.kind == "quarantine_replica"]
+    assert acts3[0].outcome == "proposed" and fleet3.killed == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos drills (tiny trained GPT; shared module fixture)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def m():
+    from paddle_tpu.fluid import framework, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    fluid.default_startup_program().random_seed = 7
+    cfg = gpt.gpt_tiny(vocab=97, max_len=256)
+    vs = gpt.build_gpt_lm(cfg, 16)
+    fluid.optimizer.Adam(5e-3).minimize(vs["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ids, labels = gpt.synthetic_lm_batch(cfg, 16, 16)
+    for _ in range(30):
+        exe.run(feed={"gpt_ids": ids, "gpt_labels": labels},
+                fetch_list=[vs["loss"]])
+    yield {"cfg": cfg, "exe": exe, "scope": fluid.global_scope(),
+           "ref": {}}
+
+
+def _solo(m, prompt, n_new):
+    from paddle_tpu.fluid import unique_name
+
+    key = (tuple(int(t) for t in prompt), int(n_new))
+    if key in m["ref"]:
+        return m["ref"][key]
+    g_prog, g_st = fluid.Program(), fluid.Program()
+    with fluid.program_guard(g_prog, g_st), unique_name.guard():
+        gen = gpt.build_gpt_generate(m["cfg"], len(prompt), n_new,
+                                     mode="greedy")
+    out = np.asarray(m["exe"].run(
+        g_prog, feed={"gpt_prompt": np.asarray(prompt).reshape(1, -1)},
+        fetch_list=[gen["ids"]], scope=m["scope"])[0])
+    m["ref"][key] = [int(t) for t in out[0, len(prompt) - 1:]]
+    return m["ref"][key]
+
+
+def _prompt(n, seed=11):
+    rng = np.random.default_rng(seed + n)
+    return rng.integers(1, 97, n).astype("int64")
+
+
+@pytest.mark.chaos
+def test_chaos_corrupted_handoff_reprefills_bit_exact(m,
+                                                      armed_sanitizers):
+    """A bitflipped KV handoff is caught by its sealed digest at adopt
+    time, the inner stream fails, and the router's migration path
+    re-prefills — the client sees the bit-exact token stream and
+    ``failed_streams`` stays 0."""
+    router = disagg_fleet(m["cfg"], m["scope"], n_prefill=1, n_decode=2,
+                          slots=2, cache_len=64, prompt_buckets=(8, 32),
+                          kv_dtype="fp32", wire_dtype="fp32",
+                          name="integ-wire")
+    try:
+        ref = _solo(m, _prompt(6), 10)
+        obs.reset()
+        R.FaultInjector.install("wire:at=1:corrupt=bitflip")
+        got = router.submit(_prompt(6), max_new=10).result(120.0)
+        st = router.stats()
+        assert got == ref
+        assert st["failed_streams"] == 0
+        assert st["migrations"] >= 1
+        c = obs.snapshot()["counters"]
+        assert c["integrity.handoff_digest_mismatch"] == 1
+        assert c["integrity.fault_corrupt_fired"] == 1
+        # unfaulted traffic afterwards stays clean
+        R.FaultInjector.uninstall()
+        ref2 = _solo(m, _prompt(5), 8)
+        assert router.submit(_prompt(5), max_new=8).result(120.0) == ref2
+        assert router.stats()["failed_streams"] == 0
+    finally:
+        R.FaultInjector.uninstall()
+        router.stop(drain=False, timeout=10.0)
+
+
+@pytest.mark.chaos
+def test_chaos_sdc_sentinel_catches_and_quarantines_liar(
+        m, armed_sanitizers, tmp_path, monkeypatch):
+    """A decode replica whose chip lies exactly once is caught by the
+    sampled replay BEFORE its tokens are emitted, confirmed by the
+    peer vote, and quarantined through a journaled, traced
+    ``quarantine_replica`` autopilot action — while the client stream
+    migrates and stays bit-exact."""
+    monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    router = disagg_fleet(m["cfg"], m["scope"], n_prefill=1, n_decode=2,
+                          slots=2, cache_len=64, prompt_buckets=(8, 32),
+                          kv_dtype="fp32", wire_dtype="fp32",
+                          name="integ-sdc")
+    journal_path = str(tmp_path / "journal.jsonl")
+    from paddle_tpu.autopilot.actions import DecisionJournal
+
+    sent = SDCSentinel(check_every=3)
+    router.attach_sentinel(sent)
+    pilot = Autopilot(disagg=router, sentinel=sent, mode="apply",
+                      journal=DecisionJournal(path=journal_path))
+
+    class LyingPred:
+        """One-shot SDC: the 3rd run (a sampled step's LIVE dispatch)
+        returns perturbed outputs; the replay sees the truth."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.calls = 0
+
+        def run(self, feeds, **kw):
+            outs = self.inner.run(feeds, **kw)
+            self.calls += 1
+            if self.calls == 3:
+                outs = list(outs)
+                outs[0] = np.asarray(outs[0]) + 1
+            return outs
+
+        def __getattr__(self, k):
+            return getattr(self.inner, k)
+
+    _, decode_rids = router.live_replicas()
+    victim = decode_rids[0]
+    with router._lock:
+        veng = router._decode[victim].engine
+    veng._step_pred = LyingPred(veng._step_pred)
+    try:
+        ref = _solo(m, _prompt(6), 12)
+        obs.reset()
+        ctx = obs.TraceContext.new()
+        got = router.submit(_prompt(6), max_new=12,
+                            trace_ctx=ctx).result(120.0)
+        acts = pilot.tick()
+        st = router.stats()
+        _, live_after = router.live_replicas()
+        # never serves a corrupted token
+        assert got == ref
+        assert st["failed_streams"] == 0 and st["migrations"] >= 1
+        assert st["sdc_disagree"] == 1 and st["quarantined"] == 1
+        assert victim not in live_after
+        q = [a for a in acts if a.kind == "quarantine_replica"]
+        assert len(q) == 1 and q[0].outcome == "verified"
+        assert q[0].detail["failed_streams"] == 0
+        c = obs.snapshot()["counters"]
+        assert c["integrity.sdc_replay_disagree"] == 1
+        assert c["integrity.sdc_vote_confirmed"] == 1
+        assert c["integrity.replicas_quarantined"] == 1
+        # journaled...
+        back = DecisionJournal.read_jsonl(journal_path)
+        assert any(r["kind"] == "quarantine_replica"
+                   and r["outcome"] == "verified" for r in back)
+        # ...and visible in one Perfetto trace: the incident trace_id
+        # carries detect -> act -> verify spans
+        from paddle_tpu.observability.distributed import (
+            chrome_trace, read_spans)
+
+        spans = read_spans(str(tmp_path))
+        qspans = [s for s in spans
+                  if s.get("args", {}).get("kind") == "quarantine_replica"
+                  or (s.get("name") == "autopilot.detect"
+                      and str(s.get("args", {}).get("trigger", ""))
+                      .startswith("sdc:"))]
+        assert {s["name"] for s in qspans} >= {
+            "autopilot.detect", "autopilot.act", "autopilot.verify"}
+        incident = {s["trace"] for s in qspans}
+        assert len(incident) == 1  # ...on ONE incident timeline
+        perfetto = chrome_trace(spans, trace_id=incident.pop())
+        names = {ev.get("name") for ev in perfetto["traceEvents"]}
+        assert "autopilot.act" in names
+    finally:
+        router.stop(drain=False, timeout=10.0)

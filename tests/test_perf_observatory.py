@@ -508,4 +508,5 @@ class TestLedgerIntegration:
         assert doc["executables"], "ledger tail missing from crash dump"
         assert doc["executables"][-1]["kind"] == "executor"
         assert set(doc["compile_cache"]) == {
-            "disk_hit", "disk_miss", "corrupt", "store", "store_error"}
+            "disk_hit", "disk_miss", "corrupt", "corrupt_digest",
+            "corrupt_deserialize", "store", "store_error"}
