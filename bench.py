@@ -580,18 +580,32 @@ def _measure(tag, on_accel, use_flash, batch, seq, n_steps,
     exe.run(feed=feed, fetch_list=fetch)
 
     # timed steps; keep fetches on device so the loop isn't serialized on
-    # per-step host readbacks (sync once at the end)
+    # per-step host readbacks (sync once at the end). The goodput
+    # account decomposes the same window: productive step time vs any
+    # in-loop compiles/retries (a warm steady-state loop should report
+    # goodput ~1.0 — a sag here means the cache is churning)
+    from paddle_tpu.observability import runhealth as _rh
+
     seed_slowdown = os.environ.get("PADDLE_TPU_BENCH_SEED_SLOWDOWN")
+    acct = obs.GoodputAccount()
+    prev_acct = _rh.set_active_goodput(acct)
+    acct.start()
     t0 = time.time()
-    for _ in range(n_steps):
-        if seed_slowdown:
-            # deliberate regression for perf_lane.sh: dropping the
-            # executable LRU forces a cache lookup + AOT reload every
-            # step, which --check-regressions must flag
-            exe._cache.clear()
-        out = exe.run(feed=feed, fetch_list=fetch, return_numpy=False)
-    last = float(np.asarray(out[0]))
-    dt = time.time() - t0
+    try:
+        for _ in range(n_steps):
+            if seed_slowdown:
+                # deliberate regression for perf_lane.sh: dropping the
+                # executable LRU forces a cache lookup + AOT reload every
+                # step, which --check-regressions must flag
+                exe._cache.clear()
+            with acct.step():
+                out = exe.run(feed=feed, fetch_list=fetch,
+                              return_numpy=False)
+        last = float(np.asarray(out[0]))
+        dt = time.time() - t0
+    finally:
+        acct.stop()
+        _rh.set_active_goodput(prev_acct)
     tokens_per_sec = n_steps * batch * seq / dt
 
     variant = {
@@ -605,6 +619,7 @@ def _measure(tag, on_accel, use_flash, batch, seq, n_steps,
         "compile_s": round(compile_s, 1),
         "loss_first": round(loss0, 4),
         "loss_last": round(last, 4),
+        "goodput_fraction": round(acct.goodput_fraction(), 4),
     }
     # static roofline prediction next to the measurement: the
     # predicted-vs-measured column continuously validates the analyzer's
@@ -1965,6 +1980,16 @@ def child_main(status_path):
             # DeviceProfile.calibrated_from fits effective roofline
             # constants from it
             doc["ledger"] = _obs.get_ledger().snapshot()
+            # per-variant goodput fractions ride under "runhealth" so
+            # `python -m paddle_tpu.observability run <this file>`
+            # reads the same doc the perf CLI does
+            goodput = {
+                v["tag"]: v["goodput_fraction"]
+                for v in st.data.get("variants", [])
+                if isinstance(v, dict) and "goodput_fraction" in v}
+            if goodput:
+                doc["runhealth"] = {
+                    "goodput": {"per_variant": goodput}}
             _atomic_write_json(tel_out, doc)
         except Exception as e:  # noqa: BLE001 — never sink the bench
             st.error("telemetry-out failed: %s: %s"

@@ -1,0 +1,140 @@
+#!/usr/bin/env bash
+# Training-run health lane (ISSUE 18): convergence flight recorder,
+# goodput accounting, divergence-triggered rollback.
+#
+#   bash bench_experiments/runhealth_lane.sh
+#
+# Lane 1 runs the runhealth pytest slice INCLUDING its slow-marked
+# budget tests (goodput decomposition residual < 5% of wall-clock on a
+# real multi-step CPU run; one StepSeries.record() < 1% of a pipelined
+# CPU step). Lane 2 is the acceptance drill end to end in one process:
+# a guarded training run is seeded with NaN batches mid-run, the
+# divergence detector fires, the autopilot (apply mode) executes
+# exactly one gated journaled rollback_lr_cut back to the last finite
+# checkpoint, the detect->decide->act->verify trail shares one trace
+# id in a merged Perfetto doc, training converges afterwards, and the
+# `run` CLI renders the health report + an A/B comparison against the
+# recovered leg.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PADDLE_TPU_TELEMETRY=on
+
+echo "== lane 1: runhealth pytest slice (incl. slow budget tests) =="
+python -m pytest -q -p no:cacheprovider tests/test_runhealth.py
+
+echo "== lane 2: end-to-end divergence drill + run CLI =="
+WORK_DIR=$(mktemp -d /tmp/paddle_tpu_runhealth_lane.XXXXXX)
+trap 'rm -rf "$WORK_DIR"' EXIT
+export RUNHEALTH_LANE_DIR="$WORK_DIR"
+export PADDLE_TPU_TRACE_DIR="$WORK_DIR/traces"
+
+python - <<'EOF'
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.autopilot import ActionGate, Autopilot, DecisionJournal
+from paddle_tpu.fluid import resilience as R
+from paddle_tpu.observability import runhealth as rh
+
+work = os.environ["RUNHEALTH_LANE_DIR"]
+
+fluid.default_startup_program().random_seed = 42
+x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+y = fluid.layers.fc(input=x, size=3,
+                    param_attr=fluid.ParamAttr(name="w"))
+loss = fluid.layers.mean(y)
+opt = fluid.optimizer.SGD(learning_rate=0.1)
+opt.minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+
+
+def feed_fn(step):
+    if step in (21, 22):   # the seeded divergence
+        return {"x": np.full((2, 4), np.nan, dtype="float32")}
+    rng = np.random.RandomState(step)
+    return {"x": rng.rand(2, 4).astype("float32")}
+
+
+bundle = rh.RunHealth(jsonl_path=os.path.join(work, "steps.jsonl"))
+tg = R.TrainGuard(exe, ckpt_dir=os.path.join(work, "ckpt"),
+                  fetch_list=[loss], feed_fn=feed_fn,
+                  save_every=10, final_save=False,
+                  lr_var=opt._global_learning_rate(),
+                  runhealth=bundle)
+journal = DecisionJournal(path=os.path.join(work, "journal.jsonl"))
+pilot = Autopilot(ledger=obs.ExecutableLedger(), mode="apply",
+                  trainguard=tg, runhealth=bundle,
+                  gate=ActionGate(confirm_n=2, cooldown_s=300.0),
+                  journal=journal, train_lr_cut=0.5)
+
+tg.train(22)
+assert bundle.diverging()["kind"] == "nonfinite_loss", \
+    "seeded divergence was not detected"
+assert pilot.tick() == []          # hysteresis: confirm 1 of 2
+acts = pilot.tick()
+assert [(a.kind, a.outcome) for a in acts] \
+    == [("rollback_lr_cut", "verified")], acts
+act = acts[0]
+assert act.detail["restored_step"] == 20, act.detail
+assert pilot.tick() == [], "a second rollback was minted"
+ring = journal.entries()
+disk = DecisionJournal.read_jsonl(journal.path)
+assert disk[-len(ring):] == ring, "journal ring != disk suffix"
+
+# one incident trace across the whole decision
+spans = obs.read_spans(os.environ["PADDLE_TPU_TRACE_DIR"])
+names = {s["name"] for s in spans if s["trace"] == act.trace_id}
+assert {"autopilot.detect", "autopilot.decide", "autopilot.act",
+        "autopilot.verify"} <= names, names
+doc = obs.chrome_trace(spans, trace_id=act.trace_id)
+trace_out = os.path.join(work, "incident_trace.json")
+with open(trace_out, "w") as f:
+    json.dump(doc, f)
+print("drill: divergence at step 21 detected, one journaled "
+      "rollback_lr_cut to step %d (lr cut x%.2f), incident trace %s "
+      "spans %s" % (act.detail["restored_step"],
+                    act.detail["lr_cut"], act.trace_id[:16],
+                    sorted(names)))
+
+# converges afterwards: clean guarded steps from the restored state
+_, scope = tg._resolve()
+for step in range(23, 28):
+    out = tg.guard.run(fluid.default_main_program(),
+                       feed=feed_fn(step), fetch_list=[loss],
+                       scope=scope)
+    assert np.isfinite(np.asarray(out[0])).all(), step
+print("recovery: 5 post-rollback steps finite at the cut lr")
+
+# bank both legs for the CLI
+bundle.dump(os.path.join(work, "run_diverged.json"))
+b2 = rh.RunHealth()
+b2.goodput.start()
+for step in range(23, 43):
+    with b2.goodput.step():
+        out = tg.guard.run(fluid.default_main_program(),
+                           feed=feed_fn(step), fetch_list=[loss],
+                           scope=scope)
+    b2.series.record(step, loss=float(np.asarray(out[0]).reshape(-1)[0]))
+b2.goodput.stop()
+gp = b2.goodput.snapshot()
+assert gp["unaccounted_s"] < 0.05 * gp["wall_s"], gp
+print("goodput decomposition residual %.2f%% of wall (budget 5%%)"
+      % (100 * gp["unaccounted_s"] / gp["wall_s"]))
+b2.dump(os.path.join(work, "run_recovered.json"))
+EOF
+
+echo "== run CLI: health report (diverged leg) =="
+python -m paddle_tpu.observability run "$WORK_DIR/run_diverged.json"
+
+echo "== run CLI: A/B diverged vs recovered =="
+python -m paddle_tpu.observability run \
+    "$WORK_DIR/run_diverged.json" "$WORK_DIR/run_recovered.json"
+
+echo "runhealth lane: ALL GREEN"

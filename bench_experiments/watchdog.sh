@@ -58,6 +58,12 @@ PY
   echo "== integrity_lane start $(date -u)" >> $LOG
   bash bench_experiments/integrity_lane.sh > .bench_runs/integrity_lane.log 2>&1
   echo "== integrity_lane done rc=$? $(date -u)" >> $LOG
+  # run-health lane (ISSUE 18): flight-recorder slice + goodput/hook
+  # budgets + divergence-rollback drill. Non-blocking like the other
+  # lanes — a red drill is recorded for the next session.
+  echo "== runhealth_lane start $(date -u)" >> $LOG
+  bash bench_experiments/runhealth_lane.sh > .bench_runs/runhealth_lane.log 2>&1
+  echo "== runhealth_lane done rc=$? $(date -u)" >> $LOG
   for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
     # an experiment whose json already holds variants is DONE — its
     # results are cited in BENCHMARKS.md and must not be clobbered by

@@ -1,7 +1,7 @@
 """The self-healing control loop: ledger -> planner -> fleet.
 
 :class:`Autopilot` closes the observe/decide/act cycle the previous
-subsystems left open. One :meth:`tick` runs four legs in order:
+subsystems left open. One :meth:`tick` runs five legs in order:
 
 1. **calibrate** — measured step times the serving/bench loops feed
    into the :class:`~paddle_tpu.observability.ExecutableLedger` are
@@ -22,7 +22,15 @@ subsystems left open. One :meth:`tick` runs four legs in order:
    to a cross-replica vote; a replica its peers confirm as lying is
    pulled from rotation with ``quarantine_replica`` (journaled,
    gated, traced — and never the last decode replica).
-4. **drift** — when a measured step time departs the *calibrated*
+4. **train** — the active training run's convergence signal (a
+   :class:`~paddle_tpu.observability.RunHealth` bundle, usually the
+   one its :class:`~paddle_tpu.fluid.resilience.TrainGuard` carries):
+   divergence — non-finite loss, a loss-spike z-score, a grad-norm
+   explosion — confirmed over ``confirm_n`` ticks triggers a
+   journaled ``rollback_lr_cut``: restore the last checkpoint whose
+   state is entirely finite and scale the learning rate down. Never
+   acts on an unguarded executor.
+5. **drift** — when a measured step time departs the *calibrated*
    re-prediction beyond ``drift_tolerance_pct``, the planner re-ranks
    under the calibrated profile (``replan`` callback, typically a
    ``plan_search`` wrapper) and proposes the new config; in ``apply``
@@ -76,6 +84,11 @@ class Autopilot:
       SDCSentinel`; arms the integrity leg (cross-replica vote +
       ``quarantine_replica`` for confirmed-lying decode replicas).
     - ``router`` — a ServingRouter; arms warm-standby ``scale_up``.
+    - ``trainguard`` / ``runhealth`` — a
+      :class:`~paddle_tpu.fluid.resilience.TrainGuard` (and/or its
+      RunHealth bundle); arms the TRAIN leg's divergence-triggered
+      ``rollback_lr_cut`` (lr scaled by ``train_lr_cut``, default
+      0.5).
     - ``replan`` — ``callable(profile) -> proposal dict``; the drift
       leg's planner hook (wrap ``plan_search`` + ``best_runnable``).
     - ``measure`` / ``apply`` / ``rollback`` — the apply path:
@@ -91,7 +104,8 @@ class Autopilot:
     def __init__(self, ledger=None, tenants=None, router=None,
                  disagg=None, sentinel=None, replan=None, measure=None,
                  apply=None, rollback=None, mode=None, journal=None,
-                 gate=None,
+                 gate=None, trainguard=None, runhealth=None,
+                 train_lr_cut=0.5,
                  calibration_path=None, device_kind=None,
                  burn_threshold=1.0, slo_budget=0.1,
                  drift_tolerance_pct=50.0, verify_tolerance_pct=15.0,
@@ -110,6 +124,13 @@ class Autopilot:
         self._mode_override = mode
         self.journal = journal if journal is not None else DecisionJournal()
         self.gate = gate if gate is not None else ActionGate(clock=clock)
+        # TRAIN leg (observability/runhealth.py): a TrainGuard (and/or
+        # its RunHealth bundle) arms divergence-triggered rollback —
+        # confirmed divergence rolls back to the last finite checkpoint
+        # and cuts the lr by `train_lr_cut`
+        self.trainguard = trainguard
+        self.runhealth = runhealth
+        self.train_lr_cut = float(train_lr_cut)
         self.calibration_path = calibration_path
         self.device_kind = device_kind
         self.burn_threshold = float(burn_threshold)
@@ -177,6 +198,7 @@ class Autopilot:
         self._leg_calibrate(actions, mode)
         self._leg_slo(actions, mode)
         self._leg_integrity(actions, mode)
+        self._leg_train(actions, mode)
         self._leg_drift(actions, mode)
         return actions
 
@@ -540,7 +562,93 @@ class Autopilot:
             "verified" if failed == 0 else "applied",
             failed_streams=failed), ctx=ictx))
 
-    # -- leg 4: re-plan on drift --------------------------------------------
+    # -- leg 4: training divergence rollback --------------------------------
+    def _leg_train(self, actions, mode):
+        """Watch the active training run's convergence (a
+        :class:`~paddle_tpu.observability.RunHealth` bundle, either
+        passed directly or carried by the ``trainguard``): divergence
+        confirmed over ``confirm_n`` consecutive ticks triggers a
+        journaled rollback-to-last-finite-checkpoint + lr-cut. Quiet
+        without a runhealth signal."""
+        rh = self.runhealth
+        if rh is None:
+            rh = getattr(self.trainguard, "runhealth", None)
+        if rh is None:
+            return
+        try:
+            verdict = rh.diverging()
+        except Exception:  # noqa: BLE001 — detector bug != outage
+            obs.inc("autopilot.runhealth_errors")
+            return
+        trigger = "train:divergence"
+        if not self.gate.confirm(trigger, verdict is not None):
+            return
+        self.gate.clear(trigger)
+        if self.gate.quarantined(trigger):
+            actions.append(self._record(AutopilotAction(
+                "rollback_lr_cut", trigger, mode, outcome="rejected",
+                detail={"reason": "quarantined", "anomaly": verdict})))
+            return
+        if not self.gate.ready("rollback_lr_cut"):
+            return
+        self._train_incident(actions, mode, trigger, verdict)
+
+    def _train_incident(self, actions, mode, trigger, verdict):
+        """One confirmed divergence: detect -> decide -> act (rollback
+        + lr-cut via the TrainGuard) -> verify, children of one trace.
+        Never acts on an unguarded executor — without a TrainGuard
+        (whose every step runs under the GuardedExecutor) a state
+        restore could race a live unguarded dispatch."""
+        ctx = obs.TraceContext.new()
+        with self._span("autopilot.detect", ctx, trigger=trigger,
+                        anomaly=verdict.get("kind"),
+                        step=verdict.get("step"),
+                        last_step=verdict.get("last_step")) as sp:
+            ictx = sp.ctx if sp is not None else ctx
+        act = AutopilotAction(
+            "rollback_lr_cut", trigger, mode,
+            detail={"anomaly": verdict, "lr_cut": self.train_lr_cut})
+        tg = self.trainguard
+        guarded = tg is not None and getattr(tg, "guard", None) is not None
+        with self._span("autopilot.decide", ictx,
+                        kind="rollback_lr_cut", guarded=guarded):
+            pass
+        if not guarded:
+            actions.append(self._record(act.resolve(
+                "rejected", reason="no guarded executor"), ctx=ictx))
+            return
+        self.gate.stamp("rollback_lr_cut")
+        if mode != "apply":
+            actions.append(self._record(act, ctx=ictx))
+            return
+        with self._span("autopilot.act", ictx, kind="rollback_lr_cut",
+                        lr_cut=self.train_lr_cut):
+            try:
+                result = tg.rollback_to_last_finite(
+                    lr_scale=self.train_lr_cut)
+            except Exception as e:  # noqa: BLE001 — failed act = no change
+                actions.append(self._record(act.resolve(
+                    "rejected", error="%s: %s"
+                    % (type(e).__name__, str(e)[:200])), ctx=ictx))
+                return
+        if result is None:
+            actions.append(self._record(act.resolve(
+                "rejected", reason="no finite checkpoint"), ctx=ictx))
+            return
+        # rollback_to_last_finite only restores checkpoints whose float
+        # state verified finite — surface that check as the verify leg
+        with self._span("autopilot.verify", ictx,
+                        kind="rollback_lr_cut", finite=True,
+                        restored_step=result["step"],
+                        lr=result.get("lr")):
+            pass
+        obs.inc("autopilot.train_rollbacks")
+        actions.append(self._record(act.resolve(
+            "verified", restored_step=result["step"],
+            vars=result["vars"], skipped_steps=result["skipped_steps"],
+            lr=result.get("lr")), ctx=ictx))
+
+    # -- leg 5: re-plan on drift --------------------------------------------
     def _leg_drift(self, actions, mode):
         """Score measured step times against the *calibrated*
         re-prediction. Until the first calibration fit the leg stays

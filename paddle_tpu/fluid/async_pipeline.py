@@ -49,6 +49,7 @@ import numpy as np
 
 from . import core
 from .. import observability as obs
+from ..observability import runhealth as _runhealth
 from ..analysis import concurrency as _conc
 
 __all__ = ["PipelinedRunner", "ASYNC_DEPTH_ENV"]
@@ -180,7 +181,13 @@ class PipelinedRunner:
             while True:
                 if _conc._on:
                     _conc.note_blocking("queue.get")
+                t_wait = time.monotonic()
                 item = self._q.get()
+                # consumer-side queue wait IS the input-bound signal: a
+                # fully overlapped pipeline pops instantly, so any time
+                # here is data stall in the goodput decomposition
+                _runhealth.goodput_note(
+                    "data_stall", time.monotonic() - t_wait)
                 if item is _END:
                     break
                 if isinstance(item, tuple) and item[0] == "__error__":

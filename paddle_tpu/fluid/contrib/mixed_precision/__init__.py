@@ -115,6 +115,37 @@ class OptimizerWithMixedPrecision:
         knows the update op was already skip-gated in-graph."""
         return getattr(self, "_finite_flag", None)
 
+    def publish_step_telemetry(self, scope=None, skipped=None):
+        """Publish this step's AMP state to the telemetry hub: the
+        ``amp.loss_scale`` gauge (read from the scope on the dynamic
+        fp16 path, where the scale lives in the graph state; the static
+        float otherwise) and the ``amp.skipped_steps`` counter when
+        ``skipped`` is true (the in-graph gate zeroed this update).
+        GuardedExecutor calls this once per guarded step when built
+        with ``amp_optimizer=``; returns the published scale (or None
+        when the dynamic scale isn't resolvable host-side yet)."""
+        from .... import observability as obs
+
+        val = None
+        if self._scale_var is not None:
+            if scope is None:
+                from ...executor import global_scope
+
+                scope = global_scope()
+            raw = scope.find_value(self._scale_var.name)
+            if raw is not None:
+                try:
+                    val = float(np.asarray(raw).reshape(-1)[0])
+                except (TypeError, ValueError, IndexError):
+                    val = None
+        else:
+            val = float(self._loss_scaling)
+        if val is not None:
+            obs.set_gauge("amp.loss_scale", val)
+        if skipped:
+            obs.inc("amp.skipped_steps")
+        return val
+
     def _ensure_scale_state(self):
         from ...layers import tensor
 

@@ -23,6 +23,7 @@ from .framework import Program, Variable, default_main_program
 from .lowering import OpLoweringError, build_step_fn
 from .resilience import fault_check
 from .. import observability as obs
+from ..observability import runhealth as _runhealth
 # stdlib-only runtime guard (PADDLE_TPU_SCOPE_SANITIZER); the hot-path
 # cost with the sanitizer off is one module-bool check per Scope write
 from ..analysis import concurrency as _conc
@@ -284,10 +285,16 @@ class Executor:
         fetch_list = fetch_list or []
         fetch_names = [_as_name(f) for f in fetch_list]
 
+        # run-health phase split: three monotonic reads per step when a
+        # run-health bundle is active (TrainGuard pops the result right
+        # after this call returns), zero timestamps otherwise
+        rh_on = _runhealth.active() is not None
+        t_feed0 = time.monotonic() if rh_on else 0.0
         with obs.span("executor.run"):
             with obs.span("executor.feed_convert"):
                 feed_arrays = self._prepare_feeds(program, feed)
                 state = self._gather_state(program, scope)
+            t_feed1 = time.monotonic() if rh_on else 0.0
 
             sig = (
                 program._uid,
@@ -361,6 +368,7 @@ class Executor:
                     compile_cache.store(
                         disk_key, jitted, (state, feed_arrays, rng))
                 dt_compile = time.monotonic() - t_compile
+                _runhealth.goodput_note("compile", dt_compile)
                 obs.observe("executor.compile_seconds", dt_compile)
                 obs.event("compile_done", source="executor", count=False,
                           program=program._uid, version=program._version,
@@ -381,6 +389,7 @@ class Executor:
 
                 _dataflow.note_donation(scope, state)
                 _conc.note_blocking("device.dispatch")
+            t_comp0 = time.monotonic() if rh_on else 0.0
             with obs.span("executor.device_compute"):
                 try:
                     fetches, new_state = entry(state, feed_arrays, rng)
@@ -403,12 +412,20 @@ class Executor:
                         if hasattr(v, "block_until_ready"):
                             v.block_until_ready()
 
+            t_comp1 = time.monotonic() if rh_on else 0.0
             with obs.span("executor.fetch"):
                 for k, v in new_state.items():
                     scope.update(k, v)
                 if return_numpy:
-                    return [np.asarray(v) for v in fetches]
-                return list(fetches)
+                    result = [np.asarray(v) for v in fetches]
+                else:
+                    result = list(fetches)
+            if rh_on:
+                _runhealth.note_exec_phases(
+                    feed_convert_s=t_feed1 - t_feed0,
+                    compute_s=t_comp1 - t_comp0,
+                    fetch_s=time.monotonic() - t_comp1)
+            return result
 
     # ------------------------------------------------------------------
     def run_pipelined(self, program=None, feeds=None, fetch_list=None,

@@ -82,6 +82,7 @@ import numpy as np
 from . import core
 from .lowering import OpLoweringError
 from .. import observability as obs
+from ..observability import runhealth as _runhealth
 
 __all__ = [
     "FaultInjector", "FaultSpecError", "GuardedExecutor", "TrainGuard",
@@ -529,9 +530,11 @@ class EventLog:
         self._sink = sink
         self._recorder = recorder
         self._source = source
+        self._seq = 0
 
     def emit(self, kind, _forward=True, **fields):
-        ev = dict(kind=kind, **fields)
+        self._seq += 1
+        ev = dict(kind=kind, seq=self._seq, **fields)
         self.counters[kind] += 1
         self.events.append(ev)
         if self._sink is not None:
@@ -541,8 +544,30 @@ class EventLog:
                       recorder=self._recorder, **fields)
         return ev
 
-    def of(self, kind):
-        return [ev for ev in self.events if ev["kind"] == kind]
+    def last_seq(self):
+        """Sequence number of the newest event (0 before any emit).
+        Monotonic across ring rollover — feed it back as ``since_seq``
+        to poll incrementally."""
+        return self._seq
+
+    def of(self, kind, since_seq=None):
+        """Events of `kind`, oldest first. With ``since_seq`` only
+        events emitted AFTER that sequence number are returned — and,
+        because events land in seq order, the scan walks backwards and
+        stops at the watermark instead of rescanning the whole bounded
+        ring on every poll. Events that rolled off the deque before the
+        watermark are gone either way (the ring is bounded); a stale
+        watermark never raises, it just returns what survived."""
+        if since_seq is None:
+            return [ev for ev in self.events if ev["kind"] == kind]
+        out = []
+        for ev in reversed(self.events):
+            if ev["seq"] <= since_seq:
+                break
+            if ev["kind"] == kind:
+                out.append(ev)
+        out.reverse()
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -761,6 +786,7 @@ class GuardedExecutor:
                 self._emit("retry", attempt=attempt, delay=delay,
                            error="%s: %s" % (type(e).__name__, e),
                            **extra)
+                _runhealth.goodput_note("retry_backoff", delay)
                 time.sleep(delay)
 
         report = StepReport(fetches if fetches is not None else [])
@@ -790,7 +816,25 @@ class GuardedExecutor:
             self._emit("skip", consecutive=bad, managed=report.managed)
         else:
             self._consecutive_nonfinite = 0
+        if self.amp_optimizer is not None:
+            # loss-scale telemetry at the origin: one gauge read per
+            # step, plus the skipped-steps counter when AMP's in-graph
+            # gate owned this skip
+            publish = getattr(self.amp_optimizer,
+                              "publish_step_telemetry", None)
+            if publish is not None:
+                try:
+                    publish(scope=kwargs.get("scope"),
+                            skipped=report.skipped and report.managed)
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
         return report
+
+    def reset_nonfinite_streak(self):
+        """Forget consecutive non-finite history (call after restoring
+        state from a checkpoint — the streak belonged to the rolled-back
+        trajectory)."""
+        self._consecutive_nonfinite = 0
 
 
 def run_guarded(executor, program=None, feed=None, fetch_list=None,
@@ -836,7 +880,7 @@ class TrainGuard:
                  reader_restarts=2, restart_on_eof=True, max_to_keep=None,
                  save_wait=True, on_event=None, log_maxlen=10000,
                  recorder=None, compile_cache=False, stage_to_device=False,
-                 **guard_opts):
+                 runhealth=None, lr_var=None, **guard_opts):
         self._exe = executor
         self._program = program
         self._ckpt_dir = ckpt_dir
@@ -872,6 +916,15 @@ class TrainGuard:
         self._restart_on_eof = restart_on_eof
         self._max_to_keep = max_to_keep
         self._save_wait = save_wait
+        # run-health observatory (observability/runhealth.py): when a
+        # RunHealth bundle is passed, train() activates it, records a
+        # StepSeries entry per step (loss, retries, AMP state, the
+        # executor's phase split), and feeds its GoodputAccount
+        # (feed-wait, checkpoint, retry-backoff, crash-resume rework).
+        # lr_var names the learning-rate Variable (or its name) that
+        # rollback_to_last_finite's lr-cut scales in the scope.
+        self.runhealth = runhealth
+        self._lr_var = lr_var
         self.log = EventLog(maxlen=log_maxlen, sink=on_event,
                             recorder=recorder, source="resilience")
         self.guard = GuardedExecutor(
@@ -913,6 +966,7 @@ class TrainGuard:
         self.log.emit("restore", step=step, vars=restored,
                       dirname=self._ckpt_dir,
                       seconds=round(time.monotonic() - t0, 6))
+        self._account_rework(step)
         # warm-start invalidation: batches staged (host or device-side)
         # before the restore belong to the pre-crash stream position —
         # restart started readers so nothing stale is consumed. Emitted
@@ -926,6 +980,35 @@ class TrainGuard:
             self.log.emit("staging_invalidate", step=step,
                           reason="resume", readers=len(started))
         return int(step)
+
+    def _account_rework(self, resumed_step):
+        """Goodput restart-rework: steps the crashed run completed past
+        ``latest_step`` are re-executed after this resume — their wall
+        time (recovered from the previous run's StepSeries JSONL, read
+        through the tolerant reader so a torn crash-time line is
+        skipped, not fatal) is charged to the ``restart_rework``
+        bucket."""
+        rh = self.runhealth
+        if rh is None or not rh.series.jsonl_path:
+            return
+        try:
+            records, _dropped = rh.series.load(rh.series.jsonl_path)
+        except OSError:
+            return
+        lost = {}
+        for rec in records:
+            try:
+                s = int(rec["step"])
+            except (TypeError, ValueError):
+                continue
+            if s > resumed_step:
+                lost[s] = float(rec.get("step_s") or 0.0)
+        if lost:
+            rh.goodput.add("restart_rework", sum(lost.values()),
+                           steps=len(lost))
+            self.log.emit("restart_rework", resumed_step=resumed_step,
+                          steps=len(lost),
+                          seconds=round(sum(lost.values()), 6))
 
     def save(self, step, program=None, scope=None):
         """Checkpoint the program's persistable state as `step`."""
@@ -941,8 +1024,10 @@ class TrainGuard:
         ckpt.save_checkpoint(
             self._ckpt_dir, state, step=int(step),
             max_to_keep=self._max_to_keep, wait=self._save_wait)
+        dt = time.monotonic() - t0
+        _runhealth.goodput_note("checkpoint", dt)
         self.log.emit("save", step=int(step), vars=len(state),
-                      seconds=round(time.monotonic() - t0, 6))
+                      seconds=round(dt, 6))
 
     def _restart_readers(self, step, reason):
         for r in self._readers:
@@ -964,17 +1049,89 @@ class TrainGuard:
                 stage = getattr(r, "prefetch_to_device", None)
                 if stage is not None:
                     stage(self._exe.place)
+        rh = self.runhealth
+        if rh is None:
+            return self._train_loop(num_steps, program, scope)
+        # run-health active: the goodput window spans the whole call
+        # (resume/restore included), the executor/guard hooks feed the
+        # account, and every step lands one StepSeries record
+        prev = _runhealth.activate(rh)
+        rh.goodput.start()
+        try:
+            return self._train_loop(num_steps, program, scope)
+        finally:
+            rh.goodput.stop()
+            rh.series.flush()
+            _runhealth.deactivate(prev)
+
+    def _record_step(self, step, report, data_wait_s, step_s):
+        """One StepSeries record from what the loop can see: the first
+        fetch as the loss, guard/AMP step state, and the executor's
+        parked phase split."""
+        rh = self.runhealth
+        fields = dict(skipped=report.skipped, amp_skipped=report.managed,
+                      retries=report.retries, data_wait_s=data_wait_s,
+                      step_s=step_s)
+        if len(report):
+            try:
+                fields["loss"] = float(np.asarray(report[0]).reshape(-1)[0])
+            except (TypeError, ValueError, IndexError):
+                pass
+        for name, raw in getattr(report, "runhealth_extras",
+                                 {}).items():
+            try:
+                fields[name] = float(np.asarray(raw).reshape(-1)[0])
+            except (TypeError, ValueError, IndexError):
+                pass
+        phases = _runhealth.take_exec_phases()
+        if phases:
+            if phases.get("compute_s") is not None:
+                fields["compute_s"] = phases["compute_s"]
+            if phases.get("fetch_s") is not None:
+                fields["fetch_s"] = phases["fetch_s"]
+            if phases.get("feed_convert_s") is not None:
+                fields["feed_convert_s"] = phases["feed_convert_s"]
+        if self.guard.amp_optimizer is not None:
+            scale = obs.gauge("amp.loss_scale")
+            if scale is not None:
+                fields["loss_scale"] = scale
+        rh.series.record(step, **fields)
+
+    def _train_loop(self, num_steps, program, scope):
+        rh = self.runhealth
+        fetch_list = self._fetch_list
+        extra_names = []
+        if rh is not None and rh.extra_fetches:
+            # graph-side health signals (grad norms, schedule lr, ...)
+            # ride the fetch list and are stripped off the report below
+            extra_names = sorted(rh.extra_fetches)
+            fetch_list = list(self._fetch_list or []) \
+                + [rh.extra_fetches[k] for k in extra_names]
         start = self._maybe_resume(program, scope)
         completed = start
         last_saved = start if start else None
         last_eof_step = None
         step = start + 1
         while step <= num_steps:
+            t_feed = time.monotonic()
             feed = self._feed_fn(step) if self._feed_fn else None
+            feed_wait = time.monotonic() - t_feed
+            if rh is not None and self._feed_fn is not None:
+                # host-side batch production is input-pipeline time,
+                # not productive compute (py_reader waits are charged
+                # at the pipeline pop instead)
+                rh.goodput.add("data_stall", feed_wait)
+            t_step = time.monotonic()
             try:
-                report = self.guard.run(
-                    program, feed=feed, fetch_list=self._fetch_list,
-                    scope=scope)
+                if rh is not None:
+                    with rh.goodput.step():
+                        report = self.guard.run(
+                            program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+                else:
+                    report = self.guard.run(
+                        program, feed=feed, fetch_list=fetch_list,
+                        scope=scope)
             except core.EOFException:
                 self.log.emit("eof", step=step)
                 if not (self._readers and self._restart_on_eof):
@@ -999,9 +1156,16 @@ class TrainGuard:
                         step, "%s: %s" % (type(e).__name__, e))
                     continue
                 raise
+            if extra_names:
+                vals = [report.pop() for _ in extra_names]
+                report.runhealth_extras = dict(
+                    zip(extra_names, reversed(vals)))
             completed = step
             self.log.emit("step", step=step, skipped=report.skipped,
                           retries=report.retries)
+            if rh is not None:
+                self._record_step(step, report, feed_wait,
+                                  time.monotonic() - t_step)
             if (self._ckpt_dir and self._save_every
                     and step % self._save_every == 0):
                 self.save(step, program, scope)
@@ -1012,7 +1176,7 @@ class TrainGuard:
             self.save(completed, program, scope)
             last_saved = completed
         self.log.emit("final", step=completed)
-        return {
+        summary = {
             "resumed_from": start if start else None,
             "first_step": start + 1,
             "final_step": completed,
@@ -1021,3 +1185,83 @@ class TrainGuard:
             "counters": dict(self.log.counters),
             "events": list(self.log.events),
         }
+        if rh is not None:
+            summary["runhealth"] = rh.snapshot()
+        return summary
+
+    # -- divergence remediation -----------------------------------------
+    def rollback_to_last_finite(self, lr_scale=None, program=None,
+                                scope=None):
+        """Restore the newest checkpoint whose float state is entirely
+        finite (walking past any NaN-poisoned saves), optionally scale
+        the learning-rate variable by ``lr_scale``, and reset the
+        non-finite streak + detector windows so the restored trajectory
+        re-baselines. This is the autopilot TRAIN leg's act step.
+
+        Returns ``{"step", "vars", "skipped_steps", "lr", "lr_scale"}``
+        on success, None when no finite checkpoint exists (or there is
+        no ckpt_dir). The var restore is the same scope.update walk as
+        crash-resume, so the restored state is bit-identical to a clean
+        ``load_checkpoint`` resume from that step."""
+        if not self._ckpt_dir:
+            return None
+        from ..parallel import checkpoint as ckpt
+
+        if program is None or scope is None:
+            rprogram, rscope = self._resolve()
+            program = program or rprogram
+            scope = scope or rscope
+        t0 = time.monotonic()
+        state = None
+        chosen = None
+        skipped = []
+        for step in ckpt.all_steps(self._ckpt_dir):
+            try:
+                cand = ckpt.load_checkpoint(self._ckpt_dir, step=step)
+            except Exception:  # torn/corrupt save: keep walking back
+                skipped.append(int(step))
+                continue
+            finite = True
+            for arr in cand.values():
+                a = np.asarray(arr)
+                if a.dtype.kind == "f" and not np.isfinite(a).all():
+                    finite = False
+                    break
+            if finite:
+                state, chosen = cand, int(step)
+                break
+            skipped.append(int(step))
+        if state is None:
+            self.log.emit("rollback_failed", reason="no finite checkpoint",
+                          skipped_steps=skipped)
+            return None
+        src = getattr(program, "_program", program)
+        restored = 0
+        for v in src.list_vars():
+            if v.persistable and v.name in state:
+                scope.update(v.name, state[v.name])
+                restored += 1
+        out = {"step": chosen, "vars": restored,
+               "skipped_steps": skipped, "lr": None,
+               "lr_scale": lr_scale}
+        if lr_scale is not None and self._lr_var is not None:
+            name = getattr(self._lr_var, "name", self._lr_var)
+            raw = scope.find_value(name)
+            if raw is not None:
+                cut = np.asarray(raw, dtype="float32") * float(lr_scale)
+                scope.update(name, cut)
+                out["lr"] = float(cut.reshape(-1)[0])
+        # staged batches + failure streaks belong to the abandoned
+        # trajectory
+        started = [r for r in self._readers
+                   if getattr(r, "_started", False)]
+        for r in started:
+            r.restart()
+        self.guard.reset_nonfinite_streak()
+        if self.runhealth is not None:
+            self.runhealth.series.reset_anomalies()
+        self.log.emit("rollback", step=chosen, vars=restored,
+                      skipped_steps=skipped, lr_scale=lr_scale,
+                      lr=out["lr"], readers=len(started),
+                      seconds=round(time.monotonic() - t0, 6))
+        return out

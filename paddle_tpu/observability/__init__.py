@@ -286,6 +286,33 @@ Well-known data-integrity metrics (PR 17, ``paddle_tpu.integrity``):
   ``mailbox``) and, where known, the tensor — attribution rides the
   event, not just the counter.
 
+Well-known run-health metrics (PR 18, ``observability.runhealth``):
+
+- ``runhealth.steps`` counter — StepSeries records taken;
+  ``runhealth.loss`` / ``runhealth.grad_norm`` /
+  ``runhealth.loss_scale`` / ``runhealth.step_seconds`` gauges — the
+  latest recorded convergence signals.
+- ``runhealth.loss_spike`` / ``grad_explosion`` / ``nonfinite_loss``
+  / ``plateau`` / ``throughput_sag`` counters — streaming anomaly
+  detector firings; each also lands a flight-recorder event (source
+  ``runhealth``) carrying the step and the trailing-window evidence.
+- ``runhealth.goodput_fraction`` gauge — productive-step seconds /
+  run wall-clock at the last ``GoodputAccount.stop()``; the full
+  decomposition (``productive_step`` / ``compile`` / ``data_stall``
+  / ``checkpoint`` / ``retry_backoff`` / ``restart_rework``) rides
+  ``TrainGuard.train()``'s summary, crash dumps, and bench
+  ``--telemetry-out`` docs (under ``"runhealth"``).
+- ``amp.loss_scale`` gauge / ``amp.skipped_steps`` counter — the AMP
+  decorator's dynamic loss scale and in-graph overflow skips,
+  published once per guarded step (``GuardedExecutor`` with
+  ``amp_optimizer=``).
+- ``autopilot.train_rollbacks`` counter — verified
+  ``rollback_lr_cut`` actions the autopilot TRAIN leg executed on
+  confirmed divergence; ``autopilot.runhealth_errors`` — detector
+  polls that raised.
+- Render a run-health report or an A/B comparison with
+  ``python -m paddle_tpu.observability run <dir|snapshot.json> [B]``.
+
 Corruption fault grammar (``fluid.resilience``, chaos drills)::
 
     site:every=N:corrupt=MODE    # or site:at=N:corrupt=MODE
@@ -322,6 +349,11 @@ from .ledger import ExecutableLedger, get_ledger  # noqa: F401
 from .perf import (  # noqa: F401
     drift_rows, drift_summary, load_snapshot, render_drift_table,
 )
+from . import runhealth as _runhealth_mod
+from .runhealth import (  # noqa: F401
+    GoodputAccount, RunHealth, StepSeries, load_run,
+    render_comparison, render_health_report,
+)
 from .recorder import (  # noqa: F401
     CRASH_DUMP_ENV, FlightRecorder, crash_dump_path, get_recorder,
     install_excepthook,
@@ -347,6 +379,8 @@ __all__ = [
     "SLOMonitor", "replica_metrics_doc", "PROM_STYLE_ENV",
     "ExecutableLedger", "get_ledger", "drift_rows", "drift_summary",
     "load_snapshot", "render_drift_table",
+    "StepSeries", "GoodputAccount", "RunHealth", "load_run",
+    "render_health_report", "render_comparison",
 ]
 
 
@@ -423,8 +457,10 @@ def render_prom(style=None):
 
 
 def reset():
-    """Clear the hub, the global event ring, and the executable ledger
-    (testing / session scoping). Does not uninstall the excepthook."""
+    """Clear the hub, the global event ring, the executable ledger,
+    and the active run-health bundle (testing / session scoping). Does
+    not uninstall the excepthook."""
     _telemetry._hub.reset()
     _recorder._global.clear()
     _ledger_mod._global.clear()
+    _runhealth_mod.reset()

@@ -11,6 +11,13 @@ renders the executable ledger's predicted-vs-XLA-vs-measured drift
 table from a bench ``--telemetry-out`` file (the ledger rides under
 its ``"ledger"`` key), a bare ``ExecutableLedger.snapshot()`` JSON, or
 a directory of either.
+
+``python -m paddle_tpu.observability run <dir|snapshot.json> [B]``
+renders a training run-health report — goodput decomposition, loss
+trajectory, anomaly counts — from a ``RunHealth.dump()`` snapshot, a
+StepSeries JSONL, a crash dump, a bench ``--telemetry-out`` file, or
+a directory of any. With a second path it renders the A/B comparison
+table instead.
 """
 import argparse
 import json
@@ -18,6 +25,7 @@ import sys
 
 from . import distributed as _dist
 from . import perf as _perf
+from . import runhealth as _rh
 
 
 def _cmd_trace(args):
@@ -83,6 +91,38 @@ def _cmd_perf(args):
     return 0
 
 
+def _cmd_run(args):
+    run_a = _rh.load_run(args.path)
+    if run_a["series"] is None and run_a["goodput"] is None:
+        print("no run-health records under %s (want a RunHealth "
+              "snapshot JSON, a StepSeries JSONL, a crash dump, a "
+              "bench --telemetry-out file, or a directory of any)"
+              % args.path, file=sys.stderr)
+        return 1
+    if args.path_b:
+        run_b = _rh.load_run(args.path_b)
+        if run_b["series"] is None and run_b["goodput"] is None:
+            print("no run-health records under %s" % args.path_b,
+                  file=sys.stderr)
+            return 1
+        print("A: %s\nB: %s" % (run_a["path"], run_b["path"]))
+        print(_rh.render_comparison(run_a, run_b))
+    else:
+        print(_rh.render_health_report(run_a))
+    if args.out:
+        doc = {"a": run_a}
+        if args.path_b:
+            doc["b"] = run_b
+        tmp = args.out + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        import os
+
+        os.replace(tmp, args.out)
+        print("wrote %s" % args.out)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability",
@@ -104,6 +144,17 @@ def main(argv=None):
     pf.add_argument("-o", "--out", default=None,
                     help="also write the rows+summary as JSON here")
     pf.set_defaults(fn=_cmd_perf)
+    rn = sub.add_parser("run", help="render a training run-health "
+                        "report (goodput + anomalies), or an A/B "
+                        "comparison of two runs")
+    rn.add_argument("path", help="RunHealth snapshot JSON, StepSeries "
+                    "JSONL, crash dump, bench --telemetry-out file, "
+                    "or a directory of any")
+    rn.add_argument("path_b", nargs="?", default=None,
+                    help="optional second run for an A/B comparison")
+    rn.add_argument("-o", "--out", default=None,
+                    help="also write the loaded run doc(s) as JSON")
+    rn.set_defaults(fn=_cmd_run)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -158,9 +158,11 @@ class FlightRecorder:
     def crash_dump(self, path=None, exc=None):
         """Write the black box: last events + active spans + telemetry
         snapshot + the executable-ledger tail and compile-cache
-        hit/miss counters (what was compiled and resident at death),
-        plus the exception when given. Returns the path, or None if
-        even the dump write failed (a crash path must not raise)."""
+        hit/miss counters (what was compiled and resident at death) +
+        the active run's StepSeries tail and goodput decomposition
+        (convergence state at death), plus the exception when given.
+        Returns the path, or None if even the dump write failed (a
+        crash path must not raise)."""
         path = path or crash_dump_path()
         doc = {
             "wall": time.time(),
@@ -176,6 +178,15 @@ class FlightRecorder:
             doc["executables"] = _ledger.get_ledger().tail(16)
         except Exception:  # noqa: BLE001 — crash path must not raise
             doc["executables"] = []
+        try:
+            # convergence state at death: last-N StepSeries records +
+            # the goodput decomposition of the active training run
+            # (lazy import — runhealth imports this module)
+            from . import runhealth as _rh
+
+            doc["runhealth"] = _rh.crash_snapshot()
+        except Exception:  # noqa: BLE001
+            doc["runhealth"] = None
         try:
             hub = _t.get_telemetry()
             doc["compile_cache"] = {

@@ -1,0 +1,719 @@
+"""Training-run health observatory (paddle_tpu/observability/
+runhealth.py): StepSeries ring + streaming anomaly detectors,
+GoodputAccount wall-clock decomposition, TrainGuard/executor/AMP
+wiring, the run-health CLI, the EventLog since_seq bugfix, and the
+autopilot TRAIN leg's divergence-triggered rollback drill."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.autopilot import ActionGate, Autopilot, DecisionJournal
+from paddle_tpu.fluid import resilience as R
+from paddle_tpu.observability import runhealth as rh
+from paddle_tpu.parallel import checkpoint as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _scoped_obs():
+    """Scope hub counters/gauges + the active runhealth bundle to each
+    test, and never leak a fault injector."""
+    R.FaultInjector.uninstall()
+    obs.reset()
+    yield
+    R.FaultInjector.uninstall()
+    obs.reset()
+
+
+def _build_sgd_net(seed=42, lr=0.1, size=3):
+    fluid.default_startup_program().random_seed = seed
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=size,
+                        param_attr=fluid.ParamAttr(name="w"))
+    loss = fluid.layers.mean(y)
+    opt = fluid.optimizer.SGD(learning_rate=lr)
+    opt.minimize(loss)
+    return loss, opt
+
+
+def _feed(step, rows=2, scale=1.0):
+    rng = np.random.RandomState(step)
+    return {"x": (scale * rng.rand(rows, 4)).astype("float32")}
+
+
+# ---------------------------------------------------------------------------
+# StepSeries: ring, JSONL, detectors
+# ---------------------------------------------------------------------------
+
+
+class TestStepSeries:
+    def test_ring_bounds_and_total(self):
+        s = rh.StepSeries(maxlen=8)
+        for i in range(1, 21):
+            s.record(i, loss=1.0)
+        assert len(s) == 8
+        assert s.total == 20
+        assert [r["step"] for r in s.tail(3)] == [18, 19, 20]
+        assert s.last()["step"] == 20
+        assert obs.counter("runhealth.steps") == 20
+        assert obs.gauge("runhealth.loss") == 1.0
+
+    def test_jsonl_export_tolerates_torn_tail(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        s = rh.StepSeries(jsonl_path=path, flush_every=2)
+        for i in range(1, 6):
+            s.record(i, loss=1.0 / i, step_s=0.01)
+        s.flush()
+        # simulate a crash mid-append: torn final line
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"step": 6, "loss"')
+        records, dropped = rh.StepSeries.load(path)
+        assert [r["step"] for r in records] == [1, 2, 3, 4, 5]
+        assert dropped == 1
+        assert records[2]["loss"] == pytest.approx(1.0 / 3)
+
+    def test_dump_jsonl_roundtrip(self, tmp_path):
+        s = rh.StepSeries()
+        for i in range(1, 4):
+            s.record(i, loss=float(i), lr=0.1)
+        out = s.dump_jsonl(str(tmp_path / "ring.jsonl"))
+        records, dropped = rh.StepSeries.load(out)
+        assert dropped == 0
+        assert [r["loss"] for r in records] == [1.0, 2.0, 3.0]
+
+    def test_loss_spike_z_score_fires_once(self):
+        s = rh.StepSeries(window=16, spike_z=6.0)
+        for i in range(1, 21):
+            s.record(i, loss=1.0 + 0.01 * (i % 3))
+        assert s.anomalies["loss_spike"] == 0
+        s.record(21, loss=50.0)
+        assert s.anomalies["loss_spike"] == 1
+        assert obs.counter("runhealth.loss_spike") == 1
+        ev = [e for e in obs.get_recorder().tail()
+              if e["kind"] == "loss_spike"]
+        assert ev and ev[0]["step"] == 21
+        assert ev[0]["source"] == "runhealth"
+
+    def test_detectors_never_fire_cold(self):
+        s = rh.StepSeries()
+        s.record(1, loss=1e9, grad_norm=1e9, step_s=100.0)
+        assert sum(s.anomalies.values()) == 0
+
+    def test_nonfinite_loss(self):
+        s = rh.StepSeries()
+        s.record(1, loss=float("nan"))
+        s.record(2, loss=float("inf"))
+        assert s.anomalies["nonfinite_loss"] == 2
+
+    def test_grad_explosion_vs_trailing_median(self):
+        s = rh.StepSeries(explode_factor=10.0)
+        for i in range(1, 11):
+            s.record(i, grad_norm=1.0 + 0.1 * (i % 2))
+        s.record(11, grad_norm=100.0)
+        assert s.anomalies["grad_explosion"] == 1
+        assert s.anomalies["loss_spike"] == 0
+
+    def test_plateau(self):
+        s = rh.StepSeries(plateau_window=16, plateau_rel=1e-3)
+        for i in range(1, 40):
+            s.record(i, loss=0.5)        # perfectly flat
+        assert s.anomalies["plateau"] >= 1
+        # a healthily-descending run never plateaus
+        s2 = rh.StepSeries(plateau_window=16, plateau_rel=1e-3)
+        for i in range(1, 40):
+            s2.record(i, loss=1.0 / i)
+        assert s2.anomalies["plateau"] == 0
+
+    def test_throughput_sag(self):
+        s = rh.StepSeries(sag_factor=3.0)
+        for i in range(1, 11):
+            s.record(i, step_s=0.010)
+        s.record(11, step_s=0.100)
+        assert s.anomalies["throughput_sag"] == 1
+
+    def test_diverging_signal_recency_and_reset(self):
+        s = rh.StepSeries()
+        for i in range(1, 21):
+            s.record(i, loss=1.0)
+        s.record(21, loss=float("nan"))
+        d = s.diverging()
+        assert d and d["kind"] == "nonfinite_loss" and d["step"] == 21
+        # signal ages out once the run moves on
+        for i in range(22, 30):
+            s.record(i, loss=1.0)
+        assert s.diverging(recent=4) is None
+        s.record(30, loss=float("nan"))
+        assert s.diverging() is not None
+        s.reset_anomalies()
+        assert s.diverging() is None
+
+    def test_snapshot_aggregates(self):
+        s = rh.StepSeries()
+        for i in range(1, 6):
+            s.record(i, loss=1.0 / i, step_s=0.01, data_wait_s=0.002,
+                     skipped=(i == 3), retries=1 if i == 2 else 0)
+        snap = s.snapshot()
+        assert snap["steps"] == 5 and snap["last_step"] == 5
+        assert snap["loss_first"] == 1.0
+        assert snap["loss_last"] == pytest.approx(0.2)
+        assert snap["skipped"] == 1 and snap["retries"] == 1
+        assert snap["mean_step_s"] == pytest.approx(0.01)
+        json.dumps(snap)  # JSON-safe
+
+
+# ---------------------------------------------------------------------------
+# GoodputAccount
+# ---------------------------------------------------------------------------
+
+
+class TestGoodputAccount:
+    def test_decomposition_with_fake_clock(self):
+        t = [0.0]
+        acct = rh.GoodputAccount(clock=lambda: t[0])
+        acct.start()
+        with acct.step():
+            t[0] += 1.0
+        acct.add("checkpoint", 0.25)
+        t[0] += 0.25
+        with acct.step():
+            t[0] += 1.0
+        t[0] += 0.5                    # unaccounted loop overhead
+        acct.stop()
+        snap = acct.snapshot()
+        assert snap["wall_s"] == pytest.approx(2.75)
+        assert snap["buckets"]["productive_step"] == pytest.approx(2.0)
+        assert snap["buckets"]["checkpoint"] == pytest.approx(0.25)
+        assert snap["unaccounted_s"] == pytest.approx(0.5)
+        assert snap["goodput_fraction"] == pytest.approx(2.0 / 2.75)
+        assert obs.gauge("runhealth.goodput_fraction") == pytest.approx(
+            2.0 / 2.75)
+
+    def test_step_window_excludes_in_step_overhead(self):
+        t = [0.0]
+        acct = rh.GoodputAccount(clock=lambda: t[0])
+        acct.start()
+        with acct.step():
+            t[0] += 0.2
+            acct.add("compile", 0.8)   # compile inside exe.run
+            t[0] += 0.8
+        acct.stop()
+        snap = acct.snapshot()
+        assert snap["buckets"]["productive_step"] == pytest.approx(0.2)
+        assert snap["buckets"]["compile"] == pytest.approx(0.8)
+        assert snap["accounted_s"] == pytest.approx(snap["wall_s"])
+
+    def test_failed_step_not_productive(self):
+        t = [0.0]
+        acct = rh.GoodputAccount(clock=lambda: t[0])
+        acct.start()
+        with pytest.raises(RuntimeError):
+            with acct.step():
+                t[0] += 1.0
+                raise RuntimeError("boom")
+        assert acct.total("productive_step") == 0.0
+
+    def test_rework_steps_and_unknown_bucket(self):
+        acct = rh.GoodputAccount()
+        acct.add("restart_rework", 1.5, steps=3)
+        assert acct.rework_steps == 3
+        with pytest.raises(ValueError, match="unknown goodput bucket"):
+            acct.add("lunch", 1.0)
+
+    def test_goodput_note_inert_without_active_account(self):
+        assert rh.active_goodput() is None
+        rh.goodput_note("compile", 1.0)   # must not raise
+        acct = rh.GoodputAccount()
+        prev = rh.set_active_goodput(acct)
+        try:
+            rh.goodput_note("compile", 1.0)
+        finally:
+            rh.set_active_goodput(prev)
+        assert acct.total("compile") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# EventLog since_seq (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+
+class TestEventLogSinceSeq:
+    def test_seq_stamped_and_filter(self):
+        log = R.EventLog(maxlen=100)
+        for i in range(5):
+            log.emit("step", step=i)
+        log.emit("save", step=4)
+        assert log.last_seq() == 6
+        assert [e["step"] for e in log.of("step")] == [0, 1, 2, 3, 4]
+        assert [e["step"] for e in log.of("step", since_seq=3)] == [3, 4]
+        assert log.of("step", since_seq=6) == []
+        # incremental polling: nothing new after the watermark
+        mark = log.last_seq()
+        log.emit("step", step=5)
+        got = log.of("step", since_seq=mark)
+        assert [e["step"] for e in got] == [5]
+
+    def test_bounded_ring_rollover_regression(self):
+        """seq stays monotonic across deque rollover and since_seq
+        returns exactly the surviving events after the watermark —
+        the old full-ring rescan had no watermark at all."""
+        log = R.EventLog(maxlen=4)
+        for i in range(10):
+            log.emit("step", step=i)
+        assert log.last_seq() == 10
+        # ring holds seqs 7..10 (steps 6..9)
+        assert [e["step"] for e in log.of("step")] == [6, 7, 8, 9]
+        # watermark older than the ring: returns all survivors, no error
+        assert [e["step"] for e in log.of("step", since_seq=2)] \
+            == [6, 7, 8, 9]
+        assert [e["step"] for e in log.of("step", since_seq=8)] == [8, 9]
+        assert log.of("step", since_seq=10) == []
+        # mixed kinds roll over independently of the filter
+        log.emit("save", step=9)
+        log.emit("step", step=10)
+        assert [e["step"] for e in log.of("save", since_seq=0)] == [9]
+
+
+# ---------------------------------------------------------------------------
+# wiring: executor phases, TrainGuard, AMP, crash dump
+# ---------------------------------------------------------------------------
+
+
+class TestTrainGuardWiring:
+    def test_trainguard_records_series_and_goodput(self, tmp_path):
+        loss, _ = _build_sgd_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        bundle = rh.RunHealth(jsonl_path=str(tmp_path / "steps.jsonl"))
+        tg = R.TrainGuard(exe, ckpt_dir=str(tmp_path / "ckpt"),
+                          fetch_list=[loss], feed_fn=_feed,
+                          save_every=3, runhealth=bundle)
+        summary = tg.train(6)
+        assert summary["final_step"] == 6
+        assert len(bundle.series) == 6
+        recs = bundle.series.tail()
+        assert all(np.isfinite(r["loss"]) for r in recs)
+        # the executor's phase split rode along
+        assert all(r["step_s"] > 0 for r in recs)
+        assert all("compute_s" in r and "fetch_s" in r for r in recs)
+        gp = bundle.goodput.snapshot()
+        assert gp["wall_s"] > 0
+        assert gp["buckets"]["productive_step"] > 0
+        assert gp["buckets"]["checkpoint"] > 0        # saves at 3 and 6
+        assert gp["buckets"]["compile"] > 0           # first-step compile
+        assert summary["runhealth"]["goodput"]["buckets"] == gp["buckets"]
+        # deactivated on exit
+        assert rh.active() is None
+        # JSONL sidecar flushed on exit
+        records, dropped = rh.StepSeries.load(
+            str(tmp_path / "steps.jsonl"))
+        assert dropped == 0 and len(records) == 6
+
+    def test_extra_fetches_ride_and_strip(self, tmp_path):
+        loss, opt = _build_sgd_net(lr=0.25)
+        lr_var = opt._global_learning_rate()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        bundle = rh.RunHealth(extra_fetches={"lr": lr_var})
+        seen = []
+        tg = R.TrainGuard(exe, fetch_list=[loss], feed_fn=_feed,
+                          runhealth=bundle,
+                          on_event=lambda ev: seen.append(ev))
+        tg.train(3)
+        recs = bundle.series.tail()
+        assert all(r["lr"] == pytest.approx(0.25) for r in recs)
+        # the extra fetch never leaks into the user-visible report:
+        # loss stays the only fetch the step events were built from
+        assert len(bundle.series) == 3
+
+    def test_restart_rework_accounted_on_resume(self, tmp_path):
+        path = str(tmp_path / "steps.jsonl")
+        loss, _ = _build_sgd_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        b1 = rh.RunHealth(jsonl_path=path, flush_every=1)
+        tg1 = R.TrainGuard(exe, ckpt_dir=str(tmp_path / "ckpt"),
+                           fetch_list=[loss], feed_fn=_feed,
+                           save_every=3, final_save=False, runhealth=b1)
+        tg1.train(5)                     # ckpt at 3; steps 4,5 lost
+        assert ckpt.latest_step(str(tmp_path / "ckpt")) == 3
+        b2 = rh.RunHealth(jsonl_path=path, flush_every=1)
+        tg2 = R.TrainGuard(exe, ckpt_dir=str(tmp_path / "ckpt"),
+                           fetch_list=[loss], feed_fn=_feed,
+                           save_every=3, final_save=False, runhealth=b2)
+        tg2.train(5)                     # resumes at 4: re-runs 4,5
+        assert b2.goodput.rework_steps == 2
+        assert b2.goodput.total("restart_rework") > 0
+        assert [e["resumed_step"] for e in tg2.log.of("restart_rework")] \
+            == [3]
+
+    def test_crash_dump_carries_runhealth(self, tmp_path):
+        bundle = rh.RunHealth()
+        for i in range(1, 5):
+            bundle.series.record(i, loss=1.0 / i)
+        bundle.goodput.start()
+        bundle.goodput.add("compile", 0.1)
+        prev = rh.activate(bundle)
+        try:
+            path = obs.get_recorder().crash_dump(
+                str(tmp_path / "crash.json"))
+        finally:
+            rh.deactivate(prev)
+        doc = json.load(open(path))
+        tail = doc["runhealth"]["series_tail"]
+        assert [r["step"] for r in tail] == [1, 2, 3, 4]
+        assert doc["runhealth"]["goodput"]["buckets"]["compile"] \
+            == pytest.approx(0.1)
+        # inactive: the section is present but null
+        path2 = obs.get_recorder().crash_dump(
+            str(tmp_path / "crash2.json"))
+        assert json.load(open(path2))["runhealth"] is None
+
+
+class TestAMPTelemetry:
+    def _amp_net(self):
+        fluid.default_startup_program().random_seed = 7
+        x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(input=x, size=3))
+        from paddle_tpu.fluid.contrib.mixed_precision import decorate
+
+        opt = decorate(fluid.optimizer.SGD(learning_rate=0.1),
+                       init_loss_scaling=2.0 ** 10, use_bf16=False)
+        opt.minimize(loss)
+        return loss, opt
+
+    def test_publishes_loss_scale_gauge(self):
+        loss, opt = self._amp_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        guard = R.GuardedExecutor(exe, amp_optimizer=opt)
+        report = guard.run(feed=_feed(1), fetch_list=[loss])
+        assert not report.skipped
+        assert obs.gauge("amp.loss_scale") == pytest.approx(2.0 ** 10)
+        assert obs.counter("amp.skipped_steps") == 0
+
+    def test_skipped_step_bumps_counter(self):
+        loss, opt = self._amp_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        R.FaultInjector.install("fetch:at=1:nan")
+        guard = R.GuardedExecutor(exe, amp_optimizer=opt)
+        report = guard.run(feed=_feed(1), fetch_list=[loss])
+        assert report.skipped and report.managed
+        assert obs.counter("amp.skipped_steps") == 1
+
+    def test_static_scale_published_without_scope_read(self):
+        from paddle_tpu.fluid.contrib.mixed_precision import decorate
+
+        opt = decorate(fluid.optimizer.SGD(learning_rate=0.1),
+                       init_loss_scaling=128.0, use_bf16=True,
+                       use_dynamic_loss_scaling=False)
+        val = opt.publish_step_telemetry()
+        assert val == 128.0
+        assert obs.gauge("amp.loss_scale") == 128.0
+
+
+# ---------------------------------------------------------------------------
+# rollback + the autopilot TRAIN leg
+# ---------------------------------------------------------------------------
+
+
+class TestRollback:
+    def _trained_guard(self, tmp_path, **kw):
+        loss, opt = _build_sgd_net(lr=0.1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        tg = R.TrainGuard(exe, ckpt_dir=str(tmp_path / "ckpt"),
+                          fetch_list=[loss], feed_fn=_feed,
+                          save_every=2, final_save=False,
+                          lr_var=opt._global_learning_rate(), **kw)
+        tg.train(4)                     # ckpts at 2 and 4
+        return tg, loss, opt
+
+    def test_rolls_back_past_nonfinite_checkpoint(self, tmp_path):
+        tg, loss, _ = self._trained_guard(tmp_path)
+        dirname = str(tmp_path / "ckpt")
+        clean = ckpt.load_checkpoint(dirname, step=4)
+        # a poisoned newer checkpoint (NaN weights) must be skipped
+        bad = {k: np.full_like(np.asarray(v), np.nan)
+               if np.asarray(v).dtype.kind == "f" else v
+               for k, v in clean.items()}
+        ckpt.save_checkpoint(dirname, bad, step=6)
+        out = tg.rollback_to_last_finite()
+        assert out["step"] == 4 and out["skipped_steps"] == [6]
+        # bit-identical to a clean resume from the same checkpoint
+        _, scope = tg._resolve()
+        for name, v in clean.items():
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_value(name)), np.asarray(v))
+        assert [e["step"] for e in tg.log.of("rollback")] == [4]
+
+    def test_lr_cut_scales_scope_value(self, tmp_path):
+        tg, loss, opt = self._trained_guard(tmp_path)
+        _, scope = tg._resolve()
+        name = opt._global_learning_rate().name
+        before = float(np.asarray(scope.find_value(name)).reshape(-1)[0])
+        out = tg.rollback_to_last_finite(lr_scale=0.5)
+        assert out["lr"] == pytest.approx(0.5 * before)
+        after = float(np.asarray(scope.find_value(name)).reshape(-1)[0])
+        assert after == pytest.approx(0.5 * before)
+
+    def test_none_without_ckpt_or_finite(self, tmp_path):
+        loss, _ = _build_sgd_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        tg = R.TrainGuard(exe, fetch_list=[loss], feed_fn=_feed)
+        assert tg.rollback_to_last_finite() is None
+
+
+class TestAutopilotTrainLeg:
+    def _diverged_bundle(self):
+        bundle = rh.RunHealth()
+        for i in range(1, 20):
+            bundle.series.record(i, loss=1.0)
+        bundle.series.record(20, loss=float("nan"))
+        assert bundle.diverging()
+        return bundle
+
+    def test_quiet_without_runhealth(self):
+        pilot = Autopilot(ledger=obs.ExecutableLedger(), mode="apply")
+        assert pilot.tick() == []
+
+    def test_never_acts_on_unguarded_executor(self):
+        bundle = self._diverged_bundle()
+        gate = ActionGate(confirm_n=2, cooldown_s=0.0)
+        pilot = Autopilot(ledger=obs.ExecutableLedger(), mode="apply",
+                          runhealth=bundle, gate=gate)
+        assert pilot.tick() == []            # confirm 1 of 2
+        acts = pilot.tick()
+        assert [a.kind for a in acts] == ["rollback_lr_cut"]
+        assert acts[0].outcome == "rejected"
+        assert acts[0].detail["reason"] == "no guarded executor"
+        assert acts[0].trace_id
+
+    def test_propose_mode_journals_without_acting(self, tmp_path):
+        loss, _ = _build_sgd_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        bundle = self._diverged_bundle()
+        tg = R.TrainGuard(exe, ckpt_dir=str(tmp_path / "ckpt"),
+                          fetch_list=[loss], feed_fn=_feed,
+                          save_every=2, runhealth=bundle)
+        tg.train(2)
+        w0 = np.asarray(tg._resolve()[1].find_value("w")).copy()
+        gate = ActionGate(confirm_n=1, cooldown_s=60.0)
+        pilot = Autopilot(ledger=obs.ExecutableLedger(), mode="propose",
+                          trainguard=tg, runhealth=bundle, gate=gate)
+        acts = pilot.tick()
+        assert [a.outcome for a in acts] == ["proposed"]
+        assert acts[0].detail["anomaly"]["kind"] == "nonfinite_loss"
+        np.testing.assert_array_equal(
+            np.asarray(tg._resolve()[1].find_value("w")), w0)
+        # gate cooldown: the proposal does not re-mint every tick
+        assert pilot.tick() == []
+
+
+@pytest.mark.chaos
+def test_divergence_drill_rollback_and_recovery(tmp_path, monkeypatch):
+    """The PR's chaos acceptance: a seeded NaN divergence is detected
+    within the window, the autopilot journals exactly ONE gated
+    rollback_lr_cut (ring == disk suffix), the restored weights are
+    bit-identical to a clean resume from the same checkpoint, the
+    detect -> decide -> act -> verify trail shares one trace id, and
+    the run converges (finite loss) afterwards."""
+    monkeypatch.setenv(obs.TRACE_DIR_ENV, str(tmp_path / "traces"))
+    loss, opt = _build_sgd_net(lr=0.1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def feed_fn(step):
+        if step in (11, 12):   # the seeded divergence: NaN batches
+            return {"x": np.full((2, 4), np.nan, dtype="float32")}
+        return _feed(step)
+
+    bundle = rh.RunHealth(jsonl_path=str(tmp_path / "steps.jsonl"))
+    tg = R.TrainGuard(exe, ckpt_dir=str(tmp_path / "ckpt"),
+                      fetch_list=[loss], feed_fn=feed_fn,
+                      save_every=5, final_save=False,
+                      lr_var=opt._global_learning_rate(),
+                      runhealth=bundle)
+    journal = DecisionJournal(path=str(tmp_path / "journal.jsonl"))
+    gate = ActionGate(confirm_n=2, cooldown_s=300.0)
+    pilot = Autopilot(ledger=obs.ExecutableLedger(), mode="apply",
+                      trainguard=tg, runhealth=bundle, gate=gate,
+                      journal=journal, train_lr_cut=0.5)
+
+    tg.train(12)               # ckpts at 5, 10; steps 11-12 diverge
+    # detector fired within the window, on the diverging steps
+    assert bundle.series.anomalies["nonfinite_loss"] >= 1
+    assert bundle.diverging()["kind"] == "nonfinite_loss"
+    # the NaN batches poisoned the live weights (that is the incident)
+    _, scope = tg._resolve()
+    assert not np.isfinite(np.asarray(scope.find_value("w"))).all()
+
+    # two ticks to confirm through hysteresis -> exactly one action
+    assert pilot.tick() == []
+    acts = pilot.tick()
+    assert [(a.kind, a.outcome) for a in acts] \
+        == [("rollback_lr_cut", "verified")]
+    act = acts[0]
+    assert act.detail["restored_step"] == 10
+    # exactly one: anomalies reset + gate cooldown keep it that way
+    assert pilot.tick() == []
+    all_acts = journal.entries()
+    assert [a["kind"] for a in all_acts] == ["rollback_lr_cut"]
+    # journal ring == disk suffix (the append-only audit trail)
+    disk = DecisionJournal.read_jsonl(journal.path)
+    assert disk[-len(all_acts):] == all_acts
+
+    # bit-identical to a clean resume from the same checkpoint
+    clean = ckpt.load_checkpoint(str(tmp_path / "ckpt"), step=10)
+    lr_name = opt._global_learning_rate().name
+    for name, v in clean.items():
+        got = np.asarray(scope.find_value(name))
+        if name == lr_name:
+            np.testing.assert_allclose(got, 0.5 * np.asarray(v))
+        else:
+            np.testing.assert_array_equal(got, np.asarray(v))
+
+    # one incident trace: detect -> decide -> act -> verify
+    assert act.trace_id
+    spans = obs.read_spans(str(tmp_path / "traces"))
+    names = {s["name"] for s in spans if s["trace"] == act.trace_id}
+    assert {"autopilot.detect", "autopilot.decide", "autopilot.act",
+            "autopilot.verify"} <= names
+    doc = obs.chrome_trace(spans, trace_id=act.trace_id)
+    assert any("autopilot" in p for p in doc["otherData"]["processes"])
+
+    # and the run converges afterwards: guarded steps on clean batches
+    # from the rolled-back state stay finite
+    out = tg.guard.run(fluid.default_main_program(), feed=_feed(13),
+                       fetch_list=[loss], scope=scope)
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert obs.counter("autopilot.train_rollbacks") == 1
+
+
+# ---------------------------------------------------------------------------
+# the run CLI
+# ---------------------------------------------------------------------------
+
+
+class TestRunCLI:
+    def _dump(self, tmp_path, name="run.json", steps=10, base=1.0):
+        bundle = rh.RunHealth()
+        bundle.goodput.start()
+        for i in range(1, steps + 1):
+            with bundle.goodput.step():
+                time.sleep(0.001)
+            bundle.series.record(i, loss=base / i, step_s=0.001)
+        bundle.goodput.stop()
+        return bundle.dump(str(tmp_path / name))
+
+    def test_load_run_snapshot_json(self, tmp_path):
+        path = self._dump(tmp_path)
+        run = rh.load_run(path)
+        assert run["series"]["steps"] == 10
+        assert run["goodput"]["goodput_fraction"] > 0
+        report = rh.render_health_report(run)
+        assert "goodput fraction" in report
+        assert "productive-step s" in report
+
+    def test_load_run_jsonl_and_dir(self, tmp_path):
+        s = rh.StepSeries(jsonl_path=str(tmp_path / "steps.jsonl"),
+                          flush_every=1)
+        for i in range(1, 6):
+            s.record(i, loss=1.0 / i)
+        run = rh.load_run(str(tmp_path / "steps.jsonl"))
+        assert run["series"]["steps"] == 5
+        assert run["series"]["loss_last"] == pytest.approx(0.2)
+        # directory scan finds the same evidence
+        run2 = rh.load_run(str(tmp_path))
+        assert run2["series"]["steps"] == 5
+
+    def test_cli_report_and_comparison(self, tmp_path, capsys):
+        from paddle_tpu.observability.__main__ import main
+
+        a = self._dump(tmp_path, "a.json", base=1.0)
+        b = self._dump(tmp_path, "b.json", base=2.0)
+        assert main(["run", a]) == 0
+        out = capsys.readouterr().out
+        assert "run health:" in out and "goodput fraction" in out
+        assert main(["run", a, b]) == 0
+        out = capsys.readouterr().out
+        assert "delta%" in out and "loss first" in out
+
+    def test_cli_rejects_empty(self, tmp_path, capsys):
+        from paddle_tpu.observability.__main__ import main
+
+        (tmp_path / "noise.json").write_text('{"unrelated": 1}')
+        assert main(["run", str(tmp_path)]) == 1
+        assert "no run-health records" in capsys.readouterr().err
+
+    def test_crash_dump_is_loadable(self, tmp_path):
+        bundle = rh.RunHealth()
+        for i in range(1, 4):
+            bundle.series.record(i, loss=1.0 / i)
+        prev = rh.activate(bundle)
+        try:
+            path = obs.get_recorder().crash_dump(
+                str(tmp_path / "crash.json"))
+        finally:
+            rh.deactivate(prev)
+        run = rh.load_run(path)
+        assert run["series"]["steps"] == 3
+
+
+# ---------------------------------------------------------------------------
+# budget assertions (lane-enforced; slow-marked out of tier-1 because
+# they assert on wall-clock ratios, which a loaded CI host can skew)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_goodput_decomposition_sums_within_5pct(tmp_path):
+    """Acceptance: the bucket decomposition + unaccounted residual sum
+    to measured wall-clock exactly (by construction), and the residual
+    the instrumentation could not attribute stays under 5% of wall."""
+    loss, _ = _build_sgd_net(size=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    bundle = rh.RunHealth()
+    tg = R.TrainGuard(exe, ckpt_dir=str(tmp_path / "ckpt"),
+                      fetch_list=[loss],
+                      feed_fn=lambda s: _feed(s, rows=64),
+                      save_every=10, runhealth=bundle)
+    tg.train(40)
+    snap = bundle.goodput.snapshot()
+    total = snap["accounted_s"] + snap["unaccounted_s"]
+    assert total == pytest.approx(snap["wall_s"], rel=1e-6)
+    assert snap["unaccounted_s"] < 0.05 * snap["wall_s"], snap
+
+
+@pytest.mark.slow
+def test_stepseries_hook_under_1pct_of_pipelined_step(tmp_path):
+    """Acceptance: one StepSeries.record() (ring append + detectors +
+    gauges) costs <1% of a pipelined CPU training step."""
+    loss, _ = _build_sgd_net(size=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = _feed(1, rows=64)
+    n = 30
+    runner = exe.run_pipelined(feeds=(feed for _ in range(n)),
+                               fetch_list=[loss], return_numpy=False)
+    t0 = time.monotonic()
+    for out in runner:
+        pass
+    float(np.asarray(out[0]))
+    step_s = (time.monotonic() - t0) / n
+
+    s = rh.StepSeries(jsonl_path=str(tmp_path / "steps.jsonl"))
+    t0 = time.monotonic()
+    for i in range(1, 2001):
+        s.record(i, loss=1.0 / i, grad_norm=1.0, lr=0.1,
+                 data_wait_s=0.001, compute_s=0.008, fetch_s=0.001,
+                 step_s=0.01)
+    hook_s = (time.monotonic() - t0) / 2000
+    assert hook_s < 0.01 * step_s, (hook_s, step_s)
