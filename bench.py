@@ -1469,6 +1469,176 @@ def _measure_disagg_serving(latency_clients=6, long_clients=2,
     }
 
 
+def _measure_spec_serving(clients=12, max_new=12):
+    """Speculative-decoding + prefix-cache KV reuse lane (ISSUE 19):
+    shared-prefix traffic (one 24-token system prompt, unique 4..8
+    token tails) against a plain DecodeEngine vs one with a PrefixPool
+    + draft model attached — recording tokens/s both ways, the draft
+    acceptance rate, and the redundant-prefill FLOPs ledger (the lane
+    FAILS unless >50%% of prefill rows are adopted instead of computed
+    and every reuse-path token stream is bit-identical to the plain
+    engine's) — plus a session-tiering leg where hibernate/resume
+    serves more concurrent conversations than the engine has slots
+    (gated by PADDLE_TPU_BENCH_SPEC=1)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import Scope
+    from paddle_tpu.models import gpt
+
+    def train(cfg, seed, steps=30):
+        framework.switch_main_program(framework.Program())
+        framework.switch_startup_program(framework.Program())
+        unique_name.switch()
+        fluid.default_startup_program().random_seed = seed
+        vs = gpt.build_gpt_lm(cfg, 16)
+        fluid.optimizer.Adam(5e-3).minimize(vs["loss"])
+        scope = Scope()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program(), scope=scope)
+        ids, labels = gpt.synthetic_lm_batch(cfg, 16, 16)
+        for _ in range(steps):
+            exe.run(feed={"gpt_ids": ids, "gpt_labels": labels},
+                    fetch_list=[vs["loss"]], scope=scope)
+        return scope
+
+    cfg = gpt.gpt_tiny(vocab=97, max_len=128)
+    tscope = train(cfg, seed=9)
+    # the draft trains on the SAME synthetic task (that alignment, not
+    # size, is what buys acceptance): 1 layer, half the width
+    dcfg = gpt.GPTConfig(vocab=97, hidden=16, num_layers=1, heads=2,
+                         ffn=32, max_len=128, dropout=0.0)
+    dscope = train(dcfg, seed=13)
+
+    cache_len, buckets = 64, (8, 32)
+    shared_len = 24
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab, shared_len).astype("int64")
+    prompts = [np.concatenate([shared, rng.integers(
+        1, cfg.vocab, 4 + (c % 5)).astype("int64")])
+        for c in range(clients)]
+
+    def drive(eng):
+        handles = [eng.submit(p, max_new=max_new) for p in prompts]
+        t0 = time.monotonic()
+        toks = [h.result(180.0) for h in handles]
+        return toks, time.monotonic() - t0
+
+    # -- leg 1: plain engine (every prompt cold-prefills in full) ------
+    base = serving.DecodeEngine(
+        cfg, tscope, slots=2, cache_len=cache_len,
+        prompt_buckets=buckets, queue_capacity=256, name="spec-base")
+    base.warmup(check_hbm=False)
+    drive(base)  # warm the dispatch path once
+    ref_toks, base_wall = drive(base)
+    base_rows = base.stats()["prefill_rows_computed"]
+    base.stop(drain=True)
+
+    # -- leg 2: prefix pool + draft (k=4) ------------------------------
+    unique_name.switch()
+    reuse = serving.DecodeEngine(
+        cfg, tscope, slots=2, cache_len=cache_len,
+        prompt_buckets=buckets, queue_capacity=256, name="spec-reuse",
+        draft=serving.DraftModel(dcfg, dscope, k=4, name="spec-draft"),
+        prefix_pool=serving.PrefixPool(prefix_lens=(shared_len,),
+                                       name="spec-bench"))
+    reuse.warmup(check_hbm=False)
+    drive(reuse)  # seed the pool + warm; correctness scored on run 2
+    got_toks, reuse_wall = drive(reuse)
+    if got_toks != ref_toks:
+        raise RuntimeError(
+            "reuse-path tokens diverged from the plain engine "
+            "(speculation/prefix adoption must be bit-exact)")
+    info = reuse.reuse_info()
+    st = reuse.stats()
+    reuse.stop(drain=True)
+    saved_pct = info["prefill_rows_saved_pct"]
+    if saved_pct is None or saved_pct <= 50.0:
+        raise RuntimeError(
+            "prefix reuse saved only %r%% of prefill rows (need >50%%)"
+            % (saved_pct,))
+
+    # -- leg 3: session tiering — conversations > slots ----------------
+    unique_name.switch()
+    n_sessions, slots = 6, 2
+    # fp32 wire: the lane gates on bit-exact resume-vs-replay (int8
+    # wire is the capacity choice; its parity is argmax-stable, not
+    # bitwise, on an fp32-resident engine)
+    tier = serving.SessionTier(wire_dtype="fp32", name="spec-bench")
+    sess = serving.DecodeEngine(
+        cfg, tscope, slots=slots, cache_len=cache_len,
+        prompt_buckets=buckets, queue_capacity=256, name="spec-sess",
+        session_tier=tier)
+    sess.warmup(check_hbm=False)
+    turn1 = {c: prompts[c][:6 + (c % 3)] for c in range(n_sessions)}
+    turn2 = {c: rng.integers(1, cfg.vocab, 4).astype("int64")
+             for c in range(n_sessions)}
+    t0 = time.monotonic()
+    first = {c: sess.submit(turn1[c], max_new=6,
+                            session="conv%d" % c).result(180.0)
+             for c in range(n_sessions)}
+    second = {c: sess.submit(turn2[c], max_new=6,
+                             session="conv%d" % c).result(180.0)
+              for c in range(n_sessions)}
+    sess_wall = time.monotonic() - t0
+    sess_st = sess.stats()
+    tier_st = tier.stats()
+    sess.stop(drain=True)
+    if sess_st["resumed"] != n_sessions:
+        raise RuntimeError(
+            "only %d/%d sessions resumed from the tier"
+            % (sess_st["resumed"], n_sessions))
+    # tiering-off comparison: turn 2 replays the full transcript cold
+    unique_name.switch()
+    cold = serving.DecodeEngine(
+        cfg, tscope, slots=slots, cache_len=cache_len,
+        prompt_buckets=buckets, queue_capacity=256, name="spec-cold")
+    cold.warmup(check_hbm=False)
+    for c in range(n_sessions):
+        transcript = np.concatenate(
+            [turn1[c], np.asarray(first[c], np.int64), turn2[c]])
+        toks = cold.generate(transcript, max_new=6, timeout=180.0)
+        if toks != second[c]:
+            raise RuntimeError(
+                "session resume diverged from the cold transcript "
+                "replay (delta adoption must be bit-exact)")
+    cold_rows = cold.stats()["prefill_rows_computed"]
+    cold.stop(drain=True)
+
+    return {
+        "clients": clients,
+        "shared_prefix_len": shared_len,
+        "baseline_tokens_per_sec": round(
+            clients * max_new / base_wall, 1),
+        "reuse_tokens_per_sec": round(
+            clients * max_new / reuse_wall, 1),
+        "spec_accept_rate": round(st["spec_accept_rate"], 4),
+        "spec_rounds": int(st["spec_rounds"]),
+        "spec_fallback_steps": int(st["spec_fallback_steps"]),
+        "prefix_full_hits": int(st["prefix_full_hits"]),
+        "delta_prefills": int(st["delta_prefills"]),
+        "prefill_rows_computed_plain": int(base_rows),
+        "prefill_rows_computed_reuse": int(
+            info["prefill_rows_computed"]),
+        "prefill_rows_saved": int(info["prefill_rows_saved"]),
+        "prefill_flops_saved_pct": round(saved_pct, 1),
+        "bit_exact": True,
+        "sessions": n_sessions,
+        "session_slots": slots,
+        "sessions_per_chip_tiered": n_sessions,
+        "session_resumes": int(sess_st["resumed"]),
+        "session_hibernates": int(sess_st["hibernated"]),
+        "session_rows_computed_tiered": int(
+            sess_st["prefill_rows_computed"]),
+        "session_rows_computed_untiered": int(cold_rows),
+        "session_wall_s": round(sess_wall, 3),
+        "tier_bytes": int(tier_st["bytes"]),
+        "tier_wire_dtype": tier_st["wire_dtype"],
+    }
+
+
 def _measure_comms(steps=10, batch=64, hidden=256, n_layers=3):
     """Gradient-communication lane (ISSUE 10): the same dp training step
     three ways — GSPMD fp32 baseline, explicit bucketed comms fp32, and
@@ -1939,6 +2109,18 @@ def child_main(status_path):
             st.flush()
         except Exception as e:  # noqa: BLE001
             st.error("disagg_serving failed: %s: %s"
+                     % (type(e).__name__, str(e)[:300]))
+
+    if os.environ.get("PADDLE_TPU_BENCH_SPEC"):
+        # spec lane (ISSUE 19): prefix-cache KV adoption + speculative
+        # block-verify decode vs the plain engine — bit-exact tokens,
+        # >50% prefill rows adopted, sessions-per-chip via tiering
+        st.stage("spec_serving")
+        try:
+            st.data["detail"]["spec_serving"] = _measure_spec_serving()
+            st.flush()
+        except Exception as e:  # noqa: BLE001
+            st.error("spec_serving failed: %s: %s"
                      % (type(e).__name__, str(e)[:300]))
 
     if os.environ.get("PADDLE_TPU_BENCH_COMMS"):

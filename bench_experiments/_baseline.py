@@ -18,7 +18,8 @@ Store schema (``version`` 1)::
 Lanes are the bench's independently-measured sections: the headline
 training lane (keyed by the result's ``metric`` field, e.g.
 ``bert_tiny_pretrain_throughput_cpu``) plus ``serving`` /
-``decode_serving`` / ``disagg_serving`` when present. ``update`` keeps
+``decode_serving`` / ``disagg_serving`` / ``spec_serving`` when
+present. ``update`` keeps
 the BEST value per metric across rounds (direction-aware), so a lucky
 round ratchets the bar and a slow round never lowers it.
 
@@ -40,12 +41,18 @@ DEFAULT_TOLERANCES = {
     "ttft_ms_p99": ("lower", 25.0),
     "per_token_ms_p99": ("lower", 25.0),
     "predicted_oom": ("lower", 0.0),
+    # spec_serving lane (ISSUE 19): the prefix-adoption economics must
+    # not erode, and draft acceptance is seed-sensitive so it gets a
+    # wide band — the lane itself hard-fails under 50% rows saved
+    "prefill_flops_saved_pct": ("higher", 10.0),
+    "spec_accept_rate": ("higher", 40.0),
 }
 
 # keys lifted out of serving-style lane docs (top level + one nested
 # dict level, so decode_serving's inner sections are covered)
 _WANTED = ("ttft_ms_p99", "per_token_ms_p99", "tokens_per_sec",
-           "step_ms", "compile_s")
+           "step_ms", "compile_s", "prefill_flops_saved_pct",
+           "spec_accept_rate")
 
 
 def _num(v):
@@ -83,7 +90,8 @@ def extract_lanes(result):
     head["predicted_oom"] = _count_oom(detail.get("errors") or [])
     lane_name = result.get("metric") or "headline"
     lanes[lane_name] = head
-    for sect in ("serving", "decode_serving", "disagg_serving"):
+    for sect in ("serving", "decode_serving", "disagg_serving",
+                 "spec_serving"):
         doc = detail.get(sect)
         if not isinstance(doc, dict):
             continue

@@ -64,6 +64,12 @@ PY
   echo "== runhealth_lane start $(date -u)" >> $LOG
   bash bench_experiments/runhealth_lane.sh > .bench_runs/runhealth_lane.log 2>&1
   echo "== runhealth_lane done rc=$? $(date -u)" >> $LOG
+  # spec/KV-reuse lane (ISSUE 19): speculative-decode bit-exactness +
+  # prefix-pool adoption economics + session tiering. Non-blocking
+  # like the other lanes — a red run is recorded for the next session.
+  echo "== spec_lane start $(date -u)" >> $LOG
+  bash bench_experiments/spec_lane.sh > .bench_runs/spec_lane.log 2>&1
+  echo "== spec_lane done rc=$? $(date -u)" >> $LOG
   for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
     # an experiment whose json already holds variants is DONE — its
     # results are cited in BENCHMARKS.md and must not be clobbered by

@@ -378,41 +378,65 @@ def lint_parallel_plan(program, mesh, strategy=None, n_devices=None,
 
 
 def lint_decode_ladder(prompt_buckets, slot_counts=(1,), cache_lens=(),
-                       threshold=None, kv_dtypes=("fp32",)):
+                       threshold=None, kv_dtypes=("fp32",),
+                       delta_buckets=(), spec_blocks=(),
+                       draft_buckets=()):
     """Lint a decode engine's AOT program ladder BEFORE it compiles.
 
     A DecodeEngine compiles one prefill program per (prompt bucket,
     cache_len) and one step program per (slot count, cache_len, KV
     residency dtype) — a disaggregated fleet that runs both fp32- and
     int8-resident decode replicas doubles its step variants, which is
-    why ``kv_dtypes`` multiplies the step leg. An over-wide ladder
-    (per-token prompt buckets, a cache_len per client) quietly
-    re-creates the unbounded-shape-vocab hazard the feed lint catches
-    for dynamic axes — but here every rung is *declared*, so the feed
-    shapes all look static. Warns against the same
-    ``SHAPE_VOCAB_THRESHOLD`` budget; also flags non-pow2 prompt
-    buckets (each odd rung is a whole extra executable a pow2 ladder
-    would have covered)."""
+    why ``kv_dtypes`` multiplies the step leg. KV reuse and
+    speculation widen the ladder further, and each leg is declared
+    here so the estimate never undercounts: ``delta_buckets`` adds one
+    delta-prefill program per (bucket, cache_len) (prefix-pool +
+    session-tier engines), ``spec_blocks`` one block-verify program
+    per (block width, slot count, cache_len), and ``draft_buckets``
+    the attached draft model's own ladder — its prefill rungs plus one
+    draft step per slot count. An over-wide ladder (per-token prompt
+    buckets, a cache_len per client) quietly re-creates the
+    unbounded-shape-vocab hazard the feed lint catches for dynamic
+    axes — but here every rung is *declared*, so the feed shapes all
+    look static. Warns against the same ``SHAPE_VOCAB_THRESHOLD``
+    budget; also flags non-pow2 prompt buckets (each odd rung is a
+    whole extra executable a pow2 ladder would have covered)."""
     report = AnalysisReport(checks=["decode_ladder"])
     prompt_buckets = sorted({int(b) for b in (prompt_buckets or ())})
     slot_counts = sorted({int(s) for s in (slot_counts or (1,))})
     cache_lens = sorted({int(c) for c in (cache_lens or (1,))})
     kv_dtypes = sorted({str(d) for d in (kv_dtypes or ("fp32",))})
+    delta_buckets = sorted({int(b) for b in (delta_buckets or ())})
+    spec_blocks = sorted({int(b) for b in (spec_blocks or ())})
+    draft_buckets = sorted({int(b) for b in (draft_buckets or ())})
     threshold = SHAPE_VOCAB_THRESHOLD if threshold is None else threshold
+    spec_programs = len(cache_lens) * len(slot_counts) * len(spec_blocks)
+    draft_programs = 0
+    if draft_buckets:
+        draft_programs = len(cache_lens) * (
+            len(draft_buckets) + len(slot_counts))
     programs = len(cache_lens) * (
-        len(prompt_buckets) + len(slot_counts) * len(kv_dtypes))
+        len(prompt_buckets) + len(delta_buckets)
+        + len(slot_counts) * len(kv_dtypes)
+    ) + spec_programs + draft_programs
     report.meta["decode_ladder_programs"] = programs
     report.meta["decode_ladder_kv_dtypes"] = list(kv_dtypes)
+    report.meta["decode_ladder_delta_programs"] = (
+        len(cache_lens) * len(delta_buckets))
+    report.meta["decode_ladder_spec_programs"] = spec_programs
+    report.meta["decode_ladder_draft_programs"] = draft_programs
     if programs > threshold:
         report.add(
             WARNING, "unbounded-shape-vocab",
             "decode ladder compiles %d AOT programs (%d prompt buckets "
-            "+ %d slot counts x %d KV dtypes over %d cache lengths) — "
-            "over the %d shape-vocabulary budget; thin the "
-            "prompt-bucket ladder (pow2 rungs) and pin one "
-            "(slots, cache_len, kv_dtype) per engine"
-            % (programs, len(prompt_buckets), len(slot_counts),
-               len(kv_dtypes), len(cache_lens), threshold),
+            "+ %d delta buckets + %d slot counts x %d KV dtypes over "
+            "%d cache lengths, + %d verify + %d draft) — over the %d "
+            "shape-vocabulary budget; thin the prompt-bucket ladder "
+            "(pow2 rungs) and pin one (slots, cache_len, kv_dtype) "
+            "per engine"
+            % (programs, len(prompt_buckets), len(delta_buckets),
+               len(slot_counts), len(kv_dtypes), len(cache_lens),
+               spec_programs, draft_programs, threshold),
             block_idx=0)
     odd = [b for b in prompt_buckets
            if b & (b - 1) and b != max(prompt_buckets or [0])]

@@ -146,6 +146,31 @@ Well-known disaggregated-serving metrics (PR 12, ``serving.disagg``):
   ``serving.disagg.handoff_bytes.<engine>`` gauge price the KV handoff
   itself (int8 block-scaled wire ≈ 3.9x smaller than fp32).
 
+Well-known KV-reuse + speculation metrics (``serving.spec`` /
+``serving.prefix`` / ``serving.tier``):
+
+- ``serving.spec.accept_rate`` (and ``.<engine>``) gauges — cumulative
+  accepted draft tokens / proposed, the speculation economics dial
+  (tokens-per-dispatch ≈ 1 + k * accept_rate);
+  ``serving.spec.round_seconds`` histogram — one draft-propose +
+  block-verify round; ``serving.decode.spec_rounds`` /
+  ``spec_proposed`` / ``spec_accepted`` / ``spec_fallback_steps`` /
+  ``draft_step_errors`` counters (fallbacks are cache-edge demotions
+  to the plain step — correctness never depends on the draft).
+- ``serving.prefix.hits`` / ``misses`` / ``inserts`` / ``evictions``
+  counters and ``serving.prefix.entries`` / ``bytes`` gauges — the
+  prefix pool's LRU economy; ``serving.decode.prefix_full_hits`` /
+  ``delta_prefills`` counters split hits into zero-dispatch adoptions
+  vs suffix-only delta prefills, and
+  ``serving.decode.prefill_rows_computed`` / ``prefill_rows_saved``
+  counters are the redundant-prefill FLOPs ledger (saved/(saved+
+  computed) is the bench lane's headline).
+- ``serving.tier.hibernated`` / ``resumed`` / ``evictions`` counters
+  and ``serving.tier.sessions`` / ``bytes`` gauges — hibernated
+  sessions parked in host RAM (sessions-per-chip = live slots + what
+  fits the tier budget); ``serving.decode.hibernated`` / ``resumed``
+  count the engine-side lifecycle.
+
 Well-known concurrency/donation metrics (PR 13,
 ``analysis.concurrency`` / ``analysis.dataflow``):
 

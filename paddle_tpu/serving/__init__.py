@@ -33,6 +33,21 @@ engine (``reg.publish("gpt", DecodeEngine(cfg, scope))``) and streams
 per-token over ``POST /v1/models/<name>:generate`` (chunked
 transfer-encoding).
 
+Decode multiplies tokens/sec and sessions-per-chip with **KV reuse +
+speculation** (:mod:`~paddle_tpu.serving.prefix_pool`,
+:mod:`~paddle_tpu.serving.spec`): a :class:`PrefixPool` banks
+prefilled KV rows under content-hash prefix digests so shared-prefix
+traffic adopts instead of recomputing (full hits cost ZERO prefill
+FLOPs; partial hits delta-prefill only the unshared tail), a
+:class:`SessionTier` hibernates idle conversations' KV to host RAM in
+the int8 wire format and re-adopts them on resume, and a
+:class:`DraftModel` sidecar proposes ``k`` tokens per round for the
+target to verify in one block dispatch — bit-exact with plain greedy
+decode by construction, since every emitted token is the target's own
+argmax. All three attach as constructor kwargs
+(``DecodeEngine(cfg, scope, draft=..., prefix_pool=...,
+session_tier=...)``) and surface through ``/healthz`` reuse blocks.
+
 Decode scales past one engine by **disaggregating the phases**
 (:mod:`~paddle_tpu.serving.disagg`): prefill replicas turn prompts
 into serialized int8 block-scaled KV handoffs, step-only decode
@@ -71,7 +86,9 @@ from .engine import (  # noqa: F401
     DeadlineExceededError, EngineClosedError, ServingEngine, ShedError,
 )
 from .http import ServingHandler, ServingServer  # noqa: F401
+from .prefix_pool import PrefixPool, SessionTier, prefix_digest  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
+from .spec import DraftModel  # noqa: F401
 from .router import (  # noqa: F401
     LocalReplica, NoReplicasError, ReplicaGoneError, ReplicaWorker,
     RolloutError, ServingRouter, StoreReplica, local_fleet,
@@ -84,12 +101,13 @@ from .disagg import (  # noqa: F401  (after .decode/.router: it layers on them)
 
 __all__ = [
     "BucketSpec", "DeadlineExceededError", "DecodeEngine", "DecodeStream",
-    "DisaggReplica", "DisaggRouter", "DisaggStream",
+    "DisaggReplica", "DisaggRouter", "DisaggStream", "DraftModel",
     "EngineClosedError", "KVHandoff", "LocalReplica", "ModelRegistry",
-    "NoReplicasError", "PrefillEngine", "PrefillTicket",
+    "NoReplicasError", "PrefillEngine", "PrefillTicket", "PrefixPool",
     "ReplicaGoneError", "ReplicaWorker", "RolloutError", "ServingEngine",
-    "ServingHandler", "ServingRouter", "ServingServer", "ShedError",
-    "StoreReplica", "TenantSpec", "TenantTable", "default_prompt_buckets",
-    "disagg_fleet", "local_fleet", "make_engine_factory",
-    "round_up_pow2", "tail_signature",
+    "ServingHandler", "ServingRouter", "ServingServer", "SessionTier",
+    "ShedError", "StoreReplica", "TenantSpec", "TenantTable",
+    "default_prompt_buckets", "disagg_fleet", "local_fleet",
+    "make_engine_factory", "prefix_digest", "round_up_pow2",
+    "tail_signature",
 ]

@@ -58,6 +58,33 @@ fp32 scales — ~4x the decode slots at equal HBM, priced honestly by
 :meth:`submit_prefilled` adopts a serialized
 :class:`~paddle_tpu.serving.disagg.kv_wire.KVHandoff` from a prefill
 replica straight into a slot.
+
+KV-reuse + speculation hooks (``serving.prefix_pool`` /
+``serving.spec``):
+
+- ``prefix_pool=PrefixPool(...)`` — before a cold prefill the engine
+  hashes the prompt against the pool; a full hit adopts the cached
+  rows and emits the cached first token with NO program run, a prefix
+  hit adopts ``plen`` rows and **delta-prefills** only the suffix
+  (:func:`~paddle_tpu.models.gpt.build_gpt_prefill_delta`), and every
+  cold/delta prefill inserts its rows back. Redundant-prefill
+  economics land in the ``prefill_rows_computed`` /
+  ``prefill_rows_saved`` counters.
+- ``draft=DraftModel(...)`` — speculative decoding (fp32-resident
+  engines): each iteration the draft proposes ``k`` tokens and ONE
+  verify dispatch (:func:`~paddle_tpu.models.gpt.
+  build_gpt_verify_block`) scores the block; the longest prefix
+  matching the target's own greedy picks is emitted (plus the
+  correction/bonus token), so every stream stays bit-exact with
+  non-speculative decode while one dispatch yields up to ``k + 1``
+  tokens. Near the cache edge the engine falls back to the plain step
+  (mirrored into the draft via ``sync_step``). Acceptance is exported
+  as ``serving.spec.accept_rate``.
+- ``session_tier=SessionTier(...)`` — ``submit(session=...)``
+  hibernates the slot's KV rows to host RAM (the KVHandoff wire
+  format) when the stream retires, and a later submit with the same
+  session id re-adopts them and delta-prefills only the new turn, so
+  concurrent sessions stop being bounded by live slots.
 """
 import collections
 import queue
@@ -198,14 +225,22 @@ class DecodeStream:
 class _Request:
     __slots__ = ("prompt", "plen", "bucket", "max_new", "eos_id",
                  "deadline", "handle", "handoff", "tenant", "priority",
-                 "trace", "t_wall")
+                 "trace", "t_wall",
+                 # KV-reuse routing: "session" id (tiering), "base"
+                 # (adopted rows: a KVHandoff on resume, a pool entry
+                 # on a prefix hit), "start" adopted row count,
+                 # "suffix"/"sbucket" the delta-prefill tail, "hist"
+                 # the token-per-written-row history
+                 "session", "base", "start", "suffix", "sbucket",
+                 "hist")
 
 
 class _Slot:
     __slots__ = ("handle", "remaining", "eos_id", "t_prefill",
-                 "trace", "t_wall", "t_last")
+                 "trace", "t_wall", "t_last", "session", "hist")
 
-    def __init__(self, handle, remaining, eos_id, trace=None):
+    def __init__(self, handle, remaining, eos_id, trace=None,
+                 session=None, hist=None):
         self.handle = handle
         self.remaining = remaining
         self.eos_id = eos_id
@@ -215,6 +250,11 @@ class _Slot:
         self.trace = trace
         self.t_wall = time.time() if trace is not None else None
         self.t_last = self.t_prefill
+        # tiering: session id to hibernate under at retire, plus the
+        # token history whose rows the slot held at admission (the
+        # emitted tokens extend it — see _hibernate)
+        self.session = session
+        self.hist = hist
 
 
 class DecodeEngine:
@@ -245,7 +285,8 @@ class DecodeEngine:
                  request_timeout_s=60.0, name="default",
                  barrier=False, auto_start=True,
                  build_prefill=None, build_step=None,
-                 kv_dtype="fp32", role="colocated"):
+                 kv_dtype="fp32", role="colocated",
+                 draft=None, prefix_pool=None, session_tier=None):
         import jax
 
         import paddle_tpu.fluid as fluid
@@ -257,6 +298,17 @@ class DecodeEngine:
         if role not in ("colocated", "decode"):
             raise ValueError("role must be 'colocated' or 'decode', "
                              "got %r" % (role,))
+        if draft is not None and kv_dtype != "fp32":
+            raise ValueError(
+                "speculative decoding needs an fp32-resident cache "
+                "(the verify program scores the raw fp32 rows); drop "
+                "the draft or use kv_dtype='fp32'")
+        if role == "decode" and (prefix_pool is not None
+                                 or session_tier is not None):
+            raise ValueError(
+                "prefix_pool/session_tier need the delta-prefill "
+                "program a pure decode-role replica does not build — "
+                "attach them to the router's prefill side instead")
         if build_prefill is None or build_step is None:
             from ..models.gpt import (build_gpt_decode_step,
                                       build_gpt_decode_step_q,
@@ -288,6 +340,10 @@ class DecodeEngine:
                 "largest prompt bucket (%d) exceeds cache_len (%d)"
                 % (self.prompt_buckets[-1], self.cache_len))
 
+        self._prefix_pool = prefix_pool
+        self._session_tier = session_tier
+        self._draft = draft
+
         # -- build the program pair (never touching the caller's
         # default_main_program) and share ONE device param set ---------
         with fluid.program_guard(fluid.Program(), fluid.Program()):
@@ -299,8 +355,31 @@ class DecodeEngine:
                 with fluid.program_guard(fluid.Program(), fluid.Program()):
                     pv = build_prefill(cfg, b, self.cache_len)
                     prefill[b] = (fluid.default_main_program(), pv)
+        # delta-prefill ladder (prefix-pool hits + session resumes):
+        # same bucket widths as cold prefill, suffix-sized at use
+        delta = {}
+        if prefix_pool is not None or session_tier is not None:
+            from ..models.gpt import build_gpt_prefill_delta
+
+            for b in self.prompt_buckets:
+                with fluid.program_guard(fluid.Program(), fluid.Program()):
+                    dv = build_gpt_prefill_delta(cfg, b, self.cache_len)
+                    delta[b] = (fluid.default_main_program(), dv)
+        # block-verify program (speculative decoding): k proposals +
+        # the slot's current token = a k+1 wide block per dispatch
+        verify = None
+        if draft is not None:
+            from ..models.gpt import build_gpt_verify_block
+
+            with fluid.program_guard(fluid.Program(), fluid.Program()):
+                vv = build_gpt_verify_block(cfg, draft.k + 1,
+                                            self.cache_len)
+                verify = (fluid.default_main_program(), vv)
         persist = {}
-        for prog in [step_prog] + [p for p, _ in prefill.values()]:
+        all_progs = ([step_prog] + [p for p, _ in prefill.values()]
+                     + [p for p, _ in delta.values()]
+                     + ([verify[0]] if verify is not None else []))
+        for prog in all_progs:
             for v in prog.list_vars():
                 if not getattr(v, "persistable", False):
                     continue
@@ -337,6 +416,18 @@ class DecodeEngine:
             self._prefill_preds[b].ledger_tag = (
                 "decode.prefill:%s" % self.name)
             self._prefill_vars[b] = pv
+        self._delta_preds = {}
+        for b, (prog, dv) in delta.items():
+            self._delta_preds[b] = Predictor(
+                prog, dv["feed_names"], dv["fetch_vars"], scope=persist)
+            self._delta_preds[b].ledger_tag = (
+                "decode.delta_prefill:%s" % self.name)
+        self._verify_pred = None
+        if verify is not None:
+            prog, vv = verify
+            self._verify_pred = Predictor(
+                prog, vv["feed_names"], vv["fetch_vars"], scope=persist)
+            self._verify_pred.ledger_tag = "decode.verify:%s" % self.name
 
         # -- the persistent slot buffer pair + host-side slot state ----
         shape = (self.slots, cfg.num_layers, self.cache_len, cfg.hidden)
@@ -382,6 +473,8 @@ class DecodeEngine:
         # the disagg router (or a test); None = zero per-step overhead
         self._sentinel = None
         self._sentinel_id = self.name
+        if draft is not None:
+            draft.bind(self)
         if auto_start:
             self.start()
 
@@ -477,8 +570,55 @@ class DecodeEngine:
                 return b
         return None
 
+    def _route_request(self, prompt, plen, h):
+        """Build a partially-filled :class:`_Request` routed either
+        through a resumed session handoff ``h`` (adopt ``h.plen`` rows,
+        delta-prefill ``[h.next_token] + prompt``) or the cold path.
+        A resume whose geometry no longer fits a delta pass falls back
+        to cold-prefilling the full transcript."""
+        req = _Request()
+        req.base = None
+        req.start = 0
+        req.suffix = None
+        req.sbucket = None
+        if h is not None:
+            suffix = np.concatenate(
+                [[np.int64(h.next_token)], prompt]).astype(np.int64)
+            sbucket = self._bucket_for(len(suffix))
+            start = int(h.plen)
+            expect = (self.cfg.num_layers, self.cache_len,
+                      self.cfg.hidden)
+            if (tuple(h.shape) == expect and sbucket is not None
+                    and start + sbucket <= self.cache_len):
+                req.base = h
+                req.start = start
+                req.suffix = suffix
+                req.sbucket = sbucket
+                req.prompt = prompt
+                req.plen = plen
+                req.bucket = None
+                req.hist = np.concatenate(
+                    [np.asarray(h.prompt, np.int64), suffix])
+                self._bump("resumed")
+                return req
+            # transcript no longer delta-fits: replay it cold
+            prompt = np.concatenate(
+                [np.asarray(h.prompt, np.int64), suffix])
+            plen = int(prompt.size)
+        bucket = self._bucket_for(plen)
+        if bucket is None:
+            raise ValueError(
+                "prompt length %d exceeds the largest prompt bucket "
+                "(%d) — raise cache_len/prompt_buckets"
+                % (plen, self.prompt_buckets[-1]))
+        req.prompt = prompt
+        req.plen = plen
+        req.bucket = bucket
+        req.hist = prompt
+        return req
+
     def submit(self, prompt, max_new=None, eos_id=None, deadline_ms=None,
-               tenant=None, priority=None, trace_ctx=None):
+               tenant=None, priority=None, trace_ctx=None, session=None):
         """Enqueue one generation request; returns a
         :class:`DecodeStream`. Raises :class:`ShedError` when the queue
         is full, :class:`EngineClosedError` after ``stop()``, and
@@ -486,7 +626,15 @@ class DecodeEngine:
         ``tenant``/``priority`` are carried for observability — the
         disagg router schedules on them; a lone engine records them.
         A sampled ``trace_ctx`` puts this request's queue/prefill/
-        per-token spans into its distributed trace."""
+        per-token spans into its distributed trace.
+
+        ``session`` (with a ``session_tier`` attached) names a
+        resumable conversation: when the stream retires, the slot's KV
+        rows hibernate to host RAM under that id, and a later submit
+        with the same id adopts them back and delta-prefills only the
+        new ``prompt`` tokens (the continuation — NOT the transcript
+        so far, which the tier already holds). A first-time or evicted
+        session cold-prefills ``prompt`` as usual."""
         if self._closed:
             raise EngineClosedError(
                 "engine %r is draining/stopped" % self.name)
@@ -502,23 +650,28 @@ class DecodeEngine:
         if prompt.min() < 0 or prompt.max() >= self.cfg.vocab:
             raise ValueError(
                 "prompt token out of range [0, %d)" % self.cfg.vocab)
-        bucket = self._bucket_for(plen)
-        if bucket is None:
-            raise ValueError(
-                "prompt length %d exceeds the largest prompt bucket "
-                "(%d) — raise cache_len/prompt_buckets"
-                % (plen, self.prompt_buckets[-1]))
-        max_new = self.default_max_new if max_new is None else int(max_new)
-        if max_new < 1:
-            raise ValueError("max_new must be >= 1")
-        if plen + max_new - 1 > self.cache_len:
-            raise ValueError(
-                "prompt_len %d + max_new %d - 1 exceeds cache_len %d"
-                % (plen, max_new, self.cache_len))
-        req = _Request()
-        req.prompt = prompt
-        req.plen = plen
-        req.bucket = bucket
+        session = None if session is None else str(session)
+        h = None
+        if session is not None and self._session_tier is not None:
+            h = self._session_tier.resume(session)
+        try:
+            req = self._route_request(prompt, plen, h)
+            max_new = (self.default_max_new if max_new is None
+                       else int(max_new))
+            if max_new < 1:
+                raise ValueError("max_new must be >= 1")
+            total = (req.start + len(req.suffix) if req.base is not None
+                     else req.plen)
+            if total + max_new - 1 > self.cache_len:
+                raise ValueError(
+                    "context %d + max_new %d - 1 exceeds cache_len %d"
+                    % (total, max_new, self.cache_len))
+        except Exception:
+            if h is not None:
+                # a failed resume must not lose the hibernated session
+                self._session_tier.hibernate(session, h)
+            raise
+        req.session = session
         req.max_new = max_new
         req.eos_id = self.eos_id if eos_id is None else eos_id
         req.handoff = None
@@ -542,8 +695,14 @@ class DecodeEngine:
                     raise EngineClosedError(
                         "engine %r is draining/stopped" % self.name)
                 self._q.put_nowait(req)
+        except EngineClosedError:
+            if h is not None:
+                self._session_tier.hibernate(session, h)
+            raise
         except queue.Full:
             self._bump("shed")
+            if h is not None:
+                self._session_tier.hibernate(session, h)
             obs.event("shed", source="serving", model=self.name,
                       engine="decode", prompt_len=plen,
                       queue_capacity=self._q.maxsize)
@@ -566,7 +725,7 @@ class DecodeEngine:
 
     def submit_prefilled(self, handoff, max_new=None, eos_id=None,
                          deadline_ms=None, tenant=None, priority=None,
-                         trace_ctx=None):
+                         trace_ctx=None, session=None):
         """Enqueue a generation whose prefill already happened on
         another replica: ``handoff`` is a
         :class:`~paddle_tpu.serving.disagg.kv_wire.KVHandoff` whose KV
@@ -601,6 +760,12 @@ class DecodeEngine:
         req.max_new = max_new
         req.eos_id = self.eos_id if eos_id is None else eos_id
         req.handoff = handoff
+        req.session = None if session is None else str(session)
+        req.base = None
+        req.start = 0
+        req.suffix = None
+        req.sbucket = None
+        req.hist = req.prompt
         req.tenant = tenant
         req.priority = priority
         if deadline_ms is None:
@@ -659,6 +824,15 @@ class DecodeEngine:
             budget_bytes = profile.hbm_bytes if profile else None
         if not budget_bytes:
             return None
+        # co-resident KV-reuse state eats budget before the step does:
+        # an hbm-placed prefix pool reserves its full capacity, a bound
+        # draft its params + slot buffer pair
+        overhead = 0
+        if self._prefix_pool is not None:
+            overhead += self._prefix_pool.hbm_bytes()
+        if self._draft is not None:
+            overhead += self._draft.resident_bytes()
+        budget_bytes = budget_bytes - overhead
         jax = self._jax
         pred = self._step_pred
         sv = self._step_vars
@@ -714,7 +888,12 @@ class DecodeEngine:
         report = tpu_lint.lint_decode_ladder(
             self.prompt_buckets, slot_counts=(self.slots,),
             cache_lens=(self.cache_len,),
-            kv_dtypes=(self.kv_dtype,))
+            kv_dtypes=(self.kv_dtype,),
+            delta_buckets=tuple(sorted(self._delta_preds)),
+            spec_blocks=((self._draft.k + 1,)
+                         if self._draft is not None else ()),
+            draft_buckets=(tuple(self._draft._buckets)
+                           if self._draft is not None else ()))
         for d in report.findings:
             obs.event("decode_ladder_lint", source="serving",
                       model=self.name, message=d.message[:200])
@@ -747,6 +926,27 @@ class DecodeEngine:
                 "gpt_prefill_len": np.ones((1, 1), np.int64)})
             report.append({"program": "prefill", "bucket": b,
                            "source": source})
+        cache1 = (1, self.cfg.num_layers, self.cache_len,
+                  self.cfg.hidden)
+        for b in sorted(self._delta_preds):
+            source = self._delta_preds[b].warm({
+                "gpt_dpre_ids": np.zeros((1, b), np.int64),
+                "gpt_dpre_len": np.ones((1, 1), np.int64),
+                "gpt_dpre_start": np.zeros((1, 1), np.int64),
+                "gpt_dpre_k": np.zeros(cache1, np.float32),
+                "gpt_dpre_v": np.zeros(cache1, np.float32)})
+            report.append({"program": "delta_prefill", "bucket": b,
+                           "source": source})
+        if self._verify_pred is not None:
+            blk = self._draft.k + 1
+            source = self._verify_pred.warm({
+                "gpt_vrf_tok": np.zeros((self.slots, blk), np.int64),
+                "gpt_vrf_pos": np.zeros((self.slots, 1), np.int64),
+                "gpt_vrf_k": np.zeros(self._k.shape, np.float32),
+                "gpt_vrf_v": np.zeros(self._v.shape, np.float32)})
+            report.append({"program": "verify", "block": blk,
+                           "source": source})
+            report.extend(self._draft.warmup())
         obs.event(
             "warmup", source="serving", count=False, model=self.name,
             engine="decode", engines=len(report),
@@ -770,7 +970,10 @@ class DecodeEngine:
                     _conc.note_blocking("time.sleep(idle)")
                 time.sleep(0.002)
                 continue
-            self._step()
+            if self._draft is not None:
+                self._spec_step()
+            else:
+                self._step()
 
     def _fail_all(self):
         while True:
@@ -827,12 +1030,45 @@ class DecodeEngine:
                         "deadline expired after %s ms in decode queue "
                         "(model %r)" % (waited_ms, self.name)))
                     req = None
-            if req.handoff is not None:
-                self._adopt(i, req)
-            else:
-                self._prefill(i, req)
+            self._fill_slot(i, req)
         obs.set_gauge("serving.queue_depth.%s" % self.name,
                       self._q.qsize())
+
+    def _fill_slot(self, slot, req):
+        """Route one admitted request onto its cheapest fill path:
+        remote handoff adopt, session-resume delta, prefix-pool
+        full-hit adopt, prefix-pool delta, or cold prefill."""
+        if req.handoff is not None:
+            return self._adopt(slot, req)
+        if req.base is not None:  # session resume (handoff from tier)
+            return self._delta_prefill(slot, req)
+        if self._prefix_pool is not None:
+            entry = self._prefix_pool.lookup(req.prompt)
+            if entry is not None and self._entry_fits(entry, req):
+                req.base = entry
+                req.start = entry.plen
+                if entry.plen == req.plen:
+                    return self._adopt_prefix(slot, req)
+                req.suffix = req.prompt[entry.plen:]
+                req.sbucket = self._bucket_for(len(req.suffix))
+                return self._delta_prefill(slot, req)
+        return self._prefill(slot, req)
+
+    def _entry_fits(self, entry, req):
+        """A pool entry is adoptable when its geometry matches this
+        engine, a FULL hit knows its first token, and a partial hit's
+        suffix fits a delta bucket without the block write running off
+        the cache edge (dynamic_update_slice clamps — never risk it)."""
+        if tuple(np.asarray(entry.k).shape) != (
+                self.cfg.num_layers, self.cache_len, self.cfg.hidden):
+            return False
+        if entry.plen > req.plen:
+            return False
+        if entry.plen == req.plen:
+            return entry.next_token is not None
+        sbucket = self._bucket_for(req.plen - entry.plen)
+        return (sbucket is not None
+                and entry.plen + sbucket <= self.cache_len)
 
     def _write_slot_cache(self, slot, k1, v1, ks=None, vs=None):
         """Install one sequence's cache pair into slot ``slot``.
@@ -901,7 +1137,18 @@ class DecodeEngine:
         self._pos[slot, 0] = req.plen
         self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id,
                                   trace=sp.ctx if sp is not None
-                                  else None)
+                                  else None, session=req.session,
+                                  hist=req.hist)
+        self._bump("prefill_rows_computed", req.bucket)
+        if self._prefix_pool is not None:
+            # bank this prompt's rows (fp32, pre-residency) so the
+            # next shared-prefix request adopts instead of recomputing
+            try:
+                self._prefix_pool.put(req.prompt, np.asarray(k1),
+                                      np.asarray(v1), next_token=tok)
+            except Exception:  # noqa: BLE001 — caching is best-effort
+                self._bump("prefix_insert_errors")
+        self._draft_fill(slot, req.hist)
         now = time.monotonic()
         obs.observe("serving.decode.prefill_seconds", now - t0)
         obs.observe("serving.decode.ttft_seconds",
@@ -909,6 +1156,128 @@ class DecodeEngine:
         self._bump("prefills")
         self._emit(slot, tok)
         self._gauges()
+
+    def _adopt_prefix(self, slot, req):
+        """FULL prefix-pool hit: the pool holds rows for the whole
+        prompt AND the greedy token after it — adopt and emit with no
+        program dispatch at all (zero prefill FLOPs)."""
+        t0 = time.monotonic()
+        entry = req.base
+        if req.trace is not None:
+            self._trace_queue_span(req, t0)
+        kd, vd = entry.dense()
+        if self.kv_dtype == "int8":
+            from .disagg import kv_wire
+
+            if entry.store_dtype == "int8":
+                kq, ks = np.asarray(entry.k), np.asarray(entry.k_scales)
+                vq, vs = np.asarray(entry.v), np.asarray(entry.v_scales)
+            else:
+                kq, ks = kv_wire.quantize_rows(kd)
+                vq, vs = kv_wire.quantize_rows(vd)
+            self._write_slot_cache(slot, kq[None], vq[None],
+                                   ks[None], vs[None])
+        else:
+            self._write_slot_cache(slot, kd[None], vd[None])
+        self._tok[slot, 0] = tok = int(entry.next_token)
+        self._pos[slot, 0] = req.plen
+        self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id,
+                                  session=req.session, hist=req.hist)
+        self._bump("prefix_full_hits")
+        self._bump("prefill_rows_saved", entry.plen)
+        self._draft_fill(slot, req.hist)
+        now = time.monotonic()
+        obs.observe("serving.decode.prefill_seconds", now - t0)
+        obs.observe("serving.decode.ttft_seconds",
+                    now - req.handle.t_submit)
+        self._emit(slot, tok)
+        self._gauges()
+
+    def _delta_prefill(self, slot, req):
+        """Adopt ``req.start`` base rows (a prefix-pool entry or a
+        hibernated session's handoff) and run the delta-prefill program
+        over only the suffix — prefill FLOPs proportional to the
+        unshared tail. The base rows feed the program in fp32; int8-
+        resident engines requantize the returned cache, which is
+        bit-stable on untouched rows (idempotent codec)."""
+        t0 = time.monotonic()
+        base = req.base
+        if req.trace is not None:
+            self._trace_queue_span(req, t0)
+        suffix = np.asarray(req.suffix, np.int64).reshape(-1)
+        slen = int(suffix.size)
+        ids = np.zeros((1, req.sbucket), np.int64)
+        ids[0, :slen] = suffix
+        try:
+            # a hibernated handoff is verified against its sealed
+            # digest before any row lands in a slot (same contract as
+            # _adopt); pool entries live in-process — their digest is
+            # the lookup key, not a seal, and they carry no verify()
+            if (getattr(base, "digest", None) is not None
+                    and callable(getattr(base, "verify", None))):
+                base.verify()
+            kd, vd = base.dense()
+            if _conc._on:
+                _conc.note_blocking("device.dispatch")
+            nxt, k1, v1 = self._delta_preds[req.sbucket].run(
+                {"gpt_dpre_ids": ids,
+                 "gpt_dpre_len": np.asarray([[slen]], np.int64),
+                 "gpt_dpre_start": np.asarray([[req.start]], np.int64),
+                 "gpt_dpre_k": kd[None], "gpt_dpre_v": vd[None]},
+                return_numpy=False)
+        except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+            self._bump("delta_errors")
+            obs.event("delta_error", source="serving", model=self.name,
+                      error="%s: %s" % (type(e).__name__, str(e)[:200]))
+            req.handle._fail(e)
+            return
+        if self.kv_dtype == "int8":
+            from .disagg import kv_wire
+
+            kq, ks = kv_wire.quantize_rows(np.asarray(k1)[0])
+            vq, vs = kv_wire.quantize_rows(np.asarray(v1)[0])
+            self._write_slot_cache(slot, kq[None], vq[None],
+                                   ks[None], vs[None])
+        else:
+            self._write_slot_cache(slot, k1, v1)
+        self._tok[slot, 0] = tok = int(np.asarray(nxt)[0, 0])
+        self._pos[slot, 0] = req.start + slen
+        self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id,
+                                  session=req.session, hist=req.hist)
+        self._bump("delta_prefills")
+        self._bump("prefill_rows_computed", req.sbucket)
+        self._bump("prefill_rows_saved", req.start)
+        if self._prefix_pool is not None and req.session is None:
+            # extend the pool's coverage to the full prompt (resumed
+            # sessions skip this: transcripts are not shared prefixes)
+            try:
+                self._prefix_pool.put(req.prompt, np.asarray(k1),
+                                      np.asarray(v1), next_token=tok)
+            except Exception:  # noqa: BLE001 — caching is best-effort
+                self._bump("prefix_insert_errors")
+        self._draft_fill(slot, req.hist)
+        now = time.monotonic()
+        obs.observe("serving.decode.prefill_seconds", now - t0)
+        obs.observe("serving.decode.ttft_seconds",
+                    now - req.handle.t_submit)
+        self._emit(slot, tok)
+        self._gauges()
+
+    def _draft_fill(self, slot, hist):
+        """Mirror a freshly filled slot into the draft's cache (the
+        draft prefills the same token history). Draft staleness can
+        only cost acceptance, never correctness — so a draft prefill
+        failure downgrades the slot to effectively non-speculative
+        instead of failing the stream."""
+        if self._draft is None:
+            return
+        try:
+            self._draft.prefill_slot(slot, hist)
+        except Exception as e:  # noqa: BLE001 — speculation is optional
+            self._bump("draft_fill_errors")
+            obs.event("draft_fill_error", source="serving",
+                      model=self.name,
+                      error="%s: %s" % (type(e).__name__, str(e)[:200]))
 
     def _adopt(self, slot, req):
         """Install a remote prefill's :class:`KVHandoff` into a slot —
@@ -974,7 +1343,9 @@ class DecodeEngine:
         self._pos[slot, 0] = req.plen
         self._slots[slot] = _Slot(req.handle, req.max_new, req.eos_id,
                                   trace=sp.ctx if sp is not None
-                                  else None)
+                                  else None, session=req.session,
+                                  hist=req.hist)
+        self._draft_fill(slot, req.hist)
         obs.observe("serving.disagg.adopt_seconds",
                     time.monotonic() - t0)
         self._bump("adopts")
@@ -1007,6 +1378,16 @@ class DecodeEngine:
 
     def _retire(self, slot, reason, error=None):
         s = self._slots[slot]
+        if (self._session_tier is not None and s.session is not None
+                and reason in ("eos", "length") and error is None):
+            try:
+                self._hibernate(slot, s)
+            except Exception as e:  # noqa: BLE001 — tiering is best-effort
+                self._bump("hibernate_errors")
+                obs.event("hibernate_error", source="serving",
+                          model=self.name, session=s.session,
+                          error="%s: %s" % (type(e).__name__,
+                                            str(e)[:200]))
         self._slots[slot] = None
         self._tok[slot, 0] = 0
         self._pos[slot, 0] = 0
@@ -1031,6 +1412,42 @@ class DecodeEngine:
         obs.event("slot_retired", source="serving", count=False,
                   model=self.name, slot=slot, reason=reason,
                   tokens=len(s.handle._tokens))
+
+    def _hibernate(self, slot, s):
+        """Encode a retiring session slot's live KV rows into the
+        KVHandoff wire format and park them in the session tier.
+        ``prompt`` carries the token-per-row history (admission history
+        + every emitted token but the last), ``next_token`` the last
+        emitted token — exactly what the resume delta-prefill consumes
+        first — and ``plen`` the written row count. int8-resident
+        engines ship payload + scales verbatim (no requantize), fp32
+        engines encode at the tier's wire dtype."""
+        from .disagg import kv_wire
+
+        emitted = np.asarray(s.handle._tokens, np.int64)
+        if emitted.size == 0:
+            return
+        pos = int(self._pos[slot, 0])
+        hist = np.concatenate([np.asarray(s.hist, np.int64),
+                               emitted[:-1]])
+        if hist.size != pos:
+            raise ValueError(
+                "slot %d history %d rows != pos %d — refusing to "
+                "hibernate a misaligned session"
+                % (slot, hist.size, pos))
+        if self.kv_dtype == "int8":
+            h = kv_wire.encode_kv_q(
+                np.asarray(self._k[slot]), np.asarray(self._v[slot]),
+                np.asarray(self._kscale[slot]),
+                np.asarray(self._vscale[slot]),
+                int(emitted[-1]), pos, hist)
+        else:
+            h = kv_wire.encode_kv(
+                np.asarray(self._k[slot]), np.asarray(self._v[slot]),
+                int(emitted[-1]), pos, hist,
+                wire_dtype=self._session_tier.wire_dtype)
+        self._session_tier.hibernate(s.session, h)
+        self._bump("hibernated")
 
     def _step_feeds(self):
         feeds = {"gpt_step_tok": self._tok, "gpt_step_pos": self._pos,
@@ -1103,6 +1520,87 @@ class DecodeEngine:
             self._pos[i, 0] += 1
             self._tok[i, 0] = tok
             self._emit(i, tok)
+        self._gauges()
+
+    def _spec_step(self):
+        """One speculative iteration: ``k`` draft proposals per slot,
+        ONE target verify dispatch over the ``k + 1`` block, emit the
+        longest prefix matching the target's own greedy picks plus the
+        correction/bonus token. Every emitted token is the target's
+        argmax — bit-exact with :meth:`_step` by construction. Any
+        live slot without ``k + 1`` rows of cache headroom demotes the
+        whole iteration to the plain step (mirrored into the draft so
+        its cache stays gapless)."""
+        k = self._draft.k
+        blk = k + 1
+        live = [i for i, s in enumerate(self._slots) if s is not None]
+        if any(int(self._pos[i, 0]) + blk > self.cache_len
+               for i in live):
+            # cache-edge fallback: single-token step, draft mirrored
+            self._bump("spec_fallback_steps")
+            try:
+                self._draft.sync_step(self._tok, self._pos)
+            except Exception:  # noqa: BLE001 — speculation is optional
+                self._bump("draft_step_errors")
+            self._step()
+            return
+        t0 = time.monotonic()
+        try:
+            proposals = self._draft.propose(self._tok, self._pos)
+        except Exception as e:  # noqa: BLE001 — draft down ≠ engine down
+            self._bump("draft_step_errors")
+            obs.event("draft_step_error", source="serving",
+                      model=self.name,
+                      error="%s: %s" % (type(e).__name__, str(e)[:200]))
+            self._step()
+            return
+        feeds = {"gpt_vrf_tok": np.concatenate(
+                     [self._tok, proposals], axis=1),
+                 "gpt_vrf_pos": self._pos,
+                 "gpt_vrf_k": self._k, "gpt_vrf_v": self._v}
+        try:
+            R.fault_check("dispatch")
+            if _conc._on:
+                _conc.note_blocking("device.dispatch")
+            y, self._k, self._v = self._verify_pred.run(
+                feeds, return_numpy=False)
+        except Exception as e:  # noqa: BLE001 — fail the slots, not the loop
+            self._bump("step_errors")
+            obs.event("step_error", source="serving", model=self.name,
+                      error="%s: %s" % (type(e).__name__, str(e)[:200]))
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    self._retire(i, "error", error=e)
+            return
+        dt = time.monotonic() - t0
+        obs.observe("serving.spec.round_seconds", dt)
+        y = np.asarray(y)                                 # (S, k+1)
+        accepted = 0
+        for i in live:
+            # longest prefix of the draft's proposals matching the
+            # target's picks; emit those + the correction/bonus token
+            m = 0
+            while m < k and proposals[i, m] == y[i, m]:
+                m += 1
+            accepted += m
+            for j in range(m + 1):
+                if self._slots[i] is None:
+                    break  # EOS/length retired the slot mid-block
+                tok = int(y[i, j])
+                self._pos[i, 0] += 1
+                self._tok[i, 0] = tok
+                self._emit(i, tok)
+        self._bump("spec_rounds")
+        self._bump("spec_proposed", k * len(live))
+        self._bump("spec_accepted", accepted)
+        with self._stats_lock:
+            proposed = self._stats["spec_proposed"]
+            acc = self._stats["spec_accepted"]
+        if proposed:
+            rate = acc / float(proposed)
+            obs.set_gauge("serving.spec.accept_rate", rate)
+            obs.set_gauge("serving.spec.accept_rate.%s" % self.name,
+                          rate)
         self._gauges()
 
     def _note_step_measured(self, dt):
@@ -1191,13 +1689,48 @@ class DecodeEngine:
             out = dict(self._stats)
         for k in ("requests", "tokens", "prefills", "adopts", "steps",
                   "retired", "shed", "deadline_miss", "cancelled",
-                  "prefill_errors", "adopt_errors", "step_errors"):
+                  "prefill_errors", "adopt_errors", "step_errors",
+                  "prefill_rows_computed", "prefill_rows_saved",
+                  "prefix_full_hits", "delta_prefills", "delta_errors",
+                  "spec_rounds", "spec_proposed", "spec_accepted",
+                  "spec_fallback_steps", "hibernated", "resumed"):
             out.setdefault(k, 0)
+        out["spec_accept_rate"] = (
+            out["spec_accepted"] / float(out["spec_proposed"])
+            if out["spec_proposed"] else None)
         out["live_slots"] = sum(1 for s in self._slots if s is not None)
         out["slots"] = self.slots
         out["kv_dtype"] = self.kv_dtype
         out["role"] = self.role
         return out
+
+    def reuse_info(self):
+        """KV-reuse + speculation state for ``/healthz``
+        (:func:`paddle_tpu.serving.registry.info` attaches it):
+        draft-model attachment, prefix-pool and session-tier stats,
+        and the redundant-prefill economics counters."""
+        with self._stats_lock:
+            st = dict(self._stats)
+        computed = st.get("prefill_rows_computed", 0)
+        saved = st.get("prefill_rows_saved", 0)
+        proposed = st.get("spec_proposed", 0)
+        return {
+            "draft": (self._draft.info()
+                      if self._draft is not None else None),
+            "spec_accept_rate": (
+                st.get("spec_accepted", 0) / float(proposed)
+                if proposed else None),
+            "prefix_pool": (self._prefix_pool.stats()
+                            if self._prefix_pool is not None else None),
+            "session_tier": (self._session_tier.stats()
+                             if self._session_tier is not None
+                             else None),
+            "prefill_rows_computed": computed,
+            "prefill_rows_saved": saved,
+            "prefill_rows_saved_pct": (
+                100.0 * saved / float(saved + computed)
+                if (saved + computed) else None),
+        }
 
     def slot_bytes(self):
         """HBM bytes one slot's resident KV pair occupies (see

@@ -42,8 +42,9 @@ from ...integrity.digest import IntegrityError
 from ...parallel.comms import quantize as Q
 
 __all__ = [
-    "KVHandoff", "encode_kv", "decode_kv", "quantize_rows",
-    "dequantize_rows", "handoff_wire_bytes", "handoff_compression",
+    "KVHandoff", "encode_kv", "encode_kv_q", "decode_kv",
+    "quantize_rows", "dequantize_rows", "handoff_wire_bytes",
+    "handoff_compression",
 ]
 
 
@@ -228,6 +229,32 @@ def encode_kv(k, v, next_token, plen, prompt, wire_dtype="int8",
         vq, vs = quantize_rows(v, wire_dtype)
         h = KVHandoff(kq, vq, ks, vs, next_token, plen, prompt,
                       wire_dtype, trace=trace)
+    h.seal()
+    return _wire_fault(h)
+
+
+def encode_kv_q(k, v, k_scales, v_scales, next_token, plen, prompt,
+                wire_dtype="int8", trace=None):
+    """Build a sealed :class:`KVHandoff` from ALREADY-quantized rows —
+    an int8-**resident** engine's payload + per-row scale planes, each
+    with an optional leading batch-of-1 axis. This is the session-
+    hibernation path: the resident layout IS the wire layout (block =
+    hidden width), so parking a slot costs a host copy and a digest,
+    never a requantize — and re-adoption restores bit-identical
+    payloads (the codec is idempotent on untouched rows)."""
+    k = np.asarray(k)
+    v = np.asarray(v)
+    k_scales = np.asarray(k_scales, np.float32)
+    v_scales = np.asarray(v_scales, np.float32)
+    if k.ndim == 4:
+        if k.shape[0] != 1:
+            raise ValueError(
+                "encode_kv_q wants one sequence, got batch %d"
+                % k.shape[0])
+        k, v = k[0], v[0]
+        k_scales, v_scales = k_scales[0], v_scales[0]
+    h = KVHandoff(k, v, k_scales, v_scales, next_token, plen, prompt,
+                  wire_dtype, trace=trace)
     h.seal()
     return _wire_fault(h)
 

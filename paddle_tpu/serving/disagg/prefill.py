@@ -103,7 +103,7 @@ class PrefillEngine:
     def __init__(self, cfg, scope, cache_len=64, prompt_buckets=None,
                  queue_capacity=64, name="prefill", wire_dtype="int8",
                  ttft_slo_ms=None, request_timeout_s=60.0,
-                 auto_start=True, build_prefill=None):
+                 auto_start=True, build_prefill=None, prefix_pool=None):
         import jax
 
         import paddle_tpu.fluid as fluid
@@ -118,6 +118,7 @@ class PrefillEngine:
         self.name = str(name)
         self.cache_len = int(cache_len)
         self.wire_dtype = str(wire_dtype)
+        self._prefix_pool = prefix_pool
         self.ttft_slo_ms = (None if ttft_slo_ms is None
                             else float(ttft_slo_ms))
         self.request_timeout_s = float(request_timeout_s)
@@ -136,8 +137,19 @@ class PrefillEngine:
             with fluid.program_guard(fluid.Program(), fluid.Program()):
                 pv = build_prefill(cfg, b, self.cache_len)
                 prefill[b] = (fluid.default_main_program(), pv)
+        # a prefix pool turns this replica into a delta-prefill source:
+        # pooled base rows + the suffix program cost only the unshared
+        # tail of each prompt (same ladder widths as cold prefill)
+        delta = {}
+        if prefix_pool is not None:
+            from ...models.gpt import build_gpt_prefill_delta
+
+            for b in self.prompt_buckets:
+                with fluid.program_guard(fluid.Program(), fluid.Program()):
+                    dv = build_gpt_prefill_delta(cfg, b, self.cache_len)
+                    delta[b] = (fluid.default_main_program(), dv)
         persist = {}
-        for prog, _ in prefill.values():
+        for prog, _ in list(prefill.values()) + list(delta.values()):
             for v in prog.list_vars():
                 if not getattr(v, "persistable", False):
                     continue
@@ -157,6 +169,12 @@ class PrefillEngine:
         for b, (prog, pv) in prefill.items():
             self._prefill_preds[b] = Predictor(
                 prog, pv["feed_names"], pv["fetch_vars"], scope=persist)
+        self._delta_preds = {}
+        for b, (prog, dv) in delta.items():
+            self._delta_preds[b] = Predictor(
+                prog, dv["feed_names"], dv["fetch_vars"], scope=persist)
+            self._delta_preds[b].ledger_tag = (
+                "prefill.delta:%s" % self.name)
 
         self._capacity = int(queue_capacity)
         self._heap = []          # (priority, seq, req) — min-heap
@@ -342,20 +360,16 @@ class PrefillEngine:
             pred = self._predicted_s(req.bucket)
             if pred is not None:
                 sp_fields["predicted_s"] = pred
-        ids = np.zeros((1, req.bucket), np.int64)
-        ids[0, :req.plen] = req.prompt
-        plen = np.asarray([[req.plen]], np.int64)
         try:
             if _conc._on:
                 _conc.note_blocking("device.dispatch")
             cm = (obs.span("disagg.prefill", ctx=ctx, **sp_fields)
                   if ctx is not None else contextlib.nullcontext())
             with cm as sp:
-                nxt, k1, v1 = self._prefill_preds[req.bucket].run(
-                    {"gpt_prefill_ids": ids, "gpt_prefill_len": plen})
+                tok, k1, v1 = self._compute_kv(req)
                 handoff = kv_wire.encode_kv(
-                    k1, v1, int(np.asarray(nxt)[0, 0]), req.plen,
-                    req.prompt, wire_dtype=req.wire_dtype,
+                    k1, v1, tok, req.plen, req.prompt,
+                    wire_dtype=req.wire_dtype,
                     trace=getattr(sp, "ctx", None))
         except Exception as e:  # noqa: BLE001 — fail the request, not the loop
             self._bump("prefill_errors")
@@ -383,6 +397,70 @@ class PrefillEngine:
         with self._stats_lock:
             self._rate.append((now, 1))
         req.ticket._set(handoff)
+
+    def _entry_fits(self, entry, req):
+        """Same adoption contract as the decode engine: geometry match,
+        a full hit knows its next token, a partial hit's suffix fits a
+        delta bucket without the block write running off the cache."""
+        if tuple(np.asarray(entry.k).shape) != (
+                self.cfg.num_layers, self.cache_len, self.cfg.hidden):
+            return False
+        if entry.plen > req.plen:
+            return False
+        if entry.plen == req.plen:
+            return entry.next_token is not None
+        sbucket = self._bucket_for(req.plen - entry.plen)
+        return (sbucket is not None
+                and entry.plen + sbucket <= self.cache_len)
+
+    def _compute_kv(self, req):
+        """Produce ``(next_token, k, v)`` for one prompt by the
+        cheapest route: pool full hit (zero dispatch), pool partial hit
+        (delta-prefill of the suffix), or the cold bucket program.
+        Cold and delta results are banked back into the pool so the
+        next shared-prefix prompt adopts instead of recomputing."""
+        entry = (self._prefix_pool.lookup(req.prompt)
+                 if self._prefix_pool is not None else None)
+        if entry is not None and self._entry_fits(entry, req):
+            kd, vd = entry.dense()
+            if entry.plen == req.plen:
+                self._bump("prefix_full_hits")
+                self._bump("prefill_rows_saved", entry.plen)
+                return int(entry.next_token), kd, vd
+            suffix = req.prompt[entry.plen:]
+            slen = int(suffix.size)
+            sbucket = self._bucket_for(slen)
+            ids = np.zeros((1, sbucket), np.int64)
+            ids[0, :slen] = suffix
+            nxt, k1, v1 = self._delta_preds[sbucket].run(
+                {"gpt_dpre_ids": ids,
+                 "gpt_dpre_len": np.asarray([[slen]], np.int64),
+                 "gpt_dpre_start": np.asarray([[entry.plen]], np.int64),
+                 "gpt_dpre_k": kd[None], "gpt_dpre_v": vd[None]})
+            tok = int(np.asarray(nxt)[0, 0])
+            k1, v1 = np.asarray(k1)[0], np.asarray(v1)[0]
+            self._bump("delta_prefills")
+            self._bump("prefill_rows_computed", sbucket)
+            self._bump("prefill_rows_saved", entry.plen)
+            try:
+                self._prefix_pool.put(req.prompt, k1, v1, next_token=tok)
+            except Exception:  # noqa: BLE001 — caching is best-effort
+                self._bump("prefix_insert_errors")
+            return tok, k1, v1
+        ids = np.zeros((1, req.bucket), np.int64)
+        ids[0, :req.plen] = req.prompt
+        nxt, k1, v1 = self._prefill_preds[req.bucket].run(
+            {"gpt_prefill_ids": ids,
+             "gpt_prefill_len": np.asarray([[req.plen]], np.int64)})
+        tok = int(np.asarray(nxt)[0, 0])
+        self._bump("prefill_rows_computed", req.bucket)
+        if self._prefix_pool is not None:
+            try:
+                self._prefix_pool.put(req.prompt, np.asarray(k1),
+                                      np.asarray(v1), next_token=tok)
+            except Exception:  # noqa: BLE001 — caching is best-effort
+                self._bump("prefix_insert_errors")
+        return tok, k1, v1
 
     def _predicted_s(self, bucket):
         """Cost-model predicted seconds for one prefill of `bucket`,
@@ -421,6 +499,17 @@ class PrefillEngine:
                 "gpt_prefill_len": np.ones((1, 1), np.int64)})
             report.append({"program": "prefill", "bucket": b,
                            "source": source})
+        cache1 = (1, self.cfg.num_layers, self.cache_len,
+                  self.cfg.hidden)
+        for b in sorted(self._delta_preds):
+            source = self._delta_preds[b].warm({
+                "gpt_dpre_ids": np.zeros((1, b), np.int64),
+                "gpt_dpre_len": np.ones((1, 1), np.int64),
+                "gpt_dpre_start": np.zeros((1, 1), np.int64),
+                "gpt_dpre_k": np.zeros(cache1, np.float32),
+                "gpt_dpre_v": np.zeros(cache1, np.float32)})
+            report.append({"program": "delta_prefill", "bucket": b,
+                           "source": source})
         obs.event(
             "warmup", source="serving", count=False, model=self.name,
             engine="prefill", engines=len(report),
@@ -437,11 +526,30 @@ class PrefillEngine:
         with self._stats_lock:
             out = dict(self._stats)
         for k in ("requests", "prefills", "shed", "deadline_miss",
-                  "cancelled", "prefill_errors", "slo_miss_ttft"):
+                  "cancelled", "prefill_errors", "slo_miss_ttft",
+                  "prefix_full_hits", "delta_prefills",
+                  "prefill_rows_computed", "prefill_rows_saved"):
             out.setdefault(k, 0)
         with self._cond:
             out["queued"] = len(self._heap)
         return out
+
+    def reuse_info(self):
+        """Prefix-pool reuse snapshot (``/healthz`` + router
+        aggregation) — mirrors ``DecodeEngine.reuse_info``'s shape."""
+        with self._stats_lock:
+            st = dict(self._stats)
+        computed = st.get("prefill_rows_computed", 0)
+        saved = st.get("prefill_rows_saved", 0)
+        return {
+            "prefix_pool": (self._prefix_pool.stats()
+                            if self._prefix_pool is not None else None),
+            "prefill_rows_computed": computed,
+            "prefill_rows_saved": saved,
+            "prefill_rows_saved_pct": (
+                100.0 * saved / float(saved + computed)
+                if (saved + computed) else None),
+        }
 
     def queue_depth(self):
         with self._cond:

@@ -727,6 +727,37 @@ class DisaggRouter:
             self.tenants.stats()["shed"].values())
         return dict(out)
 
+    def reuse_info(self):
+        """Fleet-wide KV-reuse snapshot: per-replica ``reuse_info()``
+        docs (prefix pools on the prefill side, draft/pool/tier state
+        on decode replicas) plus summed redundant-prefill economics —
+        the ``reuse`` block ``/healthz`` shows for a published
+        router."""
+        with self._lock:
+            pool = (list(self._prefill.values())
+                    + list(self._decode.values()))
+        replicas = {}
+        computed = saved = 0
+        for rep in pool:
+            fn = getattr(rep.engine, "reuse_info", None)
+            if not callable(fn):
+                continue
+            try:
+                doc = fn()
+            except Exception:  # noqa: BLE001 — health must not raise
+                continue
+            replicas[rep.rid] = doc
+            computed += doc.get("prefill_rows_computed") or 0
+            saved += doc.get("prefill_rows_saved") or 0
+        return {
+            "replicas": replicas,
+            "prefill_rows_computed": computed,
+            "prefill_rows_saved": saved,
+            "prefill_rows_saved_pct": (
+                100.0 * saved / float(saved + computed)
+                if (saved + computed) else None),
+        }
+
     # -- fleet metrics federation ----------------------------------------
     def fleet_metrics(self):
         """A :class:`~paddle_tpu.observability.FleetMetrics` aggregator

@@ -225,6 +225,16 @@ class ServingHandler(BaseHTTPRequestHandler):
             kw = {"max_new": body.get("max_new_tokens"),
                   "eos_id": body.get("eos_id"),
                   "deadline_ms": body.get("deadline_ms")}
+            if body.get("session") is not None:
+                # resumable-conversation id (engines with a session
+                # tier hibernate/adopt KV under it); forwarded only
+                # when present so engines that predate it keep working
+                session = body["session"]
+                if not isinstance(session, str) or not session.strip():
+                    raise ValueError(
+                        "session must be a non-empty string, got %r"
+                        % (session,))
+                kw["session"] = session.strip()
             kw.update(self._parse_tenant_priority(body))
             timeout_s = body.get("timeout_s")
             stream = bool(body.get("stream", True))

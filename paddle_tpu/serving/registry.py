@@ -148,19 +148,28 @@ class ModelRegistry:
             return sorted(self._models)
 
     def info(self):
-        """Per-model health snapshot (the /healthz payload)."""
+        """Per-model health snapshot (the /healthz payload). Engines
+        that expose ``reuse_info()`` (a DecodeEngine with a draft
+        model, prefix pool, or session tier attached — or a disagg
+        router aggregating them) get a ``reuse`` block: draft
+        attachment, speculation acceptance, pool hit/miss/evict
+        counters, and the redundant-prefill savings."""
         with self._lock:
             entries = dict(self._models)
-        return {
-            name: {
+        out = {}
+        for name, e in entries.items():
+            doc = {
                 "version": e["version"],
                 "dirname": e["dirname"],
                 "kind": getattr(e["engine"], "engine_kind", "predict"),
                 "queue_depth": e["engine"].queue_depth(),
                 "stats": e["engine"].stats(),
             }
-            for name, e in entries.items()
-        }
+            reuse = getattr(e["engine"], "reuse_info", None)
+            if callable(reuse):
+                doc["reuse"] = reuse()
+            out[name] = doc
+        return out
 
     def unload(self, name, drain=True):
         """Remove `name`; its engine stops (draining by default)."""

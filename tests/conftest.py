@@ -58,6 +58,13 @@ def pytest_configure(config):
         "digest envelopes, corrupt= fault arms, SDC sentinel + "
         "quarantine); `pytest -m integrity` is the slice "
         "bench_experiments/integrity_lane.sh runs")
+    config.addinivalue_line(
+        "markers",
+        "spec: speculative-decoding + KV-reuse tests "
+        "(paddle_tpu.serving: DraftModel block-verify bit-exactness, "
+        "PrefixPool adopt/delta-prefill parity, SessionTier "
+        "hibernate/resume); `pytest -m spec` is the slice "
+        "bench_experiments/spec_lane.sh runs")
 
 
 @pytest.fixture()
