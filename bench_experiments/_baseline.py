@@ -18,8 +18,8 @@ Store schema (``version`` 1)::
 Lanes are the bench's independently-measured sections: the headline
 training lane (keyed by the result's ``metric`` field, e.g.
 ``bert_tiny_pretrain_throughput_cpu``) plus ``serving`` /
-``decode_serving`` / ``disagg_serving`` / ``spec_serving`` when
-present. ``update`` keeps
+``decode_serving`` / ``disagg_serving`` / ``spec_serving`` /
+``retrieval`` when present. ``update`` keeps
 the BEST value per metric across rounds (direction-aware), so a lucky
 round ratchets the bar and a slow round never lowers it.
 
@@ -46,13 +46,22 @@ DEFAULT_TOLERANCES = {
     # wide band — the lane itself hard-fails under 50% rows saved
     "prefill_flops_saved_pct": ("higher", 10.0),
     "spec_accept_rate": ("higher", 40.0),
+    # retrieval lane (ISSUE 20): throughputs get the serving band;
+    # recall is exact-or-fail (the lane hard-errors below 1.0, the
+    # gate backstops a silently-degraded result doc)
+    "lookup_ex_per_sec": ("higher", 25.0),
+    "search_queries_per_sec": ("higher", 25.0),
+    "recall_at_k": ("higher", 0.0),
+    "blocked_matmul_gflops": ("higher", 30.0),
 }
 
 # keys lifted out of serving-style lane docs (top level + one nested
 # dict level, so decode_serving's inner sections are covered)
 _WANTED = ("ttft_ms_p99", "per_token_ms_p99", "tokens_per_sec",
            "step_ms", "compile_s", "prefill_flops_saved_pct",
-           "spec_accept_rate")
+           "spec_accept_rate", "lookup_ex_per_sec",
+           "search_queries_per_sec", "recall_at_k",
+           "blocked_matmul_gflops")
 
 
 def _num(v):
@@ -91,7 +100,7 @@ def extract_lanes(result):
     lane_name = result.get("metric") or "headline"
     lanes[lane_name] = head
     for sect in ("serving", "decode_serving", "disagg_serving",
-                 "spec_serving"):
+                 "spec_serving", "retrieval"):
         doc = detail.get(sect)
         if not isinstance(doc, dict):
             continue

@@ -118,6 +118,16 @@ if [[ -n "${PADDLE_TPU_JAX_LATEST_PY:-}" ]]; then
         || echo "WARN: perf/ledger slice not clean under latest jax" \
                "(non-gating; cost_analysis/memory_analysis probing" \
                "tracks jax HEAD — see output above)"
+    # retrieval slice: shard_map + bitcast psum + streamed top_k lean
+    # on collective semantics that have shifted across jax releases —
+    # the bit-exactness proofs run under the matrix non-gating so a
+    # pin move that breaks them degrades to a WARN here first
+    echo "-- latest jax, retrieval slice (non-gating) --"
+    "$PADDLE_TPU_JAX_LATEST_PY" -m pytest -q -p no:cacheprovider \
+        -m retrieval tests/ \
+        || echo "WARN: retrieval slice not clean under latest jax" \
+               "(non-gating; shard_map/bitcast-psum/top_k semantics" \
+               "track jax HEAD — see output above)"
 else
     echo "SKIP latest-jax leg: set PADDLE_TPU_JAX_LATEST_PY to a python"
     echo "with a newer jax to run the matrix (no packages are installed"

@@ -70,6 +70,13 @@ PY
   echo "== spec_lane start $(date -u)" >> $LOG
   bash bench_experiments/spec_lane.sh > .bench_runs/spec_lane.log 2>&1
   echo "== spec_lane done rc=$? $(date -u)" >> $LOG
+  # retrieval lane (ISSUE 20): ep-sharded lookup bit-exactness +
+  # brute-force recall@10 + roofline-model accuracy, in-process and
+  # over HTTP. Non-blocking like the other lanes — a red run is
+  # recorded for the next session.
+  echo "== retrieval_lane start $(date -u)" >> $LOG
+  bash bench_experiments/retrieval_lane.sh > .bench_runs/retrieval_lane.log 2>&1
+  echo "== retrieval_lane done rc=$? $(date -u)" >> $LOG
   for s in bert_s512_ablate resnet_gap int8_infer profile_b48; do
     # an experiment whose json already holds variants is DONE — its
     # results are cited in BENCHMARKS.md and must not be clobbered by

@@ -34,14 +34,16 @@ __all__ = ["MemoryReport", "estimate", "sizes_from", "shard_divisors",
 DEFAULT_DIM = 8  # matches shapes.DEFAULT_DIM (keep import-light)
 
 # mesh axis names that shard the BATCH (divide activations); every
-# other axis is assumed to shard parameters (tp/mp/ZeRO)
+# other axis is assumed to shard parameters (tp/mp/ZeRO) — including
+# ep, which rows-shards embedding tables (paddle_tpu.retrieval), so an
+# ep-width mesh divides the table's HBM residency, not the batch
 _BATCH_AXES = ("dp", "data", "batch", "sp", "seq")
 
 
 def shard_divisors(mesh):
     """``{axis: size}`` -> ``(param_shards, act_shards)``: batch-like
-    axes divide activation footprints, everything else divides
-    parameter footprints."""
+    axes divide activation footprints, everything else (tp/mp/ZeRO/ep)
+    divides parameter footprints."""
     param_shards = act_shards = 1
     for axis, size in (mesh or {}).items():
         if str(axis).lower() in _BATCH_AXES:

@@ -11,7 +11,7 @@ from . import walker
 from .diagnostics import INFO, PERF, WARNING, AnalysisReport
 
 __all__ = ["lint", "lint_decode_ladder", "lint_parallel_plan",
-           "SUBOPTIMAL_PLAN_SLOWDOWN"]
+           "lint_retrieval_ladder", "SUBOPTIMAL_PLAN_SLOWDOWN"]
 
 # MXU is 128x128, VPU lanes are 8x128; a float32 tile is (8, 128)
 # (see the pallas guide) — XLA pads unaligned dims with dead lanes.
@@ -45,6 +45,14 @@ QUANTIZABLE_ALLREDUCE_BYTES = 1 << 16
 # a gated composition priced this much slower than the best
 # same-device-count plan draws the suboptimal-parallel-plan finding
 SUBOPTIMAL_PLAN_SLOWDOWN = 1.25
+
+# gather-family ops: ~zero FLOPs per byte streamed from HBM
+_GATHER_OPS = {"lookup_table", "lookup_table_v2", "gather", "gather_nd"}
+
+# tables smaller than this gather fast from anywhere — the
+# low-intensity-gather finding targets embedding tables where HBM
+# streaming dominates the step, and keeps small smoke models clean
+LOW_INTENSITY_GATHER_BYTES = 1 << 20
 
 
 def lint(program, shape_env=None, feed_names=(), fetch_names=(),
@@ -98,6 +106,9 @@ def lint(program, shape_env=None, feed_names=(), fetch_names=(),
                          hot_rank=hot_rank,
                          total_flops=(cost.total_flops
                                       if cost is not None else None))
+        # -- memory-bound embedding gathers ---------------------------------
+        if op.type in _GATHER_OPS:
+            _lint_low_intensity_gather(block, i, op, shape_of, report)
         # -- host sync inside scan regions ----------------------------------
         if op.type in _HOST_SYNC_OPS and block.idx != 0:
             owner = owners.get(block.idx)
@@ -266,6 +277,57 @@ def _lint_quantizable_allreduce(collectives, shape_of, shape_env, report):
 
 def _round_up(x, m):
     return ((x + m - 1) // m) * m
+
+
+def _memory_bound_knee():
+    """The roofline knee (FLOP/byte) of the lint target device, when
+    the cost model knows it: ops below it are HBM-bandwidth-bound no
+    matter how the MXU is fed."""
+    try:
+        from ..fluid.executor import _device_kind
+        from .costs import device_profile
+
+        p = device_profile(_device_kind())
+        if p is not None and p.peak_flops and p.hbm_bw:
+            return p.peak_flops / p.hbm_bw
+    except Exception:  # noqa: BLE001 — advisory pass only
+        pass
+    return None
+
+
+def _lint_low_intensity_gather(block, i, op, shape_of, report):
+    """PERF-flag embedding lookups that are pure HBM streaming: a
+    gather performs ~zero FLOPs per byte it moves, so its arithmetic
+    intensity sits far below the memory-bound knee — the fix is not
+    feeding the MXU better but streaming less table per chip
+    (paddle_tpu.retrieval's ep-sharded tables). Gated on a table-size
+    floor so small smoke models lint clean."""
+    slot = "W" if op.type.startswith("lookup_table") else "X"
+    names = op.inputs.get(slot) or ()
+    if not names:
+        return
+    shape = shape_of(block, names[0])
+    if not shape or len(shape) < 2 or any(
+            s is None or s < 0 for s in shape):
+        return
+    table_bytes = 4  # fp32 rows; dtype refinement isn't worth a miss
+    for s in shape:
+        table_bytes *= int(s)
+    if table_bytes < LOW_INTENSITY_GATHER_BYTES:
+        return
+    knee = _memory_bound_knee()
+    report.add(
+        PERF, "low-intensity-gather",
+        "op '%s' gathers from table '%s' (%s, ~%.1f MB): arithmetic "
+        "intensity ~0 FLOP/byte is far below the memory-bound knee%s — "
+        "the lookup is pure HBM streaming and scales with table bytes "
+        "per chip, not FLOPs; shard the table over an ep mesh "
+        "(paddle_tpu.retrieval.ShardedEmbeddingTable) so each chip "
+        "streams 1/ep of it"
+        % (op.type, names[0], "x".join(str(s) for s in shape),
+           table_bytes / 1e6,
+           " (%.0f FLOP/byte here)" % knee if knee else ""),
+        block_idx=block.idx, op_index=i, op=op, var=names[0])
 
 
 def _lint_shape_vocab(gb, feed_names, report):
@@ -444,5 +506,49 @@ def lint_decode_ladder(prompt_buckets, slot_counts=(1,), cache_lens=(),
         report.add(
             INFO, "decode-ladder-rungs",
             "non-pow2 prompt buckets %s: each is an extra executable a "
+            "pow2 ladder would already cover" % (odd,), block_idx=0)
+    return report
+
+
+def lint_retrieval_ladder(query_buckets, ops=("lookup", "search"),
+                          k_values=(10,), threshold=None):
+    """Lint a RetrievalEngine's AOT program ladder BEFORE it compiles
+    — the retrieval arm of the unbounded-shape-vocab count. The engine
+    compiles one lookup program per query bucket plus one top-k search
+    program per (query bucket, k); like the decode ladder, every rung
+    is *declared*, so the feed lint sees only static shapes and this
+    count is the one that keeps the vocabulary honest. Warns against
+    the shared ``SHAPE_VOCAB_THRESHOLD`` budget; non-pow2 rungs draw
+    the same each-is-an-extra-executable INFO."""
+    report = AnalysisReport(checks=["retrieval_ladder"])
+    buckets = sorted({int(b) for b in (query_buckets or ())})
+    k_values = sorted({int(k) for k in (k_values or (10,))})
+    ops = tuple(ops or ())
+    threshold = SHAPE_VOCAB_THRESHOLD if threshold is None else threshold
+    programs = 0
+    if "lookup" in ops:
+        programs += len(buckets)
+    if "search" in ops:
+        programs += len(buckets) * len(k_values)
+    report.meta["retrieval_ladder_programs"] = programs
+    report.meta["retrieval_ladder_k_values"] = list(k_values)
+    if programs > threshold:
+        report.add(
+            WARNING, "unbounded-shape-vocab",
+            "retrieval ladder compiles %d AOT programs (%d query "
+            "buckets%s) — over the %d shape-vocabulary budget; thin "
+            "the query-bucket ladder (pow2 rungs) and serve one k per "
+            "engine"
+            % (programs, len(buckets),
+               " x %d k value(s) for search" % len(k_values)
+               if "search" in ops else "",
+               threshold),
+            block_idx=0)
+    odd = [b for b in buckets
+           if b & (b - 1) and b != max(buckets or [0])]
+    if odd:
+        report.add(
+            INFO, "retrieval-ladder-rungs",
+            "non-pow2 query buckets %s: each is an extra executable a "
             "pow2 ladder would already cover" % (odd,), block_idx=0)
     return report

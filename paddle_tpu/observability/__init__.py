@@ -171,6 +171,24 @@ Well-known KV-reuse + speculation metrics (``serving.spec`` /
   fits the tier budget); ``serving.decode.hibernated`` / ``resumed``
   count the engine-side lifecycle.
 
+Well-known retrieval metrics (``retrieval.*``, the RetrievalEngine +
+ShardedEmbeddingTable from :mod:`paddle_tpu.retrieval`):
+
+- ``retrieval.lookup_seconds`` / ``retrieval.search_seconds``
+  histograms — one coalesced dispatch through the ep-sharded gather /
+  the chunked brute-force top-k; ``retrieval.batch_rows`` /
+  ``retrieval.padding_waste`` histograms — rows per dispatch and the
+  pad rows the query-bucket ladder added (a fat waste tail means the
+  ladder's rungs don't match the arriving batch sizes).
+- ``retrieval.lookups`` / ``retrieval.searches`` / ``retrieval.
+  lookup_rows`` / ``retrieval.search_queries`` counters — dispatches
+  and per-row/per-query volume (lookup_rows also counts direct
+  ``table.lookup()`` calls outside the engine).
+- the shared ``serving.queue_depth.<model>`` gauge and
+  ``serving.predicted_peak_hbm.<model>`` gauge (worst query-ladder
+  rung from ``check_hbm_budget``) carry the same meaning as for the
+  other engine kinds, so one dashboard covers all three.
+
 Well-known concurrency/donation metrics (PR 13,
 ``analysis.concurrency`` / ``analysis.dataflow``):
 

@@ -76,6 +76,10 @@ class DistributedStrategy:
         self.tensor_parallel_degree = 1
         self.sequence_parallel_degree = 1
         self.pipeline_parallel_degree = 1
+        # ep: embedding-parallel width for retrieval/embedding programs
+        # (paddle_tpu.retrieval sharded tables) — carried by the
+        # strategy, consumed by retrieval.ep_mesh, never by _build
+        self.embedding_parallel_degree = 1
         self.sharding_degree = 1  # ZeRO-style optimizer-state sharding
         # name-pattern tensor-parallel rules: [(regex, spec tuple)]
         self.tensor_parallel_rules = []
@@ -84,13 +88,21 @@ class DistributedStrategy:
         self.recompute_checkpoints = []
 
     @classmethod
-    def from_plan(cls, plan):
+    def from_plan(cls, plan, workload="train"):
         """Build a strategy from a planner plan — a
         :class:`paddle_tpu.planner.ParallelPlan`, the dict its
         ``to_dict`` emits, or a whole ``--json-out`` plan document
-        (the ``best.plan`` entry is used). Raises NotImplementedError
-        for plans the collective build cannot run (pp/ep meshes route
-        through PipelineOptimizer / the MoE path)."""
+        (the ``best.plan`` entry is used).
+
+        ``workload`` picks the program family the strategy will drive:
+        the default ``"train"`` is the dense collective build (dp/tp/sp
+        meshes); ``"retrieval"`` / ``"embedding"`` / ``"lookup"``
+        additionally accept ``ep`` meshes — the degree lands in
+        ``embedding_parallel_degree`` for
+        :func:`paddle_tpu.retrieval.ep_mesh` to consume. For dense
+        training, ep/pp plans still raise NotImplementedError, naming
+        the search's best fleet-runnable alternative when a full plan
+        document is given."""
         d = plan
         if hasattr(d, "to_dict"):
             d = d.to_dict()
@@ -98,23 +110,43 @@ class DistributedStrategy:
             raise TypeError(
                 "from_plan wants a ParallelPlan or its dict, got %r"
                 % type(plan).__name__)
-        # accept the full search document too
+        # accept the full search document too (keep its ranked list so
+        # a rejection can name the best runnable alternative)
+        ranked = d.get("ranked") if isinstance(d.get("ranked"), list) else None
         if "plan" in d and isinstance(d["plan"], dict):
             d = d["plan"]
+            if ranked is None and isinstance(d.get("ranked"), list):
+                ranked = d["ranked"]
         if "best" in d and isinstance(d["best"], dict):
             d = d["best"].get("plan", d["best"])
         mesh = d.get("mesh") or {}
-        bad = [a for a in mesh if a not in ("dp", "tp", "sp")]
+        retrieval = workload in ("retrieval", "embedding", "lookup")
+        allowed = ("dp", "tp", "sp", "ep") if retrieval else ("dp", "tp", "sp")
+        bad = [a for a in mesh if a not in allowed]
         if bad:
+            alt = None
+            for entry in ranked or []:
+                p = entry.get("plan", entry) if isinstance(entry, dict) else {}
+                if p.get("fleet_runnable") or all(
+                        a in ("dp", "tp", "sp")
+                        for a in (p.get("mesh") or {})):
+                    alt = p.get("name")
+                    break
+            hint = ("; best fleet-runnable alternative in this search: "
+                    "%r" % alt) if alt else ""
+            if "ep" in bad and not retrieval:
+                hint += ("; for embedding/retrieval programs pass "
+                         "workload='retrieval' — ep plans run through "
+                         "paddle_tpu.retrieval sharded tables")
             raise NotImplementedError(
                 "plan %r uses mesh axes %s the fleet collective build "
-                "does not run (pp -> fluid.optimizer.PipelineOptimizer, "
-                "ep -> the MoE path); pick the search's best "
-                "fleet-runnable plan instead"
-                % (d.get("name", "?"), sorted(bad)))
+                "does not run for %r workloads (pp -> fluid.optimizer."
+                "PipelineOptimizer, ep -> paddle_tpu.retrieval)%s"
+                % (d.get("name", "?"), sorted(bad), workload, hint))
         s = cls()
         s.tensor_parallel_degree = int(mesh.get("tp", 1))
         s.sequence_parallel_degree = int(mesh.get("sp", 1))
+        s.embedding_parallel_degree = int(mesh.get("ep", 1))
         s.grad_sync_mode = d.get("grad_sync_mode", "gspmd")
         s.grad_quantize = bool(d.get("grad_quantize", False))
         s.grad_quantize_block = int(d.get("grad_quantize_block", 256))

@@ -1,0 +1,52 @@
+"""Embedding & retrieval serving: the ``ep`` mesh axis made runnable.
+
+The reference framework's answer to huge embedding workloads was
+parameter servers — ``layers.embedding(..., is_sparse=True)`` rows
+living on pservers, DistributeTranspiler routing sparse gradients.
+The TPU-native answer is this package:
+
+- :mod:`~paddle_tpu.retrieval.table` —
+  :class:`~paddle_tpu.retrieval.table.ShardedEmbeddingTable`, one
+  (vocab, dim) table row-sharded over an ``ep`` mesh axis with a
+  batched-gather lookup program **bit-identical** to a single-device
+  gather (integer-bitcast ``psum`` combine), checkpointable through
+  the consensus/orbax path.
+- :mod:`~paddle_tpu.retrieval.linalg` — distributed-linalg scoring
+  primitives: :func:`~paddle_tpu.retrieval.linalg.blocked_matmul`
+  over sharded operands,
+  :func:`~paddle_tpu.retrieval.linalg.power_iteration`, and the
+  chunked brute-force top-k scorer
+  :func:`~paddle_tpu.retrieval.linalg.sharded_topk` — all priced in
+  fraction-of-roofline terms
+  (:func:`~paddle_tpu.retrieval.linalg.fraction_of_roofline`).
+- :mod:`~paddle_tpu.retrieval.engine` —
+  :class:`~paddle_tpu.retrieval.engine.RetrievalEngine`, the third
+  engine kind (``engine_kind = "retrieval"``) wearing the standard
+  ``submit``/``predict``/``stats``/``warmup``/``check_hbm_budget``/
+  ``stop`` surface so ``ModelRegistry.publish``, the HTTP frontend
+  (``POST /v1/models/<name>:lookup`` / ``:search``), ``ServingRouter``
+  fleet dispatch, tracing, and telemetry all work unchanged.
+
+::
+
+    from paddle_tpu import retrieval
+
+    tbl = retrieval.ShardedEmbeddingTable(100_000, 64, ep=8)
+    eng = retrieval.RetrievalEngine(tbl, k=10)
+    eng.warmup()                      # ladder priced, then compiled
+    emb = eng.lookup([3, 14, 159])    # == table rows, bit for bit
+    ids, scores = eng.search(queries) # exact brute-force top-k
+"""
+from .engine import RetrievalEngine, default_query_buckets
+from .linalg import (
+    blocked_matmul, build_sharded_topk, fraction_of_roofline,
+    matmul_flops, power_iteration, sharded_topk,
+)
+from .table import ShardedEmbeddingTable, ep_mesh
+
+__all__ = [
+    "RetrievalEngine", "ShardedEmbeddingTable", "blocked_matmul",
+    "build_sharded_topk", "default_query_buckets", "ep_mesh",
+    "fraction_of_roofline", "matmul_flops", "power_iteration",
+    "sharded_topk",
+]

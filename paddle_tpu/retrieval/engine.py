@@ -1,0 +1,544 @@
+"""RetrievalEngine: the embedding/retrieval serving kind.
+
+A third engine kind next to ``predict`` (:class:`~paddle_tpu.serving.
+engine.ServingEngine`) and ``decode`` (:class:`~paddle_tpu.serving.
+decode.DecodeEngine`), wearing the same duck type — ``submit`` /
+``predict`` / ``stats`` / ``queue_depth`` / ``warmup`` /
+``check_hbm_budget`` / ``stop`` — so ``ModelRegistry.publish``, the
+HTTP frontend, ``ServingRouter`` fleet dispatch, tracing, and
+telemetry all work unchanged.
+
+Two request ops, both batched through one bounded queue + dispatch
+thread with the serving stack's admission control (shed / deadline /
+drain):
+
+- ``{"op": "lookup", "ids": [...]}`` — id -> embedding rows through
+  the ep-sharded batched gather (bit-identical to a single-device
+  gather);
+- ``{"op": "search", "query": [[...]], "k": 10?}`` — query -> top-k
+  (ids, scores) through the chunked brute-force scorer with the
+  streamed ``lax.top_k`` merge.
+
+Concurrent requests of the same op coalesce into one padded dispatch:
+rows pad up to a declared **query-bucket ladder** (pow2 by default) so
+the engine compiles a bounded program vocabulary, and
+``check_hbm_budget()`` prices every ladder rung — table residency plus
+the worst rung's transient score/gather buffers — against the device
+profile BEFORE warmup compiles anything.
+
+Telemetry: ``retrieval.lookup_seconds`` / ``retrieval.search_seconds``
+/ ``retrieval.batch_rows`` / ``retrieval.padding_waste`` histograms,
+``retrieval.lookups`` / ``retrieval.searches`` /
+``retrieval.lookup_rows`` / ``retrieval.search_queries`` counters, and
+the shared ``serving.queue_depth.<model>`` gauge.
+"""
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from .. import observability as obs
+from ..analysis import concurrency as _conc
+from ..serving.engine import (
+    DeadlineExceededError, EngineClosedError, ShedError,
+)
+from ..serving.batcher import round_up_pow2
+from .linalg import build_sharded_topk
+from .table import ShardedEmbeddingTable
+
+__all__ = ["RetrievalEngine", "default_query_buckets"]
+
+
+def default_query_buckets(max_batch=64):
+    """The pow2 query ladder 1..max_batch."""
+    out = []
+    b = 1
+    while b < int(max_batch):
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(sorted(set(out)))
+
+
+class _Request:
+    __slots__ = ("op", "ids", "query", "k", "rows", "deadline", "future",
+                 "t_enqueue")
+
+
+class RetrievalEngine:
+    """Queued, coalescing dispatch over one
+    :class:`~paddle_tpu.retrieval.table.ShardedEmbeddingTable`."""
+
+    engine_kind = "retrieval"
+
+    def __init__(self, table, query_buckets=None, k=10, max_wait_ms=2.0,
+                 queue_capacity=64, default_deadline_ms=None,
+                 request_timeout_s=60.0, name="default", replica_id=None,
+                 chunk_rows=None, auto_start=True):
+        if not isinstance(table, ShardedEmbeddingTable):
+            raise TypeError(
+                "RetrievalEngine wants a ShardedEmbeddingTable, got %s"
+                % type(table).__name__)
+        self.table = table
+        self.name = str(name)
+        self.replica_id = replica_id
+        self.k = int(k)
+        if self.k < 1 or self.k > table.vocab_size:
+            raise ValueError(
+                "k=%d out of range for a %d-row index"
+                % (self.k, table.vocab_size))
+        self._buckets = tuple(sorted({
+            int(b) for b in (query_buckets or default_query_buckets())}))
+        if not self._buckets or self._buckets[0] < 1:
+            raise ValueError(
+                "query_buckets must be positive ints, got %r"
+                % (query_buckets,))
+        self._max_rows = self._buckets[-1]
+        self._chunk_rows = chunk_rows
+        self._max_wait_s = float(max_wait_ms) / 1000.0
+        self._default_deadline_ms = default_deadline_ms
+        self.request_timeout_s = float(request_timeout_s)
+        self._q = queue.Queue(maxsize=int(queue_capacity))
+        self._topk_fn = None  # built lazily / at warmup
+        self._stop_event = threading.Event()
+        self._closed = False
+        self._admit_lock = _conc.named_lock("retrieval.engine.admit")
+        self._stats_lock = _conc.named_lock("retrieval.engine.stats")
+        self._owner = _conc.owner_token("retrieval-engine", self.name, self)
+        self._stats = collections.Counter()
+        self._rate = collections.deque(maxlen=64)
+        self._thread = None
+        if auto_start:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._closed:
+            raise EngineClosedError("engine %r is closed" % self.name)
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="retrieval-dispatch-%s" % self.name)
+            _conc.track_thread(self._thread, self._owner)
+            self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        """Stop admitting work; with ``drain=True`` finish the queue
+        first, else fail queued requests with EngineClosedError."""
+        with self._admit_lock:
+            self._closed = True
+        alive = self._thread is not None and self._thread.is_alive()
+        if drain and alive:
+            t_end = time.monotonic() + float(timeout)
+            while not self._q.empty() and time.monotonic() < t_end:
+                if _conc._on:
+                    _conc.note_blocking("time.sleep(drain)")
+                time.sleep(0.005)
+        self._stop_event.set()
+        if alive:
+            self._thread.join(timeout=max(0.1, float(timeout)))
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            r.future.set_exception(EngineClosedError(
+                "engine %r stopped before dispatch" % self.name))
+        _conc.check_stopped(self._owner, grace=10.0)
+        obs.event("engine_stop", source="retrieval", count=False,
+                  model=self.name, drained=bool(drain))
+
+    # -- admission -------------------------------------------------------
+    def _parse(self, feeds):
+        """Normalize one request doc into a _Request (pre-validated so
+        the dispatch loop never fails on malformed input)."""
+        if not isinstance(feeds, dict):
+            raise ValueError(
+                "retrieval request must be a dict with 'op' "
+                "('lookup'|'search'), got %s" % type(feeds).__name__)
+        op = feeds.get("op") or ("search" if "query" in feeds else "lookup")
+        req = _Request()
+        req.op = op
+        req.ids = req.query = None
+        req.k = self.k
+        if op == "lookup":
+            ids = np.asarray(feeds.get("ids"))
+            if ids.size == 0:
+                raise ValueError("empty request: no ids")
+            if ids.ndim != 1:
+                ids = ids.reshape(-1)
+            if not np.issubdtype(ids.dtype, np.integer):
+                if np.issubdtype(ids.dtype, np.floating) and np.all(
+                        ids == ids.astype(np.int64)):
+                    ids = ids.astype(np.int64)  # JSON numbers arrive float
+                else:
+                    raise ValueError(
+                        "ids must be integers, got dtype %s" % ids.dtype)
+            if ids.min() < 0 or ids.max() >= self.table.vocab_size:
+                raise ValueError(
+                    "ids out of range [0, %d)" % self.table.vocab_size)
+            req.ids = ids.astype(np.int32)
+            req.rows = int(ids.shape[0])
+        elif op == "search":
+            q = np.asarray(feeds.get("query"), dtype=self.table.dtype)
+            if q.size == 0:
+                raise ValueError("empty request: no query rows")
+            if q.ndim == 1:
+                q = q[None, :]
+            if q.ndim != 2 or q.shape[1] != self.table.dim:
+                raise ValueError(
+                    "query shape %s does not match index dim %d"
+                    % (q.shape, self.table.dim))
+            if "k" in feeds and feeds["k"] is not None:
+                k = int(feeds["k"])
+                if k != self.k:
+                    raise ValueError(
+                        "this engine serves k=%d (one compiled ladder "
+                        "per k; asked k=%d)" % (self.k, k))
+            req.query = q
+            req.rows = int(q.shape[0])
+        else:
+            raise ValueError(
+                "unknown retrieval op %r (want 'lookup' or 'search')"
+                % (op,))
+        if req.rows > self._max_rows:
+            raise ValueError(
+                "request has %d rows but the largest query bucket is %d "
+                "— split the request" % (req.rows, self._max_rows))
+        return req
+
+    def submit(self, feeds, deadline_ms=None, trace_ctx=None):
+        """Enqueue one request doc; returns a Future resolving to
+        ``{"embeddings": ...}`` (lookup) or ``{"ids": ..., "scores":
+        ...}`` (search). Same admission contract as ServingEngine:
+        ShedError on a full queue, EngineClosedError after stop()."""
+        if self._closed:
+            raise EngineClosedError(
+                "engine %r is draining/stopped" % self.name)
+        req = self._parse(feeds)
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        req.deadline = (
+            time.monotonic() + float(deadline_ms) / 1000.0
+            if deadline_ms is not None else None)
+        req.future = Future()
+        req.t_enqueue = time.monotonic()
+        try:
+            with self._admit_lock:
+                if self._closed:
+                    raise EngineClosedError(
+                        "engine %r is draining/stopped" % self.name)
+                self._q.put_nowait(req)
+        except queue.Full:
+            self._bump("shed")
+            obs.event("shed", source="retrieval", model=self.name,
+                      rows=req.rows, queue_capacity=self._q.maxsize)
+            raise ShedError(
+                "retrieval queue full (%d) for model %r%s — request shed"
+                % (self._q.maxsize, self.name,
+                   "" if self.replica_id is None
+                   else " (replica %s)" % self.replica_id),
+                model=self.name, replica=self.replica_id,
+                retry_after=self.retry_after_hint())
+        self._bump("requests")
+        obs.set_gauge("serving.queue_depth.%s" % self.name, self._q.qsize())
+        if trace_ctx is not None and getattr(trace_ctx, "sampled", False):
+            ctx = trace_ctx.child()
+            t_wall = time.time()
+            req.future.add_done_callback(
+                lambda f, c=ctx, t=t_wall, op=req.op, rows=req.rows:
+                obs.export_span(
+                    "retrieval.%s" % op, c, t, time.time() - t,
+                    {"proc": "engine:%s" % self.name, "rows": rows,
+                     "error": (type(f.exception()).__name__
+                               if f.exception() else None)}))
+        return req.future
+
+    def predict(self, feeds, deadline_ms=None, timeout=None):
+        """Synchronous submit + wait."""
+        fut = self.submit(feeds, deadline_ms=deadline_ms)
+        return fut.result(
+            timeout if timeout is not None else self.request_timeout_s)
+
+    def lookup(self, ids, **kw):
+        """Convenience: id rows, synchronously."""
+        return self.predict({"op": "lookup", "ids": ids}, **kw)["embeddings"]
+
+    def search(self, query, k=None, **kw):
+        """Convenience: ``(ids, scores)`` arrays, synchronously."""
+        out = self.predict(
+            {"op": "search", "query": query, "k": k}, **kw)
+        return out["ids"], out["scores"]
+
+    # -- pricing / warmup ------------------------------------------------
+    def _bucket_for(self, rows):
+        for b in self._buckets:
+            if b >= rows:
+                return b
+        return min(round_up_pow2(rows), self._max_rows)
+
+    def _transient_bytes(self, rows):
+        """Worst transient HBM per shard for one dispatch of ``rows``
+        queries: the chunked score block + streamed candidate sets
+        (search) and the gathered/psum row pair (lookup)."""
+        t = self.table
+        item = t.dtype.itemsize
+        chunk = self._chunk_rows or t.rows_per_shard
+        chunk = max(1, min(int(chunk), t.rows_per_shard))
+        search = (
+            rows * chunk * item            # one chunk's score block
+            + 2 * rows * self.k * (item + 4)   # streamed candidates
+            + t.ep * rows * self.k * (item + 4)  # all_gather merge
+            + rows * t.dim * item)         # replicated queries
+        lookup = 2 * rows * t.dim * item + rows * 4
+        return max(search, lookup)
+
+    def check_hbm_budget(self, budget_bytes=None):
+        """Price the query ladder BEFORE warmup: per-shard table
+        residency + the worst rung's transient buffers against the
+        device HBM budget (from the analyzer's device table /
+        ``PADDLE_TPU_HBM_BYTES`` when ``budget_bytes`` is None; no-op
+        when no capacity is known). Raises ProgramVerifyError naming
+        every over-budget rung before any compile."""
+        from ..analysis import costs as _costs
+        from ..analysis.diagnostics import ProgramVerifyError
+        from ..fluid.executor import _device_kind
+
+        if budget_bytes is None:
+            profile = _costs.device_profile(_device_kind())
+            budget_bytes = profile.hbm_bytes if profile else None
+        if not budget_bytes:
+            return []
+        resident = self.table.resident_bytes(per_shard=True)
+        results = []
+        worst = 0
+        for b in self._buckets:
+            peak = resident + self._transient_bytes(b)
+            worst = max(worst, peak)
+            results.append((b, peak))
+        obs.set_gauge("serving.predicted_peak_hbm.%s" % self.name, worst)
+        over = [(b, peak) for b, peak in results if peak > budget_bytes]
+        if not over:
+            return results
+        obs.event("bucket_rejected", source="retrieval", model=self.name,
+                  rejected=len(over), budget_bytes=int(budget_bytes))
+        lines = [
+            "query bucket %d: predicted peak %.2f MB "
+            "(table shard %.2f MB + transients %.2f MB)"
+            % (b, peak / 1e6, resident / 1e6, (peak - resident) / 1e6)
+            for b, peak in over]
+        raise ProgramVerifyError(
+            "predicted-oom: %d of %d query ladder rung(s) exceed the "
+            "HBM budget (%.2f MB) — trim the ladder, shrink chunk_rows, "
+            "or widen the ep mesh:\n%s"
+            % (len(over), len(results), budget_bytes / 1e6,
+               "\n".join(lines)))
+
+    def check_ladder(self):
+        """Lint the query ladder's program count (the retrieval arm of
+        the unbounded-shape-vocab check)."""
+        from ..analysis.tpu_lint import lint_retrieval_ladder
+
+        return lint_retrieval_ladder(
+            self._buckets, k_values=(self.k,))
+
+    def warmup(self, check_hbm=True):
+        """Build every (op, query-bucket) program: one lookup and one
+        top-k dispatch per rung. With ``check_hbm`` the ladder is
+        priced first; an over-budget rung raises before any compile."""
+        if check_hbm:
+            self.check_hbm_budget()
+        t = self.table
+        if self._topk_fn is None:
+            self._topk_fn = build_sharded_topk(
+                t.mesh, t.rows_per_shard, t.dim, t.vocab_size, self.k,
+                chunk_rows=self._chunk_rows)
+        report = []
+        for b in self._buckets:
+            t0 = time.monotonic()
+            t.lookup(np.zeros(b, dtype=np.int32))
+            report.append({"op": "lookup", "batch_size": b,
+                           "seconds": round(time.monotonic() - t0, 4)})
+            t0 = time.monotonic()
+            z = np.zeros((b, t.dim), dtype=t.dtype)
+            import jax.numpy as jnp
+
+            self._topk_fn(t.device_table, jnp.asarray(z))
+            report.append({"op": "search", "batch_size": b,
+                           "seconds": round(time.monotonic() - t0, 4)})
+        obs.event("warmup", source="retrieval", count=False,
+                  model=self.name, engines=len(report))
+        return report
+
+    # -- dispatch --------------------------------------------------------
+    def _loop(self):
+        carry = None
+        while True:
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    if _conc._on:
+                        _conc.note_blocking("queue.get")
+                    first = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    if self._stop_event.is_set():
+                        return
+                    continue
+            batch = [first]
+            rows = first.rows
+            t_flush = time.monotonic() + self._max_wait_s
+            while rows < self._max_rows:
+                remaining = t_flush - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    if _conc._on:
+                        _conc.note_blocking("queue.get")
+                    r = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if r.op != first.op or rows + r.rows > self._max_rows:
+                    # different program, or would overshoot the ladder:
+                    # starts the next micro-batch
+                    carry = r
+                    break
+                batch.append(r)
+                rows += r.rows
+            obs.set_gauge(
+                "serving.queue_depth.%s" % self.name, self._q.qsize())
+            self._execute(batch)
+
+    def _execute(self, batch):
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                self._bump("deadline_miss")
+                waited_ms = round(1000 * (now - r.t_enqueue), 3)
+                obs.event("deadline_miss", source="retrieval",
+                          model=self.name, rows=r.rows,
+                          waited_ms=waited_ms)
+                r.future.set_exception(DeadlineExceededError(
+                    "deadline expired after %s ms in queue (model %r)"
+                    % (waited_ms, self.name)))
+            else:
+                live.append(r)
+        if live:
+            self._run_group(live)
+
+    def _run_group(self, reqs):
+        t0 = time.monotonic()
+        op = reqs[0].op
+        rows = sum(r.rows for r in reqs)
+        target = self._bucket_for(rows)
+        try:
+            if _conc._on:
+                _conc.note_blocking("device.dispatch")
+            if op == "lookup":
+                ids = np.zeros(target, dtype=np.int32)
+                off = 0
+                for r in reqs:
+                    ids[off:off + r.rows] = r.ids
+                    off += r.rows
+                emb = self.table.lookup(ids)
+                outs = [("embeddings", emb)]
+            else:
+                q = np.zeros((target, self.table.dim),
+                             dtype=self.table.dtype)
+                off = 0
+                for r in reqs:
+                    q[off:off + r.rows] = r.query
+                    off += r.rows
+                if self._topk_fn is None:
+                    t = self.table
+                    self._topk_fn = build_sharded_topk(
+                        t.mesh, t.rows_per_shard, t.dim, t.vocab_size,
+                        self.k, chunk_rows=self._chunk_rows)
+                import jax.numpy as jnp
+
+                scores, ids_out = self._topk_fn(
+                    self.table.device_table, jnp.asarray(q))
+                outs = [("ids", np.asarray(ids_out)),
+                        ("scores", np.asarray(scores))]
+                self._bump("search_queries", rows)
+                obs.inc("retrieval.search_queries", rows)
+        except Exception as e:  # noqa: BLE001 — fail the requests, not the loop
+            self._bump("batch_errors")
+            obs.event("batch_error", source="retrieval", model=self.name,
+                      op=op, rows=rows,
+                      error="%s: %s" % (type(e).__name__, str(e)[:200]))
+            for r in reqs:
+                r.future.set_exception(e)
+            with self._stats_lock:
+                self._rate.append((time.monotonic(), len(reqs)))
+            return
+        done = time.monotonic()
+        self._bump("batches")
+        self._bump("lookups" if op == "lookup" else "searches", len(reqs))
+        obs.inc("retrieval.%s" % ("lookups" if op == "lookup"
+                                  else "searches"), len(reqs))
+        if len(reqs) > 1:
+            self._bump("coalesced")
+        self._bump("rows", rows)
+        obs.observe("retrieval.batch_rows", rows)
+        obs.observe("retrieval.padding_waste",
+                    (target - rows) / float(target))
+        obs.observe(
+            "retrieval.%s_seconds" % ("lookup" if op == "lookup"
+                                      else "search"), done - t0)
+        with self._stats_lock:
+            self._rate.append((done, len(reqs)))
+        off = 0
+        for r in reqs:
+            doc = {k: v[off:off + r.rows].copy() for k, v in outs}
+            r.future.set_result(doc)
+            off += r.rows
+            obs.observe("serving.request_seconds", done - r.t_enqueue)
+
+    # -- introspection ---------------------------------------------------
+    def _bump(self, key, n=1):
+        with self._stats_lock:
+            self._stats[key] += n
+
+    def stats(self):
+        with self._stats_lock:
+            out = dict(self._stats)
+        for k in ("requests", "lookups", "searches", "shed",
+                  "deadline_miss", "batches", "coalesced", "rows",
+                  "batch_errors"):
+            out.setdefault(k, 0)
+        return out
+
+    def index_info(self):
+        """The registry/healthz index-stats block."""
+        info = self.table.index_info()
+        info["k"] = self.k
+        info["query_buckets"] = list(self._buckets)
+        return info
+
+    def queue_depth(self):
+        return self._q.qsize()
+
+    def drain_rate(self):
+        now = time.monotonic()
+        with self._stats_lock:
+            pts = [(t, n) for t, n in self._rate if now - t < 30.0]
+        if not pts:
+            return None
+        span = max(1e-3, now - min(t for t, _ in pts))
+        return sum(n for _, n in pts) / span
+
+    def retry_after_hint(self):
+        rate = self.drain_rate()
+        if not rate:
+            return 1.0
+        return min(60.0, max(1.0, (self.queue_depth() + 1) / rate))
+
+    @property
+    def closed(self):
+        return self._closed

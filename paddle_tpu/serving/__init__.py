@@ -58,6 +58,17 @@ migrates sessions off dead replicas via re-prefill, and gates
 admission with per-tenant priorities/quotas/SLOs
 (:class:`~paddle_tpu.serving.disagg.TenantTable`).
 
+Embedding/retrieval traffic gets the third engine kind
+(:class:`~paddle_tpu.retrieval.engine.RetrievalEngine`, imported from
+:mod:`paddle_tpu.retrieval` to keep the layering one-way): an
+``ep``-sharded embedding table served through ``:lookup``
+(id -> embedding rows, bit-identical to the single-device gather) and
+``:search`` (query -> exact brute-force top-k), publishing like any
+engine — ``reg.publish("items", RetrievalEngine(table, k=10))`` —
+with query-bucket ladders priced through ``check_hbm_budget`` before
+warmup and the index geometry (rows/dim/shards/resident bytes)
+surfaced in ``/healthz``.
+
 Quick start::
 
     from paddle_tpu import serving

@@ -29,8 +29,20 @@ thread per model does the batching):
   a client disconnect mid-stream cancels the request and frees its
   engine slot at the next dispatch iteration. ``"stream": false``
   returns one aggregate JSON document.
+- ``POST /v1/models/<name>:lookup`` / ``:search`` — retrieval engines
+  only (:class:`~paddle_tpu.retrieval.engine.RetrievalEngine`).
+  ``:lookup`` body ``{"ids": [3, 14, 159], "deadline_ms": 50?,
+  "timeout_s": 10?}`` replies ``{"embeddings": [[...]], "shape": ...,
+  "dtype": ...}`` — rows bit-identical to the sharded table's gather.
+  ``:search`` body ``{"query": [[...]], "k": 10?}`` replies
+  ``{"ids": [[...]], "scores": [[...]], "k": 10}`` — exact brute-force
+  top-k per query row. Posting any verb to a mismatched engine kind
+  answers 400 with the model's actual kind (and the verb it speaks)
+  named in the body.
 - ``GET /healthz`` — ``{"status": "ok", "models": {...}}`` with
-  per-model version, queue depth, and lifetime counters.
+  per-model kind, version, queue depth, lifetime counters, and (for
+  retrieval engines) the index block: rows, dim, shards, resident
+  bytes.
 - ``GET /metrics`` — the telemetry hub's Prometheus text
   (``render_prom()``): serving histograms with p50/p90/p99 quantiles,
   shed/deadline-miss counters, queue-depth gauges.
@@ -64,6 +76,27 @@ __all__ = ["ServingHandler", "ServingServer", "main"]
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
 _GENERATE_RE = re.compile(r"^/v1/models/([^/:]+):generate$")
+_LOOKUP_RE = re.compile(r"^/v1/models/([^/:]+):lookup$")
+_SEARCH_RE = re.compile(r"^/v1/models/([^/:]+):search$")
+
+_VERB_FOR_KIND = {"predict": ":predict", "decode": ":generate",
+                  "retrieval": ":lookup or :search"}
+
+
+def _kind_of(engine):
+    return getattr(engine, "engine_kind", "predict")
+
+
+def _wrong_kind_doc(name, engine, wanted):
+    """400 body naming the engine's actual kind and the verb it speaks,
+    so a misrouted client learns where to go instead of guessing."""
+    kind = _kind_of(engine)
+    return {
+        "error": "model %r is a %r engine, not %r — use %s"
+                 % (name, kind, wanted,
+                    _VERB_FOR_KIND.get(kind, ":predict")),
+        "model": name, "kind": kind,
+    }
 
 
 class ServingHandler(BaseHTTPRequestHandler):
@@ -214,10 +247,9 @@ class ServingHandler(BaseHTTPRequestHandler):
         return obs.sample_request()
 
     def _do_generate(self, name, engine):
-        if getattr(engine, "engine_kind", None) != "decode":
+        if _kind_of(engine) != "decode":
             return self._send_json(
-                400, {"error": "model %r is not a decode engine — use "
-                               ":predict" % name})
+                400, _wrong_kind_doc(name, engine, "decode"))
         try:
             n = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(n) or b"{}")
@@ -324,6 +356,82 @@ class ServingHandler(BaseHTTPRequestHandler):
             except (BrokenPipeError, ConnectionResetError):
                 self.close_connection = True
 
+    # -- retrieval (:lookup / :search) -----------------------------------
+    def _do_retrieval(self, name, engine, op):
+        """``:lookup`` (``{"ids": [...]}`` -> embedding rows) and
+        ``:search`` (``{"query": [[...]], "k": 10?}`` -> top-k ids +
+        scores) against a retrieval engine; same status mapping as
+        ``:predict`` (429 shed + Retry-After, 504 deadline/timeout,
+        503 draining, 400 malformed)."""
+        if _kind_of(engine) != "retrieval":
+            return self._send_json(
+                400, _wrong_kind_doc(name, engine, "retrieval"))
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if op == "lookup":
+                feeds = {"op": "lookup", "ids": body["ids"]}
+            else:
+                feeds = {"op": "search", "query": body["query"],
+                         "k": body.get("k")}
+            deadline_ms = body.get("deadline_ms")
+            timeout_s = body.get("timeout_s")
+        except (ValueError, KeyError, TypeError) as e:
+            return self._send_json(
+                400, {"error": "bad request: %s: %s"
+                               % (type(e).__name__, e)})
+        tctx = self._trace_ctx(body)
+        t_req = time.time() if tctx is not None else None
+        try:
+            fut = engine.submit(feeds, deadline_ms=deadline_ms,
+                                trace_ctx=tctx)
+        except ShedError as e:
+            return self._send_json(429, self._shed_doc(e, name, engine),
+                                   headers=self._shed_headers(e, engine))
+        except EngineClosedError as e:
+            return self._send_json(503, {"error": str(e), "model": name})
+        except (ValueError, KeyError, TypeError) as e:
+            return self._send_json(
+                400, {"error": "bad request: %s: %s"
+                               % (type(e).__name__, e)})
+        try:
+            out = fut.result(
+                timeout_s if timeout_s is not None
+                else engine.request_timeout_s)
+        except DeadlineExceededError as e:
+            return self._send_json(504, {"error": str(e), "model": name})
+        except ShedError as e:
+            return self._send_json(429, self._shed_doc(e, name, engine),
+                                   headers=self._shed_headers(e, engine))
+        except _FutureTimeout:
+            return self._send_json(
+                504, {"error": "timed out waiting for model %r" % name,
+                      "model": name})
+        except EngineClosedError as e:
+            return self._send_json(503, {"error": str(e), "model": name})
+        except Exception as e:  # noqa: BLE001 — engine errors -> 500
+            if type(e).__name__ == "NoReplicasError":
+                return self._send_json(
+                    503, {"error": str(e), "model": name})
+            return self._send_json(
+                500, {"error": "%s: %s" % (type(e).__name__, e)})
+        if tctx is not None:
+            obs.export_span(
+                "http.%s" % op, tctx, t_req, time.time() - t_req,
+                {"proc": "http", "model": name})
+        if op == "lookup":
+            emb = out["embeddings"]
+            doc = {"embeddings": emb.tolist(),
+                   "shape": list(emb.shape), "dtype": str(emb.dtype),
+                   "model": name}
+        else:
+            doc = {"ids": out["ids"].tolist(),
+                   "scores": out["scores"].tolist(),
+                   "k": int(out["ids"].shape[-1]), "model": name}
+        if tctx is not None:
+            doc["trace_id"] = tctx.trace_id
+        self._send_json(200, doc)
+
     def do_POST(self):  # noqa: N802 — stdlib handler name
         g = _GENERATE_RE.match(self.path)
         if g:
@@ -333,16 +441,29 @@ class ServingHandler(BaseHTTPRequestHandler):
                 return self._send_json(
                     404, {"error": "unknown model %r" % name})
             return self._do_generate(name, engine)
+        for op, rx in (("lookup", _LOOKUP_RE), ("search", _SEARCH_RE)):
+            r = rx.match(self.path)
+            if r:
+                name = r.group(1)
+                engine = self.server.registry.get(name)
+                if engine is None:
+                    return self._send_json(
+                        404, {"error": "unknown model %r" % name})
+                return self._do_retrieval(name, engine, op)
         m = _PREDICT_RE.match(self.path)
         if not m:
             return self._send_json(
                 404, {"error": "not found: %s (expected "
-                               "/v1/models/<name>:predict or :generate)"
+                               "/v1/models/<name>:predict, :generate, "
+                               ":lookup, or :search)"
                                % self.path})
         name = m.group(1)
         engine = self.server.registry.get(name)
         if engine is None:
             return self._send_json(404, {"error": "unknown model %r" % name})
+        if _kind_of(engine) in ("decode", "retrieval"):
+            return self._send_json(
+                400, _wrong_kind_doc(name, engine, "predict"))
         import numpy as np
 
         try:

@@ -168,6 +168,11 @@ class ModelRegistry:
             reuse = getattr(e["engine"], "reuse_info", None)
             if callable(reuse):
                 doc["reuse"] = reuse()
+            index = getattr(e["engine"], "index_info", None)
+            if callable(index):
+                # retrieval engines: the served index's geometry (rows,
+                # dim, shards, resident bytes) next to the queue stats
+                doc["index"] = index()
             out[name] = doc
         return out
 

@@ -65,6 +65,13 @@ def pytest_configure(config):
         "PrefixPool adopt/delta-prefill parity, SessionTier "
         "hibernate/resume); `pytest -m spec` is the slice "
         "bench_experiments/spec_lane.sh runs")
+    config.addinivalue_line(
+        "markers",
+        "retrieval: embedding & retrieval serving tests "
+        "(paddle_tpu.retrieval: ep-sharded table lookup bit-exactness, "
+        "distributed-linalg parity, RetrievalEngine through registry/"
+        "HTTP, ladder lint + HBM budget); `pytest -m retrieval` is the "
+        "slice bench_experiments/retrieval_lane.sh runs")
 
 
 @pytest.fixture()

@@ -1,0 +1,501 @@
+"""Embedding & retrieval serving (ISSUE 20): ep-sharded tables,
+distributed-linalg parity, and the RetrievalEngine kind.
+
+Bit-identity note: the sharded lookup combines per-shard gathers with
+an integer-bitcast ``psum`` (one non-zero word per element — lossless),
+so lookups assert ``array_equal`` against the single-device gather, not
+allclose. Top-k scoring runs ONE ``dot_general`` over the full inner
+dim per chunk (the reduction is never split), so ids assert exact
+equality whenever the synthetic scores are tie-free; score values get
+the documented float tolerance.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import retrieval
+from paddle_tpu.analysis import tpu_lint
+from paddle_tpu.analysis.diagnostics import ProgramVerifyError
+from paddle_tpu.retrieval import (
+    RetrievalEngine, ShardedEmbeddingTable, default_query_buckets, ep_mesh,
+)
+from paddle_tpu.serving import (
+    EngineClosedError, ModelRegistry, ServingServer,
+)
+
+pytestmark = pytest.mark.retrieval
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return ep_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def table8(mesh8):
+    # 1000 rows over 8 shards: 125 rows/shard, no pad — plus the odd
+    # table below covers padding
+    return ShardedEmbeddingTable(1000, 16, mesh=mesh8, seed=3)
+
+
+# ---------------------------------------------------------------------------
+# sharded table: 8-way lookup parity (the tentpole bit-exactness claim)
+# ---------------------------------------------------------------------------
+
+def test_lookup_bit_identical_8way(table8):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, table8.vocab_size, size=257)
+    got = table8.lookup(ids)
+    ref = table8.host_rows()[ids]
+    assert got.dtype == ref.dtype
+    assert np.array_equal(
+        got.view(np.uint32), ref.view(np.uint32))  # bit for bit
+
+
+def test_lookup_padded_vocab_and_shapes(mesh8):
+    # 1003 rows over 8 shards -> 126/shard with 5 pad rows: ids near
+    # the boundary still resolve to the true rows, never the pad
+    tbl = ShardedEmbeddingTable(1003, 8, mesh=mesh8, seed=1)
+    ids = np.array([[0, 1001], [1002, 500]])
+    got = tbl.lookup(ids)
+    assert got.shape == (2, 2, 8)
+    assert np.array_equal(got, tbl.host_rows()[ids])
+    # empty request short-circuits host-side
+    assert tbl.lookup(np.zeros((0,), np.int64)).shape == (0, 8)
+
+
+def test_lookup_float16_bit_identical(mesh8):
+    tbl = ShardedEmbeddingTable(200, 8, mesh=mesh8, dtype="float16",
+                                seed=2)
+    ids = np.arange(0, 200, 3)
+    assert np.array_equal(
+        tbl.lookup(ids).view(np.uint16),
+        tbl.host_rows()[ids].view(np.uint16))
+
+
+def test_lookup_rejects_bad_ids(table8):
+    with pytest.raises(ValueError, match="out of range"):
+        table8.lookup([0, table8.vocab_size])
+    with pytest.raises(ValueError, match="out of range"):
+        table8.lookup([-1])
+    with pytest.raises(ValueError, match="integers"):
+        table8.lookup(np.array([0.5]))
+
+
+def test_from_array_and_geometry(mesh8):
+    rows = np.arange(24, dtype=np.float32).reshape(6, 4)
+    tbl = ShardedEmbeddingTable.from_array(rows, mesh=mesh8, name="toy")
+    assert np.array_equal(tbl.host_rows(), rows)
+    assert np.array_equal(tbl.lookup([5, 0]), rows[[5, 0]])
+    info = tbl.index_info()
+    assert info["rows"] == 6 and info["dim"] == 4 and info["shards"] == 8
+    # 6 rows pad to 8 (1/shard) and residency accounts the pad
+    assert tbl.rows_per_shard == 1
+    assert info["resident_bytes"] == 8 * 4 * 4
+    assert info["resident_bytes_per_shard"] == 4 * 4
+
+
+def test_checkpoint_roundtrip_and_reshard(tmp_path, mesh8):
+    tbl = ShardedEmbeddingTable(77, 8, mesh=mesh8, seed=9, name="idx")
+    tbl.save(str(tmp_path), step=3)
+    # restore onto a DIFFERENT ep width: the checkpoint holds plain
+    # host rows, so resharding is free — and still bit-identical
+    back = ShardedEmbeddingTable.restore(str(tmp_path), ep=4, name="idx")
+    assert back.ep == 4
+    assert np.array_equal(
+        back.host_rows().view(np.uint32),
+        tbl.host_rows().view(np.uint32))
+    ids = np.arange(77)
+    assert np.array_equal(back.lookup(ids), tbl.lookup(ids))
+    # a single-table checkpoint is adopted whatever name was asked...
+    adopted = ShardedEmbeddingTable.restore(str(tmp_path), name="nope")
+    assert adopted.name == "idx"
+    # ...but an ambiguous (multi-table) checkpoint raises
+    from paddle_tpu.parallel.checkpoint import save_checkpoint
+
+    multi = tmp_path / "multi"
+    save_checkpoint(str(multi), {"a.table": tbl.host_rows(),
+                                 "b.table": tbl.host_rows()}, step=0)
+    with pytest.raises(IOError, match="holds no 'nope' table"):
+        ShardedEmbeddingTable.restore(str(multi), name="nope")
+
+
+# ---------------------------------------------------------------------------
+# distributed linalg: blocked matmul / power iteration / sharded top-k
+# ---------------------------------------------------------------------------
+
+def test_blocked_matmul_parity(mesh8):
+    rng = np.random.default_rng(4)
+    # 37 rows: NOT a multiple of ep=8, exercises the row pad; block
+    # rounds down to a divisor of the 5-row shard
+    a = rng.standard_normal((37, 24)).astype(np.float32)
+    b = rng.standard_normal((24, 11)).astype(np.float32)
+    out = retrieval.blocked_matmul(a, b, mesh=mesh8, block_rows=3)
+    assert out.shape == (37, 11)
+    np.testing.assert_allclose(out, a @ b, rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="blocked_matmul wants"):
+        retrieval.blocked_matmul(a, b.T, mesh=mesh8)
+
+
+def test_power_iteration_dominant_eigenpair(mesh8):
+    rng = np.random.default_rng(5)
+    g = rng.standard_normal((64, 64)).astype(np.float32)
+    psd = (g @ g.T) / 64.0  # PSD: clean eigengap, no +/- ambiguity
+    eig, vec, residual = retrieval.power_iteration(
+        psd, iters=60, mesh=mesh8)
+    ref = float(np.linalg.eigvalsh(psd)[-1])
+    assert abs(eig - ref) / ref < 1e-2
+    assert residual < 0.05
+    assert abs(np.linalg.norm(vec) - 1.0) < 1e-4
+
+
+def test_sharded_topk_exact_vs_reference(mesh8):
+    rng = np.random.default_rng(6)
+    tbl = ShardedEmbeddingTable(500, 12, mesh=mesh8, seed=7)
+    q = rng.standard_normal((9, 12)).astype(np.float32)
+    scores, ids = retrieval.sharded_topk(tbl, q, k=10, chunk_rows=17)
+    full = q @ tbl.host_rows().T
+    ref_ids = np.argsort(-full, axis=1)[:, :10]
+    # continuous random scores are tie-free -> ids match exactly
+    assert np.array_equal(ids, ref_ids)
+    np.testing.assert_allclose(
+        scores, np.take_along_axis(full, ref_ids, axis=1),
+        rtol=1e-5, atol=1e-5)
+    # 1-d query promotes to one row
+    s1, i1 = retrieval.sharded_topk(tbl, q[0], k=3)
+    assert i1.shape == (1, 3) and np.array_equal(i1[0], ref_ids[0, :3])
+    with pytest.raises(ValueError, match="does not match table dim"):
+        retrieval.sharded_topk(tbl, np.zeros((2, 5), np.float32))
+
+
+def test_roofline_accounting():
+    assert retrieval.matmul_flops(3, 5, 7) == 2.0 * 3 * 5 * 7
+
+    class P:
+        peak_flops = 1e9
+
+    assert retrieval.fraction_of_roofline(5e8, 1.0, P()) == 0.5
+    assert retrieval.fraction_of_roofline(5e8, 1.0, P(), n_devices=2) == 0.25
+    assert retrieval.fraction_of_roofline(5e8, 0.0, P()) is None
+    assert retrieval.fraction_of_roofline(5e8, 1.0, None) is None
+
+
+# ---------------------------------------------------------------------------
+# RetrievalEngine: the serving surface
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine(table8):
+    eng = RetrievalEngine(table8, k=5, query_buckets=(2, 4, 8),
+                          name="idx8")
+    eng.warmup()
+    yield eng
+    eng.stop(drain=False, timeout=5)
+
+
+def test_engine_lookup_and_search_parity(engine, table8):
+    rng = np.random.default_rng(8)
+    ids = rng.integers(0, table8.vocab_size, size=6)
+    emb = engine.lookup(ids)
+    assert np.array_equal(np.asarray(emb), table8.host_rows()[ids])
+    q = rng.standard_normal((3, table8.dim)).astype(np.float32)
+    got_ids, got_scores = engine.search(q)
+    ref = np.argsort(-(q @ table8.host_rows().T), axis=1)[:, :5]
+    assert np.array_equal(np.asarray(got_ids), ref)
+    assert np.asarray(got_scores).shape == (3, 5)
+
+
+def test_engine_coalesces_same_op(engine, table8):
+    # several concurrent lookups of the same op land in ONE padded
+    # dispatch: per-request results still match the reference exactly
+    before = engine.stats().get("coalesced", 0)
+    futs = [engine.submit({"op": "lookup", "ids": [i, i + 1]})
+            for i in range(5)]
+    outs = [f.result(30) for f in futs]
+    for i, out in enumerate(outs):
+        assert np.array_equal(
+            np.asarray(out["embeddings"]),
+            table8.host_rows()[[i, i + 1]])
+    assert engine.stats().get("coalesced", 0) >= before
+
+
+def test_engine_rejects_malformed(engine):
+    with pytest.raises(ValueError, match="unknown retrieval op"):
+        engine.submit({"op": "frobnicate"})
+    with pytest.raises(ValueError, match="must be a dict"):
+        engine.submit([1, 2])
+    with pytest.raises(ValueError, match="out of range"):
+        engine.submit({"op": "lookup", "ids": [10**9]})
+    with pytest.raises(ValueError, match="one compiled ladder per k"):
+        engine.submit({"op": "search",
+                       "query": np.zeros((1, 16)), "k": 7})
+    with pytest.raises(ValueError, match="largest query bucket"):
+        engine.submit({"op": "lookup", "ids": list(range(100))})
+    with pytest.raises(ValueError, match="does not match index dim"):
+        engine.submit({"op": "search", "query": np.zeros((1, 3))})
+    # op inferred from the payload: "query" present -> search
+    ids, _ = engine.search(np.zeros((1, 16), np.float32))
+    out = engine.predict({"query": np.zeros((1, 16)).tolist()})
+    assert np.array_equal(np.asarray(out["ids"]), np.asarray(ids))
+
+
+def test_engine_budget_gates_warmup(table8):
+    eng = RetrievalEngine(table8, k=5, query_buckets=(4, 64),
+                          auto_start=False, name="budget")
+    # generous budget: every rung priced and admitted
+    rungs = eng.check_hbm_budget(budget_bytes=1 << 34)
+    assert [b for b, _ in rungs] == [4, 64]
+    assert all(peak > 0 for _, peak in rungs)
+    # starvation budget: the raise names the over-budget rungs and the
+    # text carries the predicted-oom marker the perf gate greps for
+    with pytest.raises(ProgramVerifyError, match="predicted-oom"):
+        eng.check_hbm_budget(budget_bytes=1024)
+    try:
+        eng.check_hbm_budget(budget_bytes=1024)
+    except ProgramVerifyError as e:
+        assert "2 of 2 query ladder rung(s)" in str(e)
+        assert "query bucket 64" in str(e)
+
+
+def test_engine_ladder_lint(table8):
+    eng = RetrievalEngine(table8, k=5, query_buckets=(2, 4, 8),
+                          auto_start=False, name="lint")
+    rep = eng.check_ladder()
+    # 3 lookup rungs + 3 search rungs (one k)
+    assert rep.meta["retrieval_ladder_programs"] == 6
+    assert not rep.findings
+
+
+def test_engine_stats_and_stop(table8):
+    eng = RetrievalEngine(table8, k=3, query_buckets=(2,), name="brief")
+    eng.lookup([1, 2])
+    st = eng.stats()
+    assert st["requests"] >= 1 and st["lookups"] >= 1
+    assert eng.queue_depth() == 0
+    eng.stop(drain=True, timeout=5)
+    assert eng.closed
+    with pytest.raises(EngineClosedError):
+        eng.submit({"op": "lookup", "ids": [1]})
+
+
+# ---------------------------------------------------------------------------
+# registry + HTTP: the third engine kind through the shared frontend
+# ---------------------------------------------------------------------------
+
+def _post(url, doc, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_http_lookup_search_and_kind_routing(engine, table8):
+    reg = ModelRegistry()
+    reg.publish("items", engine)
+    # registry info carries the served index geometry
+    assert reg.info()["items"]["index"]["rows"] == table8.vocab_size
+    srv = ServingServer(reg).start()
+    try:
+        code, doc = _post(srv.url + "/v1/models/items:lookup",
+                          {"ids": [3, 14, 159]})
+        assert code == 200, doc
+        got = np.asarray(doc["embeddings"], dtype=np.float32)
+        assert np.array_equal(got, table8.host_rows()[[3, 14, 159]])
+        assert doc["model"] == "items"
+
+        rng = np.random.default_rng(10)
+        q = rng.standard_normal((2, table8.dim)).astype(np.float32)
+        code, doc = _post(srv.url + "/v1/models/items:search",
+                          {"query": q.tolist(), "k": 5})
+        assert code == 200, doc
+        ref = np.argsort(-(q @ table8.host_rows().T), axis=1)[:, :5]
+        assert np.array_equal(np.asarray(doc["ids"]), ref)
+        assert doc["k"] == 5
+
+        # mismatched verb: 400 that NAMES the engine kind + right verb
+        code, doc = _post(srv.url + "/v1/models/items:predict",
+                          {"feeds": {"x": [1.0]}})
+        assert code == 400 and doc["kind"] == "retrieval"
+        assert ":lookup or :search" in doc["error"]
+        code, doc = _post(srv.url + "/v1/models/items:generate",
+                          {"prompt": [1]})
+        assert code == 400 and doc["kind"] == "retrieval"
+
+        # malformed body / unknown model keep the standard mapping
+        code, doc = _post(srv.url + "/v1/models/items:search",
+                          {"query": [[0.0] * 3]})
+        assert code == 400
+        code, doc = _post(srv.url + "/v1/models/nope:lookup",
+                          {"ids": [1]})
+        assert code == 404
+
+        with urllib.request.urlopen(srv.url + "/healthz",
+                                    timeout=10) as r:
+            hz = json.load(r)
+        m = hz["models"]["items"]
+        assert m["kind"] == "retrieval"
+        assert m["index"]["shards"] == 8 and m["index"]["k"] == 5
+    finally:
+        srv.stop()  # engine lifecycle belongs to the publish caller
+
+
+def test_http_predict_engine_refuses_retrieval_verbs(tmp_path):
+    # a plain predict engine on :search gets the same kind-naming 400
+    from paddle_tpu.fluid.inference import Predictor
+    from paddle_tpu.serving import BucketSpec, ServingEngine
+    from paddle_tpu.fluid import framework, unique_name
+
+    framework.switch_main_program(framework.Program())
+    framework.switch_startup_program(framework.Program())
+    unique_name.switch()
+    x = fluid.data(name="x", shape=[None, 4], dtype="float32")
+    out = fluid.layers.fc(x, size=2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(
+        str(tmp_path / "m"), ["x"], [out], exe,
+        main_program=fluid.default_main_program())
+    eng = ServingEngine(
+        Predictor.from_model(str(tmp_path / "m")),
+        buckets=[BucketSpec({"x": (4,)}, batch_sizes=(1, 2))])
+    reg = ModelRegistry()
+    reg.publish("m", eng)
+    srv = ServingServer(reg).start()
+    try:
+        code, doc = _post(srv.url + "/v1/models/m:search",
+                          {"query": [[0.0] * 4]})
+        assert code == 400 and doc["kind"] == "predict"
+        assert ":predict" in doc["error"]
+    finally:
+        srv.stop(close_registry=True)
+
+
+# ---------------------------------------------------------------------------
+# satellites: planner ingestion, lint, memory accounting
+# ---------------------------------------------------------------------------
+
+def test_from_plan_accepts_ep_for_retrieval():
+    from paddle_tpu.parallel.fleet import DistributedStrategy
+    from paddle_tpu.planner import ParallelPlan
+
+    s = DistributedStrategy.from_plan(
+        ParallelPlan({"ep": 8}), workload="retrieval")
+    assert s.embedding_parallel_degree == 8
+    # the degree feeds ep_mesh directly
+    assert ep_mesh(s.embedding_parallel_degree).shape["ep"] == 8
+    # dp x ep composes for the embedding workload family
+    s = DistributedStrategy.from_plan(
+        ParallelPlan({"dp": 2, "ep": 4}), workload="embedding")
+    assert s.embedding_parallel_degree == 4
+
+
+def test_from_plan_refuses_ep_for_train_with_hint():
+    from paddle_tpu.parallel.fleet import DistributedStrategy
+    from paddle_tpu.planner import ParallelPlan
+
+    with pytest.raises(NotImplementedError) as ei:
+        DistributedStrategy.from_plan(ParallelPlan({"ep": 8}))
+    msg = str(ei.value)
+    assert "workload='retrieval'" in msg
+    assert "paddle_tpu.retrieval" in msg
+    # pp stays refused even for retrieval workloads
+    with pytest.raises(NotImplementedError):
+        DistributedStrategy.from_plan(
+            ParallelPlan({"ep": 4, "pp": 2}), workload="retrieval")
+
+
+def test_lint_low_intensity_gather_on_ctr():
+    from paddle_tpu.models import wide_deep as wd
+
+    wd.build_wide_deep(num_sparse_fields=6, sparse_vocab=100000,
+                       emb_dim=16, num_dense=13, hidden=[32])
+    rep = tpu_lint.lint(fluid.default_main_program())
+    perf = [d for d in rep.diagnostics
+            if d.check == "low-intensity-gather"]
+    # the 6.4 MB ctr_emb draws the finding; the 400 KB wide table is
+    # under the floor and stays quiet
+    assert len(perf) == 1 and perf[0].var == "ctr_emb"
+    assert "ShardedEmbeddingTable" in perf[0].message
+    # PERF advisories never fail a gate
+    assert not [d for d in rep.findings
+                if d.check == "low-intensity-gather"]
+
+
+def test_lint_small_embedding_stays_clean():
+    sparse = fluid.data(name="s", shape=[None, 4], dtype="int64")
+    fluid.layers.embedding(sparse, size=[1000, 16])
+    rep = tpu_lint.lint(fluid.default_main_program())
+    assert not [d for d in rep.diagnostics
+                if d.check == "low-intensity-gather"]
+
+
+def test_lint_retrieval_ladder_counts():
+    # a sane pow2 ladder is clean
+    rep = tpu_lint.lint_retrieval_ladder((1, 2, 4, 8), k_values=(10,))
+    assert rep.meta["retrieval_ladder_programs"] == 8
+    assert not rep.findings
+    # thousands of rungs x many k blows the shared shape-vocab budget
+    rep = tpu_lint.lint_retrieval_ladder(
+        tuple(range(1, 1001)), k_values=(1, 5, 10, 50, 100))
+    assert rep.meta["retrieval_ladder_programs"] == 1000 + 1000 * 5
+    assert "unbounded-shape-vocab" in {
+        d.check for d in rep.findings}
+    # non-pow2 rungs draw the each-is-an-extra-executable INFO
+    rep = tpu_lint.lint_retrieval_ladder((3, 4, 8), k_values=(10,))
+    assert "retrieval-ladder-rungs" in {d.check for d in rep.diagnostics}
+    assert not rep.findings
+
+
+def test_memory_shard_divisors_ep_divides_params():
+    from paddle_tpu.analysis.memory import shard_divisors
+
+    # ep rows-shards the table (a parameter), never the batch
+    assert shard_divisors({"ep": 8}) == (8, 1)
+    assert shard_divisors({"dp": 2, "ep": 4}) == (4, 2)
+
+
+def test_ctr_embedding_rides_sharded_table(mesh8):
+    """The migration path: train the CTR model's ``ctr_emb`` the fluid
+    way, lift the trained rows out of the scope into a sharded table,
+    and serve lookups bit-identical to the trained parameter."""
+    from paddle_tpu.models import wide_deep as wd
+
+    fluid.default_startup_program().random_seed = 5
+    vs = wd.build_wide_deep(num_sparse_fields=6, sparse_vocab=512,
+                            emb_dim=8, num_dense=4, hidden=[16])
+    fluid.optimizer.Adam(1e-2).minimize(vs["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    dense, sparse, label = wd.synthetic_ctr_batch(
+        64, num_sparse_fields=6, sparse_vocab=512, num_dense=4)
+    for _ in range(2):
+        exe.run(feed={"dense": dense, "sparse": sparse,
+                      "ctr_label": label},
+                fetch_list=[vs["loss"]])
+    trained = np.asarray(
+        fluid.global_scope().find_var("ctr_emb").get_tensor()).copy()
+    tbl = ShardedEmbeddingTable.from_array(trained, mesh=mesh8,
+                                           name="ctr_emb")
+    ids = np.unique(sparse.reshape(-1))[:32]
+    assert np.array_equal(
+        tbl.lookup(ids).view(np.uint32),
+        trained[ids].view(np.uint32))
+    eng = RetrievalEngine(tbl, k=4, query_buckets=(8, 32), name="ctr")
+    try:
+        out = np.asarray(eng.lookup(ids.tolist()))
+        assert np.array_equal(out.view(np.uint32),
+                              trained[ids].view(np.uint32))
+    finally:
+        eng.stop(drain=True, timeout=5)
